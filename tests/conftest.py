@@ -1,0 +1,14 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform (the reference tests a
+16-rank in-process job the same way — test/gtest/common/test_ucc.h:209; we
+mirror it with 8 virtual chips so multi-chip sharding paths compile and
+execute without TPU hardware). Must run before jax is first imported.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,281 @@
+"""Schedule framework tests — mirrors reference test/gtest/core/test_schedule.cc
+plus pipelined-schedule behavior (src/schedule/ucc_schedule_pipelined.c)."""
+import time
+
+import pytest
+
+from ucc_tpu.constants import EventType
+from ucc_tpu.schedule import (CollTask, PipelinedSchedule, PipelineOrder,
+                              PipelineParams, ProgressQueue, Schedule,
+                              parse_pipeline_params)
+from ucc_tpu.status import Status
+
+
+class CounterTask(CollTask):
+    """Completes after `n_steps` progress calls; records execution order."""
+
+    def __init__(self, name, trace, n_steps=1, fail=False):
+        super().__init__()
+        self.name = name
+        self.trace = trace
+        self.n_steps = n_steps
+        self.steps = 0
+        self.fail = fail
+
+    def post_fn(self):
+        self.trace.append(("post", self.name))
+        self.steps = 0
+        return Status.OK
+
+    def progress_fn(self):
+        self.steps += 1
+        if self.steps >= self.n_steps:
+            if self.fail:
+                self.status = Status.ERR_NO_MESSAGE
+            else:
+                self.trace.append(("done", self.name))
+                self.status = Status.OK
+
+
+def drive(pq, task, max_iters=1000):
+    it = 0
+    while not task.is_completed():
+        pq.progress()
+        it += 1
+        assert it < max_iters, "progress did not converge"
+    return task.super_status
+
+
+class TestTask:
+    def test_simple_lifecycle(self):
+        pq = ProgressQueue()
+        trace = []
+        t = CounterTask("a", trace, n_steps=3)
+        t.progress_queue = pq
+        assert t.status == Status.OPERATION_INITIALIZED
+        t.post()
+        assert drive(pq, t) == Status.OK
+        assert trace == [("post", "a"), ("done", "a")]
+
+    def test_sync_completion_skips_queue(self):
+        # enqueue-progresses-once optimization (ucc_progress_queue.h:32-44)
+        pq = ProgressQueue()
+        t = CounterTask("a", [], n_steps=1)
+        t.progress_queue = pq
+        t.post()
+        assert len(pq) == 0 and t.is_completed()
+
+    def test_callback(self):
+        pq = ProgressQueue()
+        seen = []
+        t = CounterTask("a", [])
+        t.cb = lambda task, st: seen.append(st)
+        t.progress_queue = pq
+        t.post()
+        drive(pq, t)
+        assert seen == [Status.OK]
+
+    def test_timeout(self):
+        # mirrors gtest core/test_timeout.cc
+        pq = ProgressQueue()
+        t = CounterTask("never", [], n_steps=10**9)
+        t.timeout = 0.01
+        t.progress_queue = pq
+        t.post()
+        deadline = time.monotonic() + 5.0
+        while not t.is_completed() and time.monotonic() < deadline:
+            pq.progress()
+            time.sleep(0.002)
+        assert t.super_status == Status.ERR_TIMED_OUT
+
+
+class TestSchedule:
+    def test_dependency_chain(self):
+        pq = ProgressQueue()
+        trace = []
+        sched = Schedule()
+        sched.progress_queue = pq
+        t1 = CounterTask("t1", trace, n_steps=2)
+        t2 = CounterTask("t2", trace, n_steps=2)
+        sched.add_task(t1)
+        sched.add_task(t2)
+        sched.add_dep_on_schedule_start(t1)
+        t2.subscribe_dep(t1, EventType.EVENT_COMPLETED)
+        sched.post()
+        assert drive(pq, sched) == Status.OK
+        assert trace == [("post", "t1"), ("done", "t1"),
+                         ("post", "t2"), ("done", "t2")]
+
+    def test_parallel_tasks(self):
+        pq = ProgressQueue()
+        trace = []
+        sched = Schedule()
+        sched.progress_queue = pq
+        tasks = [CounterTask(f"t{i}", trace, n_steps=i + 1) for i in range(4)]
+        for t in tasks:
+            sched.add_task(t)
+            sched.add_dep_on_schedule_start(t)
+        sched.post()
+        assert drive(pq, sched) == Status.OK
+        assert {n for op, n in trace if op == "done"} == {"t0", "t1", "t2", "t3"}
+
+    def test_error_propagates(self):
+        pq = ProgressQueue()
+        sched = Schedule()
+        sched.progress_queue = pq
+        bad = CounterTask("bad", [], n_steps=2, fail=True)
+        good = CounterTask("good", [], n_steps=1)
+        sched.add_task(bad)
+        sched.add_task(good)
+        sched.add_dep_on_schedule_start(bad)
+        sched.add_dep_on_schedule_start(good)
+        sched.post()
+        assert drive(pq, sched) == Status.ERR_NO_MESSAGE
+
+    def test_dep_on_error_parent_completes_child(self):
+        pq = ProgressQueue()
+        sched = Schedule()
+        sched.progress_queue = pq
+        bad = CounterTask("bad", [], n_steps=1, fail=True)
+        child = CounterTask("child", [], n_steps=1)
+        sched.add_task(bad)
+        sched.add_task(child)
+        sched.add_dep_on_schedule_start(bad)
+        child.subscribe_dep(bad, EventType.EVENT_COMPLETED)
+        sched.post()
+        assert drive(pq, sched) == Status.ERR_NO_MESSAGE
+        assert child.super_status == Status.ERR_NO_MESSAGE
+
+    def test_diamond_dag(self):
+        #    a
+        #   / \
+        #  b   c
+        #   \ /
+        #    d
+        pq = ProgressQueue()
+        trace = []
+        sched = Schedule()
+        sched.progress_queue = pq
+        a, b, c, d = (CounterTask(n, trace, n_steps=2) for n in "abcd")
+        for t in (a, b, c, d):
+            sched.add_task(t)
+        sched.add_dep_on_schedule_start(a)
+        b.subscribe_dep(a, EventType.EVENT_COMPLETED)
+        c.subscribe_dep(a, EventType.EVENT_COMPLETED)
+        d.subscribe_dep(b, EventType.EVENT_COMPLETED)
+        d.subscribe_dep(c, EventType.EVENT_COMPLETED)
+        sched.post()
+        assert drive(pq, sched) == Status.OK
+        order = [n for op, n in trace if op == "post"]
+        assert order[0] == "a" and order[-1] == "d"
+
+    def test_persistent_reset_and_repost(self):
+        pq = ProgressQueue()
+        trace = []
+        sched = Schedule()
+        sched.progress_queue = pq
+        t1 = CounterTask("t1", trace)
+        sched.add_task(t1)
+        sched.add_dep_on_schedule_start(t1)
+        for _ in range(3):
+            sched.post()
+            assert drive(pq, sched) == Status.OK
+            sched.reset()
+        assert trace.count(("done", "t1")) == 3
+
+
+class FragTask(CounterTask):
+    def __init__(self, name, trace, n_steps=2):
+        super().__init__(name, trace, n_steps)
+        self.frag_num = -1
+
+
+def make_pipeline(trace, n_frags, n_frags_total, order, tasks_per_frag=2):
+    def frag_init(sched, idx):
+        frag = Schedule()
+        for j in range(tasks_per_frag):
+            t = FragTask(f"w{idx}.t{j}", trace)
+            frag.add_task(t)
+            frag.add_dep_on_schedule_start(t)
+        return frag
+
+    def frag_setup(sched, frag, frag_num):
+        for t in frag.tasks:
+            t.frag_num = frag_num
+            trace.append(("setup", t.name, frag_num))
+        return Status.OK
+
+    return PipelinedSchedule(frag_init=frag_init, frag_setup=frag_setup,
+                             n_frags=n_frags, n_frags_total=n_frags_total,
+                             order=order)
+
+
+class TestPipelined:
+    @pytest.mark.parametrize("order", [PipelineOrder.PARALLEL,
+                                       PipelineOrder.ORDERED,
+                                       PipelineOrder.SEQUENTIAL])
+    def test_all_fragments_run(self, order):
+        pq = ProgressQueue()
+        trace = []
+        sched = make_pipeline(trace, n_frags=2, n_frags_total=5, order=order)
+        sched.progress_queue = pq
+        sched.post()
+        assert drive(pq, sched) == Status.OK
+        setups = [e for e in trace if e[0] == "setup"]
+        # every fragment 0..4 was set up on some window entry, x2 tasks each
+        frag_nums = sorted({e[2] for e in setups})
+        assert frag_nums == [0, 1, 2, 3, 4]
+        dones = [e for e in trace if e[0] == "done"]
+        assert len(dones) == 5 * 2
+
+    def test_sequential_order_strict(self):
+        pq = ProgressQueue()
+        trace = []
+        sched = make_pipeline(trace, n_frags=2, n_frags_total=4,
+                              order=PipelineOrder.SEQUENTIAL,
+                              tasks_per_frag=1)
+        sched.progress_queue = pq
+        sched.post()
+        assert drive(pq, sched) == Status.OK
+        # with 1 task/frag sequential ordering → done(frag k) before post(frag k+1)
+        evs = [e for e in trace if e[0] in ("post", "done")]
+        for i in range(0, len(evs) - 1, 2):
+            assert evs[i][0] == "post" and evs[i + 1][0] == "done"
+
+    def test_window_smaller_than_total(self):
+        pq = ProgressQueue()
+        trace = []
+        sched = make_pipeline(trace, n_frags=3, n_frags_total=10,
+                              order=PipelineOrder.ORDERED)
+        sched.progress_queue = pq
+        sched.post()
+        assert drive(pq, sched) == Status.OK
+        assert len([e for e in trace if e[0] == "done"]) == 20
+
+    def test_single_frag(self):
+        pq = ProgressQueue()
+        trace = []
+        sched = make_pipeline(trace, n_frags=4, n_frags_total=1,
+                              order=PipelineOrder.SEQUENTIAL)
+        sched.progress_queue = pq
+        sched.post()
+        assert drive(pq, sched) == Status.OK
+        assert len([e for e in trace if e[0] == "done"]) == 2
+
+
+class TestPipelineParams:
+    def test_nfrags_pdepth(self):
+        p = PipelineParams(threshold=1 << 16, frag_size=1 << 20, n_frags=2,
+                           pdepth=2)
+        assert p.nfrags_pdepth(1000) == (1, 1)            # below threshold
+        nf, pd = p.nfrags_pdepth(10 << 20)
+        assert nf == 10 and pd == 2
+
+    def test_parse_dsl(self):
+        p = parse_pipeline_params("thresh=64K:fragsize=1M:nfrags=4:pdepth=2:ordered")
+        assert p.threshold == 65536 and p.frag_size == 1 << 20
+        assert p.n_frags == 4 and p.pdepth == 2
+        assert p.order == PipelineOrder.ORDERED
+        assert parse_pipeline_params("n").threshold == (1 << 64) - 1
+        with pytest.raises(ValueError):
+            parse_pipeline_params("bogus=1")

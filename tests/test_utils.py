@@ -1,0 +1,212 @@
+"""Unit tests for ucc_tpu.utils — mirrors reference gtest utils suites
+(test/gtest/utils/: test_ep_map, test_math, test_string, test_cfg_file)."""
+import os
+
+import numpy as np
+import pytest
+
+from ucc_tpu.constants import (CollType, DataType, GenericDataType, MemoryType,
+                               ReductionOp, dt_from_numpy, dt_numpy, dt_size)
+from ucc_tpu.status import Status, UccError, check
+from ucc_tpu.utils import mathutils as m
+from ucc_tpu.utils.config import (Config, ConfigField, ConfigTable, MRangeUint,
+                                  SIZE_AUTO, SIZE_INF, memunits_str,
+                                  parse_bool, parse_list, parse_memunits,
+                                  parse_mrange_uint, parse_uint)
+from ucc_tpu.utils.ep_map import EpMap, EpMapType, Subset, active_set_map
+from ucc_tpu.utils.mpool import MPool
+
+
+class TestStatus:
+    def test_error_predicate(self):
+        assert not Status.OK.is_error
+        assert not Status.IN_PROGRESS.is_error
+        assert Status.ERR_NOT_SUPPORTED.is_error
+
+    def test_check_raises(self):
+        with pytest.raises(UccError):
+            check(Status.ERR_INVALID_PARAM, "bad")
+        assert check(Status.OK) == Status.OK
+
+
+class TestDatatypes:
+    def test_all_18_predefined(self):
+        assert len(list(DataType)) == 18
+
+    def test_sizes(self):
+        assert dt_size(DataType.INT8) == 1
+        assert dt_size(DataType.BFLOAT16) == 2
+        assert dt_size(DataType.FLOAT32) == 4
+        assert dt_size(DataType.INT128) == 16
+        assert dt_size(DataType.FLOAT128_COMPLEX) == 32
+
+    def test_numpy_roundtrip(self):
+        for dt in (DataType.FLOAT32, DataType.INT64, DataType.BFLOAT16,
+                   DataType.FLOAT32_COMPLEX):
+            assert dt_from_numpy(dt_numpy(dt)) == dt
+
+    def test_128bit_no_compute(self):
+        with pytest.raises(TypeError):
+            dt_numpy(DataType.INT128)
+
+    def test_generic_dt(self):
+        g = GenericDataType(24, name="triple")
+        assert dt_size(g) == 24
+
+    def test_16_coll_types(self):
+        assert len(list(CollType)) == 16
+
+    def test_13_reduction_ops(self):
+        assert len(list(ReductionOp)) == 13
+
+    def test_memtype_parse(self):
+        assert MemoryType.parse("host") == MemoryType.HOST
+        assert MemoryType.parse("cuda") == MemoryType.TPU  # alias
+
+
+class TestMath:
+    def test_ilog2(self):
+        assert m.ilog2(1) == 0 and m.ilog2(8) == 3 and m.ilog2(9) == 3
+
+    def test_block_count_offset(self):
+        # splitting 10 into 4: 3,3,2,2
+        counts = [m.block_count(10, 4, i) for i in range(4)]
+        offs = [m.block_offset(10, 4, i) for i in range(4)]
+        assert counts == [3, 3, 2, 2]
+        assert offs == [0, 3, 6, 8]
+        assert sum(counts) == 10
+
+    def test_block_cover(self):
+        for total in (1, 7, 16, 1023):
+            for n in (1, 2, 3, 8):
+                assert sum(m.block_count(total, n, i) for i in range(n)) == total
+                assert m.block_offset(total, n, n - 1) + \
+                    m.block_count(total, n, n - 1) == total
+
+
+class TestConfig:
+    def test_memunits(self):
+        assert parse_memunits("8") == 8
+        assert parse_memunits("4k") == 4096
+        assert parse_memunits("128M") == 128 << 20
+        assert parse_memunits("2G") == 2 << 30
+        assert parse_memunits("inf") == SIZE_INF
+        assert parse_memunits("auto") == SIZE_AUTO
+        assert memunits_str(4096) == "4K"
+
+    def test_bool(self):
+        assert parse_bool("y") and parse_bool("1") and parse_bool("true")
+        assert not parse_bool("n") and not parse_bool("off")
+        with pytest.raises(ValueError):
+            parse_bool("maybe")
+
+    def test_uint_inf(self):
+        assert parse_uint("inf") == (1 << 32) - 1
+
+    def test_list(self):
+        assert parse_list("ucp,xla, self") == ["ucp", "xla", "self"]
+        assert parse_list("") == []
+
+    def test_mrange_uint(self):
+        # mirrors UCC_TL_UCP_ALLREDUCE_KN_RADIX syntax (tl_ucp.h:63-70)
+        r = parse_mrange_uint("0-4k:4,4k-inf:8")
+        assert r.get(100) == 4
+        assert r.get(4096) == 4
+        assert r.get(5000) == 8
+        assert r.get(1 << 30) == 8
+
+    def test_mrange_memtype(self):
+        r = parse_mrange_uint("host:0-inf:2,tpu:0-inf:8")
+        assert r.get(100, "host") == 2
+        assert r.get(100, "tpu") == 8
+
+    def test_table_env(self, monkeypatch):
+        table = ConfigTable(prefix="TL_TEST_", name="tl/test", fields=[
+            ConfigField("RADIX", "4", "knomial radix", parse_uint),
+            ConfigField("THRESH", "64k", "", parse_memunits),
+        ])
+        cfg = Config(table, env={})
+        assert cfg.radix == 4 and cfg.thresh == 65536
+        cfg2 = Config(table, env={"UCC_TL_TEST_RADIX": "8"})
+        assert cfg2.radix == 8
+        cfg2.modify("radix", "2")
+        assert cfg2.radix == 2
+        with pytest.raises(KeyError):
+            cfg2.modify("nope", "1")
+
+    def test_config_file(self, tmp_path, monkeypatch):
+        f = tmp_path / "ucc.conf"
+        f.write_text("UCC_TL_TEST2_RADIX = 16\n")
+        table = ConfigTable(prefix="TL_TEST2_", name="tl/test2", fields=[
+            ConfigField("RADIX", "4", "", parse_uint)])
+        cfg = Config(table, env={"UCC_CONFIG_FILE": str(f)})
+        assert cfg.radix == 16
+        # env wins over file
+        cfg = Config(table, env={"UCC_CONFIG_FILE": str(f),
+                                 "UCC_TL_TEST2_RADIX": "32"})
+        assert cfg.radix == 32
+
+
+class TestEpMap:
+    def test_full(self):
+        em = EpMap.full(8)
+        assert [em.eval(i) for i in range(8)] == list(range(8))
+        assert em.local_rank(5) == 5
+
+    def test_strided(self):
+        em = EpMap.strided(2, 3, 4)
+        assert em.to_array().tolist() == [2, 5, 8, 11]
+        assert em.local_rank(8) == 2
+        assert not em.contains(3)
+
+    def test_array_optimization(self):
+        # reference optimizes array maps to full/strided (ucc_ep_map_from_array)
+        assert EpMap.from_array([0, 1, 2, 3]).type == EpMapType.FULL
+        assert EpMap.from_array([1, 3, 5]).type == EpMapType.STRIDED
+        em = EpMap.from_array([4, 1, 7])
+        assert em.type == EpMapType.ARRAY
+        assert em.local_rank(7) == 2
+
+    def test_cb(self):
+        em = EpMap.from_cb(lambda i: i * i, 4)
+        assert em.to_array().tolist() == [0, 1, 4, 9]
+
+    def test_reversed(self):
+        em = EpMap.reversed(4)
+        assert em.to_array().tolist() == [3, 2, 1, 0]
+        assert em.local_rank(0) == 3
+
+    def test_compose(self):
+        outer = EpMap.strided(10, 10, 8)     # sbgp -> team
+        inner = EpMap.from_array([1, 3, 5])  # alg -> sbgp
+        comp = outer.compose(inner)
+        assert comp.to_array().tolist() == [20, 40, 60]
+
+    def test_active_set(self):
+        em = active_set_map(start=1, stride=2, size=4)
+        assert em.to_array().tolist() == [1, 3, 5, 7]
+
+    def test_subset(self):
+        s = Subset(EpMap.strided(4, 1, 4), myrank=2)
+        assert s.size == 4 and s.rank_to_parent(2) == 6
+
+    def test_bounds(self):
+        with pytest.raises(IndexError):
+            EpMap.full(4).eval(4)
+
+
+class TestMPool:
+    def test_recycle(self):
+        created = []
+
+        def factory():
+            created.append(1)
+            return {}
+
+        pool = MPool(factory, obj_reset=lambda d: d.clear(), elems_per_chunk=4)
+        a = pool.get()
+        a["x"] = 1
+        pool.put(a)
+        b = pool.get()
+        assert b == {}  # reset ran
+        assert pool.num_allocated == 4

@@ -1,0 +1,5 @@
+from .task import CollTask, EventManager, dependency_handler  # noqa: F401
+from .schedule import Schedule  # noqa: F401
+from .pipelined import (PipelinedSchedule, PipelineOrder, PipelineParams,  # noqa: F401
+                        parse_pipeline_params)
+from .progress import ProgressQueue, ProgressQueueMT  # noqa: F401

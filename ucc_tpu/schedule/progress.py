@@ -1,0 +1,103 @@
+"""Progress queues.
+
+Reference: /root/reference/src/core/ucc_progress_queue{_st,_mt}.c. The
+single-threaded queue walks enqueued tasks calling their progress fn,
+completing finished ones and detecting per-task timeouts
+(ucc_progress_queue_st.c:19-56). The MT variant locks (the reference also has
+a lock-free option, ucc_context.h:95). Enqueue progresses the task once
+immediately (ucc_progress_queue.h:32-44) so fast ops never hit the queue.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, List
+
+from ..status import Status
+from .task import CollTask
+
+
+class ProgressQueue:
+    """Single-threaded progress queue."""
+
+    def __init__(self):
+        self._q: Deque[CollTask] = deque()
+        #: extra progress callbacks registered by components (the analog of
+        #: ucc_context_progress_register used by tl/ucp for
+        #: ucp_worker_progress, ucc_context.h:126-139)
+        self._progress_fns: List[Callable[[], None]] = []
+        self._throttle = 0
+        self._throttle_period = 64
+
+    # ------------------------------------------------------------------
+    def register_progress_fn(self, fn: Callable[[], None]) -> None:
+        self._progress_fns.append(fn)
+
+    def deregister_progress_fn(self, fn: Callable[[], None]) -> None:
+        if fn in self._progress_fns:
+            self._progress_fns.remove(fn)
+
+    # ------------------------------------------------------------------
+    def enqueue(self, task: CollTask) -> None:
+        task.progress_queue = self
+        self._finish_or_queue(task, queue=True)
+
+    def _finish_or_queue(self, task: CollTask, queue: bool) -> None:
+        task.progress()
+        if task.status != Status.IN_PROGRESS:
+            if not task.is_completed():
+                task.complete()
+        elif queue:
+            self._q.append(task)
+
+    def progress(self) -> int:
+        """One pass over registered fns + queued tasks; returns number of
+        tasks completed this pass (ucc_context_progress return flavor)."""
+        # throttle component progress fns when queue is empty, mirroring
+        # ucc_context.c:1070-1080
+        if self._q or self._throttle == 0:
+            for fn in self._progress_fns:
+                fn()
+        self._throttle = (self._throttle + 1) % self._throttle_period
+        if not self._q:
+            return 0
+        completed = 0
+        now = time.monotonic()
+        n = len(self._q)
+        for _ in range(n):
+            task = self._q.popleft()
+            if task.is_completed():
+                completed += 1
+                continue
+            if task.check_timeout(now):
+                task.complete(Status.ERR_TIMED_OUT)
+                completed += 1
+                continue
+            task.progress()
+            if task.status != Status.IN_PROGRESS:
+                if not task.is_completed():
+                    task.complete()
+                completed += 1
+            else:
+                self._q.append(task)
+        return completed
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+
+class ProgressQueueMT(ProgressQueue):
+    """Locked variant for ThreadMode.MULTIPLE (ucc_progress_queue_mt.c)."""
+
+    def __init__(self):
+        super().__init__()
+        self._lock = threading.RLock()
+
+    def enqueue(self, task: CollTask) -> None:
+        with self._lock:
+            super().enqueue(task)
+
+    def progress(self) -> int:
+        with self._lock:
+            return super().progress()

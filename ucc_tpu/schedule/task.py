@@ -1,0 +1,235 @@
+"""Collective task — the universal async operation.
+
+Re-design of /root/reference/src/schedule/ucc_schedule.h:114-149
+(``ucc_coll_task_t``) and its event manager (:187-193, handlers :208,
+dependency subscription :289). Semantics preserved:
+
+  - a task has user-visible ``status`` plus post/progress/finalize hooks
+  - tasks publish events (COMPLETED / STARTED / ERROR / ...) to subscribers
+  - dependency edges: a task with ``n_deps`` starts only after that many
+    dependency events arrive (``ucc_dependency_handler``) — a tiny DAG engine
+  - completion runs the user callback, notifies the parent schedule, and
+    stamps timing for timeout detection
+
+The TPU twist: a task's ``progress()`` may be driven either by the host
+progress queue (host/DCN transports) or by XLA async dispatch — a task
+wrapping a dispatched jax computation completes when its output arrays are
+ready, so ``test()`` maps to ``jax.Array`` readiness rather than a host state
+machine.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..constants import EventType
+from ..status import Status
+from ..utils.log import get_logger
+
+logger = get_logger("schedule")
+
+_seq_counter = 0
+
+
+def _next_seq() -> int:
+    global _seq_counter
+    _seq_counter += 1
+    return _seq_counter
+
+
+class EventManager:
+    """Per-task subscriber lists (ucc_schedule.h:187-193).
+
+    Handlers: ``fn(parent_task, event, subscriber_task) -> None``.
+    """
+
+    __slots__ = ("listeners",)
+
+    def __init__(self):
+        self.listeners: List[List[Tuple[Callable, Any]]] = \
+            [[] for _ in range(EventType.EVENT_LAST)]
+
+    def subscribe(self, event: EventType, handler: Callable, subscriber: Any) -> None:
+        self.listeners[event].append((handler, subscriber))
+
+    def notify(self, parent: "CollTask", event: EventType) -> None:
+        for handler, subscriber in list(self.listeners[event]):
+            handler(parent, event, subscriber)
+
+    def reset(self) -> None:
+        for lst in self.listeners:
+            lst.clear()
+
+
+class CollTask:
+    """Base async collective task.
+
+    Subclasses (or instances, via attribute assignment) provide:
+      ``post_fn()``   — start the operation; returns Status
+      ``progress_fn()`` — advance; sets ``self.status`` (IN_PROGRESS / OK / error)
+      ``finalize_fn()`` — release resources
+
+    Lifecycle mirrors the reference:
+      init -> OPERATION_INITIALIZED -> post -> IN_PROGRESS -> ... -> OK
+    """
+
+    def __init__(self, team=None, args=None, flags_internal: bool = False):
+        self.team = team
+        self.args = args
+        self.status: Status = Status.OPERATION_INITIALIZED
+        self.super_status: Status = Status.OPERATION_INITIALIZED  # user-visible
+        self.em = EventManager()
+        self.n_deps = 0
+        self.n_deps_satisfied = 0
+        self.n_deps_base = 0          # for persistent re-post reset
+        self.schedule: Optional["Schedule"] = None
+        self.executor = None
+        self.flags_internal = flags_internal
+        self.cb: Optional[Callable[["CollTask", Status], None]] = None
+        self.start_time: float = 0.0
+        self.timeout: float = 0.0      # seconds; 0 = no timeout
+        self.seq_num = _next_seq()
+        self.bargs = None              # resolved coll args (set by core)
+        self.progress_queue = None     # set at post time by core/schedule
+        self.triggered_task = None     # EE proxy task when triggered
+        self.executor_owned = False
+
+    # ------------------------------------------------------------------ hooks
+    def post_fn(self) -> Status:
+        raise NotImplementedError
+
+    def progress_fn(self) -> None:
+        """Advance the op; must update self.status."""
+
+    def finalize_fn(self) -> Status:
+        return Status.OK
+
+    def triggered_post_setup(self) -> Status:
+        return Status.OK
+
+    # ------------------------------------------------------------------ core
+    def post(self, inherit_start: bool = False) -> Status:
+        """ucc_coll_task post path: stamp start time, run post_fn, then hand
+        the task to the progress queue (which runs one progress pass
+        immediately — the enqueue-progresses-once optimization of
+        ucc_progress_queue.h:32-44).
+
+        ``inherit_start=True`` keeps a start_time assigned by the caller
+        (schedule/dependency handlers propagate the collective's start so
+        timeouts bound the whole operation, ucc_schedule.c:257).
+        """
+        if not inherit_start or not self.start_time:
+            self.start_time = time.monotonic()
+        self.status = Status.IN_PROGRESS
+        self.super_status = Status.IN_PROGRESS
+        st = self.post_fn()
+        if isinstance(st, Status) and st.is_error:
+            self.status = st
+            self.complete(st)
+            return st
+        if self.status.is_error:
+            # post_fn signaled failure via self.status while returning OK
+            self.complete(self.status)
+            return self.status
+        if self.status == Status.OK:
+            # post_fn completed synchronously without calling complete()
+            if self.super_status == Status.IN_PROGRESS:
+                self.complete(Status.OK)
+        elif self.status == Status.IN_PROGRESS and self.progress_queue is not None:
+            self.progress_queue.enqueue(self)
+        return st if isinstance(st, Status) else Status.OK
+
+    def progress(self) -> None:
+        self.progress_fn()
+
+    def finalize(self) -> Status:
+        return self.finalize_fn()
+
+    def reset(self) -> None:
+        """Prepare for re-post (persistent collectives)."""
+        self.status = Status.OPERATION_INITIALIZED
+        self.super_status = Status.OPERATION_INITIALIZED
+        self.n_deps_satisfied = 0
+        self.n_deps = self.n_deps_base
+
+    # -------------------------------------------------------------- events
+    def subscribe(self, event: EventType, handler: Callable,
+                  subscriber: "CollTask") -> None:
+        self.em.subscribe(event, handler, subscriber)
+
+    def notify(self, event: EventType) -> None:
+        self.em.notify(self, event)
+
+    def subscribe_dep(self, parent: "CollTask", event: EventType) -> None:
+        """ucc_task_subscribe_dep (ucc_schedule.h:289): start after *parent*
+        raises *event*. Errors in the parent propagate: the dependency
+        handler completes this task with the parent's error status."""
+        parent.subscribe(event, dependency_handler, self)
+        if event != EventType.EVENT_ERROR:
+            parent.subscribe(EventType.EVENT_ERROR, dependency_handler, self)
+        self.n_deps += 1
+        self.n_deps_base = self.n_deps
+
+    # ------------------------------------------------------------ completion
+    def complete(self, status: Optional[Status] = None) -> None:
+        """ucc_task_complete (ucc_schedule.h:214-287). Idempotent: late
+        events after completion (e.g. stragglers of an errored pipeline)
+        must not re-run callbacks or double-count in a parent schedule."""
+        if self.is_completed():
+            return
+        if status is not None:
+            self.status = status
+        st = self.status
+        if st == Status.IN_PROGRESS:
+            st = self.status = Status.OK
+        if st.is_error:
+            if self.timeout and st == Status.ERR_TIMED_OUT:
+                logger.warning(
+                    "timeout %.3fs: coll task %s seq %d", self.timeout,
+                    type(self).__name__, self.seq_num)
+            self.notify(EventType.EVENT_ERROR)
+        else:
+            self.notify(EventType.EVENT_COMPLETED)
+        if self.executor is not None and self.executor_owned:
+            try:
+                self.executor.stop()
+            except Exception:  # noqa: BLE001 - executor teardown is best-effort
+                pass
+        if self.cb is not None:
+            self.cb(self, st)
+        self.super_status = st
+        if self.schedule is not None:
+            self.schedule.child_completed(self)
+        if self.flags_internal and self.schedule is None:
+            # internal tasks with no parent are auto-finalized like the
+            # reference's TASK_FLAG_INTERNAL
+            self.finalize()
+
+    def is_completed(self) -> bool:
+        return self.super_status != Status.IN_PROGRESS and \
+            self.super_status != Status.OPERATION_INITIALIZED
+
+    def check_timeout(self, now: float) -> bool:
+        return bool(self.timeout) and (now - self.start_time) > self.timeout
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} seq={self.seq_num} "
+                f"status={self.status.name}>")
+
+
+def dependency_handler(parent: CollTask, event: EventType,
+                       task: CollTask) -> None:
+    """ucc_dependency_handler (ucc_schedule.h:208): count satisfied deps,
+    post the task once all arrived."""
+    if event == EventType.EVENT_ERROR:
+        if not task.is_completed():
+            task.complete(parent.status)
+        return
+    task.n_deps_satisfied += 1
+    if task.n_deps_satisfied == task.n_deps:
+        task.start_time = parent.start_time or task.start_time
+        st = task.post(inherit_start=True)
+        if not (isinstance(st, Status) and st.is_error):
+            # reference notifies TASK_STARTED only after a successful post
+            # (ucc_schedule_pipelined.c ucc_dependency_handler tail)
+            task.notify(EventType.EVENT_TASK_STARTED)

@@ -1,0 +1,74 @@
+"""Status codes for ucc_tpu.
+
+TPU-native re-design of the reference status model
+(/root/reference/src/ucc/api/ucc_status.h:13-56): the same tri-state
+contract — OK / OPERATION_INITIALIZED / INPROGRESS are non-errors, everything
+below zero is an error — expressed as an IntEnum plus an exception type so
+Python call sites can either poll (UCC-style nonblocking test) or raise.
+"""
+from __future__ import annotations
+
+import enum
+
+
+class Status(enum.IntEnum):
+    """Operation status. Mirrors ucc_status_t semantics."""
+
+    # Non-error statuses
+    OK = 0
+    IN_PROGRESS = 1            # ucc_status.h: UCC_INPROGRESS
+    OPERATION_INITIALIZED = 2  # ucc_status.h: UCC_OPERATION_INITIALIZED
+
+    # Error statuses
+    ERR_NOT_SUPPORTED = -1
+    ERR_NOT_IMPLEMENTED = -2
+    ERR_INVALID_PARAM = -3
+    ERR_NO_MEMORY = -4
+    ERR_NO_RESOURCE = -5
+    ERR_NO_MESSAGE = -6
+    ERR_NOT_FOUND = -7
+    ERR_TIMED_OUT = -8
+    ERR_LAST = -100
+
+    @property
+    def is_error(self) -> bool:
+        return self.value < 0
+
+    def __str__(self) -> str:  # matches ucc_status_string flavor
+        return _STATUS_STR.get(self, f"unknown status {self.value}")
+
+
+_STATUS_STR = {
+    Status.OK: "Success",
+    Status.IN_PROGRESS: "Operation in progress",
+    Status.OPERATION_INITIALIZED: "Operation initialized",
+    Status.ERR_NOT_SUPPORTED: "Operation is not supported",
+    Status.ERR_NOT_IMPLEMENTED: "Operation is not implemented",
+    Status.ERR_INVALID_PARAM: "Invalid parameter",
+    Status.ERR_NO_MEMORY: "Out of memory",
+    Status.ERR_NO_RESOURCE: "Resource is not available",
+    Status.ERR_NO_MESSAGE: "No message available",
+    Status.ERR_NOT_FOUND: "Not found",
+    Status.ERR_TIMED_OUT: "Operation timed out",
+}
+
+
+class UccError(Exception):
+    """Raised by the raising flavor of the API when a call fails."""
+
+    def __init__(self, status: Status, msg: str = ""):
+        self.status = Status(status)
+        super().__init__(f"{self.status.name}: {msg}" if msg else self.status.name)
+
+
+def check(status, msg: str = ""):
+    """Raise UccError if *status* is an error; return it otherwise.
+    Accepts raw ints too (negative = error), so statuses forwarded through
+    callbacks that lost the enum type still raise."""
+    if isinstance(status, int) and int(status) < 0:
+        try:
+            status = Status(status)
+        except ValueError:
+            status = Status.ERR_LAST
+        raise UccError(status, msg)
+    return status

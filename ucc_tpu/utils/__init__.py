@@ -1,0 +1,1 @@
+from . import config, ep_map, log, mathutils, mpool  # noqa: F401

@@ -1,0 +1,67 @@
+"""Math helpers (reference: /root/reference/src/utils/ucc_math.h and
+ucc_coll_utils.h block helpers)."""
+from __future__ import annotations
+
+
+def ilog2(n: int) -> int:
+    if n <= 0:
+        raise ValueError("ilog2 of non-positive value")
+    return n.bit_length() - 1
+
+
+def is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def next_pow2(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def gcd(a: int, b: int) -> int:
+    while b:
+        a, b = b, a % b
+    return a
+
+
+def lcm(a: int, b: int) -> int:
+    return a // gcd(a, b) * b
+
+
+def div_round_up(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+def align_up(x: int, a: int) -> int:
+    return div_round_up(x, a) * a
+
+
+def block_count(total: int, n_blocks: int, block: int) -> int:
+    """Size of *block* when splitting `total` into `n_blocks` near-equal parts
+    (ucc_buffer_block_count, ucc_coll_utils.h:301): first `total % n` blocks
+    get one extra element."""
+    base = total // n_blocks
+    rem = total % n_blocks
+    return base + (1 if block < rem else 0)
+
+
+def block_offset(total: int, n_blocks: int, block: int) -> int:
+    """Offset of *block* (ucc_buffer_block_offset, ucc_coll_utils.h:387)."""
+    base = total // n_blocks
+    rem = total % n_blocks
+    return block * base + min(block, rem)
+
+
+def block_count_aligned(total: int, n_blocks: int, block: int, align: int) -> int:
+    """Aligned variant used by ring reduce-scatter fragmenting."""
+    off = block_offset_aligned(total, n_blocks, block, align)
+    nxt = block_offset_aligned(total, n_blocks, block + 1, align) \
+        if block + 1 < n_blocks else total
+    return nxt - off
+
+
+def block_offset_aligned(total: int, n_blocks: int, block: int, align: int) -> int:
+    off = block_offset(total, n_blocks, block)
+    off = (off + align - 1) // align * align
+    return min(off, total)
