@@ -1,0 +1,103 @@
+"""In-process multi-rank test harness.
+
+Mirrors the reference gtest harness (test/gtest/common/test_ucc.h:123-226):
+``UccJob`` = N "processes" inside one process, each with its own Lib +
+Context, bootstrapped by a thread OOB; teams over subsets; ``UccReq`` posts
+a collective on every rank and progresses all contexts until done.
+Context creation (blocking OOB exchange) runs in threads; everything after
+is driven cooperatively single-threaded.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+import ucc_tpu
+from ucc_tpu import (CollArgs, Context, ContextParams, Status, TeamParams,
+                     ThreadOobWorld)
+
+
+class UccJob:
+    def __init__(self, n: int, lib_overrides: Optional[dict] = None):
+        self.n = n
+        self.world = ThreadOobWorld(n)
+        self.libs = [ucc_tpu.init(**(lib_overrides or {})) for _ in range(n)]
+        self.contexts: List[Context] = [None] * n  # type: ignore[list-item]
+        errs = []
+
+        def make_ctx(r):
+            try:
+                self.contexts[r] = Context(
+                    self.libs[r],
+                    ContextParams(oob=self.world.endpoint(r)))
+            except Exception as e:  # noqa: BLE001
+                errs.append((r, e))
+
+        threads = [threading.Thread(target=make_ctx, args=(r,))
+                   for r in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        if errs:
+            raise errs[0][1]
+        self.teams: List[List] = []
+
+    # ------------------------------------------------------------------
+    def create_team(self, ranks: Optional[Sequence[int]] = None,
+                    timeout: float = 30.0):
+        """Create a team over `ranks` (default: all). Returns the per-member
+        team list indexed by group rank."""
+        ranks = list(ranks) if ranks is not None else list(range(self.n))
+        sub_world = ThreadOobWorld(len(ranks))
+        teams = [self.contexts[r].create_team_post(
+            TeamParams(oob=sub_world.endpoint(i)))
+            for i, r in enumerate(ranks)]
+        deadline = time.monotonic() + timeout
+        while True:
+            sts = [t.create_test() for t in teams]
+            for r in ranks:
+                self.contexts[r].progress()
+            if all(s == Status.OK for s in sts):
+                break
+            bad = [s for s in sts if s.is_error]
+            if bad:
+                raise ucc_tpu.UccError(bad[0], "team create failed")
+            if time.monotonic() > deadline:
+                raise TimeoutError("team create timed out")
+        self.teams.append(teams)
+        return teams
+
+    # ------------------------------------------------------------------
+    def run_coll(self, teams, make_args: Callable[[int], CollArgs],
+                 timeout: float = 30.0) -> List:
+        """Init+post `make_args(group_rank)` on every member, progress all
+        contexts to completion, return the per-rank requests."""
+        reqs = [t.collective_init(make_args(i)) for i, t in enumerate(teams)]
+        for rq in reqs:
+            rq.post()
+        self.progress_until(lambda: all(
+            rq.test() != Status.IN_PROGRESS for rq in reqs), timeout)
+        for rq in reqs:
+            st = rq.test()
+            assert st == Status.OK, f"collective failed: {st}"
+        return reqs
+
+    def progress_until(self, cond: Callable[[], bool],
+                       timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not cond():
+            for ctx in self.contexts:
+                ctx.progress()
+            if time.monotonic() > deadline:
+                raise TimeoutError("progress_until timed out")
+
+    def cleanup(self) -> None:
+        for teams in self.teams:
+            for t in teams:
+                t.destroy()
+        for ctx in self.contexts:
+            ctx.destroy()

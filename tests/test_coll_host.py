@@ -1,0 +1,447 @@
+"""Multi-rank host collective correctness — mirrors the reference gtest
+per-coll suites (test/gtest/coll/test_allreduce.cc etc.): coll × dtype ×
+op × team size × inplace, validated against locally computed expectations
+(the test/mpi/buffer.cc approach)."""
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, BufferInfoV, CollArgs, CollArgsFlags,
+                     CollType, DataType, ReductionOp, Status)
+from ucc_tpu.constants import dt_numpy
+
+from harness import UccJob
+
+TEAM_SIZES = [2, 3, 5, 8]
+
+
+@pytest.fixture(scope="module")
+def job():
+    j = UccJob(8)
+    yield j
+    j.cleanup()
+
+
+@pytest.fixture(scope="module")
+def teams_by_size(job):
+    cache = {}
+
+    def get(n):
+        if n not in cache:
+            cache[n] = job.create_team(list(range(n)))
+        return cache[n]
+
+    return get
+
+
+def _mkdata(rank, count, nd, seed=7):
+    rng = np.random.default_rng(seed + rank)
+    if np.issubdtype(nd, np.floating):
+        return (rng.random(count) * 4 - 2).astype(nd)
+    return rng.integers(1, 50, size=count).astype(nd)
+
+
+class TestAllreduce:
+    @pytest.mark.parametrize("n", TEAM_SIZES)
+    @pytest.mark.parametrize("count", [1, 17, 4096])
+    def test_sum_f32(self, job, teams_by_size, n, count):
+        teams = teams_by_size(n)
+        nd = np.float32
+        srcs = [_mkdata(r, count, nd) for r in range(n)]
+        dsts = [np.zeros(count, dtype=nd) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+            op=ReductionOp.SUM))
+        expect = np.sum(srcs, axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], expect, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("op,npop", [
+        (ReductionOp.MAX, np.maximum.reduce),
+        (ReductionOp.MIN, np.minimum.reduce),
+        (ReductionOp.PROD, lambda a: np.prod(np.stack(a), axis=0)),
+    ])
+    def test_ops_i64(self, job, teams_by_size, op, npop):
+        n = 4
+        teams = teams_by_size(n)
+        count = 33
+        srcs = [_mkdata(r, count, np.int64) % 7 + 1 for r in range(n)]
+        dsts = [np.zeros(count, dtype=np.int64) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.INT64),
+            dst=BufferInfo(dsts[r], count, DataType.INT64), op=op))
+        expect = npop(srcs)
+        for r in range(n):
+            np.testing.assert_array_equal(dsts[r], expect)
+
+    def test_avg(self, job, teams_by_size):
+        n = 5
+        teams = teams_by_size(n)
+        count = 40
+        srcs = [_mkdata(r, count, np.float64) for r in range(n)]
+        dsts = [np.zeros(count, dtype=np.float64) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+            op=ReductionOp.AVG))
+        expect = np.mean(srcs, axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], expect, rtol=1e-9)
+
+    def test_bf16(self, job, teams_by_size):
+        import ml_dtypes
+        n = 4
+        teams = teams_by_size(n)
+        count = 64
+        nd = np.dtype(ml_dtypes.bfloat16)
+        srcs = [(np.arange(count) % 5 + r).astype(nd) for r in range(n)]
+        dsts = [np.zeros(count, dtype=nd) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.BFLOAT16),
+            dst=BufferInfo(dsts[r], count, DataType.BFLOAT16),
+            op=ReductionOp.SUM))
+        expect = np.sum([s.astype(np.float32) for s in srcs], axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r].astype(np.float32), expect,
+                                       rtol=1e-2)
+
+    def test_inplace(self, job, teams_by_size):
+        n = 3
+        teams = teams_by_size(n)
+        count = 20
+        bufs = [_mkdata(r, count, np.int32) for r in range(n)]
+        expect = np.sum(bufs, axis=0)
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            dst=BufferInfo(bufs[r], count, DataType.INT32),
+            op=ReductionOp.SUM, flags=CollArgsFlags.IN_PLACE))
+        for r in range(n):
+            np.testing.assert_array_equal(bufs[r], expect)
+
+    def test_minloc(self, job, teams_by_size):
+        n = 4
+        teams = teams_by_size(n)
+        pairs = 10
+        srcs = []
+        for r in range(n):
+            vals = _mkdata(r, pairs, np.float32)
+            arr = np.empty(pairs * 2, dtype=np.float32)
+            arr[0::2] = vals
+            arr[1::2] = r
+            srcs.append(arr)
+        dsts = [np.zeros(pairs * 2, dtype=np.float32) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], pairs * 2, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], pairs * 2, DataType.FLOAT32),
+            op=ReductionOp.MINLOC))
+        vals = np.stack([s[0::2] for s in srcs])
+        which = np.argmin(vals, axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r][0::2], np.min(vals, axis=0))
+            np.testing.assert_array_equal(dsts[r][1::2].astype(int), which)
+
+    @pytest.mark.parametrize("alg", ["knomial", "sra_knomial", "ring"])
+    def test_alg_selection(self, alg, monkeypatch):
+        # dedicated job so the TUNE env is picked up at team create
+        monkeypatch.setenv("UCC_TL_SHM_TUNE", f"allreduce:@{alg}:inf")
+        job = UccJob(4)
+        try:
+            teams = job.create_team()
+            count = 1000
+            srcs = [_mkdata(r, count, np.float32) for r in range(4)]
+            dsts = [np.zeros(count, dtype=np.float32) for _ in range(4)]
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+                dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+                op=ReductionOp.SUM))
+            expect = np.sum(srcs, axis=0)
+            for r in range(4):
+                np.testing.assert_allclose(dsts[r], expect, rtol=1e-4, atol=1e-5)
+        finally:
+            job.cleanup()
+
+
+class TestBcast:
+    @pytest.mark.parametrize("n", TEAM_SIZES)
+    @pytest.mark.parametrize("root", [0, 1])
+    def test_bcast(self, job, teams_by_size, n, root):
+        if root >= n:
+            pytest.skip("root out of range")
+        teams = teams_by_size(n)
+        count = 100
+        bufs = [(_mkdata(root, count, np.int32) if r == root else
+                 np.zeros(count, dtype=np.int32)) for r in range(n)]
+        expect = bufs[root].copy()
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.BCAST, root=root,
+            src=BufferInfo(bufs[r], count, DataType.INT32)))
+        for r in range(n):
+            np.testing.assert_array_equal(bufs[r], expect)
+
+
+class TestReduce:
+    @pytest.mark.parametrize("n", TEAM_SIZES)
+    def test_reduce_sum(self, job, teams_by_size, n):
+        teams = teams_by_size(n)
+        root = n - 1
+        count = 50
+        srcs = [_mkdata(r, count, np.float32) for r in range(n)]
+        dst = np.zeros(count, dtype=np.float32)
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.REDUCE, root=root,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dst if r == root else None, count,
+                           DataType.FLOAT32) if r == root else None,
+            op=ReductionOp.SUM))
+        np.testing.assert_allclose(dst, np.sum(srcs, axis=0), rtol=1e-4, atol=1e-5)
+
+
+class TestBarrier:
+    @pytest.mark.parametrize("n", TEAM_SIZES)
+    def test_barrier(self, job, teams_by_size, n):
+        teams = teams_by_size(n)
+        job.run_coll(teams, lambda r: CollArgs(coll_type=CollType.BARRIER))
+
+    def test_fanin_fanout(self, job, teams_by_size):
+        teams = teams_by_size(4)
+        job.run_coll(teams, lambda r: CollArgs(coll_type=CollType.FANIN))
+        job.run_coll(teams, lambda r: CollArgs(coll_type=CollType.FANOUT))
+
+
+class TestAllgather:
+    @pytest.mark.parametrize("n", TEAM_SIZES)
+    def test_allgather(self, job, teams_by_size, n):
+        teams = teams_by_size(n)
+        per = 13
+        srcs = [_mkdata(r, per, np.int64) for r in range(n)]
+        dsts = [np.zeros(per * n, dtype=np.int64) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLGATHER,
+            src=BufferInfo(srcs[r], per, DataType.INT64),
+            dst=BufferInfo(dsts[r], per * n, DataType.INT64)))
+        expect = np.concatenate(srcs)
+        for r in range(n):
+            np.testing.assert_array_equal(dsts[r], expect)
+
+    def test_allgatherv(self, job, teams_by_size):
+        n = 4
+        teams = teams_by_size(n)
+        counts = [3, 7, 1, 5]
+        displs = [0, 3, 10, 11]
+        total = 16
+        srcs = [_mkdata(r, counts[r], np.float32) for r in range(n)]
+        dsts = [np.zeros(total, dtype=np.float32) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLGATHERV,
+            src=BufferInfo(srcs[r], counts[r], DataType.FLOAT32),
+            dst=BufferInfoV(dsts[r], counts, displs, DataType.FLOAT32)))
+        expect = np.zeros(total, dtype=np.float32)
+        for r in range(n):
+            expect[displs[r]:displs[r] + counts[r]] = srcs[r]
+        for r in range(n):
+            np.testing.assert_array_equal(dsts[r], expect)
+
+
+class TestAlltoall:
+    @pytest.mark.parametrize("n", TEAM_SIZES)
+    @pytest.mark.parametrize("per", [4, 300])  # bruck vs pairwise ranges
+    def test_alltoall(self, job, teams_by_size, n, per):
+        teams = teams_by_size(n)
+        total = per * n
+        srcs = [np.arange(total, dtype=np.int32) + 1000 * r for r in range(n)]
+        dsts = [np.zeros(total, dtype=np.int32) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=BufferInfo(srcs[r], total, DataType.INT32),
+            dst=BufferInfo(dsts[r], total, DataType.INT32)))
+        for r in range(n):
+            expect = np.concatenate(
+                [srcs[p][r * per:(r + 1) * per] for p in range(n)])
+            np.testing.assert_array_equal(dsts[r], expect)
+
+    def test_alltoallv(self, job, teams_by_size):
+        n = 3
+        teams = teams_by_size(n)
+        # counts[r][p] = elements rank r sends to rank p
+        counts = np.array([[1, 2, 3], [4, 0, 2], [2, 5, 1]])
+        sdispl = np.zeros((n, n), dtype=int)
+        rdispl = np.zeros((n, n), dtype=int)
+        for r in range(n):
+            sdispl[r] = np.cumsum([0] + list(counts[r][:-1]))
+            rdispl[r] = np.cumsum([0] + list(counts[:, r][:-1]))
+        srcs = [np.arange(counts[r].sum(), dtype=np.int32) + 100 * r
+                for r in range(n)]
+        dsts = [np.zeros(counts[:, r].sum(), dtype=np.int32)
+                for r in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALLV,
+            src=BufferInfoV(srcs[r], list(counts[r]), list(sdispl[r]),
+                            DataType.INT32),
+            dst=BufferInfoV(dsts[r], list(counts[:, r]), list(rdispl[r]),
+                            DataType.INT32)))
+        for r in range(n):
+            expect = np.concatenate(
+                [srcs[p][sdispl[p][r]:sdispl[p][r] + counts[p][r]]
+                 for p in range(n)]) if counts[:, r].sum() else \
+                np.zeros(0, dtype=np.int32)
+            np.testing.assert_array_equal(dsts[r], expect)
+
+
+class TestGatherScatter:
+    def test_gather(self, job, teams_by_size):
+        n = 4
+        teams = teams_by_size(n)
+        per = 6
+        root = 2
+        srcs = [_mkdata(r, per, np.int32) for r in range(n)]
+        dst = np.zeros(per * n, dtype=np.int32)
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.GATHER, root=root,
+            src=BufferInfo(srcs[r], per, DataType.INT32),
+            dst=BufferInfo(dst, per * n, DataType.INT32) if r == root else None))
+        np.testing.assert_array_equal(dst, np.concatenate(srcs))
+
+    def test_scatter(self, job, teams_by_size):
+        n = 4
+        teams = teams_by_size(n)
+        per = 5
+        root = 0
+        src = np.arange(per * n, dtype=np.float32)
+        dsts = [np.zeros(per, dtype=np.float32) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.SCATTER, root=root,
+            src=BufferInfo(src, per * n, DataType.FLOAT32) if r == root else None,
+            dst=BufferInfo(dsts[r], per, DataType.FLOAT32)))
+        for r in range(n):
+            np.testing.assert_array_equal(dsts[r], src[r * per:(r + 1) * per])
+
+
+class TestReduceScatter:
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_reduce_scatter(self, job, teams_by_size, n):
+        teams = teams_by_size(n)
+        per = 7
+        total = per * n
+        srcs = [_mkdata(r, total, np.float32) for r in range(n)]
+        dsts = [np.zeros(per, dtype=np.float32) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.REDUCE_SCATTER,
+            src=BufferInfo(srcs[r], total, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], per, DataType.FLOAT32),
+            op=ReductionOp.SUM))
+        expect = np.sum(srcs, axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], expect[r * per:(r + 1) * per],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_reduce_scatterv(self, job, teams_by_size):
+        n = 3
+        teams = teams_by_size(n)
+        counts = [4, 1, 6]
+        displs = [0, 4, 5]
+        total = 11
+        srcs = [_mkdata(r, total, np.float64) for r in range(n)]
+        dsts = [np.zeros(counts[r], dtype=np.float64) for r in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.REDUCE_SCATTERV,
+            src=BufferInfo(srcs[r], total, DataType.FLOAT64),
+            dst=BufferInfoV(dsts[r], counts, None, DataType.FLOAT64),
+            op=ReductionOp.SUM))
+        expect = np.sum(srcs, axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(
+                dsts[r], expect[displs[r]:displs[r] + counts[r]], rtol=1e-9)
+
+
+class TestPersistent:
+    def test_persistent_allreduce(self, job, teams_by_size):
+        n = 4
+        teams = teams_by_size(n)
+        count = 16
+        bufs_src = [np.ones(count, dtype=np.float32) * (r + 1)
+                    for r in range(n)]
+        bufs_dst = [np.zeros(count, dtype=np.float32) for r in range(n)]
+        reqs = [teams[r].collective_init(CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(bufs_src[r], count, DataType.FLOAT32),
+            dst=BufferInfo(bufs_dst[r], count, DataType.FLOAT32),
+            op=ReductionOp.SUM, flags=CollArgsFlags.PERSISTENT))
+            for r in range(n)]
+        for it in range(3):
+            for r in range(n):
+                bufs_src[r][:] = (r + 1) * (it + 1)
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs))
+            expect = sum((r + 1) * (it + 1) for r in range(n))
+            for r in range(n):
+                np.testing.assert_allclose(bufs_dst[r], expect)
+        for rq in reqs:
+            rq.finalize()
+
+
+class TestZeroSize:
+    def test_zero_count_fast_path(self, job, teams_by_size):
+        n = 2
+        teams = teams_by_size(n)
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(np.zeros(0, np.float32), 0, DataType.FLOAT32),
+            dst=BufferInfo(np.zeros(0, np.float32), 0, DataType.FLOAT32),
+            op=ReductionOp.SUM))
+
+
+class TestTeamFeatures:
+    def test_subset_team(self, job):
+        teams = job.create_team([1, 3, 5])
+        count = 8
+        srcs = [np.full(count, i + 1, dtype=np.int32) for i in range(3)]
+        dsts = [np.zeros(count, dtype=np.int32) for _ in range(3)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.INT32),
+            dst=BufferInfo(dsts[r], count, DataType.INT32),
+            op=ReductionOp.SUM))
+        for r in range(3):
+            np.testing.assert_array_equal(dsts[r], np.full(count, 6))
+
+    def test_team_ids_consistent(self, job):
+        teams = job.create_team([0, 1, 2])
+        ids = {t.id for t in teams}
+        assert len(ids) == 1 and teams[0].id is not None
+
+    def test_concurrent_teams_isolated(self, job, teams_by_size):
+        t_a = teams_by_size(4)
+        t_b = job.create_team([0, 1, 2, 3])
+        count = 4
+        a_dst = [np.zeros(count, np.int32) for _ in range(4)]
+        b_dst = [np.zeros(count, np.int32) for _ in range(4)]
+        reqs = []
+        for r in range(4):
+            reqs.append(t_a[r].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(np.full(count, 1, np.int32), count,
+                               DataType.INT32),
+                dst=BufferInfo(a_dst[r], count, DataType.INT32),
+                op=ReductionOp.SUM)))
+            reqs.append(t_b[r].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(np.full(count, 10, np.int32), count,
+                               DataType.INT32),
+                dst=BufferInfo(b_dst[r], count, DataType.INT32),
+                op=ReductionOp.SUM)))
+        for rq in reqs:
+            rq.post()
+        job.progress_until(lambda: all(
+            rq.test() != Status.IN_PROGRESS for rq in reqs))
+        for r in range(4):
+            np.testing.assert_array_equal(a_dst[r], np.full(count, 4))
+            np.testing.assert_array_equal(b_dst[r], np.full(count, 40))

@@ -1,0 +1,149 @@
+"""Selection-engine tests — mirrors reference gtest coll_score suites
+(test/gtest/coll_score/test_score.cc, test_score_update.cc)."""
+import pytest
+
+from ucc_tpu.constants import CollType, MemoryType
+from ucc_tpu.score import (CollScore, ScoreMap, SCORE_MAX, parse_tune_str)
+from ucc_tpu.status import Status, UccError
+from ucc_tpu.utils.config import SIZE_INF
+
+
+def mkinit(tag):
+    def init(args, team):
+        return (tag, args, team)
+    return init
+
+
+class TestCollScore:
+    def test_add_and_lookup(self):
+        s = CollScore()
+        assert s.add_range(CollType.ALLREDUCE, MemoryType.HOST, 0, 4096, 10,
+                           mkinit("kn"), "teamA", "knomial") == Status.OK
+        s.add_range(CollType.ALLREDUCE, MemoryType.HOST, 4096, SIZE_INF, 20,
+                    mkinit("ring"), "teamA", "ring")
+        m = ScoreMap(s)
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 100)[0].alg_name == "knomial"
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 1 << 20)[0].alg_name == "ring"
+        assert m.lookup(CollType.BCAST, MemoryType.HOST, 100) == []
+
+    def test_invalid_range(self):
+        s = CollScore()
+        assert s.add_range(CollType.BCAST, MemoryType.HOST, 10, 10, 5) == \
+            Status.ERR_INVALID_PARAM
+
+    def test_merge_max_score_wins(self):
+        a = CollScore.build_default("tl_a", 10, [CollType.ALLREDUCE],
+                                    [MemoryType.HOST], mkinit("a"), "alg_a")
+        b = CollScore.build_default("tl_b", 40, [CollType.ALLREDUCE],
+                                    [MemoryType.HOST], mkinit("b"), "alg_b")
+        m = ScoreMap(a.merge(b))
+        cands = m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 123)
+        assert [c.alg_name for c in cands] == ["alg_b", "alg_a"]
+
+    def test_fallback_walk(self):
+        def unsupported_init(args, team):
+            raise UccError(Status.ERR_NOT_SUPPORTED)
+
+        a = CollScore.build_default("tl_a", 10, [CollType.ALLREDUCE],
+                                    [MemoryType.HOST], mkinit("a"), "alg_a")
+        b = CollScore.build_default("tl_b", 40, [CollType.ALLREDUCE],
+                                    [MemoryType.HOST], unsupported_init, "alg_b")
+        m = ScoreMap(a.merge(b))
+        task, rng = m.init_coll(CollType.ALLREDUCE, MemoryType.HOST, 8, "args")
+        assert task[0] == "a" and rng.alg_name == "alg_a"
+
+    def test_no_candidates_raises(self):
+        m = ScoreMap(CollScore())
+        with pytest.raises(UccError) as ei:
+            m.init_coll(CollType.BARRIER, MemoryType.HOST, 0, None)
+        assert ei.value.status == Status.ERR_NOT_SUPPORTED
+
+
+class TestTuneParser:
+    def test_full_section(self):
+        secs = parse_tune_str("allreduce:0-4k:@knomial:inf#bcast:host:50")
+        assert len(secs) == 2
+        s0, s1 = secs
+        assert s0.colls == [CollType.ALLREDUCE]
+        assert s0.msg_ranges == [(0, 4096)]
+        assert s0.alg == "knomial" and s0.score == SCORE_MAX
+        assert s1.colls == [CollType.BCAST]
+        assert s1.mems == [MemoryType.HOST]
+        assert s1.score == 50
+
+    def test_coll_list_and_ranges(self):
+        secs = parse_tune_str("allreduce,bcast:4k-inf:30")
+        assert secs[0].colls == [CollType.ALLREDUCE, CollType.BCAST]
+        assert secs[0].msg_ranges == [(4096, SIZE_INF)]
+
+    def test_numeric_alg_id(self):
+        secs = parse_tune_str("allreduce:0-4k:@1")
+        assert secs[0].alg == "1"
+
+    def test_bad_token(self):
+        with pytest.raises(ValueError):
+            parse_tune_str("allreduce:whatever_this_is")
+
+    def test_cuda_memtype_aliases_to_tpu(self):
+        secs = parse_tune_str("allreduce:cuda:10")
+        assert secs[0].mems == [MemoryType.TPU]
+
+
+class TestUpdateFromStr:
+    def _score(self):
+        s = CollScore()
+        s.add_range(CollType.ALLREDUCE, MemoryType.HOST, 0, SIZE_INF, 10,
+                    mkinit("kn"), "tl_x", "knomial")
+        return s
+
+    def test_score_override_splits_range(self):
+        s = self._score()
+        assert s.update_from_str("allreduce:0-4k:inf") == Status.OK
+        m = ScoreMap(s)
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 100)[0].score == SCORE_MAX
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 1 << 20)[0].score == 10
+
+    def test_disable_with_zero(self):
+        # reference idiom: UCC_TL_X_TUNE=allreduce:0 disables the coll
+        s = self._score()
+        s.update_from_str("allreduce:0")
+        m = ScoreMap(s)
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 100) == []
+
+    def test_alg_switch(self):
+        s = self._score()
+
+        def resolver(coll, alg):
+            assert coll == CollType.ALLREDUCE
+            return mkinit("ring") if alg == "ring" else None
+
+        assert s.update_from_str("allreduce:4k-inf:@ring", resolver) == Status.OK
+        m = ScoreMap(s)
+        lo = m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 8)[0]
+        hi = m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 1 << 20)[0]
+        assert lo.alg_name == "knomial" and hi.alg_name == "ring"
+        task, _ = m.init_coll(CollType.ALLREDUCE, MemoryType.HOST, 1 << 20, "a")
+        assert task[0] == "ring"
+
+    def test_unknown_alg_is_error(self):
+        s = self._score()
+        assert s.update_from_str("allreduce:@nope", lambda c, a: None) == \
+            Status.ERR_INVALID_PARAM
+
+    def test_malformed_is_error(self):
+        s = self._score()
+        assert s.update_from_str("allreduce:gibber ish") == \
+            Status.ERR_INVALID_PARAM
+
+    def test_untouched_colls_unaffected(self):
+        s = self._score()
+        s.add_range(CollType.BCAST, MemoryType.HOST, 0, SIZE_INF, 7,
+                    mkinit("b"), "tl_x", "bkn")
+        s.update_from_str("allreduce:0")
+        m = ScoreMap(s)
+        assert m.lookup(CollType.BCAST, MemoryType.HOST, 100)[0].score == 7
+
+    def test_print_info(self):
+        m = ScoreMap(self._score())
+        info = m.print_info("t0")
+        assert "allreduce/host" in info and "knomial:10" in info

@@ -1,0 +1,46 @@
+"""ucc_tpu — a TPU-native collective communication framework.
+
+A ground-up redesign of the capabilities of UCC (openucx/ucc, mounted at
+/root/reference) for TPU systems: the same layered architecture — public
+API, core objects (lib/context/team/collective), selection engine, async
+schedule DAGs, collective layers (CL) composing transport layers (TL),
+memory/execution components (MC/EC), topology — but with the compute path
+built on JAX/XLA/Pallas:
+
+* TL/XLA runs a team's collectives as compiled shard_map programs over a
+  ``jax.sharding.Mesh`` (ICI), replacing TL/NCCL+TL/CUDA.
+* TL/SHM and TL/SOCKET provide host-side tagged-p2p algorithm suites
+  (knomial/ring/DBT/Bruck/SRA...) for DCN and bootstrap, replacing TL/UCP.
+* MC/TPU + EC/TPU manage HBM-resident jax buffers and Pallas reduce
+  kernels, replacing MC/CUDA + EC/CUDA.
+* CL/HIER composes ICI (intra-slice) with DCN (inter-host) hierarchically.
+
+Quick start (single process, UCC-style objects)::
+
+    import numpy as np, ucc_tpu
+    lib = ucc_tpu.init()
+    ctx = ucc_tpu.Context(lib)                     # no OOB -> 1-rank world
+    team = ctx.create_team(ucc_tpu.TeamParams())
+    src = np.arange(4, dtype=np.float32); dst = np.zeros_like(src)
+    req = team.collective_init(ucc_tpu.CollArgs(
+        coll_type=ucc_tpu.CollType.ALLREDUCE,
+        src=ucc_tpu.BufferInfo(src, 4, ucc_tpu.DataType.FLOAT32),
+        dst=ucc_tpu.BufferInfo(dst, 4, ucc_tpu.DataType.FLOAT32),
+        op=ucc_tpu.ReductionOp.SUM))
+    req.post(); req.wait()
+"""
+
+from .constants import (CollArgsFlags, CollArgsHints, CollSyncType, CollType,  # noqa: F401
+                        DataType, EventType, GenericDataType, MemoryType,
+                        ReductionOp, ThreadMode, coll_type_str, dt_size)
+from .status import Status, UccError, check  # noqa: F401
+from .api.types import (ActiveSet, BufferInfo, BufferInfoV, CollArgs,  # noqa: F401
+                        ContextParams, ContextType, LibAttr, LibParams,
+                        OobColl, OobRequest, TeamAttr, TeamParams)
+from .core.lib import Lib, init  # noqa: F401
+from .core.context import Context  # noqa: F401
+from .core.team import Team, TeamState  # noqa: F401
+from .core.coll import CollRequest, collective_init  # noqa: F401
+from .core.oob import SubsetOob, TcpStoreOob, ThreadOob, ThreadOobWorld  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,164 @@
+"""Collective dispatch — the hot path.
+
+Reference: /root/reference/src/core/ucc_coll.c (``ucc_collective_init``:172):
+memtype auto-detect via MC (:25-36, :216), zero-size fast path with a stub
+task (:191-208), active-set restriction to bcast (:210-214), score-map
+lookup with fallback (:248), timeout stamping (:409), persistent post
+status checks (:362), user callback and coll trace (:329-345).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..api.types import (BufferInfo, BufferInfoV, CollArgs,
+                         coll_args_msgsize)
+from ..constants import (CollArgsFlags, CollType, MemoryType, coll_type_str)
+from ..mc.base import detect_mem_type
+from ..schedule.task import CollTask
+from ..status import Status, UccError
+from ..utils.log import get_logger
+from .team import Team
+
+logger = get_logger("coll")
+
+
+@dataclass
+class InitArgs:
+    """ucc_base_coll_args_t: resolved args handed to algorithm inits."""
+
+    args: CollArgs
+    team: Team
+    mem_type: MemoryType
+    msgsize: int
+
+
+class _StubTask(CollTask):
+    """Zero-size fast path (ucc_coll.c:191-208): completes at post."""
+
+    def post_fn(self) -> Status:
+        self.status = Status.OK
+        return Status.OK
+
+
+class CollRequest:
+    """ucc_coll_req_h: post/test/finalize + persistent re-post."""
+
+    def __init__(self, task: CollTask, team: Team, args: CollArgs):
+        self.task = task
+        self.team = team
+        self.args = args
+        self._posted = False
+
+    @property
+    def status(self) -> Status:
+        return self.task.super_status
+
+    def post(self) -> Status:
+        """ucc_collective_post (ucc_coll.c:375)."""
+        st = self.task.super_status
+        if self._posted:
+            if st == Status.IN_PROGRESS:
+                # COLL_POST_STATUS_CHECK (ucc_coll.c:362)
+                raise UccError(Status.ERR_INVALID_PARAM,
+                               "collective re-posted while in progress")
+            if not self.args.is_persistent:
+                raise UccError(Status.ERR_INVALID_PARAM,
+                               "re-post of non-persistent collective")
+            self.task.reset()
+        self._posted = True
+        self.task.progress_queue = self.team.context.progress_queue
+        if self.team.context.lib.config.coll_trace:
+            logger.info("coll post: %s team %s seq %d",
+                        coll_type_str(self.args.coll_type), self.team.id,
+                        self.task.seq_num)
+        return self.task.post()
+
+    def test(self) -> Status:
+        st = self.task.super_status
+        if st == Status.OPERATION_INITIALIZED:
+            return Status.OPERATION_INITIALIZED
+        return st
+
+    def wait(self, timeout: float = 60.0) -> Status:
+        deadline = time.monotonic() + timeout
+        while self.test() == Status.IN_PROGRESS:
+            self.team.context.progress()
+            if time.monotonic() > deadline:
+                raise UccError(Status.ERR_TIMED_OUT,
+                               "CollRequest.wait timed out")
+        return self.test()
+
+    def finalize(self) -> Status:
+        """ucc_collective_finalize (ucc_coll.c:460-508)."""
+        if self.task.super_status == Status.IN_PROGRESS:
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           "finalize of in-progress collective")
+        return self.task.finalize()
+
+
+def _resolve_mem_type(args: CollArgs) -> MemoryType:
+    """Memtype auto-detect (ucc_coll.c:25-36): prefer dst, else src."""
+    for bi in (args.dst, args.src):
+        if bi is None:
+            continue
+        if bi.mem_type is not None:
+            return bi.mem_type
+        mt = detect_mem_type(bi.buffer)
+        if mt != MemoryType.UNKNOWN:
+            bi.mem_type = mt
+            return mt
+    return MemoryType.HOST
+
+
+def _is_zero_size(args: CollArgs) -> bool:
+    ct = args.coll_type
+    if ct in (CollType.BARRIER, CollType.FANIN, CollType.FANOUT):
+        return False
+    for bi in (args.src, args.dst):
+        if bi is None:
+            continue
+        if isinstance(bi, BufferInfoV):
+            if bi.counts and any(int(c) > 0 for c in bi.counts):
+                return False
+        elif isinstance(bi, BufferInfo):
+            if bi.count > 0:
+                return False
+    return True
+
+
+def collective_init(args: CollArgs, team: Team) -> CollRequest:
+    """ucc_collective_init (ucc_coll.c:172)."""
+    if team.score_map is None:
+        raise UccError(Status.ERR_INVALID_PARAM, "team is not active")
+    ct = args.coll_type
+    if args.active_set is not None and ct != CollType.BCAST:
+        # reference restriction (ucc_coll.c:210-214)
+        raise UccError(Status.ERR_NOT_SUPPORTED,
+                       "active sets supported for bcast only")
+    if _is_zero_size(args):
+        task: CollTask = _StubTask()
+        req = CollRequest(task, team, args)
+        _attach_user_opts(task, args)
+        return req
+
+    mem_type = _resolve_mem_type(args)
+    msgsize = coll_args_msgsize(args, team.size, team.rank)
+    init_args = InitArgs(args=args, team=team, mem_type=mem_type,
+                         msgsize=msgsize)
+    assert team.score_map is not None
+    task, chosen = team.score_map.init_coll(ct, mem_type, msgsize, init_args)
+    if team.context.lib.config.coll_trace:
+        logger.info("coll init: %s/%s msgsize %d -> %s (score %d) team %s",
+                    coll_type_str(ct), mem_type.name.lower(), msgsize,
+                    chosen.alg_name or chosen.team, chosen.score, team.id)
+    _attach_user_opts(task, args)
+    return CollRequest(task, team, args)
+
+
+def _attach_user_opts(task: CollTask, args: CollArgs) -> None:
+    if args.flags & CollArgsFlags.TIMEOUT and args.timeout > 0:
+        task.timeout = args.timeout
+    if args.cb is not None:
+        task.cb = args.cb

@@ -1,0 +1,184 @@
+"""Component framework: base interfaces + discovery.
+
+Mirrors /root/reference/src/components/base/ucc_base_iface.h (lib/context/
+team/coll vtables), ucc_tl.h:71 (``ucc_tl_iface_t``) and ucc_cl.h:62
+(``ucc_cl_iface_t``). The reference discovers components by glob-dlopen of
+``libucc_<fw>_*.so`` (ucc_component.c:127,215); here discovery imports
+``ucc_tpu.tl.<name>`` / ``ucc_tpu.cl.<name>`` modules on demand and
+components self-register via the ``@register_tl`` / ``@register_cl``
+decorators. ``UCC_TLS`` / ``UCC_CLS`` env allow-lists select what loads
+(ucc_lib.c:23 defaults CLS=basic).
+"""
+from __future__ import annotations
+
+import importlib
+import pkgutil
+from typing import Any, Dict, List, Optional, Type
+
+from ..constants import CollType, MemoryType
+from ..score.score import CollScore
+from ..status import Status, UccError
+from ..utils.config import Config, ConfigTable
+from ..utils.ep_map import Subset
+from ..utils.log import get_logger
+
+logger = get_logger("core")
+
+
+class BaseLib:
+    """Per-(core lib × component) object (ucc_base_lib_iface_t :83)."""
+
+    def __init__(self, core_lib, config: Config):
+        self.core_lib = core_lib
+        self.config = config
+
+
+class BaseContext:
+    """Per-(core context × component) object (ucc_base_context_iface_t :121)."""
+
+    def __init__(self, comp_lib: BaseLib, core_context, config: Optional[Config]):
+        self.comp_lib = comp_lib
+        self.core_context = core_context
+        self.config = config
+
+    def pack_address(self) -> bytes:
+        """Worker address contributed to the context OOB exchange
+        (ucc_context.h:155-171 packed layout)."""
+        return b""
+
+    def unpack_addresses(self, addrs: Dict[int, bytes]) -> None:
+        """Receive peers' packed addresses keyed by ctx rank."""
+
+    def create_epilog(self) -> None:
+        """Post-exchange hook (tl/ucp preconnect analog, ucc_context.c:880)."""
+
+    def progress(self) -> None:
+        """Registered into the context progress loop when overridden."""
+
+    def destroy(self) -> None:
+        pass
+
+
+class BaseTeam:
+    """Component team (ucc_base_team_iface_t :176). Creation is
+    nonblocking: construct → poll create_test() until OK/error."""
+
+    def __init__(self, comp_context: BaseContext, core_team):
+        self.comp_context = comp_context
+        self.core_team = core_team
+
+    @property
+    def name(self) -> str:
+        return getattr(type(self), "NAME", "?")
+
+    def create_test(self) -> Status:
+        return Status.OK
+
+    def get_scores(self) -> CollScore:
+        raise NotImplementedError
+
+    def destroy(self) -> None:
+        pass
+
+
+class TransportLayer:
+    """TL component descriptor (ucc_tl_iface_t, ucc_tl.h:71)."""
+
+    NAME = "base"
+    DEFAULT_SCORE = 10            # selection prior (tl_ucp.h:21 =10 flavor)
+    SUPPORTED_COLLS: CollType = CollType(0)
+    SUPPORTED_MEM_TYPES = (MemoryType.HOST,)
+
+    LIB_CONFIG: Optional[ConfigTable] = None
+    CONTEXT_CONFIG: Optional[ConfigTable] = None
+
+    lib_cls: Type[BaseLib] = BaseLib
+    context_cls: Type[BaseContext] = BaseContext
+    team_cls: Type[BaseTeam] = BaseTeam
+
+    #: TLs that can serve as the core service team (ucc_tl.h:50 service
+    #: coll vtable). The core picks the first available in this order.
+    SERVICE_CAPABLE = False
+
+
+class CollectiveLayer:
+    """CL component descriptor (ucc_cl_iface_t, ucc_cl.h:62)."""
+
+    NAME = "base"
+    DEFAULT_SCORE = 50            # cl_hier.h:29 = 50 flavor
+    #: which TLs this CL wants (None = all loaded; per-CL TLS config can
+    #: narrow further, ucc_cl.h:44)
+    REQUIRED_TLS: Optional[List[str]] = None
+
+    LIB_CONFIG: Optional[ConfigTable] = None
+    CONTEXT_CONFIG: Optional[ConfigTable] = None
+
+    lib_cls: Type[BaseLib] = BaseLib
+    context_cls: Type[BaseContext] = BaseContext
+    team_cls: Type[BaseTeam] = BaseTeam
+
+
+# ---------------------------------------------------------------------------
+# registries + discovery
+# ---------------------------------------------------------------------------
+
+TL_REGISTRY: Dict[str, Type[TransportLayer]] = {}
+CL_REGISTRY: Dict[str, Type[CollectiveLayer]] = {}
+
+
+def register_tl(cls: Type[TransportLayer]) -> Type[TransportLayer]:
+    TL_REGISTRY[cls.NAME] = cls
+    return cls
+
+
+def register_cl(cls: Type[CollectiveLayer]) -> Type[CollectiveLayer]:
+    CL_REGISTRY[cls.NAME] = cls
+    return cls
+
+
+_discovered = False
+
+
+def discover_components() -> None:
+    """Import every module under ucc_tpu.tl / ucc_tpu.cl (the dlopen-glob
+    analog, ucc_component.c:127). Failures are logged and skipped, like the
+    reference tolerating missing optional .so deps."""
+    global _discovered
+    if _discovered:
+        return
+    _discovered = True
+    import ucc_tpu.cl as cl_pkg
+    import ucc_tpu.tl as tl_pkg
+    for pkg in (tl_pkg, cl_pkg):
+        for info in pkgutil.iter_modules(pkg.__path__):
+            if info.name.startswith("_") or info.name == "base":
+                continue
+            modname = f"{pkg.__name__}.{info.name}"
+            try:
+                importlib.import_module(modname)
+            except Exception as e:  # noqa: BLE001 - optional component
+                logger.warning("failed to load component %s: %s", modname, e)
+
+
+def get_tl(name: str) -> Type[TransportLayer]:
+    discover_components()
+    if name not in TL_REGISTRY:
+        raise UccError(Status.ERR_NOT_FOUND, f"TL '{name}' not found")
+    return TL_REGISTRY[name]
+
+
+def get_cl(name: str) -> Type[CollectiveLayer]:
+    discover_components()
+    if name not in CL_REGISTRY:
+        raise UccError(Status.ERR_NOT_FOUND, f"CL '{name}' not found")
+    return CL_REGISTRY[name]
+
+
+def available_tls() -> List[str]:
+    discover_components()
+    return sorted(TL_REGISTRY)
+
+
+def available_cls() -> List[str]:
+    discover_components()
+    return sorted(CL_REGISTRY)

@@ -1,0 +1,279 @@
+"""Built-in OOB bootstrap collectives.
+
+The reference takes OOB as a user callback (ucc_oob_coll_t, ucc.h:879-895)
+and its gtest harness implements it with threads + memcpy inside one process
+(test/gtest/common/test_ucc.h:88-119 ``ThreadAllgather``). ThreadOobWorld is
+that harness, productized: N in-process endpoints sharing a lock-protected
+round buffer — used by unit tests and by single-host multi-context runs.
+
+For real multi-process jobs, ``TcpStoreOob`` rendezvouses through a tiny
+TCP key-value store (torch-store / jax.distributed flavor), giving the same
+ordered-allgather contract over DCN.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api.types import OobColl, OobRequest
+from ..status import Status
+
+
+# ---------------------------------------------------------------------------
+# in-process thread OOB
+# ---------------------------------------------------------------------------
+
+class _ThreadRound:
+    def __init__(self, n: int):
+        self.contribs: List[Optional[bytes]] = [None] * n
+        self.n_arrived = 0
+        self.consumed = [False] * n
+
+
+class ThreadOobWorld:
+    """Shared state for N in-process OOB endpoints."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.lock = threading.Lock()
+        self.rounds: Dict[int, _ThreadRound] = {}
+        self.next_round = [0] * n  # per-endpoint round cursor
+
+    def endpoint(self, rank: int) -> "ThreadOob":
+        return ThreadOob(self, rank)
+
+    def endpoints(self) -> List["ThreadOob"]:
+        return [self.endpoint(r) for r in range(self.n)]
+
+
+class _ThreadOobRequest(OobRequest):
+    def __init__(self, world: ThreadOobWorld, round_idx: int, rank: int):
+        self.world = world
+        self.round_idx = round_idx
+        self.rank = rank
+        self._cached: Optional[List[bytes]] = None
+
+    def test(self) -> Status:
+        with self.world.lock:
+            rnd = self.world.rounds.get(self.round_idx)
+            if rnd is None:
+                return Status.OK  # already consumed+GC'd via result
+            if rnd.n_arrived == self.world.n:
+                return Status.OK
+        return Status.IN_PROGRESS
+
+    @property
+    def result(self) -> List[bytes]:
+        if self._cached is not None:
+            return self._cached
+        with self.world.lock:
+            rnd = self.world.rounds[self.round_idx]
+            self._cached = list(rnd.contribs)  # type: ignore[arg-type]
+            rnd.consumed[self.rank] = True
+            # GC only when every endpoint has read this round's result
+            if all(rnd.consumed) and rnd.n_arrived == self.world.n:
+                self.world.rounds.pop(self.round_idx, None)
+        return self._cached
+
+
+class ThreadOob(OobColl):
+    def __init__(self, world: ThreadOobWorld, rank: int):
+        self.world = world
+        self.rank = rank
+
+    @property
+    def oob_ep(self) -> int:
+        return self.rank
+
+    @property
+    def n_oob_eps(self) -> int:
+        return self.world.n
+
+    def allgather(self, data: bytes) -> OobRequest:
+        w = self.world
+        with w.lock:
+            idx = w.next_round[self.rank]
+            w.next_round[self.rank] += 1
+            rnd = w.rounds.get(idx)
+            if rnd is None:
+                rnd = w.rounds[idx] = _ThreadRound(w.n)
+            rnd.contribs[self.rank] = bytes(data)
+            rnd.n_arrived += 1
+        return _ThreadOobRequest(w, idx, self.rank)
+
+
+class SubsetOob(OobColl):
+    """Team-level OOB built from a parent OOB restricted to a subset of
+    ranks — what UccTeam::allgather does in the reference gtest harness
+    (test_ucc.h:179-183).
+
+    CONTRACT: every allgather on a SubsetOob rides a full parent-OOB round,
+    so every NON-member of the subset must call ``SubsetOob.participate(
+    parent)`` once per subset round, or the members' requests never
+    complete. ``Team.create_from_parent`` does this automatically (it uses
+    exactly one round); using SubsetOob directly requires honoring this."""
+
+    def __init__(self, parent: OobColl, ranks: List[int]):
+        self.parent = parent
+        self.ranks = list(ranks)
+        if parent.oob_ep not in self.ranks:
+            raise ValueError("SubsetOob endpoint not in subset")
+        self.my = self.ranks.index(parent.oob_ep)
+
+    @staticmethod
+    def participate(parent: OobColl) -> OobRequest:
+        """Non-member contribution to one subset round (dummy payload)."""
+        return parent.allgather(b"")
+
+    @property
+    def oob_ep(self) -> int:
+        return self.my
+
+    @property
+    def n_oob_eps(self) -> int:
+        return len(self.ranks)
+
+    def allgather(self, data: bytes) -> OobRequest:
+        inner = self.parent.allgather(data)
+        return _SubsetOobRequest(inner, self.ranks)
+
+
+class _SubsetOobRequest(OobRequest):
+    def __init__(self, inner: OobRequest, ranks: List[int]):
+        self.inner = inner
+        self.ranks = ranks
+
+    def test(self) -> Status:
+        return self.inner.test()
+
+    @property
+    def result(self) -> List[bytes]:
+        full = self.inner.result
+        return [full[r] for r in self.ranks]
+
+
+# ---------------------------------------------------------------------------
+# TCP store OOB (multi-process DCN bootstrap)
+# ---------------------------------------------------------------------------
+
+_MSG = struct.Struct("!II")  # rank, payload length
+
+
+class TcpStoreOob(OobColl):
+    """Rank 0 hosts a tiny allgather server; everyone else connects.
+    Synchronous under the hood but exposed through the nonblocking
+    OobRequest contract."""
+
+    def __init__(self, rank: int, size: int, host: str = "127.0.0.1",
+                 port: int = 29999):
+        self.rank = rank
+        self.size = size
+        self.addr = (host, port)
+        self._server: Optional[_StoreServer] = None
+        self._sock: Optional[socket.socket] = None
+        if rank == 0:
+            self._server = _StoreServer(size, (host, port))
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                self._sock = socket.create_connection(self.addr, timeout=5)
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    @property
+    def oob_ep(self) -> int:
+        return self.rank
+
+    @property
+    def n_oob_eps(self) -> int:
+        return self.size
+
+    def allgather(self, data: bytes) -> OobRequest:
+        sock = self._sock
+        assert sock is not None
+        sock.sendall(_MSG.pack(self.rank, len(data)) + data)
+        return _TcpOobRequest(sock, self.size)
+
+    def close(self) -> None:
+        if self._sock:
+            self._sock.close()
+        if self._server:
+            self._server.close()
+
+
+class _TcpOobRequest(OobRequest):
+    def __init__(self, sock: socket.socket, size: int):
+        self.sock = sock
+        self.size = size
+        self._result: Optional[List[bytes]] = None
+
+    def test(self) -> Status:
+        if self._result is None:
+            # one blob: pickled list of all contributions
+            hdr = _recv_exact(self.sock, 4)
+            (ln,) = struct.unpack("!I", hdr)
+            self._result = pickle.loads(_recv_exact(self.sock, ln))
+        return Status.OK
+
+    @property
+    def result(self) -> List[bytes]:
+        self.test()
+        assert self._result is not None
+        return self._result
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("OOB peer closed")
+        buf += chunk
+    return buf
+
+
+class _StoreServer:
+    def __init__(self, size: int, addr):
+        self.size = size
+        self.lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.lsock.bind(addr)
+        self.lsock.listen(size)
+        self.conns: List[socket.socket] = []
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self) -> None:
+        try:
+            while len(self.conns) < self.size:
+                c, _ = self.lsock.accept()
+                c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self.conns.append(c)
+            while True:
+                contribs: List[Optional[bytes]] = [None] * self.size
+                for c in list(self.conns):
+                    hdr = _recv_exact(c, _MSG.size)
+                    rank, ln = _MSG.unpack(hdr)
+                    contribs[rank] = _recv_exact(c, ln)
+                blob = pickle.dumps(contribs)
+                out = struct.pack("!I", len(blob)) + blob
+                for c in self.conns:
+                    c.sendall(out)
+        except (ConnectionError, OSError):
+            return
+
+    def close(self) -> None:
+        try:
+            self.lsock.close()
+            for c in self.conns:
+                c.close()
+        except OSError:
+            pass

@@ -1,0 +1,281 @@
+"""Team — a group of ranks that can run collectives.
+
+Reference: /root/reference/src/core/ucc_team.c. Creation is a nonblocking
+state machine (ucc_team.h:21-27, ucc_team_create_test_single:425-492):
+
+    ADDR_EXCHANGE -> SERVICE_TEAM -> ALLOC_ID -> CL_CREATE -> ACTIVE
+
+- ADDR_EXCHANGE: per-team OOB allgather of context ranks -> ``ctx_map``
+  (ucc_team.c:334-384). We additionally derive a process-unique team key
+  (leader's context counter) that scopes p2p message tags before the real
+  team id exists.
+- SERVICE_TEAM: internal TL team (reference: TL/UCP with scope
+  UCC_CL_LAST+1, :228-269) providing service collectives for the core.
+- ALLOC_ID: service allreduce(MAX) over proposed ids (reference uses an id
+  pool bitmap — same contract: all members agree on a fresh id).
+- CL_CREATE: create each CL's team; failures fall back to remaining CLs
+  (:295-317).
+- ACTIVE: merge all CL scores into the team score map (:386-423) and
+  optionally dump it.
+"""
+from __future__ import annotations
+
+import enum
+import pickle
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..api.types import OobRequest, TeamAttr, TeamParams
+from ..constants import ReductionOp
+from ..score.score import CollScore
+from ..score.score_map import ScoreMap
+from ..status import Status, UccError
+from ..topo.topo import TeamTopo
+from ..utils.ep_map import EpMap
+from ..utils.log import get_logger
+from .context import Context
+
+logger = get_logger("core")
+
+
+class TeamState(enum.IntEnum):
+    ADDR_EXCHANGE = 0
+    SERVICE_TEAM = 1
+    ALLOC_ID = 2
+    CL_CREATE = 3
+    ACTIVE = 4
+    FAILED = 5
+
+
+class Team:
+    """ucc_team_h. Construct via Context.create_team_post()."""
+
+    def __init__(self, context: Context, params: Optional[TeamParams] = None):
+        self.context = context
+        self.params = params or TeamParams()
+        p = self.params
+        self.oob = p.oob
+        if self.oob is not None:
+            self.rank = self.oob.oob_ep
+            self.size = self.oob.n_oob_eps
+        elif p.ep_map is not None:
+            self.ep_map = p.ep_map
+            self.rank = p.ep if p.ep is not None else 0
+            self.size = p.ep_map.ep_num
+        else:
+            self.rank = 0
+            self.size = 1
+        self.ctx_map: Optional[EpMap] = None
+        self.team_key: Any = None
+        self.id: Optional[int] = p.id
+        self.state = TeamState.ADDR_EXCHANGE
+        self.service_team = None
+        self.cl_teams: List[Any] = []
+        self.score_map: Optional[ScoreMap] = None
+        self.topo: Optional[TeamTopo] = None
+        self.seq_num = 0            # per-team collective tag counter
+        self._pending_req: Optional[OobRequest] = None
+        self._pending_task = None
+        self._cl_iter: Optional[List] = None
+        self._cl_current = None
+        self._failed_status = Status.OK
+        self._start_state_machine()
+
+    # ------------------------------------------------------------------
+    def _start_state_machine(self) -> None:
+        if self.oob is not None:
+            # exchange (ctx_rank, leader_counter) (ucc_team_exchange :334)
+            leader_counter = -1
+            if self.rank == 0:
+                leader_counter = self.context._team_id_counter
+                self.context._team_id_counter += 1
+            payload = pickle.dumps((self.context.rank, leader_counter,
+                                    self.context.proc_info.pid))
+            self._pending_req = self.oob.allgather(payload)
+        else:
+            # no per-team OOB: ctx_map from params or trivial
+            self.ctx_map = getattr(self, "ep_map", None) or EpMap.full(self.size)
+            self.team_key = ("local", id(self.context),
+                             self.context._team_id_counter)
+            self.context._team_id_counter += 1
+            self.state = TeamState.SERVICE_TEAM
+
+    def create_test(self) -> Status:
+        """ucc_team_create_test (ucc_team.c:494 -> :425 state machine)."""
+        try:
+            return self._create_test_inner()
+        except UccError as e:
+            logger.error("team create failed in state %s: %s",
+                         self.state.name, e)
+            self.state = TeamState.FAILED
+            self._failed_status = e.status
+            return e.status
+
+    def _create_test_inner(self) -> Status:
+        if self.state == TeamState.ADDR_EXCHANGE:
+            req = self._pending_req
+            if req is not None:
+                if req.test() == Status.IN_PROGRESS:
+                    return Status.IN_PROGRESS
+                entries = [pickle.loads(b) for b in req.result]
+                req.free()
+                self._pending_req = None
+                self.ctx_map = EpMap.from_array([e[0] for e in entries])
+                leader = entries[0]
+                self.team_key = (tuple(int(e[0]) for e in entries),
+                                 leader[1], leader[2])
+            self.state = TeamState.SERVICE_TEAM
+
+        if self.state == TeamState.SERVICE_TEAM:
+            if self.service_team is None:
+                self.service_team = self._create_service_team()
+            if self.service_team is not None:
+                st = self.service_team.create_test()
+                if st == Status.IN_PROGRESS:
+                    return Status.IN_PROGRESS
+                if st.is_error:
+                    raise UccError(st, "service team create failed")
+            self.state = TeamState.ALLOC_ID
+
+        if self.state == TeamState.ALLOC_ID:
+            st = self._alloc_id_step()
+            if st == Status.IN_PROGRESS:
+                return st
+            self.state = TeamState.CL_CREATE
+
+        if self.state == TeamState.CL_CREATE:
+            st = self._cl_create_step()
+            if st == Status.IN_PROGRESS:
+                return st
+            # build topo before activating (ucc_team.c:280-289)
+            assert self.context.topo is not None and self.ctx_map is not None
+            self.topo = TeamTopo(self.context.topo, self.ctx_map, self.rank)
+            self._build_score_map()
+            self.state = TeamState.ACTIVE
+
+        if self.state == TeamState.ACTIVE:
+            return Status.OK
+        if self.state == TeamState.FAILED:
+            return self._failed_status if self._failed_status.is_error \
+                else Status.ERR_NO_RESOURCE
+        return Status.IN_PROGRESS
+
+    # ------------------------------------------------------------------
+    def _create_service_team(self):
+        """Pick the first service-capable TL that accepts this team
+        (reference hardcodes TL/UCP, ucc_team.c:228-269; we search)."""
+        order = sorted(
+            self.context.tl_contexts.items(),
+            key=lambda kv: (not kv[1].tl_lib.tl_cls.SERVICE_CAPABLE,
+                            -kv[1].tl_lib.tl_cls.DEFAULT_SCORE))
+        for name, handle in order:
+            tl_cls = handle.tl_lib.tl_cls
+            if not tl_cls.SERVICE_CAPABLE:
+                continue
+            try:
+                team = tl_cls.team_cls(handle.obj, self, scope="svc")
+                return team
+            except UccError:
+                continue
+        return None
+
+    def _alloc_id_step(self) -> Status:
+        if self.id is not None:
+            return Status.OK
+        if self.size == 1 or self.service_team is None or \
+                not hasattr(self.service_team, "service_allreduce"):
+            self.id = self.context._team_id_counter
+            self.context._team_id_counter += 1
+            return Status.OK
+        if self._pending_task is None:
+            proposal = np.array([self.context._team_id_counter],
+                                dtype=np.int64)
+            self._pending_task = self.service_team.service_allreduce(
+                proposal, ReductionOp.MAX)
+            self._pending_task.post()
+        task = self._pending_task
+        if not task.is_completed():
+            return Status.IN_PROGRESS
+        if task.super_status.is_error:
+            raise UccError(task.super_status, "team id allreduce failed")
+        new_id = int(task.result[0])
+        self._pending_task = None
+        self.id = new_id
+        self.context._team_id_counter = new_id + 1
+        return Status.OK
+
+    def _cl_create_step(self) -> Status:
+        if self._cl_iter is None:
+            self._cl_iter = list(self.context.cl_contexts.values())
+        while self._cl_iter or self._cl_current is not None:
+            if self._cl_current is None:
+                handle = self._cl_iter.pop(0)
+                cl_cls = handle.cl_lib.cl_cls
+                try:
+                    self._cl_current = cl_cls.team_cls(handle.obj, self)
+                except UccError as e:
+                    logger.warning("CL %s team create failed: %s; falling "
+                                   "back", cl_cls.NAME, e)
+                    continue
+            st = self._cl_current.create_test()
+            if st == Status.IN_PROGRESS:
+                return Status.IN_PROGRESS
+            if st.is_error:
+                logger.warning("CL %s team create failed (%s); falling back",
+                               self._cl_current.name, st)
+                self._cl_current.destroy()
+            else:
+                self.cl_teams.append(self._cl_current)
+            self._cl_current = None
+        if not self.cl_teams:
+            raise UccError(Status.ERR_NO_RESOURCE,
+                           "no CL could create a team")
+        return Status.OK
+
+    def _build_score_map(self) -> None:
+        """ucc_team_build_score_map (ucc_team.c:386-423)."""
+        merged = CollScore()
+        for cl_team in self.cl_teams:
+            merged = merged.merge(cl_team.get_scores())
+        self.score_map = ScoreMap(merged)
+        if self.context.lib.config.coll_trace:
+            logger.info("%s", self.score_map.print_info(
+                f"team {self.id} size {self.size}"))
+
+    # ------------------------------------------------------------------
+    def get_attr(self) -> TeamAttr:
+        return TeamAttr(size=self.size, ep=self.rank,
+                        coll_types=self.context.lib.attr.coll_types)
+
+    def next_tag(self) -> int:
+        self.seq_num += 1
+        return self.seq_num
+
+    def collective_init(self, args):
+        from .coll import collective_init
+        return collective_init(args, self)
+
+    def destroy(self) -> Status:
+        for cl_team in self.cl_teams:
+            cl_team.destroy()
+        if self.service_team is not None:
+            self.service_team.destroy()
+        return Status.OK
+
+    @classmethod
+    def create_from_parent(cls, parent: "Team",
+                           ranks: List[int]) -> Optional["Team"]:
+        """ucc_team_create_from_parent (ucc.h:1656): split by explicit
+        parent-team ranks. ALL parent ranks must call this (reference
+        semantics: every rank passes include/exclude); non-members
+        contribute a dummy OOB round and get None back."""
+        from .oob import SubsetOob
+        if parent.oob is None:
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           "parent team has no OOB to split")
+        if parent.rank not in ranks:
+            SubsetOob.participate(parent.oob)   # keep members' round whole
+            return None
+        sub_oob = SubsetOob(parent.oob, ranks)
+        return Team(parent.context, TeamParams(oob=sub_oob))

@@ -1,0 +1,111 @@
+"""Execution components (EC) — compute executors.
+
+Reference: /root/reference/src/components/ec/base/ucc_ec_base.h — an
+executor is a queue of compute tasks of types REDUCE / REDUCE_STRIDED /
+REDUCE_MULTI_DST / COPY / COPY_MULTI (:64-71), arg structs (:99-174), with
+the alpha-scaling flag used to implement AVG as SUM×(1/N) (:97-98).
+``UCC_EE_EXECUTOR_NUM_BUFS = 9`` caps how many source buffers one reduce
+task takes — which in turn caps the knomial radix
+(allreduce_knomial.c:208-209); preserved here for parity.
+
+TPU mapping: EcCpu reduces with numpy on the host path; EcTpu (ec/tpu.py)
+dispatches jitted/Pallas kernels and completes asynchronously — same task
+API, device-driven completion.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..constants import DataType, MemoryType, ReductionOp
+from ..status import Status, UccError
+
+EXECUTOR_NUM_BUFS = 9   # ucc_ec_base.h: UCC_EE_EXECUTOR_NUM_BUFS
+
+
+class ExecutorTaskType(enum.IntEnum):
+    REDUCE = 0
+    REDUCE_STRIDED = 1
+    REDUCE_MULTI_DST = 2
+    COPY = 3
+    COPY_MULTI = 4
+
+
+@dataclass
+class ExecutorTask:
+    task_type: ExecutorTaskType
+    status: Status = Status.IN_PROGRESS
+    payload: Any = None
+
+
+class Executor:
+    """ucc_ee_executor: init/start/task_post/task_test/task_finalize/stop
+    (ucc_ec.h:29-47)."""
+
+    EC_NAME = "base"
+
+    def __init__(self):
+        self.started = False
+        self.context = None
+
+    def start(self, context: Any = None) -> Status:
+        self.started = True
+        self.context = context
+        return Status.OK
+
+    def stop(self) -> Status:
+        self.started = False
+        return Status.OK
+
+    def finalize(self) -> Status:
+        return Status.OK
+
+    # ------------------------------------------------------------------
+    def reduce(self, dst, srcs: Sequence[Any], count: int, dt: DataType,
+               op: ReductionOp, alpha: Optional[float] = None) -> ExecutorTask:
+        raise NotImplementedError
+
+    def reduce_strided(self, dst, src1, src2_base, stride_bytes: int,
+                       n_src2: int, count: int, dt: DataType,
+                       op: ReductionOp,
+                       alpha: Optional[float] = None) -> ExecutorTask:
+        raise NotImplementedError
+
+    def reduce_multi_dst(self, jobs: Sequence[dict]) -> ExecutorTask:
+        """jobs: [{dst, src1, src2, count, dt, op, alpha?}]"""
+        raise NotImplementedError
+
+    def copy(self, dst, src, size_bytes: int) -> ExecutorTask:
+        raise NotImplementedError
+
+    def copy_multi(self, pairs: Sequence[tuple]) -> ExecutorTask:
+        """pairs: [(dst, src, size_bytes)]"""
+        raise NotImplementedError
+
+    def task_test(self, task: ExecutorTask) -> Status:
+        return task.status
+
+    def task_finalize(self, task: ExecutorTask) -> None:
+        pass
+
+
+_executors: Dict[MemoryType, Any] = {}
+
+
+def register_ec(mem_type: MemoryType, executor_cls) -> None:
+    _executors[mem_type] = executor_cls
+
+
+def create_executor(mem_type: MemoryType) -> Executor:
+    _ensure_defaults()
+    if mem_type not in _executors:
+        raise UccError(Status.ERR_NOT_FOUND,
+                       f"no execution component for {mem_type.name}")
+    return _executors[mem_type]()
+
+
+def _ensure_defaults() -> None:
+    if MemoryType.HOST not in _executors:
+        from .cpu import EcCpu
+        register_ec(MemoryType.HOST, EcCpu)

@@ -1,0 +1,156 @@
+"""Host execution component — synchronous numpy reductions.
+
+Reference: /root/reference/src/components/ec/cpu (ec_cpu.c, ec_cpu_reduce.c)
+— macro-generated reduction loops for every (op × dtype); here one
+vectorized numpy kernel per op. All 13 reduction ops are supported,
+including AVG via the alpha post-scale flag (ucc_ec_base.h:97-98) and
+MINLOC/MAXLOC over (value, index) pairs (MPI-style loc semantics: value
+compared, lowest index wins ties).
+
+Half-precision (float16/bfloat16) accumulates in float32 and casts back,
+matching the reference CUDA executor's half kernels
+(kernel/ec_cuda_half_sm52.h) rather than accumulating in half.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..constants import DataType, ReductionOp, dt_numpy, dt_size
+from ..status import Status, UccError
+from .base import (EXECUTOR_NUM_BUFS, Executor, ExecutorTask,
+                   ExecutorTaskType)
+
+_LOGICAL = (ReductionOp.LAND, ReductionOp.LOR, ReductionOp.LXOR)
+_BITWISE = (ReductionOp.BAND, ReductionOp.BOR, ReductionOp.BXOR)
+_LOC_OPS = (ReductionOp.MINLOC, ReductionOp.MAXLOC)
+_HALF = (np.float16,)
+
+
+def _as_typed(buf: Any, count: int, nd: np.dtype) -> np.ndarray:
+    """View a buffer as `count` elements of dtype nd (zero-copy)."""
+    if isinstance(buf, np.ndarray):
+        if buf.dtype == nd:
+            return buf.reshape(-1)[:count]
+        return buf.reshape(-1).view(nd)[:count]
+    return np.frombuffer(buf, dtype=nd, count=count)
+
+
+def reduce_arrays(srcs: Sequence[np.ndarray], op: ReductionOp,
+                  dt: DataType, alpha: Optional[float] = None) -> np.ndarray:
+    """Reduce a list of equally-shaped 1-D typed arrays."""
+    nd = dt_numpy(dt)
+    is_float_like = np.issubdtype(nd, np.floating) or \
+        nd.name == "bfloat16" or np.issubdtype(nd, np.complexfloating)
+
+    if op in _LOC_OPS:
+        return _reduce_loc(srcs, op)
+
+    compute = srcs
+    if nd.type in _HALF or nd.name == "bfloat16":
+        compute = [s.astype(np.float32) for s in srcs]
+
+    acc = compute[0]
+    if op in (ReductionOp.SUM, ReductionOp.AVG):
+        acc = np.sum(compute, axis=0)
+    elif op == ReductionOp.PROD:
+        acc = compute[0].copy()
+        for s in compute[1:]:
+            acc = acc * s
+    elif op == ReductionOp.MAX:
+        acc = np.maximum.reduce(compute)
+    elif op == ReductionOp.MIN:
+        acc = np.minimum.reduce(compute)
+    elif op == ReductionOp.LAND:
+        acc = np.logical_and.reduce(compute)
+    elif op == ReductionOp.LOR:
+        acc = np.logical_or.reduce(compute)
+    elif op == ReductionOp.LXOR:
+        acc = np.logical_xor.reduce([c.astype(bool) for c in compute])
+    elif op in _BITWISE:
+        if is_float_like:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           f"{op.name} on floating-point dtype")
+        ufunc = {ReductionOp.BAND: np.bitwise_and,
+                 ReductionOp.BOR: np.bitwise_or,
+                 ReductionOp.BXOR: np.bitwise_xor}[op]
+        acc = ufunc.reduce(compute)
+    elif op not in (ReductionOp.SUM, ReductionOp.AVG):
+        raise UccError(Status.ERR_NOT_SUPPORTED, f"op {op}")
+
+    if op in _LOGICAL:
+        acc = acc.astype(nd)
+    if alpha is not None:
+        acc = acc * alpha
+    return acc.astype(nd) if acc.dtype != nd else acc
+
+
+def _reduce_loc(srcs: Sequence[np.ndarray], op: ReductionOp) -> np.ndarray:
+    """MINLOC/MAXLOC over flattened (value, index) pairs."""
+    if srcs[0].size % 2 != 0:
+        raise UccError(Status.ERR_INVALID_PARAM,
+                       "MINLOC/MAXLOC requires (value, index) pairs")
+    pairs = [s.reshape(-1, 2) for s in srcs]
+    vals = np.stack([p[:, 0] for p in pairs])          # (n_src, n)
+    idxs = np.stack([p[:, 1] for p in pairs])
+    if op == ReductionOp.MINLOC:
+        best = np.argmin(vals, axis=0)
+    else:
+        best = np.argmax(vals, axis=0)
+    # ties: lowest index wins (MPI semantics)
+    sel_val = vals[best, np.arange(vals.shape[1])]
+    ties = vals == sel_val[None, :]
+    tie_idx = np.where(ties, idxs, np.inf)
+    sel_idx = np.min(tie_idx, axis=0)
+    out = np.empty_like(pairs[0])
+    out[:, 0] = sel_val
+    out[:, 1] = sel_idx
+    return out.reshape(-1)
+
+
+class EcCpu(Executor):
+    """Synchronous executor: every task completes at post time."""
+
+    EC_NAME = "cpu"
+
+    # ------------------------------------------------------------------
+    def reduce(self, dst, srcs, count, dt, op, alpha=None) -> ExecutorTask:
+        if len(srcs) > EXECUTOR_NUM_BUFS:
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           f"reduce takes at most {EXECUTOR_NUM_BUFS} bufs")
+        nd = dt_numpy(dt)
+        typed = [_as_typed(s, count, nd) for s in srcs]
+        res = reduce_arrays(typed, op, dt, alpha)
+        _as_typed(dst, count, nd)[:] = res
+        return ExecutorTask(ExecutorTaskType.REDUCE, Status.OK)
+
+    def reduce_strided(self, dst, src1, src2_base, stride_bytes, n_src2,
+                       count, dt, op, alpha=None) -> ExecutorTask:
+        nd = dt_numpy(dt)
+        esz = dt_size(dt)
+        if stride_bytes % esz != 0:
+            raise UccError(Status.ERR_INVALID_PARAM, "unaligned stride")
+        stride = stride_bytes // esz
+        base = _as_typed(src2_base, stride * max(n_src2 - 1, 0) + count, nd)
+        srcs = [_as_typed(src1, count, nd)] + \
+            [base[i * stride:i * stride + count] for i in range(n_src2)]
+        res = reduce_arrays(srcs, op, dt, alpha)
+        _as_typed(dst, count, nd)[:] = res
+        return ExecutorTask(ExecutorTaskType.REDUCE_STRIDED, Status.OK)
+
+    def reduce_multi_dst(self, jobs) -> ExecutorTask:
+        for j in jobs:
+            self.reduce(j["dst"], [j["src1"], j["src2"]], j["count"],
+                        j["dt"], j["op"], j.get("alpha"))
+        return ExecutorTask(ExecutorTaskType.REDUCE_MULTI_DST, Status.OK)
+
+    def copy(self, dst, src, size_bytes) -> ExecutorTask:
+        from ..mc.cpu import _as_u8
+        _as_u8(dst)[:size_bytes] = _as_u8(src)[:size_bytes]
+        return ExecutorTask(ExecutorTaskType.COPY, Status.OK)
+
+    def copy_multi(self, pairs) -> ExecutorTask:
+        for dst, src, nb in pairs:
+            self.copy(dst, src, nb)
+        return ExecutorTask(ExecutorTaskType.COPY_MULTI, Status.OK)

@@ -1,0 +1,100 @@
+"""Memory components (MC).
+
+Reference: /root/reference/src/components/mc/ — dispatch by memory type with
+an ops vtable {mem_query, mem_alloc, mem_free, memcpy, memset, flush}
+(mc/base/ucc_mc_base.h:104-113). MC is how ``collective_init`` auto-detects
+buffer memory type (ucc_coll.c:25-36).
+
+TPU mapping: MemoryType.HOST -> numpy/host DRAM (mc/cpu); MemoryType.TPU ->
+jax.Array in HBM (mc/tpu). Detection must not import jax unless a non-host
+object shows up, keeping the host path dependency-light.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..constants import MemoryType
+from ..status import Status, UccError
+
+
+@dataclass
+class MemAttr:
+    """ucc_mem_attr_t: memory type + base/size when resolvable."""
+
+    mem_type: MemoryType
+    base: Any = None
+    size: int = 0
+
+
+class MemoryComponent:
+    NAME = "base"
+    MEM_TYPE = MemoryType.UNKNOWN
+
+    def mem_query(self, obj: Any) -> Optional[MemAttr]:
+        """Return MemAttr if *obj* belongs to this component, else None."""
+        raise NotImplementedError
+
+    def alloc(self, size_bytes: int) -> Any:
+        raise NotImplementedError
+
+    def free(self, buf: Any) -> None:
+        pass
+
+    def memcpy(self, dst: Any, src: Any, size_bytes: int) -> None:
+        raise NotImplementedError
+
+    def memset(self, buf: Any, value: int, size_bytes: int) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+
+_components: Dict[MemoryType, MemoryComponent] = {}
+
+
+def register_mc(mc: MemoryComponent) -> MemoryComponent:
+    _components[mc.MEM_TYPE] = mc
+    return mc
+
+
+def get_mc(mem_type: MemoryType) -> MemoryComponent:
+    _ensure_defaults()
+    if mem_type not in _components:
+        raise UccError(Status.ERR_NOT_FOUND,
+                       f"no memory component for {mem_type.name}")
+    return _components[mem_type]
+
+
+def detect_mem_type(obj: Any) -> MemoryType:
+    """ucc_coll.c:25-36 memtype auto-detection. numpy/buffer-protocol ->
+    HOST; jax.Array -> TPU (or TPU_PINNED when committed to a CPU device
+    while TPU is the default backend)."""
+    _ensure_defaults()
+    if obj is None:
+        return MemoryType.HOST
+    if isinstance(obj, np.ndarray) or isinstance(obj, (bytes, bytearray, memoryview)):
+        return MemoryType.HOST
+    # avoid importing jax for pure-host programs
+    import sys
+    if "jax" in sys.modules:
+        import jax
+        if isinstance(obj, jax.Array):
+            try:
+                platform = list(obj.devices())[0].platform
+            except Exception:  # noqa: BLE001
+                platform = "unknown"
+            return MemoryType.HOST if platform == "cpu" and \
+                jax.default_backend() == "cpu" else MemoryType.TPU
+    if hasattr(obj, "__array_interface__") or hasattr(obj, "__buffer__"):
+        return MemoryType.HOST
+    return MemoryType.UNKNOWN
+
+
+def _ensure_defaults() -> None:
+    if MemoryType.HOST not in _components:
+        from .cpu import McCpu
+        register_mc(McCpu())

@@ -74,7 +74,13 @@ class ProgressQueue:
                 task.complete(Status.ERR_TIMED_OUT)
                 completed += 1
                 continue
-            task.progress()
+            try:
+                task.progress()
+            except Exception:  # noqa: BLE001 - a broken task must not kill
+                # an unrelated caller's progress loop; fail it instead
+                task.complete(Status.ERR_NO_MESSAGE)
+                completed += 1
+                continue
             if task.status != Status.IN_PROGRESS:
                 if not task.is_completed():
                     task.complete()

@@ -1,0 +1,3 @@
+from .score import (CollScore, MsgRange, SCORE_INVALID, SCORE_MAX,  # noqa: F401
+                    TuneSection, parse_tune_str)
+from .score_map import ScoreMap  # noqa: F401

@@ -1,0 +1,86 @@
+"""Compiled score map with fallback chains.
+
+Reference: /root/reference/src/coll_score/ucc_coll_score_map.c. At team
+activation the merged CollScore is compiled into a lookup structure;
+``lookup(coll, mem, msgsize)`` returns candidates sorted best-first, and
+``map_init_coll`` walks the fallback chain when a candidate's init returns
+ERR_NOT_SUPPORTED (ucc_coll_score_map.c:114-139). The team-creation score
+dump (`ucc_coll_score_map_print_info`, shown via UCC_COLL_TRACE/team logs)
+is preserved as ``print_info()``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..constants import CollType, MemoryType, coll_type_str
+from ..status import Status, UccError
+from ..utils.log import get_logger
+from .score import CollScore, MsgRange, SCORE_MAX
+
+logger = get_logger("score")
+
+
+class ScoreMap:
+    def __init__(self, score: CollScore):
+        self._score = score
+        # candidates pre-sorted by score desc per (coll, mem)
+        self._sorted = {
+            key: sorted(lst, key=lambda r: -r.score)
+            for key, lst in score.ranges.items()
+        }
+
+    def lookup(self, coll: CollType, mem: MemoryType,
+               msgsize: int) -> List[MsgRange]:
+        """All candidates whose range contains msgsize, best score first."""
+        lst = self._sorted.get((coll, mem), [])
+        # score 0 disables a candidate (reference: `alltoall:0` tune disables
+        # the coll for that component)
+        return [r for r in lst if r.contains(msgsize) and r.score > 0]
+
+    def init_coll(self, coll: CollType, mem: MemoryType, msgsize: int,
+                  init_args) -> Tuple[Any, MsgRange]:
+        """ucc_coll_init (ucc_coll_score_map.c:114): try winner, walk
+        fallbacks on ERR_NOT_SUPPORTED. Returns (task, chosen_range)."""
+        candidates = self.lookup(coll, mem, msgsize)
+        if not candidates:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           f"no candidates for {coll_type_str(coll)}/"
+                           f"{mem.name.lower()} msgsize={msgsize}")
+        last_err: Optional[UccError] = None
+        for cand in candidates:
+            if cand.init is None:
+                continue
+            try:
+                task = cand.init(init_args, cand.team)
+                return task, cand
+            except UccError as e:
+                if e.status == Status.ERR_NOT_SUPPORTED:
+                    logger.debug(
+                        "fallback: %s/%s msgsize=%d alg=%s not supported, "
+                        "trying next", coll_type_str(coll), mem.name.lower(),
+                        msgsize, cand.alg_name or "?")
+                    last_err = e
+                    continue
+                raise
+        raise last_err or UccError(Status.ERR_NOT_SUPPORTED,
+                                   f"all candidates failed for "
+                                   f"{coll_type_str(coll)}")
+
+    def supported_colls(self) -> List[Tuple[CollType, MemoryType]]:
+        return sorted(self._sorted.keys())
+
+    def print_info(self, team_name: str = "team") -> str:
+        """Score-map dump like the reference team-create log
+        (docs/user_guide.md:330+)."""
+        from ..utils.config import memunits_str
+        lines = [f"ucc_tpu score map for {team_name}:"]
+        for (c, m), lst in sorted(self._sorted.items()):
+            segs = []
+            for r in lst:
+                score = "inf" if r.score >= SCORE_MAX else str(r.score)
+                name = r.alg_name or (getattr(r.team, "name", "") or "?")
+                segs.append(f"[{memunits_str(r.start)}..{memunits_str(r.end)}]"
+                            f" {name}:{score}")
+            lines.append(f"  {coll_type_str(c)}/{m.name.lower():10s} "
+                         + " ".join(segs))
+        return "\n".join(lines)

@@ -1,0 +1,145 @@
+"""TL shared infrastructure: buffer views, score building, team base.
+
+Reference: /root/reference/src/components/tl/ucc_tl.{h,c} — the TL iface
+(ucc_tl.h:71), service-coll vtable (:50-62), and the per-TL score
+construction pattern (tl_ucp_team.c:279-309: defaults + built-in alg-select
+strings + user ``UCC_TL_X_TUNE`` overlay).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api.types import BufferInfo, BufferInfoV
+from ..constants import (CollType, DataType, MemoryType, dt_numpy, dt_size)
+from ..score.score import CollScore
+from ..status import Status, UccError
+from ..utils.config import SIZE_INF, parse_memunits
+from .. import constants
+from ..core.components import BaseTeam
+
+
+# ---------------------------------------------------------------------------
+# buffer views (host path)
+# ---------------------------------------------------------------------------
+
+def _require_contiguous(buf: np.ndarray) -> None:
+    """Collectives mutate user buffers through flat views; a non-contiguous
+    array would silently reshape-copy and the result would never reach the
+    caller's memory. Reject it loudly instead."""
+    if not buf.flags["C_CONTIGUOUS"]:
+        raise UccError(Status.ERR_INVALID_PARAM,
+                       "collective buffers must be C-contiguous "
+                       f"(got shape {buf.shape}, strides {buf.strides})")
+
+
+def binfo_u8(bi, offset: int = 0, nbytes: Optional[int] = None) -> np.ndarray:
+    """Flat uint8 view over a BufferInfo('s first count elements)."""
+    buf = bi.buffer
+    if isinstance(buf, np.ndarray):
+        _require_contiguous(buf)
+        flat = buf.reshape(-1).view(np.uint8)
+    else:
+        flat = np.frombuffer(buf, dtype=np.uint8)
+    if nbytes is None:
+        if isinstance(bi, BufferInfoV):
+            total = sum(int(c) for c in (bi.counts or [])) * dt_size(bi.datatype)
+        else:
+            total = int(bi.count) * dt_size(bi.datatype)
+        nbytes = total - offset
+    return flat[offset:offset + nbytes]
+
+
+def binfo_typed(bi, count: Optional[int] = None, elem_offset: int = 0) -> np.ndarray:
+    """Typed 1-D view of `count` elements starting at elem_offset."""
+    nd = dt_numpy(bi.datatype)
+    buf = bi.buffer
+    if isinstance(buf, np.ndarray):
+        _require_contiguous(buf)
+        flat = buf.reshape(-1).view(nd) if buf.dtype != nd else buf.reshape(-1)
+    else:
+        flat = np.frombuffer(buf, dtype=nd)
+    if count is None:
+        count = int(bi.count) if isinstance(bi, BufferInfo) else \
+            sum(int(c) for c in (bi.counts or []))
+    return flat[elem_offset:elem_offset + count]
+
+
+def binfo_v_block(bi: BufferInfoV, block: int) -> np.ndarray:
+    """Typed view of rank-`block`'s section of a vector buffer."""
+    counts = bi.counts or []
+    displs = bi.displacements
+    if displs is None:
+        displs = np.cumsum([0] + [int(c) for c in counts[:-1]])
+    return binfo_typed(bi, int(counts[block]), int(displs[block]))
+
+
+# ---------------------------------------------------------------------------
+# algorithm tables & scores
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AlgSpec:
+    """One algorithm of a coll within a TL (tl alg ids, e.g.
+    tl_ucp_coll.c:207-233 allgather alg list)."""
+
+    id: int
+    name: str
+    init: Callable                      # fn(init_args, tl_team) -> CollTask
+    #: default selection ranges "0-4k:score,4k-inf:score" (None -> whole
+    #: range at the TL default score)
+    default_select: Optional[str] = None
+
+
+def build_scores(team: BaseTeam, default_score: int,
+                 alg_table: Dict[CollType, List[AlgSpec]],
+                 mem_types: Sequence[MemoryType],
+                 tune_env: str = "") -> CollScore:
+    """Default ranges + built-in per-alg selection + user TUNE overlay."""
+    score = CollScore()
+    for coll, specs in alg_table.items():
+        for mt in mem_types:
+            for spec in specs:
+                if spec.default_select:
+                    for tok in spec.default_select.split(","):
+                        rng, sc = tok.rsplit(":", 1)
+                        lo, hi = rng.split("-", 1)
+                        score.add_range(coll, mt, parse_memunits(lo),
+                                        parse_memunits(hi), int(sc),
+                                        spec.init, team, spec.name)
+                else:
+                    score.add_range(coll, mt, 0, SIZE_INF, default_score,
+                                    spec.init, team, spec.name)
+    if tune_env:
+        tune = os.environ.get(tune_env, "")
+        if tune:
+            def resolver(coll: CollType, alg: str):
+                specs = alg_table.get(coll, [])
+                for s in specs:
+                    if s.name == alg or str(s.id) == alg:
+                        return lambda ia, t=team, fn=s.init: fn(ia, t)
+                return None
+            st = score.update_from_str(tune, resolver, team)
+            if st.is_error:
+                raise UccError(st, f"bad tune string in {tune_env}")
+    return score
+
+
+class TlTeamBase(BaseTeam):
+    """Common TL team plumbing: rank/size shortcuts and coll tags."""
+
+    NAME = "tl_base"
+
+    def __init__(self, comp_context, core_team, scope: str = "cl"):
+        super().__init__(comp_context, core_team)
+        self.scope = scope
+        self.rank = core_team.rank
+        self.size = core_team.size
+        self.team_key = (core_team.team_key, scope)
+
+    @property
+    def context(self):
+        return self.comp_context

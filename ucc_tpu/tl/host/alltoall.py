@@ -1,0 +1,139 @@
+"""Alltoall(v) algorithms.
+
+Ports semantics of /root/reference/src/components/tl/ucp/alltoall/
+(alltoall_pairwise.c, alltoall_bruck.c) and alltoallv/alltoallv_pairwise.c.
+
+  - pairwise: N-1 balanced exchange steps (step s: send to r+s, recv from
+    r-s) with a bounded in-flight window (tl_ucp pairwise num_posts knob)
+  - linear: post everything at once (best for tiny teams)
+  - bruck: log2(N) rounds for small messages — each round ships all blocks
+    whose destination's bit `k` is set, then a local inverse rotation
+  - alltoallv pairwise: vector counts/displacements
+
+Buffer convention: src.count = dst.count = total elements (N blocks of
+count/N each), matching UCC alltoall args.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ...api.types import BufferInfoV
+from ...constants import dt_numpy
+from ..base import binfo_typed, binfo_v_block
+from .task import HostCollTask
+
+
+class AlltoallPairwise(HostCollTask):
+    WINDOW = 4   # in-flight exchanges (pairwise num_posts flavor)
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        total = int(args.dst.count)
+        blk = total // size
+        src = binfo_typed(args.src if not args.is_inplace else args.dst, total)
+        if args.is_inplace:
+            src = src.copy()
+        dst = binfo_typed(args.dst, total)
+        dst[me * blk:(me + 1) * blk] = src[me * blk:(me + 1) * blk]
+        reqs: List = []
+        for step in range(1, size):
+            to = (me + step) % size
+            frm = (me - step) % size
+            reqs.append(self.send_nb(to, src[to * blk:(to + 1) * blk],
+                                     slot=80 + step))
+            reqs.append(self.recv_nb(frm, dst[frm * blk:(frm + 1) * blk],
+                                     slot=80 + step))
+            if len(reqs) >= 2 * self.WINDOW:
+                yield from self.wait(*reqs)
+                reqs = []
+        if reqs:
+            yield from self.wait(*reqs)
+
+
+class AlltoallLinear(AlltoallPairwise):
+    WINDOW = 1 << 30  # post everything, single wait
+
+
+class AlltoallBruck(HostCollTask):
+    """Bruck alltoall (coll_patterns/bruck_alltoall.h): O(log N) rounds of
+    aggregated blocks — latency-optimal for small messages."""
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        total = int(args.dst.count)
+        blk = total // size
+        nd = dt_numpy(args.dst.datatype)
+        src = binfo_typed(args.src if not args.is_inplace else args.dst, total)
+        dst = binfo_typed(args.dst, total)
+        # phase 0: local rotation - work[i] = block for rank (me + i) % size
+        work = np.empty(total, dtype=nd)
+        for i in range(size):
+            peer = (me + i) % size
+            work[i * blk:(i + 1) * blk] = src[peer * blk:(peer + 1) * blk]
+        # phase 1: log2 rounds
+        k = 1
+        rnd = 0
+        tmp = np.empty(total, dtype=nd)
+        while k < size:
+            # blocks whose bit-k is set travel this round (any team size,
+            # ceil(log2 N) rounds). Invariant: work[i] at rank r holds data
+            # destined to r+i having already traveled (i mod k); sending
+            # slot i to r+k and receiving into the same slot preserves it.
+            idxs = [i for i in range(size) if (i // k) % 2 == 1]
+            send_to = (me + k) % size
+            recv_from = (me - k) % size
+            sbuf = np.concatenate([work[i * blk:(i + 1) * blk] for i in idxs]) \
+                if idxs else np.empty(0, dtype=nd)
+            rbuf = tmp[:sbuf.size]
+            yield from self.sendrecv(send_to, sbuf, recv_from, rbuf,
+                                     slot=84 + rnd)
+            for n, i in enumerate(idxs):
+                work[i * blk:(i + 1) * blk] = rbuf[n * blk:(n + 1) * blk]
+            k *= 2
+            rnd += 1
+        # phase 2: work[i] is from rank (me - i); unrotate
+        for i in range(size):
+            p = (me - i) % size
+            dst[p * blk:(p + 1) * blk] = work[i * blk:(i + 1) * blk]
+
+
+class AlltoallvPairwise(HostCollTask):
+    WINDOW = 4
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        srcv: BufferInfoV = args.src
+        dstv: BufferInfoV = args.dst
+        if args.is_inplace:
+            # in-place alltoallv: stage through a copy of dst
+            staged = binfo_typed(dstv).copy()
+
+            def sblock(p):
+                c = int(dstv.counts[p])
+                d = int(dstv.displacements[p]) if dstv.displacements is not None \
+                    else sum(int(x) for x in dstv.counts[:p])
+                return staged[d:d + c]
+        else:
+            def sblock(p):
+                return binfo_v_block(srcv, p)
+        own_dst = binfo_v_block(dstv, me)
+        own_src = sblock(me)
+        own_dst[:min(own_dst.size, own_src.size)] = \
+            own_src[:min(own_dst.size, own_src.size)]
+        reqs: List = []
+        for step in range(1, size):
+            to = (me + step) % size
+            frm = (me - step) % size
+            reqs.append(self.send_nb(to, sblock(to), slot=88 + step))
+            reqs.append(self.recv_nb(frm, binfo_v_block(dstv, frm),
+                                     slot=88 + step))
+            if len(reqs) >= 2 * self.WINDOW:
+                yield from self.wait(*reqs)
+                reqs = []
+        if reqs:
+            yield from self.wait(*reqs)

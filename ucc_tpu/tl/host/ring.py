@@ -1,0 +1,217 @@
+"""Ring algorithms — bandwidth-optimal host collectives.
+
+Ports the semantics of the reference's ring family
+(/root/reference/src/components/tl/ucp/allgather/allgather_ring.c,
+reduce_scatter/reduce_scatter_ring.c, allgatherv/allgatherv_ring.c,
+reduce_scatterv/reduce_scatterv_ring.c and the generic ring helper
+coll_patterns/ring.h:14-21). Ring allreduce = reduce-scatter ring +
+allgather ring (the tl_ucp allreduce ring schedule, allreduce_ring).
+
+Block layout uses the standard near-equal split (ucc_buffer_block_count/
+offset, ucc_coll_utils.h:301,387) so any count works with any team size.
+
+Buffer conventions (matching UCC coll args):
+  - allgather: src.count = per-rank, dst.count = total
+  - reduce_scatter: src.count = total, dst.count = per-rank block
+    (in-place: dst holds the full vector; result lands in rank's block)
+  - allreduce: src/dst.count = total
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...api.types import BufferInfoV
+from ...constants import ReductionOp, dt_numpy
+from ...ec.cpu import reduce_arrays
+from ...utils.mathutils import block_count, block_offset
+from ..base import binfo_typed, binfo_v_block
+from .task import HostCollTask
+
+
+class AllgatherRing(HostCollTask):
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        total = int(args.dst.count)
+        dst = binfo_typed(args.dst, total)
+        if not args.is_inplace:
+            blk = _blk_view(dst, total, size, me)
+            blk[:] = binfo_typed(args.src, blk.size)
+        if size == 1:
+            return
+        right = (me + 1) % size
+        left = (me - 1) % size
+        for step in range(size - 1):
+            sb = (me - step) % size
+            rb = (me - step - 1) % size
+            yield from self.sendrecv(right, _blk_view(dst, total, size, sb),
+                                     left, _blk_view(dst, total, size, rb),
+                                     slot=60 + step)
+
+
+class AllgathervRing(HostCollTask):
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        dstv: BufferInfoV = args.dst
+        if not args.is_inplace:
+            own = binfo_v_block(dstv, me)
+            own[:] = binfo_typed(args.src, own.size)
+        if size == 1:
+            return
+        right = (me + 1) % size
+        left = (me - 1) % size
+        for step in range(size - 1):
+            sb = (me - step) % size
+            rb = (me - step - 1) % size
+            yield from self.sendrecv(right, binfo_v_block(dstv, sb),
+                                     left, binfo_v_block(dstv, rb),
+                                     slot=62 + step)
+
+
+class ReduceScatterRing(HostCollTask):
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        op = args.op if args.op is not None else ReductionOp.SUM
+        red_op = ReductionOp.SUM if op == ReductionOp.AVG else op
+        if args.is_inplace:
+            total = int(args.dst.count)
+            work = binfo_typed(args.dst, total).copy()
+            out_block = _blk_view(binfo_typed(args.dst, total), total, size, me)
+        else:
+            total = int(args.src.count)
+            work = binfo_typed(args.src, total).copy()
+            out_block = binfo_typed(args.dst, block_count(total, size, me))
+        dt = (args.src or args.dst).datatype
+        nd = dt_numpy(dt)
+        if size == 1:
+            res = work
+            if op == ReductionOp.AVG:
+                res = reduce_arrays([work], ReductionOp.SUM, dt, alpha=1.0)
+            out_block[:] = res[:out_block.size]
+            return
+        right = (me + 1) % size
+        left = (me - 1) % size
+        max_blk = max(block_count(total, size, b) for b in range(size))
+        recv_buf = np.empty(max_blk, dtype=nd)
+        for step in range(size - 1):
+            sb = (me - 1 - step) % size
+            rb = (me - 2 - step) % size
+            sview = _blk_view(work, total, size, sb)
+            rview = recv_buf[:block_count(total, size, rb)]
+            yield from self.sendrecv(right, sview, left, rview,
+                                     slot=64 + step)
+            acc = _blk_view(work, total, size, rb)
+            acc[:] = reduce_arrays([acc, rview], red_op, dt)
+        mine = _blk_view(work, total, size, me)
+        if op == ReductionOp.AVG:
+            mine = reduce_arrays([mine], ReductionOp.SUM, dt, alpha=1.0 / size)
+        out_block[:] = mine
+
+
+class ReduceScattervRing(HostCollTask):
+    """reduce_scatterv ring (reduce_scatterv_ring.c): per-rank counts."""
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        op = args.op if args.op is not None else ReductionOp.SUM
+        red_op = ReductionOp.SUM if op == ReductionOp.AVG else op
+        dstv = args.dst
+        counts = [int(c) for c in dstv.counts]
+        # displacements describe each block's position within the source
+        # vector; default to packed cumsum
+        if dstv.displacements is not None:
+            displs = [int(d) for d in dstv.displacements]
+        else:
+            displs = list(np.cumsum([0] + counts[:-1]))
+        total = max(d + c for d, c in zip(displs, counts)) if counts else 0
+        if args.is_inplace:
+            work = binfo_typed(dstv, total).copy()
+            out_block = binfo_typed(dstv, counts[me], displs[me])
+        else:
+            work = binfo_typed(args.src, total).copy()
+            # non-inplace: dst holds only my block
+            out_block = binfo_typed(dstv, counts[me], 0)
+        dt = (args.src or dstv).datatype
+        nd = dt_numpy(dt)
+
+        def blk(arr, b):
+            return arr[displs[b]:displs[b] + counts[b]]
+
+        if size == 1:
+            res = work
+            if op == ReductionOp.AVG:
+                res = reduce_arrays([work], ReductionOp.SUM, dt, alpha=1.0)
+            out_block[:] = res[:out_block.size]
+            return
+        right = (me + 1) % size
+        left = (me - 1) % size
+        recv_buf = np.empty(max(counts) if counts else 0, dtype=nd)
+        for step in range(size - 1):
+            sb = (me - 1 - step) % size
+            rb = (me - 2 - step) % size
+            rview = recv_buf[:counts[rb]]
+            yield from self.sendrecv(right, blk(work, sb), left, rview,
+                                     slot=66 + step)
+            acc = blk(work, rb)
+            acc[:] = reduce_arrays([acc, rview], red_op, dt)
+        mine = blk(work, me)
+        if op == ReductionOp.AVG:
+            mine = reduce_arrays([mine], ReductionOp.SUM, dt, alpha=1.0 / size)
+        out_block[:] = mine
+
+
+class AllreduceRing(HostCollTask):
+    """Bandwidth allreduce: reduce-scatter ring then allgather ring inline
+    (the reference builds this as a schedule; one generator is equivalent
+    and cheaper host-side)."""
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        op = args.op if args.op is not None else ReductionOp.SUM
+        red_op = ReductionOp.SUM if op == ReductionOp.AVG else op
+        total = int(args.dst.count)
+        dst = binfo_typed(args.dst, total)
+        if not args.is_inplace:
+            dst[:] = binfo_typed(args.src, total)
+        dt = args.dst.datatype
+        nd = dt_numpy(dt)
+        if size == 1:
+            if op == ReductionOp.AVG:
+                dst[:] = reduce_arrays([dst], ReductionOp.SUM, dt, alpha=1.0)
+            return
+        right = (me + 1) % size
+        left = (me - 1) % size
+        max_blk = max(block_count(total, size, b) for b in range(size))
+        recv_buf = np.empty(max_blk, dtype=nd)
+        # phase 1: reduce-scatter
+        for step in range(size - 1):
+            sb = (me - 1 - step) % size
+            rb = (me - 2 - step) % size
+            rview = recv_buf[:block_count(total, size, rb)]
+            yield from self.sendrecv(right, _blk_view(dst, total, size, sb),
+                                     left, rview, slot=70 + step)
+            acc = _blk_view(dst, total, size, rb)
+            acc[:] = reduce_arrays([acc, rview], red_op, dt)
+        if op == ReductionOp.AVG:
+            mine = _blk_view(dst, total, size, me)
+            mine[:] = reduce_arrays([mine], ReductionOp.SUM, dt,
+                                    alpha=1.0 / size)
+        # phase 2: allgather of reduced blocks
+        for step in range(size - 1):
+            sb = (me - step) % size
+            rb = (me - step - 1) % size
+            yield from self.sendrecv(right, _blk_view(dst, total, size, sb),
+                                     left, _blk_view(dst, total, size, rb),
+                                     slot=70 + size + step)
+
+
+def _blk_view(arr: np.ndarray, total: int, size: int, block: int) -> np.ndarray:
+    off = block_offset(total, size, block)
+    cnt = block_count(total, size, block)
+    return arr[off:off + cnt]

@@ -1,0 +1,115 @@
+"""SRA allreduce — scatter-reduce + allgather (bandwidth algorithm).
+
+Ports the semantics of the reference's SRA-knomial allreduce
+(/root/reference/src/components/tl/ucp/coll_patterns/sra_knomial.h and
+allreduce/allreduce_sra_knomial.c): reduce-scatter by recursive vector
+halving, then allgather by recursive doubling, with the extra/proxy fold
+for non-power-of-two team sizes. O(log N) rounds moving ~2·(N-1)/N of the
+vector — the bandwidth-optimal tree algorithm for large messages.
+
+(The reference generalizes to radix r; radix 2 is the canonical and most
+bandwidth-efficient instance and is what this port implements. The ring
+algorithm covers the very-large-message regime.)
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ...constants import ReductionOp, dt_numpy
+from ...ec.cpu import reduce_arrays
+from .knomial import largest_pow
+from .task import HostCollTask
+
+
+class AllreduceSraKnomial(HostCollTask):
+    def __init__(self, init_args, team, subset=None, radix: Optional[int] = None):
+        super().__init__(init_args, team, subset)
+        args = init_args.args
+        self.count = int(args.dst.count)
+        self.dt = args.dst.datatype
+        self.op = args.op if args.op is not None else ReductionOp.SUM
+
+    def run(self):
+        args = self.args
+        nd = dt_numpy(self.dt)
+        dst = binfo = None
+        from ..base import binfo_typed
+        dst = binfo_typed(args.dst, self.count)
+        if not args.is_inplace:
+            dst[:] = binfo_typed(args.src, self.count)
+        op = ReductionOp.SUM if self.op == ReductionOp.AVG else self.op
+        size, me = self.gsize, self.grank
+        if size == 1:
+            if self.op == ReductionOp.AVG:
+                dst[:] = reduce_arrays([dst], ReductionOp.SUM, self.dt,
+                                       alpha=1.0)
+            return
+        full = largest_pow(size, 2)
+        n_extra = size - full
+
+        # EXTRA fold (same structure as allreduce_knomial EXTRA phase)
+        if me >= full:
+            proxy = me - full
+            yield from self.wait(self.send_nb(proxy, dst, slot=0))
+            yield from self.wait(self.recv_nb(proxy, dst, slot=1))
+            return
+        if me < n_extra:
+            extra = np.empty(self.count, dtype=nd)
+            yield from self.wait(self.recv_nb(full + me, extra, slot=0))
+            dst[:] = reduce_arrays([dst, extra], op, self.dt)
+
+        # reduce-scatter: recursive vector halving
+        lo, hi = 0, self.count
+        dist = full // 2
+        scratch = np.empty((self.count + 1) // 2, dtype=nd)
+        rnd = 0
+        while dist >= 1:
+            partner = me ^ dist
+            mid = lo + (hi - lo) // 2
+            if me & dist == 0:
+                keep = (lo, mid)
+                give = (mid, hi)
+            else:
+                keep = (mid, hi)
+                give = (lo, mid)
+            rview = scratch[:keep[1] - keep[0]]
+            yield from self.sendrecv(partner, dst[give[0]:give[1]],
+                                     partner, rview, slot=2 + rnd)
+            seg = dst[keep[0]:keep[1]]
+            seg[:] = reduce_arrays([seg, rview], op, self.dt)
+            lo, hi = keep
+            dist //= 2
+            rnd += 1
+
+        if self.op == ReductionOp.AVG and hi > lo:
+            dst[lo:hi] = reduce_arrays([dst[lo:hi]], ReductionOp.SUM, self.dt,
+                                       alpha=1.0 / size)
+
+        # allgather: recursive doubling, segments mirror the halving path
+        # replay the segment splits to know each round's partner segment
+        segs: List[Tuple[int, int, int]] = []   # (dist, lo, hi) per round
+        lo2, hi2 = 0, self.count
+        dist = full // 2
+        while dist >= 1:
+            mid = lo2 + (hi2 - lo2) // 2
+            segs.append((dist, lo2, hi2))
+            lo2, hi2 = (lo2, mid) if me & dist == 0 else (mid, hi2)
+            dist //= 2
+        for rnd, (dist, slo, shi) in enumerate(reversed(segs)):
+            partner = me ^ dist
+            mid = slo + (shi - slo) // 2
+            if me & dist == 0:
+                mine = (slo, mid)
+                theirs = (mid, shi)
+            else:
+                mine = (mid, shi)
+                theirs = (slo, mid)
+            yield from self.sendrecv(partner, dst[mine[0]:mine[1]],
+                                     partner, dst[theirs[0]:theirs[1]],
+                                     slot=100 + rnd)
+
+        # PROXY unfold
+        if me < n_extra:
+            yield from self.wait(self.send_nb(full + me, dst, slot=1))

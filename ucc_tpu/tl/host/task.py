@@ -1,0 +1,111 @@
+"""Host collective task base — resumable algorithm state machines.
+
+The reference writes TL/UCP algorithms as GOTO-resumable phase machines
+(e.g. allreduce_knomial.c:16-21 SAVE_STATE / phases EXTRA/LOOP/REDUCE/
+PROXY). The TPU build's host path expresses the same thing as Python
+generators: ``run()`` yields whenever it waits on transport completions and
+the progress queue resumes it — identical nonblocking semantics, radically
+simpler algorithm code.
+
+Rank addressing: algorithms speak *group ranks* of a Subset (active sets,
+hier sbgps); the task translates group rank -> team rank -> context rank
+(ucc_ep_map_eval chains, ucc_coll_utils.h:216) and tags messages with
+(team_key, coll seq, slot, sender ctx rank) — the dict-key analog of UCP's
+packed 64-bit tags (tl_ucp_sendrecv.h:83-110).
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional
+
+import numpy as np
+
+from ...schedule.task import CollTask
+from ...status import Status, UccError
+from ...utils.ep_map import Subset
+from .transport import RecvReq, SendReq
+
+
+class HostCollTask(CollTask):
+    """Base for all host-transport collective algorithms."""
+
+    def __init__(self, init_args, team, subset: Optional[Subset] = None,
+                 tag: Optional[int] = None):
+        super().__init__(team=team, args=init_args.args if init_args else None)
+        self.init_args = init_args
+        self.tl_team = team
+        self.subset = subset or team.full_subset()
+        self.grank = self.subset.myrank
+        self.gsize = self.subset.size
+        self.tag = tag if tag is not None else team.next_coll_tag()
+        self._gen = None
+        self._slot_counter = 0
+
+    # ------------------------------------------------------------------
+    def run(self):
+        """Override: generator implementing the algorithm."""
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+    def post_fn(self) -> Status:
+        self._gen = self.run()
+        self._advance()
+        return Status.OK
+
+    def progress_fn(self) -> None:
+        self.tl_team.transport.progress()
+        self._advance()
+
+    def _advance(self) -> None:
+        if self._gen is None:
+            return
+        try:
+            next(self._gen)
+        except StopIteration:
+            if self.status == Status.IN_PROGRESS:
+                self.status = Status.OK
+            self._gen = None
+        except UccError as e:
+            self.status = e.status
+            self._gen = None
+        except Exception:  # noqa: BLE001
+            # any algorithm bug (shape/dtype/contiguity errors, ...) must
+            # surface as a failed task, not escape into the caller's
+            # progress loop leaving this task IN_PROGRESS and peers hung
+            from ...utils.log import get_logger
+            get_logger("tl").exception(
+                "collective algorithm %s raised", type(self).__name__)
+            self.status = Status.ERR_NO_MESSAGE
+            self._gen = None
+
+    def reset(self) -> None:
+        super().reset()
+        self._gen = None
+        # persistent re-post uses a fresh team-wide tag (the reference bumps
+        # task seq_num per post). Tuple tags (active-set / service) stay
+        # fixed: they are outside the team seq space and per-key FIFO
+        # matching keeps successive posts ordered.
+        if isinstance(self.tag, int):
+            self.tag = self.tl_team.next_coll_tag()
+
+    # ------------------------------------------------------------------
+    # p2p helpers (group-rank addressed)
+    def send_nb(self, peer_grank: int, data: np.ndarray, slot: int = 0) -> SendReq:
+        return self.tl_team.send_nb(self.subset, peer_grank, self.tag, slot,
+                                    data)
+
+    def recv_nb(self, peer_grank: int, dst: np.ndarray, slot: int = 0) -> RecvReq:
+        return self.tl_team.recv_nb(self.subset, peer_grank, self.tag, slot,
+                                    dst)
+
+    def wait(self, *reqs):
+        """Yield until all requests complete."""
+        pending: List = [r for r in reqs if not r.test()]
+        while pending:
+            yield
+            pending = [r for r in pending if not r.test()]
+
+    def sendrecv(self, send_to: int, data: np.ndarray, recv_from: int,
+                 dst: np.ndarray, slot: int = 0):
+        sreq = self.send_nb(send_to, data, slot)
+        rreq = self.recv_nb(recv_from, dst, slot)
+        yield from self.wait(sreq, rreq)

@@ -1,0 +1,288 @@
+"""Host TL team — shared by TL/SHM (in-process) and TL/SOCKET (TCP).
+
+Plays the role of ucc_tl_ucp_team (tl_ucp_team.c): owns p2p endpoints,
+per-team collective tags, the algorithm table + score construction
+(tl_ucp_team.c:279-309), service collectives for the core (ucc_tl.h:50,
+tl_ucp_service_coll.c), and active-set subsets.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...api.types import BufferInfo, CollArgs
+from ...constants import CollType, DataType, MemoryType, ReductionOp
+from ...schedule.task import CollTask
+from ...score.score import CollScore
+from ...status import Status, UccError
+from ...utils.ep_map import EpMap, Subset
+from ..base import AlgSpec, TlTeamBase, build_scores
+from .alltoall import (AlltoallBruck, AlltoallLinear, AlltoallPairwise,
+                       AlltoallvPairwise)
+from .knomial import (AllreduceKnomial, BarrierKnomial, BcastKnomial,
+                      FaninKnomial, FanoutKnomial, GatherLinear,
+                      ReduceKnomial, ScatterLinear)
+from .ring import (AllgatherRing, AllgathervRing, AllreduceRing,
+                   ReduceScatterRing, ReduceScattervRing)
+from .sra import AllreduceSraKnomial
+from .task import HostCollTask
+from .transport import Mailbox, TagKey
+
+
+class HostTlTeam(TlTeamBase):
+    """Requires: comp_context exposing .transport (endpoint), .peer_mailbox
+    or send path by ctx rank, and .executor."""
+
+    NAME = "host"
+    TL_CLS: Any = None
+
+    def __init__(self, comp_context, core_team, scope: str = "cl"):
+        super().__init__(comp_context, core_team, scope)
+        self.transport = comp_context.transport
+        self.ctx_map: EpMap = core_team.ctx_map or EpMap.full(core_team.size)
+        self._coll_tag = 0
+        self._my_ctx_rank = core_team.context.rank
+
+    # ------------------------------------------------------------------
+    def full_subset(self) -> Subset:
+        return Subset(EpMap.full(self.size), self.rank)
+
+    def next_coll_tag(self) -> int:
+        self._coll_tag += 1
+        return self._coll_tag
+
+    def cfg_radix(self, knob: str, msgsize: int) -> int:
+        cfg = self.comp_context.config
+        if cfg is None:
+            return 4
+        try:
+            val = cfg.get(knob)
+        except KeyError:
+            return 4
+        from ...utils.config import MRangeUint, SIZE_AUTO
+        if isinstance(val, MRangeUint):
+            v = val.get(msgsize)
+            return 4 if v == SIZE_AUTO else int(v)
+        return int(val)
+
+    # -- p2p by group rank ---------------------------------------------
+    def _key(self, coll_tag: int, slot: int, src_ctx_rank: int) -> TagKey:
+        return (self.team_key, coll_tag, slot, src_ctx_rank)
+
+    def _peer_ctx_rank(self, subset: Subset, grank: int) -> int:
+        return self.ctx_map.eval(subset.map.eval(grank))
+
+    def send_nb(self, subset: Subset, peer_grank: int, coll_tag: int,
+                slot: int, data: np.ndarray):
+        peer_ctx = self._peer_ctx_rank(subset, peer_grank)
+        return self.comp_context.send_to(
+            peer_ctx, self._key(coll_tag, slot, self._my_ctx_rank), data)
+
+    def recv_nb(self, subset: Subset, peer_grank: int, coll_tag: int,
+                slot: int, dst: np.ndarray):
+        peer_ctx = self._peer_ctx_rank(subset, peer_grank)
+        return self.transport.recv_nb(self._key(coll_tag, slot, peer_ctx), dst)
+
+    # ------------------------------------------------------------------
+    # algorithm table (tl_ucp_coll.c alg lists; ids stable for @N tuning)
+    def alg_table(self) -> Dict[CollType, List[AlgSpec]]:
+        S = self.TL_CLS.DEFAULT_SCORE
+
+        def spec(i, name, cls, sel=None, **kw):
+            def init(ia, team, _cls=cls, _kw=kw):
+                if ia.args.active_set is not None:
+                    # active-set subset execution (bcast only, enforced by
+                    # core dispatch ucc_coll.c:210-214)
+                    return self.coll_init_active_set(ia)
+                return _cls(ia, self, **_kw)
+            return AlgSpec(i, name, init, sel)
+
+        return {
+            CollType.ALLREDUCE: [
+                # latency alg for small, bandwidth algs for large
+                # (default select mirrors tl_ucp allreduce.h:24-25)
+                spec(0, "knomial", AllreduceKnomial,
+                     sel=f"0-4k:{S + 5},4k-inf:{S - 5}"),
+                spec(1, "sra_knomial", AllreduceSraKnomial,
+                     sel=f"0-4k:{S - 5},4k-inf:{S + 5}"),
+                spec(2, "ring", AllreduceRing,
+                     sel=f"0-4k:{S - 6},4k-inf:{S + 4}"),
+            ],
+            CollType.ALLGATHER: [
+                spec(0, "ring", AllgatherRing),
+            ],
+            CollType.ALLGATHERV: [
+                spec(0, "ring", AllgathervRing),
+            ],
+            CollType.ALLTOALL: [
+                spec(0, "pairwise", AlltoallPairwise,
+                     sel=f"0-256:{S - 5},256-inf:{S + 5}"),
+                spec(1, "bruck", AlltoallBruck,
+                     sel=f"0-256:{S + 5},256-inf:{S - 5}"),
+                spec(2, "linear", AlltoallLinear),
+            ],
+            CollType.ALLTOALLV: [
+                spec(0, "pairwise", AlltoallvPairwise),
+            ],
+            CollType.BARRIER: [
+                spec(0, "knomial", BarrierKnomial),
+            ],
+            CollType.BCAST: [
+                spec(0, "knomial", BcastKnomial),
+            ],
+            CollType.FANIN: [
+                spec(0, "knomial", FaninKnomial),
+            ],
+            CollType.FANOUT: [
+                spec(0, "knomial", FanoutKnomial),
+            ],
+            CollType.GATHER: [
+                spec(0, "linear", GatherLinear),
+            ],
+            CollType.GATHERV: [
+                spec(0, "linear", GatherLinear),
+            ],
+            CollType.REDUCE: [
+                spec(0, "knomial", ReduceKnomial),
+            ],
+            CollType.REDUCE_SCATTER: [
+                spec(0, "ring", ReduceScatterRing),
+            ],
+            CollType.REDUCE_SCATTERV: [
+                spec(0, "ring", ReduceScattervRing),
+            ],
+            CollType.SCATTER: [
+                spec(0, "linear", ScatterLinear),
+            ],
+            CollType.SCATTERV: [
+                spec(0, "linear", ScatterLinear),
+            ],
+        }
+
+    def get_scores(self) -> CollScore:
+        return build_scores(self, self.TL_CLS.DEFAULT_SCORE, self.alg_table(),
+                            self.TL_CLS.SUPPORTED_MEM_TYPES,
+                            tune_env=f"UCC_TL_{self.TL_CLS.NAME.upper()}_TUNE")
+
+    # ------------------------------------------------------------------
+    # active-set bcast (ucc.h:1890-1894; restricted to bcast ucc_coll.c:210)
+    def coll_init_active_set(self, init_args) -> CollTask:
+        aset = init_args.args.active_set
+        amap = EpMap.strided(aset.start, aset.stride, aset.size)
+        my = amap.local_rank(self.rank)
+        subset = Subset(amap, my)
+        root_team_rank = int(init_args.args.root)
+        task = BcastKnomial(init_args, self, subset=subset)
+        self._coll_tag -= 1   # undo the ctor's team-wide tag consumption
+        # root is given in team ranks; translate to subset rank
+        task.root = amap.local_rank(root_team_rank)
+        # active-set colls run on a strict subset, so they must NOT consume
+        # the team-wide seq counter (that would desync members from
+        # non-members). The user tag + set geometry form the tag, exactly
+        # like the reference packs (start,stride,size,user_tag) into the
+        # UCP tag for active sets.
+        task.tag = ("as", aset.start, aset.stride, aset.size,
+                    init_args.args.tag or 0)
+        return task
+
+    # ------------------------------------------------------------------
+    # service collectives (core-facing; tl_ucp_service_coll.c analog)
+    def service_allreduce(self, arr: np.ndarray, op: ReductionOp) -> CollTask:
+        from ...core.coll import InitArgs
+        from ...constants import dt_from_numpy
+        res = arr.copy()
+        args = CollArgs(coll_type=CollType.ALLREDUCE,
+                        src=BufferInfo(arr.copy(), arr.size,
+                                       dt_from_numpy(arr.dtype)),
+                        dst=BufferInfo(res, res.size, dt_from_numpy(res.dtype)),
+                        op=op)
+        ia = InitArgs(args=args, team=self.core_team,
+                      mem_type=MemoryType.HOST, msgsize=res.nbytes)
+        task = AllreduceKnomial(ia, self)
+        task.tag = ("svc", self.next_coll_tag())
+        task.result = res
+        task.progress_queue = self.core_team.context.progress_queue
+        return task
+
+    def service_allgather(self, data: bytes) -> CollTask:
+        task = _ServiceAllgather(self, bytes(data))
+        task.progress_queue = self.core_team.context.progress_queue
+        return task
+
+    def service_bcast(self, data: Optional[bytes], root: int = 0,
+                      max_size: int = 4096) -> CollTask:
+        task = _ServiceBcast(self, data, root, max_size)
+        task.progress_queue = self.core_team.context.progress_queue
+        return task
+
+    def destroy(self) -> None:
+        pass
+
+
+class _ServiceAllgather(HostCollTask):
+    """Linear allgather of equal-or-padded byte blobs (bootstrap-path only:
+    'internal OOB' over service allgather, ucc_service_coll.c:160-210)."""
+
+    def __init__(self, team: HostTlTeam, data: bytes):
+        super().__init__(None, team)
+        self.data = data
+        self.tag = ("svc", team.next_coll_tag())
+        self.result: List[bytes] = []
+
+    def run(self):
+        size, me = self.gsize, self.grank
+        # two-phase: sizes then payloads (lets blobs differ in size)
+        szbuf = np.zeros(size, dtype=np.int64)
+        szbuf[me] = len(self.data)
+        my_sz = np.array([len(self.data)], dtype=np.int64)
+        reqs = []
+        for p in range(size):
+            if p == me:
+                continue
+            reqs.append(self.send_nb(p, my_sz, slot=0))
+            reqs.append(self.recv_nb(p, szbuf[p:p + 1], slot=0))
+        yield from self.wait(*reqs)
+        payload = np.frombuffer(self.data, dtype=np.uint8)
+        bufs = {p: np.empty(int(szbuf[p]), dtype=np.uint8)
+                for p in range(size) if p != me}
+        reqs = []
+        for p in range(size):
+            if p == me:
+                continue
+            reqs.append(self.send_nb(p, payload, slot=1))
+            reqs.append(self.recv_nb(p, bufs[p], slot=1))
+        yield from self.wait(*reqs)
+        self.result = [self.data if p == me else bufs[p].tobytes()
+                       for p in range(size)]
+
+
+class _ServiceBcast(HostCollTask):
+    def __init__(self, team: HostTlTeam, data: Optional[bytes], root: int,
+                 max_size: int):
+        super().__init__(None, team)
+        self.data = data
+        self.root = root
+        self.max_size = max_size
+        self.tag = ("svc", team.next_coll_tag())
+        self.result: bytes = b""
+
+    def run(self):
+        size, me = self.gsize, self.grank
+        szbuf = np.zeros(1, dtype=np.int64)
+        if me == self.root:
+            szbuf[0] = len(self.data or b"")
+        yield from knomial_bcast_via(self, szbuf, self.root)
+        buf = np.zeros(int(szbuf[0]), dtype=np.uint8)
+        if me == self.root and self.data:
+            buf[:] = np.frombuffer(self.data, dtype=np.uint8)
+        yield from knomial_bcast_via(self, buf, self.root, slot_base=100)
+        self.result = buf.tobytes()
+
+
+def knomial_bcast_via(task: HostCollTask, buf: np.ndarray, root: int,
+                      radix: int = 4, slot_base: int = 90):
+    from .knomial import knomial_bcast_steps
+    yield from knomial_bcast_steps(task, buf, root, min(radix, task.gsize),
+                                   slot_base=slot_base)

@@ -1,0 +1,161 @@
+"""Tagged point-to-point transport for host-side TLs.
+
+This is the stand-in for UCX tagged send/recv that TL/UCP builds on
+(/root/reference/src/components/tl/ucp/tl_ucp_sendrecv.h:83-110: 64-bit
+tags packed from team id / scope / rank / user tag). UCX is absent on TPU
+pods, so the framework owns its transports (SURVEY §7.6):
+
+  - InProcTransport ("shm"): ranks are contexts inside one process
+    (threads); matching is a lock-protected mailbox keyed by
+    (team_key, scope, coll_tag, slot, src). Eager sends under a threshold
+    copy-and-complete; larger sends hand a zero-copy view to the receiver
+    (rendezvous), completing when the receiver lands it.
+  - SocketTransport ("socket", tl/host/socket_transport.py): same mailbox
+    semantics over TCP for multi-process / DCN.
+
+Both present identical nonblocking requests, so every collective algorithm
+runs unchanged on either.
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...status import Status
+
+#: matching key: (team_key, coll_tag, slot, src_uid)
+TagKey = Tuple[Any, int, int, int]
+
+
+class SendReq:
+    __slots__ = ("done",)
+
+    def __init__(self, done: bool = False):
+        self.done = done
+
+    def test(self) -> bool:
+        return self.done
+
+
+class RecvReq:
+    __slots__ = ("done", "dst", "nbytes")
+
+    def __init__(self, dst: np.ndarray):
+        self.done = False
+        self.dst = dst
+        self.nbytes = 0
+
+    def test(self) -> bool:
+        return self.done
+
+
+class _PendingSend:
+    __slots__ = ("data", "req", "copied")
+
+    def __init__(self, data: np.ndarray, req: SendReq, copied: bool):
+        self.data = data
+        self.req = req
+        self.copied = copied
+
+
+class Mailbox:
+    """Per-context receive side with unexpected-message queues."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        #: key -> deque of _PendingSend (unexpected messages)
+        self.unexpected: Dict[TagKey, deque] = {}
+        #: key -> deque of RecvReq (posted receives)
+        self.posted: Dict[TagKey, deque] = {}
+
+    def push(self, key: TagKey, ps: _PendingSend) -> None:
+        with self.lock:
+            rq = self.posted.get(key)
+            if rq:
+                req = rq.popleft()
+                if not rq:
+                    del self.posted[key]
+            else:
+                self.unexpected.setdefault(key, deque()).append(ps)
+                return
+        _deliver(req, ps)
+
+    def post_recv(self, key: TagKey, req: RecvReq) -> None:
+        with self.lock:
+            uq = self.unexpected.get(key)
+            if uq:
+                ps = uq.popleft()
+                if not uq:
+                    del self.unexpected[key]
+            else:
+                self.posted.setdefault(key, deque()).append(req)
+                return
+        _deliver(req, ps)
+
+
+def _deliver(req: RecvReq, ps: _PendingSend) -> None:
+    n = min(req.dst.size, ps.data.size)
+    req.dst[:n] = ps.data[:n]
+    req.nbytes = n
+    req.done = True
+    ps.req.done = True
+
+
+# ---------------------------------------------------------------------------
+# in-process transport
+# ---------------------------------------------------------------------------
+
+#: process-global endpoint registry: uid -> Mailbox (the "shared memory
+#: segment"; cf. reference tl_cuda SysV shm control segment
+#: tl_cuda_team.c:141-181 — same role, in-process)
+_SHM_WORLD: Dict[str, Mailbox] = {}
+_SHM_LOCK = threading.Lock()
+
+
+class InProcTransport:
+    """One endpoint per core context."""
+
+    EAGER_THRESHOLD = 8192
+
+    def __init__(self):
+        self.uid = uuid.uuid4().hex
+        self.mailbox = Mailbox()
+        with _SHM_LOCK:
+            _SHM_WORLD[self.uid] = self.mailbox
+
+    # -- address plumbing ---------------------------------------------
+    def pack_address(self) -> bytes:
+        return self.uid.encode()
+
+    @staticmethod
+    def resolve(addr: bytes) -> Optional[Mailbox]:
+        with _SHM_LOCK:
+            return _SHM_WORLD.get(addr.decode())
+
+    # -- data path -----------------------------------------------------
+    def send_nb(self, peer_mailbox: Mailbox, key: TagKey,
+                data: np.ndarray) -> SendReq:
+        data = data.reshape(-1).view(np.uint8)
+        if data.nbytes <= self.EAGER_THRESHOLD:
+            ps = _PendingSend(data.copy(), SendReq(), copied=True)
+            ps.req.done = True        # eager: sender buffer free immediately
+        else:
+            ps = _PendingSend(data, SendReq(), copied=False)
+        peer_mailbox.push(key, ps)
+        return ps.req
+
+    def recv_nb(self, key: TagKey, dst: np.ndarray) -> RecvReq:
+        req = RecvReq(dst.reshape(-1).view(np.uint8))
+        self.mailbox.post_recv(key, req)
+        return req
+
+    def progress(self) -> None:
+        pass  # delivery happens inline at send/recv
+
+    def close(self) -> None:
+        with _SHM_LOCK:
+            _SHM_WORLD.pop(self.uid, None)

@@ -1,0 +1,93 @@
+"""TL/SELF — loopback transport for single-rank teams.
+
+Reference: /root/reference/src/components/tl/self (662 LoC): supports all 16
+coll types for team_size == 1 (tl_self.h:78-85), keeping full collective
+semantics (buffer movement via MC) so 1-rank teams behave identically to
+N-rank ones. Also serves as the service team for 1-rank teams.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..api.types import BufferInfo, BufferInfoV
+from ..constants import COLL_TYPE_ALL, CollType, MemoryType, ReductionOp
+from ..core.components import (BaseContext, BaseLib, TransportLayer,
+                               register_tl)
+from ..schedule.task import CollTask
+from ..score.score import CollScore
+from ..status import Status, UccError
+from .base import TlTeamBase, binfo_u8
+
+SUPPORTED = COLL_TYPE_ALL  # tl_self.h:78-85
+
+
+class TlSelfTask(CollTask):
+    """Local copy task: dst <- src (or no-op for in-place/sync colls)."""
+
+    def __init__(self, init_args, team):
+        super().__init__(team=team, args=init_args.args)
+        self.init_args = init_args
+
+    def post_fn(self) -> Status:
+        args = self.args
+        if not args.is_inplace and args.src is not None and \
+                args.dst is not None and args.src.buffer is not None and \
+                args.dst.buffer is not None:
+            src_u8 = binfo_u8(args.src)
+            dst_u8 = binfo_u8(args.dst)
+            n = min(src_u8.size, dst_u8.size)
+            dst_u8[:n] = src_u8[:n]
+        self.status = Status.OK
+        return Status.OK
+
+
+class _SelfServiceTask(CollTask):
+    def __init__(self, result):
+        super().__init__()
+        self.result = result
+
+    def post_fn(self) -> Status:
+        self.status = Status.OK
+        return Status.OK
+
+
+class TlSelfTeam(TlTeamBase):
+    NAME = "self"
+
+    def __init__(self, comp_context, core_team, scope: str = "cl"):
+        if core_team.size != 1:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "tl/self requires team size 1")
+        super().__init__(comp_context, core_team, scope)
+
+    def get_scores(self) -> CollScore:
+        return CollScore.build_default(
+            self, TlSelf.DEFAULT_SCORE, list(CollType),
+            TlSelf.SUPPORTED_MEM_TYPES, self.coll_init, "self")
+
+    def coll_init(self, init_args, team=None) -> CollTask:
+        return TlSelfTask(init_args, self)
+
+    # ---- service collectives (1-rank trivial) -------------------------
+    def service_allreduce(self, arr: np.ndarray, op: ReductionOp) -> CollTask:
+        return _SelfServiceTask(arr.copy())
+
+    def service_allgather(self, data: bytes) -> CollTask:
+        return _SelfServiceTask([bytes(data)])
+
+    def service_bcast(self, data: Optional[bytes], root: int = 0) -> CollTask:
+        return _SelfServiceTask(bytes(data or b""))
+
+
+@register_tl
+class TlSelf(TransportLayer):
+    NAME = "self"
+    DEFAULT_SCORE = 50
+    SUPPORTED_COLLS = SUPPORTED
+    SUPPORTED_MEM_TYPES = (MemoryType.HOST, MemoryType.TPU)
+    SERVICE_CAPABLE = True
+    lib_cls = BaseLib
+    context_cls = BaseContext
+    team_cls = TlSelfTeam

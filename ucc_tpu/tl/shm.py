@@ -1,0 +1,118 @@
+"""TL/SHM — in-process shared-memory transport layer.
+
+The fast intra-node host transport: ranks whose contexts live in one
+process (threads — the productized form of the reference's in-process gtest
+job, test_ucc.h:123-151) exchange messages through lock-protected mailboxes
+with zero-copy rendezvous for large payloads. Role-wise this mirrors the
+reference's intra-node fast path (tl/cuda over IPC; tl/ucp shm transports)
+while TL/SOCKET covers multi-process/DCN with the same algorithm suite.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict
+
+import numpy as np
+
+from ..constants import COLL_TYPE_ALL, MemoryType
+from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
+from ..ec.cpu import EcCpu
+from ..status import Status, UccError
+from ..utils.config import (ConfigField, ConfigTable, parse_memunits,
+                            parse_mrange_uint, register_table)
+from .host.team import HostTlTeam
+from .host.transport import InProcTransport
+
+TL_SHM_CONFIG = register_table(ConfigTable(
+    prefix="TL_SHM_", name="tl/shm", fields=[
+        ConfigField("ALLREDUCE_KN_RADIX", "0-inf:4",
+                    "allreduce knomial radix per msg range", parse_mrange_uint),
+        ConfigField("BCAST_KN_RADIX", "0-inf:4", "bcast tree radix",
+                    parse_mrange_uint),
+        ConfigField("REDUCE_KN_RADIX", "0-inf:4", "reduce tree radix",
+                    parse_mrange_uint),
+        ConfigField("BARRIER_KN_RADIX", "0-inf:4", "barrier dissemination "
+                    "radix", parse_mrange_uint),
+        ConfigField("EAGER_THRESH", "8k", "eager copy threshold; larger "
+                    "sends are zero-copy rendezvous", parse_memunits),
+    ]))
+
+
+class TlShmContext(BaseContext):
+    def __init__(self, comp_lib, core_context, config):
+        super().__init__(comp_lib, core_context, config)
+        self.transport = InProcTransport()
+        if config is not None:
+            self.transport.EAGER_THRESHOLD = config.eager_thresh
+        self.executor = EcCpu()
+        self.peer_info: Dict[int, tuple] = {}
+        self._mailboxes: Dict[int, object] = {}
+
+    def pack_address(self) -> bytes:
+        import os
+        return pickle.dumps((os.getpid(), self.transport.uid))
+
+    def unpack_addresses(self, addrs: Dict[int, bytes]) -> None:
+        for rank, blob in addrs.items():
+            if blob:
+                self.peer_info[rank] = pickle.loads(blob)
+
+    def same_process(self, ctx_rank: int) -> bool:
+        import os
+        info = self.peer_info.get(ctx_rank)
+        return bool(info) and info[0] == os.getpid()
+
+    def _mailbox(self, ctx_rank: int):
+        mb = self._mailboxes.get(ctx_rank)
+        if mb is None:
+            info = self.peer_info.get(ctx_rank)
+            if info is None:
+                raise UccError(Status.ERR_NOT_FOUND,
+                               f"no shm address for ctx rank {ctx_rank}")
+            mb = InProcTransport.resolve(info[1].encode()
+                                         if isinstance(info[1], str)
+                                         else info[1])
+            if mb is None:
+                raise UccError(Status.ERR_NOT_FOUND,
+                               f"shm peer {ctx_rank} endpoint gone")
+            self._mailboxes[ctx_rank] = mb
+        return mb
+
+    def send_to(self, peer_ctx_rank: int, key, data: np.ndarray):
+        return self.transport.send_nb(self._mailbox(peer_ctx_rank), key, data)
+
+    def destroy(self) -> None:
+        self.transport.close()
+
+
+class TlShmTeam(HostTlTeam):
+    NAME = "shm"
+
+    def __init__(self, comp_context, core_team, scope: str = "cl"):
+        super().__init__(comp_context, core_team, scope)
+        ctx_map = self.ctx_map
+        my_ctx = core_team.context.rank
+        for gr in range(self.size):
+            cr = ctx_map.eval(gr)
+            if cr != my_ctx and not comp_context.same_process(cr):
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               "tl/shm requires all team ranks in-process")
+
+
+TlShmTeam.TL_CLS = None  # set below
+
+
+@register_tl
+class TlShm(TransportLayer):
+    NAME = "shm"
+    DEFAULT_SCORE = 40            # intra-node prior (tl_cuda.h:28 = 40)
+    SUPPORTED_COLLS = COLL_TYPE_ALL
+    SUPPORTED_MEM_TYPES = (MemoryType.HOST,)
+    SERVICE_CAPABLE = True
+    CONTEXT_CONFIG = TL_SHM_CONFIG
+    lib_cls = BaseLib
+    context_cls = TlShmContext
+    team_cls = TlShmTeam
+
+
+TlShmTeam.TL_CLS = TlShm

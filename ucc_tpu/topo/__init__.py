@@ -1,0 +1,3 @@
+from .proc_info import ProcInfo, local_proc_info  # noqa: F401
+from .topo import ContextTopo, TeamTopo  # noqa: F401
+from .sbgp import Sbgp, SbgpType, SbgpStatus  # noqa: F401

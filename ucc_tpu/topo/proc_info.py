@@ -1,0 +1,52 @@
+"""Process/topology identity.
+
+Reference: /root/reference/src/utils/ucc_proc_info.h:35-40 —
+{host_hash, socket_id, numa_id, pid} gathered context-wide during address
+exchange. The TPU build adds the accelerator coordinates that matter on a
+pod: process index and local device ids (ICI-slice locality replaces
+socket/NUMA locality as the thing hierarchy cares about).
+"""
+from __future__ import annotations
+
+import os
+import socket as _socket
+import zlib
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+
+@dataclass(frozen=True)
+class ProcInfo:
+    host_hash: int
+    pid: int
+    socket_id: int = 0
+    numa_id: int = 0
+    #: jax process index (multi-host pods); -1 when jax not initialized
+    jax_process: int = -1
+
+    def same_host(self, other: "ProcInfo") -> bool:
+        return self.host_hash == other.host_hash
+
+
+def host_hash(name: str = "") -> int:
+    name = name or _socket.gethostname()
+    return zlib.crc32(name.encode())
+
+
+def local_proc_info() -> ProcInfo:
+    """Never triggers JAX backend initialization: proc info is gathered on
+    the host bootstrap path, possibly from several threads at once, and a
+    cold multi-thread TPU backend init can deadlock. Only reads the process
+    index when a backend already exists."""
+    jax_proc = -1
+    import sys
+    if "jax" in sys.modules:
+        try:
+            from jax._src import xla_bridge
+            if xla_bridge.backends_are_initialized():
+                import jax
+                jax_proc = jax.process_index()
+        except Exception:  # noqa: BLE001
+            jax_proc = -1
+    return ProcInfo(host_hash=host_hash(), pid=os.getpid(),
+                    jax_process=jax_proc)

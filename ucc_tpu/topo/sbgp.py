@@ -1,0 +1,60 @@
+"""Subgroups (sbgp) — topology-derived rank subsets.
+
+Reference: /root/reference/src/components/topo/ucc_sbgp.{h,c} — subgroup
+types (ucc_sbgp.h:11-41) and states NOT_EXISTS/ENABLED/DISABLED
+(ucc_sbgp.h:61-77). CL/HIER builds its hierarchy from these: NODE (ranks on
+my host), NODE_LEADERS (one rank per host), NET (my local-rank peers across
+hosts — the "rails"), FULL, FULL_HOST_ORDERED (ranks sorted so hosts are
+contiguous — used for rank reordering in TL algorithms).
+
+TPU reading: a "node" is a host driving an ICI-connected slice; NODE sbgp ≡
+intra-slice (ICI collectives), NODE_LEADERS ≡ inter-host (DCN).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..utils.ep_map import EpMap, Subset
+
+
+class SbgpType(enum.IntEnum):
+    NUMA = 0
+    SOCKET = 1
+    NODE = 2
+    NODE_LEADERS = 3
+    NET = 4
+    SOCKET_LEADERS = 5
+    NUMA_LEADERS = 6
+    FULL = 7
+    FULL_HOST_ORDERED = 8
+    LAST = 9
+
+
+class SbgpStatus(enum.IntEnum):
+    NOT_EXISTS = 0
+    ENABLED = 1
+    DISABLED = 2
+
+
+@dataclass
+class Sbgp:
+    type: SbgpType
+    status: SbgpStatus
+    #: my rank within the subgroup (-1 if not a member)
+    group_rank: int = -1
+    #: subgroup rank -> team rank
+    map: Optional[EpMap] = None
+
+    @property
+    def size(self) -> int:
+        return self.map.ep_num if self.map is not None else 0
+
+    @property
+    def is_member(self) -> bool:
+        return self.status == SbgpStatus.ENABLED and self.group_rank >= 0
+
+    def subset(self) -> Subset:
+        assert self.map is not None and self.group_rank >= 0
+        return Subset(self.map, self.group_rank)

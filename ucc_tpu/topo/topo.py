@@ -1,0 +1,125 @@
+"""Context/team topology.
+
+Reference: /root/reference/src/components/topo/ucc_topo.{h,c} —
+``ucc_context_topo_t`` (nnodes, min/max ppn, :17-34) built from the
+proc-info table gathered at context address exchange; per-team
+``ucc_topo_t`` (:56-80) evaluates subgroups lazily over the team's subset.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..utils.ep_map import EpMap
+from .proc_info import ProcInfo
+from .sbgp import Sbgp, SbgpStatus, SbgpType
+
+
+class ContextTopo:
+    """All processes' ProcInfo, indexed by context (OOB) rank."""
+
+    def __init__(self, procs: List[ProcInfo]):
+        self.procs = procs
+        hosts: Dict[int, List[int]] = {}
+        for r, p in enumerate(procs):
+            hosts.setdefault(p.host_hash, []).append(r)
+        self.hosts = hosts
+
+    @property
+    def nnodes(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def min_ppn(self) -> int:
+        return min(len(v) for v in self.hosts.values())
+
+    @property
+    def max_ppn(self) -> int:
+        return max(len(v) for v in self.hosts.values())
+
+
+class TeamTopo:
+    """Subgroup factory over a team (ucc_topo_t ucc_topo.h:56, sbgp
+    construction ucc_sbgp.c). ``team_ranks_to_ctx`` maps team rank -> ctx
+    rank (the team's ctx_map)."""
+
+    def __init__(self, ctx_topo: ContextTopo, ctx_map: EpMap, my_team_rank: int):
+        self.ctx_topo = ctx_topo
+        self.ctx_map = ctx_map
+        self.my_rank = my_team_rank
+        self._cache: Dict[SbgpType, Sbgp] = {}
+        self.team_size = ctx_map.ep_num
+
+    def _proc(self, team_rank: int) -> ProcInfo:
+        return self.ctx_topo.procs[self.ctx_map.eval(team_rank)]
+
+    def get_sbgp(self, t: SbgpType) -> Sbgp:
+        if t not in self._cache:
+            self._cache[t] = self._build(t)
+        return self._cache[t]
+
+    # ------------------------------------------------------------------
+    def _build(self, t: SbgpType) -> Sbgp:
+        size = self.team_size
+        if t == SbgpType.FULL:
+            return Sbgp(t, SbgpStatus.ENABLED, self.my_rank, EpMap.full(size))
+        if t == SbgpType.FULL_HOST_ORDERED:
+            order = sorted(range(size),
+                           key=lambda r: (self._proc(r).host_hash, r))
+            m = EpMap.from_array(order)
+            return Sbgp(t, SbgpStatus.ENABLED, order.index(self.my_rank), m)
+        if t == SbgpType.NODE:
+            my_host = self._proc(self.my_rank).host_hash
+            members = [r for r in range(size)
+                       if self._proc(r).host_hash == my_host]
+            if len(members) == size and self.ctx_topo.nnodes == 1:
+                # single-node team: NODE == FULL; reference still ENABLEs it
+                pass
+            grp_rank = members.index(self.my_rank)
+            return Sbgp(t, SbgpStatus.ENABLED, grp_rank,
+                        EpMap.from_array(members))
+        if t == SbgpType.NODE_LEADERS:
+            # leader = lowest team rank on each host; ordered by first
+            # appearance (reference uses node order of the team)
+            leaders: List[int] = []
+            seen = set()
+            for r in range(size):
+                hh = self._proc(r).host_hash
+                if hh not in seen:
+                    seen.add(hh)
+                    leaders.append(r)
+            if len(leaders) < 2:
+                return Sbgp(t, SbgpStatus.NOT_EXISTS)
+            grp_rank = leaders.index(self.my_rank) \
+                if self.my_rank in leaders else -1
+            status = SbgpStatus.ENABLED if grp_rank >= 0 else SbgpStatus.DISABLED
+            return Sbgp(t, status, grp_rank, EpMap.from_array(leaders))
+        if t == SbgpType.NET:
+            # my local-rank peers across nodes ("rails"): exists only when
+            # every node has the same ppn (ucc_sbgp.c net sbgp constraint)
+            if self.ctx_topo.nnodes < 2:
+                return Sbgp(t, SbgpStatus.NOT_EXISTS)
+            by_host: Dict[int, List[int]] = {}
+            for r in range(size):
+                by_host.setdefault(self._proc(r).host_hash, []).append(r)
+            ppns = {len(v) for v in by_host.values()}
+            if len(ppns) != 1:
+                return Sbgp(t, SbgpStatus.NOT_EXISTS)
+            my_host = self._proc(self.my_rank).host_hash
+            local_rank = by_host[my_host].index(self.my_rank)
+            members = [v[local_rank] for v in by_host.values()]
+            grp_rank = members.index(self.my_rank)
+            return Sbgp(t, SbgpStatus.ENABLED, grp_rank,
+                        EpMap.from_array(members))
+        # NUMA/SOCKET flavors: single-socket hosts assumed on TPU pods
+        return Sbgp(t, SbgpStatus.NOT_EXISTS)
+
+    @property
+    def n_nodes(self) -> int:
+        hosts = {self._proc(r).host_hash for r in range(self.team_size)}
+        return len(hosts)
+
+    def is_single_node(self) -> bool:
+        return self.n_nodes == 1
+
+    def all_procs_same_node(self) -> bool:
+        return self.is_single_node()
