@@ -1,0 +1,105 @@
+"""TL/SOCKET multi-process integration — the test/mpi-style real-transport
+check (reference test/mpi sweeps colls across processes; here 3 OS
+processes bootstrap via TcpStoreOob and run collectives over TCP)."""
+import multiprocessing as mp
+import os
+import pickle
+import sys
+
+import numpy as np
+import pytest
+
+
+def _worker(rank: int, size: int, port: int, q):
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["UCC_TLS"] = "socket,self"   # force the TCP path
+        import ucc_tpu
+        from ucc_tpu import (BufferInfo, CollArgs, CollType, ContextParams,
+                             DataType, ReductionOp, Status, TcpStoreOob,
+                             TeamParams)
+
+        oob = TcpStoreOob(rank, size, port=port)
+        lib = ucc_tpu.init()
+        ctx = ucc_tpu.Context(lib, ContextParams(oob=oob))
+        team_oob = TcpStoreOob(rank, size, port=port + 1)
+        team = ctx.create_team(TeamParams(oob=team_oob))
+
+        results = {}
+        # allreduce
+        src = np.full(32, rank + 1.0, np.float32)
+        dst = np.zeros(32, np.float32)
+        req = team.collective_init(CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(src, 32, DataType.FLOAT32),
+            dst=BufferInfo(dst, 32, DataType.FLOAT32),
+            op=ReductionOp.SUM))
+        req.post()
+        req.wait(timeout=60)
+        results["allreduce"] = float(dst[0])
+
+        # bcast from rank 1
+        buf = np.full(8, 42, np.int32) if rank == 1 else np.zeros(8, np.int32)
+        req = team.collective_init(CollArgs(
+            coll_type=CollType.BCAST, root=1,
+            src=BufferInfo(buf, 8, DataType.INT32)))
+        req.post()
+        req.wait(timeout=60)
+        results["bcast"] = int(buf[0])
+
+        # alltoall
+        total = 2 * size
+        srcs = np.arange(total, dtype=np.int32) + 100 * rank
+        dsta = np.zeros(total, np.int32)
+        req = team.collective_init(CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=BufferInfo(srcs, total, DataType.INT32),
+            dst=BufferInfo(dsta, total, DataType.INT32)))
+        req.post()
+        req.wait(timeout=60)
+        results["alltoall"] = dsta.tolist()
+
+        # barrier
+        req = team.collective_init(CollArgs(coll_type=CollType.BARRIER))
+        req.post()
+        req.wait(timeout=60)
+        results["barrier"] = "ok"
+
+        q.put((rank, results))
+        ctx.destroy()
+        if rank == 0:
+            oob.close()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        q.put((rank, {"error": f"{e}\n{traceback.format_exc()}"}))
+
+
+def test_socket_tl_three_processes():
+    size = 3
+    port = 31300 + os.getpid() % 1000
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_worker, args=(r, size, port, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(size):
+        rank, res = q.get(timeout=150)
+        results[rank] = res
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    for r in range(size):
+        assert "error" not in results[r], results[r].get("error")
+        assert results[r]["allreduce"] == 6.0       # 1+2+3
+        assert results[r]["bcast"] == 42
+        assert results[r]["barrier"] == "ok"
+    # alltoall: rank r's dst = concat over p of srcs[p][r*2:(r+1)*2]
+    for r in range(size):
+        expect = []
+        for p in range(size):
+            base = 100 * p
+            expect += [base + r * 2, base + r * 2 + 1]
+        assert results[r]["alltoall"] == expect

@@ -1,0 +1,212 @@
+"""TL/SOCKET — TCP tagged-p2p transport layer (the DCN path).
+
+The stand-in for TL/UCP's inter-node transport (UCX is absent on TPU
+pods — SURVEY §7.6): every context runs a small listener; worker addresses
+(host, port) ride the context OOB address exchange exactly like UCX worker
+addresses do in the reference (ucc_context.c:839-852); connections are
+established lazily on first send (tl/ucp preconnect analog would go in
+create_epilog). Reader threads demultiplex frames into the same Mailbox
+matching structure the in-process transport uses, so the entire host
+algorithm suite runs unchanged over TCP.
+
+Frame: [key_len u32][pickled key][payload_len u64][payload bytes].
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..constants import COLL_TYPE_ALL, MemoryType
+from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
+from ..ec.cpu import EcCpu
+from ..status import Status, UccError
+from ..utils.config import (ConfigField, ConfigTable, parse_mrange_uint,
+                            parse_string, register_table)
+from ..utils.log import get_logger
+from .host.team import HostTlTeam
+from .host.transport import Mailbox, RecvReq, SendReq, _PendingSend
+
+logger = get_logger("tl_socket")
+
+_HDR = struct.Struct("!IQ")
+
+TL_SOCKET_CONFIG = register_table(ConfigTable(
+    prefix="TL_SOCKET_", name="tl/socket", fields=[
+        ConfigField("ALLREDUCE_KN_RADIX", "0-inf:4", "allreduce knomial "
+                    "radix", parse_mrange_uint),
+        ConfigField("BCAST_KN_RADIX", "0-inf:4", "bcast tree radix",
+                    parse_mrange_uint),
+        ConfigField("REDUCE_KN_RADIX", "0-inf:4", "reduce tree radix",
+                    parse_mrange_uint),
+        ConfigField("BARRIER_KN_RADIX", "0-inf:4", "barrier radix",
+                    parse_mrange_uint),
+        ConfigField("BIND_HOST", "", "address to bind/advertise (default: "
+                    "auto-detect, 127.0.0.1 fallback)", parse_string),
+    ]))
+
+
+def _default_host() -> str:
+    try:
+        # a UDP "connection" picks the outbound interface without traffic
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        host = s.getsockname()[0]
+        s.close()
+        return host
+    except OSError:
+        return "127.0.0.1"
+
+
+class SocketTransport:
+    """Listener + lazy outbound connections + reader threads."""
+
+    def __init__(self, bind_host: str = ""):
+        self.mailbox = Mailbox()
+        self.host = bind_host or _default_host()
+        self.lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.lsock.bind((self.host if bind_host else "0.0.0.0", 0))
+        self.port = self.lsock.getsockname()[1]
+        self.lsock.listen(128)
+        self._conns: Dict[Tuple[str, int], socket.socket] = {}
+        self._send_locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._lock = threading.Lock()
+        self._closing = False
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self.lsock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                hdr = _recv_exact(conn, _HDR.size)
+                klen, plen = _HDR.unpack(hdr)
+                key = pickle.loads(_recv_exact(conn, klen))
+                payload = _recv_exact(conn, plen)
+                data = np.frombuffer(payload, dtype=np.uint8)
+                ps = _PendingSend(data, SendReq(done=True), copied=True)
+                self.mailbox.push(key, ps)
+        except (ConnectionError, OSError):
+            return
+
+    # ------------------------------------------------------------------
+    def _conn_to(self, addr: Tuple[str, int]) -> socket.socket:
+        with self._lock:
+            c = self._conns.get(addr)
+            if c is None:
+                c = socket.create_connection(addr, timeout=30)
+                c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[addr] = c
+                self._send_locks[addr] = threading.Lock()
+            return c
+
+    def send_to_addr(self, addr: Tuple[str, int], key, data: np.ndarray) -> SendReq:
+        payload = data.reshape(-1).view(np.uint8).tobytes()
+        kb = pickle.dumps(key)
+        frame = _HDR.pack(len(kb), len(payload)) + kb + payload
+        conn = self._conn_to(addr)
+        with self._send_locks[addr]:
+            conn.sendall(frame)
+        return SendReq(done=True)
+
+    def recv_nb(self, key, dst: np.ndarray) -> RecvReq:
+        req = RecvReq(dst.reshape(-1).view(np.uint8))
+        self.mailbox.post_recv(key, req)
+        return req
+
+    def progress(self) -> None:
+        pass  # reader threads drive delivery
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self.lsock.close()
+        except OSError:
+            pass
+        with self._lock:
+            for c in self._conns.values():
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        b = sock.recv(min(n - got, 1 << 20))
+        if not b:
+            raise ConnectionError("socket peer closed")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+class TlSocketContext(BaseContext):
+    def __init__(self, comp_lib, core_context, config):
+        super().__init__(comp_lib, core_context, config)
+        bind = config.bind_host if config else ""
+        self.transport = SocketTransport(bind)
+        self.executor = EcCpu()
+        self.peer_addrs: Dict[int, Tuple[str, int]] = {}
+
+    def pack_address(self) -> bytes:
+        return pickle.dumps((self.transport.host, self.transport.port))
+
+    def unpack_addresses(self, addrs: Dict[int, bytes]) -> None:
+        for rank, blob in addrs.items():
+            if blob:
+                self.peer_addrs[rank] = pickle.loads(blob)
+
+    def send_to(self, peer_ctx_rank: int, key, data: np.ndarray) -> SendReq:
+        addr = self.peer_addrs.get(peer_ctx_rank)
+        if addr is None:
+            raise UccError(Status.ERR_NOT_FOUND,
+                           f"no socket address for ctx rank {peer_ctx_rank}")
+        if peer_ctx_rank == self.core_context.rank:
+            # loopback without the network
+            data = data.reshape(-1).view(np.uint8)
+            self.transport.mailbox.push(
+                key, _PendingSend(data.copy(), SendReq(done=True), True))
+            return SendReq(done=True)
+        return self.transport.send_to_addr(addr, key, data)
+
+    def destroy(self) -> None:
+        self.transport.close()
+
+
+class TlSocketTeam(HostTlTeam):
+    NAME = "socket"
+
+
+@register_tl
+class TlSocket(TransportLayer):
+    NAME = "socket"
+    DEFAULT_SCORE = 10           # general-transport prior (tl_ucp.h:21 = 10)
+    SUPPORTED_COLLS = COLL_TYPE_ALL
+    SUPPORTED_MEM_TYPES = (MemoryType.HOST,)
+    SERVICE_CAPABLE = True
+    CONTEXT_CONFIG = TL_SOCKET_CONFIG
+    lib_cls = BaseLib
+    context_cls = TlSocketContext
+    team_cls = TlSocketTeam
+
+
+TlSocketTeam.TL_CLS = TlSocket
