@@ -12,3 +12,14 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# this environment preloads jax at interpreter startup, so the env vars
+# above may arrive too late for jax's import-time config read — force the
+# platform through the runtime config as well (backends init lazily)
+import sys
+if "jax" in sys.modules:
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 - backend already initialized
+        pass

@@ -74,9 +74,23 @@ def _worker(rank: int, size: int, port: int, q):
         q.put((rank, {"error": f"{e}\n{traceback.format_exc()}"}))
 
 
+def _free_port_pair():
+    import socket as _s
+    while True:
+        with _s.socket() as a:
+            a.bind(("127.0.0.1", 0))
+            port = a.getsockname()[1]
+        try:
+            with _s.socket() as b:
+                b.bind(("127.0.0.1", port + 1))
+            return port
+        except OSError:
+            continue
+
+
 def test_socket_tl_three_processes():
     size = 3
-    port = 31300 + os.getpid() % 1000
+    port = _free_port_pair()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     procs = [ctx.Process(target=_worker, args=(r, size, port, q))

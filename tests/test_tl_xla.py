@@ -1,0 +1,266 @@
+"""TL/XLA collective correctness on the virtual 8-device CPU mesh —
+the TPU compute path (BASELINE configs[1-2]: allreduce/allgather/bcast/
+barrier over the device mesh). Each UCC rank owns one jax device; buffers
+are jax.Arrays (MemoryType.TPU convention: dst.buffer is rebound to the
+result array)."""
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, BufferInfoV, CollArgs, CollArgsFlags,
+                     CollType, DataType, MemoryType, ReductionOp, Status)
+
+from harness import UccJob
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def job():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 virtual devices")
+    j = UccJob(4)
+    yield j
+    j.cleanup()
+
+
+@pytest.fixture(scope="module")
+def teams(job):
+    return job.create_team()
+
+
+def run_xla(job, teams, make_args):
+    reqs = [t.collective_init(make_args(i)) for i, t in enumerate(teams)]
+    for rq in reqs:
+        rq.post()
+    job.progress_until(lambda: all(
+        rq.test() != Status.IN_PROGRESS for rq in reqs))
+    for rq in reqs:
+        assert rq.test() == Status.OK, rq.test()
+    return reqs
+
+
+def dev_array(job, rank, np_arr):
+    dev = job.contexts[rank].tl_contexts["xla"].obj.device
+    return jax.device_put(jnp.asarray(np_arr), dev)
+
+
+def tpu_buf(job, rank, np_arr, dt):
+    arr = dev_array(job, rank, np_arr)
+    return BufferInfo(arr, int(np.prod(np_arr.shape)), dt,
+                      mem_type=MemoryType.TPU)
+
+
+class TestXlaAllreduce:
+    @pytest.mark.parametrize("count", [8, 1000])
+    def test_sum(self, job, teams, count):
+        n = 4
+        srcs = [np.full(count, r + 1.0, np.float32) for r in range(n)]
+        argses = []
+        for r in range(n):
+            argses.append(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=tpu_buf(job, r, srcs[r], DataType.FLOAT32),
+                dst=BufferInfo(None, count, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
+                op=ReductionOp.SUM))
+        run_xla(job, teams, lambda r: argses[r])
+        for r in range(n):
+            out = np.asarray(argses[r].dst.buffer)
+            np.testing.assert_allclose(out, np.full(count, 10.0))
+
+    def test_avg_bf16(self, job, teams):
+        n = 4
+        count = 64
+        argses = []
+        for r in range(n):
+            src = (np.ones(count) * (r + 1)).astype(jnp.bfloat16)
+            argses.append(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=tpu_buf(job, r, src, DataType.BFLOAT16),
+                dst=BufferInfo(None, count, DataType.BFLOAT16,
+                               mem_type=MemoryType.TPU),
+                op=ReductionOp.AVG))
+        run_xla(job, teams, lambda r: argses[r])
+        for r in range(n):
+            out = np.asarray(argses[r].dst.buffer).astype(np.float32)
+            np.testing.assert_allclose(out, 2.5)
+
+    @pytest.mark.parametrize("op,expect_fn", [
+        (ReductionOp.MAX, lambda s: np.maximum.reduce(s)),
+        (ReductionOp.PROD, lambda s: np.prod(np.stack(s), axis=0)),
+        (ReductionOp.BOR, lambda s: np.bitwise_or.reduce(s)),
+    ])
+    def test_exotic_ops(self, job, teams, op, expect_fn):
+        n = 4
+        count = 16
+        nd = np.int32
+        srcs = [(np.arange(count) % 5 + r + 1).astype(nd) for r in range(n)]
+        argses = [CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=tpu_buf(job, r, srcs[r], DataType.INT32),
+            dst=BufferInfo(None, count, DataType.INT32,
+                           mem_type=MemoryType.TPU),
+            op=op) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        expect = expect_fn(srcs)
+        for r in range(n):
+            np.testing.assert_array_equal(np.asarray(argses[r].dst.buffer),
+                                          expect)
+
+    def test_ring_alg_via_tune(self, monkeypatch):
+        monkeypatch.setenv("UCC_TL_XLA_TUNE", "allreduce:@ring:inf")
+        job = UccJob(4)
+        try:
+            teams = job.create_team()
+            count = 16   # divisible by 4 for the ring
+            argses = [CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=tpu_buf(job, r, np.full(count, r + 1.0, np.float32),
+                            DataType.FLOAT32),
+                dst=BufferInfo(None, count, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
+                op=ReductionOp.SUM) for r in range(4)]
+            run_xla(job, teams, lambda r: argses[r])
+            for r in range(4):
+                np.testing.assert_allclose(
+                    np.asarray(argses[r].dst.buffer), 10.0)
+        finally:
+            job.cleanup()
+
+
+class TestXlaOtherColls:
+    def test_allgather(self, job, teams):
+        n, per = 4, 5
+        srcs = [np.arange(per, dtype=np.float32) + 10 * r for r in range(n)]
+        argses = [CollArgs(
+            coll_type=CollType.ALLGATHER,
+            src=tpu_buf(job, r, srcs[r], DataType.FLOAT32),
+            dst=BufferInfo(None, per * n, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU)) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        expect = np.concatenate(srcs)
+        for r in range(n):
+            np.testing.assert_array_equal(np.asarray(argses[r].dst.buffer),
+                                          expect)
+
+    def test_allgatherv(self, job, teams):
+        n = 4
+        counts = [2, 5, 1, 3]
+        srcs = [np.arange(counts[r], dtype=np.int32) + 100 * r
+                for r in range(n)]
+        argses = [CollArgs(
+            coll_type=CollType.ALLGATHERV,
+            src=tpu_buf(job, r, srcs[r], DataType.INT32),
+            dst=BufferInfoV(None, counts, None, DataType.INT32,
+                            mem_type=MemoryType.TPU)) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        expect = np.concatenate(srcs)
+        for r in range(n):
+            np.testing.assert_array_equal(np.asarray(argses[r].dst.buffer),
+                                          expect)
+
+    def test_bcast(self, job, teams):
+        n, count, root = 4, 12, 2
+        argses = []
+        for r in range(n):
+            data = np.full(count, 7.5, np.float32) if r == root else \
+                np.zeros(count, np.float32)
+            argses.append(CollArgs(
+                coll_type=CollType.BCAST, root=root,
+                src=tpu_buf(job, r, data, DataType.FLOAT32)))
+        run_xla(job, teams, lambda r: argses[r])
+        for r in range(n):
+            np.testing.assert_array_equal(np.asarray(argses[r].src.buffer),
+                                          np.full(count, 7.5, np.float32))
+
+    def test_reduce(self, job, teams):
+        n, count, root = 4, 9, 1
+        srcs = [np.full(count, r + 1.0, np.float64) for r in range(n)]
+        argses = [CollArgs(
+            coll_type=CollType.REDUCE, root=root,
+            src=tpu_buf(job, r, srcs[r], DataType.FLOAT64),
+            dst=BufferInfo(None, count, DataType.FLOAT64,
+                           mem_type=MemoryType.TPU) if r == root else None,
+            op=ReductionOp.SUM) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        np.testing.assert_allclose(np.asarray(argses[root].dst.buffer), 10.0)
+
+    def test_alltoall(self, job, teams):
+        n, blk = 4, 3
+        total = n * blk
+        srcs = [np.arange(total, dtype=np.int32) + 100 * r for r in range(n)]
+        argses = [CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=tpu_buf(job, r, srcs[r], DataType.INT32),
+            dst=BufferInfo(None, total, DataType.INT32,
+                           mem_type=MemoryType.TPU)) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        for r in range(n):
+            expect = np.concatenate(
+                [srcs[p][r * blk:(r + 1) * blk] for p in range(n)])
+            np.testing.assert_array_equal(np.asarray(argses[r].dst.buffer),
+                                          expect)
+
+    def test_reduce_scatter(self, job, teams):
+        n, per = 4, 4
+        total = n * per
+        srcs = [np.arange(total, dtype=np.float32) * (r + 1)
+                for r in range(n)]
+        argses = [CollArgs(
+            coll_type=CollType.REDUCE_SCATTER,
+            src=tpu_buf(job, r, srcs[r], DataType.FLOAT32),
+            dst=BufferInfo(None, per, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU),
+            op=ReductionOp.SUM) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        expect = np.sum(srcs, axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(np.asarray(argses[r].dst.buffer),
+                                       expect[r * per:(r + 1) * per])
+
+    def test_scatter(self, job, teams):
+        n, per, root = 4, 3, 0
+        src = np.arange(per * n, dtype=np.float32)
+        argses = [CollArgs(
+            coll_type=CollType.SCATTER, root=root,
+            src=tpu_buf(job, r, src, DataType.FLOAT32) if r == root else None,
+            dst=BufferInfo(None, per, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU)) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        for r in range(n):
+            np.testing.assert_array_equal(np.asarray(argses[r].dst.buffer),
+                                          src[r * per:(r + 1) * per])
+
+    def test_barrier(self, job, teams):
+        argses = [CollArgs(coll_type=CollType.BARRIER,
+                           src=BufferInfo(None, 0, DataType.UINT8,
+                                          mem_type=MemoryType.TPU))
+                  for _ in range(4)]
+        run_xla(job, teams, lambda r: argses[r])
+
+
+class TestXlaProgramCache:
+    def test_second_call_uses_cache(self, job, teams):
+        n, count = 4, 32
+        shared = teams[0].cl_teams[0].tl_teams
+        # find the xla TL team and snapshot cache size after one coll
+        def one_round(val):
+            argses = [CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=tpu_buf(job, r, np.full(count, val, np.float32),
+                            DataType.FLOAT32),
+                dst=BufferInfo(None, count, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
+                op=ReductionOp.SUM) for r in range(n)]
+            run_xla(job, teams, lambda r: argses[r])
+            return argses
+
+        one_round(1.0)
+        xla_team = next(t for t in teams[0].cl_teams[0].tl_teams
+                        if t.name == "xla")
+        size_after_first = len(xla_team.shared.programs)
+        argses = one_round(2.0)
+        assert len(xla_team.shared.programs) == size_after_first
+        np.testing.assert_allclose(np.asarray(argses[0].dst.buffer), 8.0)

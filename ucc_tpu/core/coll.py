@@ -99,17 +99,20 @@ class CollRequest:
 
 
 def _resolve_mem_type(args: CollArgs) -> MemoryType:
-    """Memtype auto-detect (ucc_coll.c:25-36): prefer dst, else src."""
+    """Memtype auto-detect (ucc_coll.c:25-36). Every buffer gets its
+    mem_type resolved (TLs branch on it per-buffer); the collective's
+    selection memtype prefers dst, else src."""
+    chosen: Optional[MemoryType] = None
     for bi in (args.dst, args.src):
         if bi is None:
             continue
-        if bi.mem_type is not None:
-            return bi.mem_type
-        mt = detect_mem_type(bi.buffer)
-        if mt != MemoryType.UNKNOWN:
-            bi.mem_type = mt
-            return mt
-    return MemoryType.HOST
+        if bi.mem_type is None:
+            mt = detect_mem_type(bi.buffer)
+            if mt != MemoryType.UNKNOWN:
+                bi.mem_type = mt
+        if chosen is None and bi.mem_type is not None:
+            chosen = bi.mem_type
+    return chosen if chosen is not None else MemoryType.HOST
 
 
 def _is_zero_size(args: CollArgs) -> bool:

@@ -1,0 +1,198 @@
+"""Traceable collective operations — the compiled/ICI compute path.
+
+These are the XLA-native bodies of every UCC collective, usable in two
+ways:
+
+1. Inside any user ``shard_map``/``jit`` program (the TPU-native analog of
+   the reference's triggered-post/EE execution model, ucc.h:2050-2260: a
+   collective embedded in the device stream — here, embedded in the
+   compiled program, which is where TPUs want it).
+2. By TL/XLA (tl/xla.py), which wraps them in cached shard_map programs to
+   serve the eager init/post/test API over a team Mesh.
+
+All functions operate on a named mesh axis (default ``"r"`` = team ranks)
+and take shard-local arrays of shape ``(1, count)`` — one row per rank —
+matching TL/XLA's global layout ``(n_ranks, count)`` with
+``PartitionSpec('r', None)``.
+
+Op mapping (the TL/NCCL dt/op tables analog, tl_nccl_coll.c:21-75):
+SUM/AVG/MAX/MIN ride the native psum/pmax/pmin collectives (ICI-optimized
+by XLA); PROD/logical/bitwise/MINLOC/MAXLOC gather and reduce locally —
+semantically exact, one extra HBM pass, only used by exotic ops.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .constants import ReductionOp
+
+_NATIVE = {ReductionOp.SUM, ReductionOp.AVG, ReductionOp.MAX,
+           ReductionOp.MIN}
+
+
+def axis_size(axis_name: str) -> int:
+    return lax.axis_size(axis_name) if hasattr(lax, "axis_size") else \
+        lax.psum(1, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _gather_reduce(x, op: ReductionOp, axis_name: str):
+    """Exact fallback for ops without a native XLA collective."""
+    g = lax.all_gather(x, axis_name)            # (n, *x.shape)
+    if op == ReductionOp.PROD:
+        return jnp.prod(g, axis=0)
+    if op == ReductionOp.LAND:
+        return jnp.all(g != 0, axis=0).astype(x.dtype)
+    if op == ReductionOp.LOR:
+        return jnp.any(g != 0, axis=0).astype(x.dtype)
+    if op == ReductionOp.LXOR:
+        return (jnp.sum(g != 0, axis=0) % 2).astype(x.dtype)
+    if op == ReductionOp.BAND:
+        return _bitwise_fold(g, jnp.bitwise_and)
+    if op == ReductionOp.BOR:
+        return _bitwise_fold(g, jnp.bitwise_or)
+    if op == ReductionOp.BXOR:
+        return _bitwise_fold(g, jnp.bitwise_xor)
+    if op in (ReductionOp.MINLOC, ReductionOp.MAXLOC):
+        vals = g[..., 0::2]
+        idxs = g[..., 1::2]
+        pick = jnp.argmin(vals, axis=0) if op == ReductionOp.MINLOC \
+            else jnp.argmax(vals, axis=0)
+        sel_val = jnp.take_along_axis(vals, pick[None], axis=0)[0]
+        # ties -> lowest index (MPI loc semantics)
+        ties = vals == sel_val[None]
+        big = jnp.asarray(jnp.inf, dtype=vals.dtype) if \
+            jnp.issubdtype(vals.dtype, jnp.floating) else \
+            jnp.iinfo(vals.dtype).max
+        sel_idx = jnp.min(jnp.where(ties, idxs, big), axis=0)
+        out = jnp.empty_like(x)
+        out = out.at[..., 0::2].set(sel_val)
+        out = out.at[..., 1::2].set(sel_idx)
+        return out
+    raise NotImplementedError(f"op {op}")
+
+
+def _bitwise_fold(g, fn):
+    acc = g[0]
+    for i in range(1, g.shape[0]):
+        acc = fn(acc, g[i])
+    return acc
+
+
+def allreduce(x, op: ReductionOp = ReductionOp.SUM, axis_name: str = "r"):
+    """lax.psum-family allreduce (BASELINE north star: allreduce -> psum)."""
+    if op == ReductionOp.SUM:
+        return lax.psum(x, axis_name)
+    if op == ReductionOp.AVG:
+        return lax.pmean(x, axis_name)
+    if op == ReductionOp.MAX:
+        return lax.pmax(x, axis_name)
+    if op == ReductionOp.MIN:
+        return lax.pmin(x, axis_name)
+    return _gather_reduce(x, op, axis_name)
+
+
+def allreduce_ring(x, op: ReductionOp = ReductionOp.SUM, axis_name: str = "r"):
+    """Explicit ring allreduce via ppermute (reduce-scatter + allgather) —
+    the manual-schedule alternative the score DSL can select (@ring) when
+    XLA's own lowering is not wanted. Requires count % n == 0 (the TL pads)."""
+    n = axis_size(axis_name)
+    if op not in (ReductionOp.SUM, ReductionOp.AVG):
+        return allreduce(x, op, axis_name)
+    me = lax.axis_index(axis_name)
+    count = x.shape[-1]
+    blk = count // n
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # reduce-scatter phase: carry a partial block around the ring.
+    # Invariant: rank r starts with block (r-1); after permute at step s it
+    # holds the partial of block (r-2-s) and adds its local chunk of that
+    # block; after n-1 steps rank r holds fully-reduced block r.
+    def body(s, carry):
+        acc = lax.ppermute(carry, axis_name, perm)
+        idx = (me - 2 - s) % n
+        mine = lax.dynamic_slice_in_dim(x, idx * blk, blk, axis=-1)
+        return acc + mine
+
+    start_idx = (me - 1) % n
+    start = lax.dynamic_slice_in_dim(x, start_idx * blk, blk, axis=-1)
+    reduced = lax.fori_loop(0, n - 1, body, start)
+    if op == ReductionOp.AVG:
+        reduced = reduced / n
+    # allgather phase: row j of the gather is rank j's block == block j
+    gathered = lax.all_gather(reduced, axis_name, axis=0, tiled=False)
+    # gathered: (n, ..., blk) -> (..., n*blk)
+    out = jnp.moveaxis(gathered, 0, -2)
+    return out.reshape(x.shape[:-1] + (n * blk,))
+
+
+def reduce_scatter(x, op: ReductionOp = ReductionOp.SUM, axis_name: str = "r"):
+    """x: (..., total) -> (..., total/n), rank r gets block r
+    (lax.psum_scatter, tiled)."""
+    if op in (ReductionOp.SUM, ReductionOp.AVG):
+        out = lax.psum_scatter(x, axis_name, scatter_dimension=x.ndim - 1,
+                               tiled=True)
+        if op == ReductionOp.AVG:
+            out = out / axis_size(axis_name)
+        return out
+    full = _gather_reduce(x, op, axis_name)
+    n = axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    blk = x.shape[-1] // n
+    return lax.dynamic_slice_in_dim(full, me * blk, blk, axis=-1)
+
+
+def allgather(x, axis_name: str = "r"):
+    """x: (..., count) -> (..., n*count)."""
+    return lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+def alltoall(x, axis_name: str = "r"):
+    """x: (1, n*blk) -> (1, n*blk) with block exchange."""
+    n = axis_size(axis_name)
+    blk = x.shape[-1] // n
+    y = x.reshape(x.shape[:-1] + (n, blk))
+    y = lax.all_to_all(y, axis_name, split_axis=y.ndim - 2,
+                       concat_axis=y.ndim - 2, tiled=False)
+    return y.reshape(x.shape)
+
+
+def bcast(x, root: int, axis_name: str = "r"):
+    """Root's shard to everyone (masked psum — the ICI-friendly form)."""
+    me = lax.axis_index(axis_name)
+    masked = jnp.where(me == root, x, jnp.zeros_like(x))
+    if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.bool_:
+        return lax.psum(masked, axis_name).astype(x.dtype)
+    return lax.psum(masked, axis_name)
+
+
+def reduce(x, root: int, op: ReductionOp = ReductionOp.SUM,
+           axis_name: str = "r"):
+    """Allreduce whose result is consumed at root (XLA has no rooted
+    reduce; the all-form is what the hardware does anyway on ICI rings)."""
+    return allreduce(x, op, axis_name)
+
+
+def gather(x, root: int, axis_name: str = "r"):
+    return allgather(x, axis_name)
+
+
+def scatter(x_full, root: int, axis_name: str = "r"):
+    """Root holds (..., total); every rank gets its block."""
+    n = axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    blk = x_full.shape[-1] // n
+    full = bcast(x_full, root, axis_name)
+    return lax.dynamic_slice_in_dim(full, me * blk, blk, axis=-1)
+
+
+def barrier(axis_name: str = "r"):
+    return lax.psum(jnp.ones((1, 1), jnp.int32), axis_name)

@@ -33,12 +33,21 @@ class TlSelfTask(CollTask):
     def post_fn(self) -> Status:
         args = self.args
         if not args.is_inplace and args.src is not None and \
-                args.dst is not None and args.src.buffer is not None and \
-                args.dst.buffer is not None:
-            src_u8 = binfo_u8(args.src)
-            dst_u8 = binfo_u8(args.dst)
-            n = min(src_u8.size, dst_u8.size)
-            dst_u8[:n] = src_u8[:n]
+                args.dst is not None and args.src.buffer is not None:
+            if args.dst.mem_type == MemoryType.TPU:
+                # TPU buffer convention: jax.Arrays are immutable, the
+                # result is delivered by rebinding dst.buffer (see tl/xla).
+                # 1-rank semantics: result == src.
+                buf = args.src.buffer
+                if args.src.mem_type != MemoryType.TPU:
+                    import jax
+                    buf = jax.device_put(np.asarray(buf))
+                args.dst.buffer = buf
+            elif args.dst.buffer is not None:
+                src_u8 = binfo_u8(args.src)
+                dst_u8 = binfo_u8(args.dst)
+                n = min(src_u8.size, dst_u8.size)
+                dst_u8[:n] = src_u8[:n]
         self.status = Status.OK
         return Status.OK
 

@@ -106,23 +106,41 @@ class SocketTransport:
             return
 
     # ------------------------------------------------------------------
-    def _conn_to(self, addr: Tuple[str, int]) -> socket.socket:
+    def _addr_lock(self, addr: Tuple[str, int]) -> threading.Lock:
         with self._lock:
-            c = self._conns.get(addr)
-            if c is None:
-                c = socket.create_connection(addr, timeout=30)
-                c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conns[addr] = c
-                self._send_locks[addr] = threading.Lock()
-            return c
+            lk = self._send_locks.get(addr)
+            if lk is None:
+                lk = self._send_locks[addr] = threading.Lock()
+            return lk
+
+    def _conn_to(self, addr: Tuple[str, int]) -> socket.socket:
+        """Must be called with the per-addr lock held. Connection
+        establishment happens outside the transport-wide lock so one slow
+        or dead peer cannot stall sends to healthy peers."""
+        c = self._conns.get(addr)
+        if c is None:
+            c = socket.create_connection(addr, timeout=30)
+            c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns[addr] = c
+        return c
 
     def send_to_addr(self, addr: Tuple[str, int], key, data: np.ndarray) -> SendReq:
         payload = data.reshape(-1).view(np.uint8).tobytes()
         kb = pickle.dumps(key)
         frame = _HDR.pack(len(kb), len(payload)) + kb + payload
-        conn = self._conn_to(addr)
-        with self._send_locks[addr]:
-            conn.sendall(frame)
+        with self._addr_lock(addr):
+            conn = self._conn_to(addr)
+            try:
+                conn.sendall(frame)
+            except (ConnectionError, OSError):
+                # evict the broken socket and retry once (peer restart)
+                self._conns.pop(addr, None)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                conn = self._conn_to(addr)
+                conn.sendall(frame)
         return SendReq(done=True)
 
     def recv_nb(self, key, dst: np.ndarray) -> RecvReq:

@@ -1,0 +1,565 @@
+"""TL/XLA — the TPU transport layer: collectives as compiled XLA programs
+over a team ``jax.sharding.Mesh``.
+
+This is the BASELINE.json north star ("TL/NCCL -> TL/XLA"): where the
+reference posts ncclAllReduce onto a CUDA stream (tl_nccl), this TL maps a
+team onto a 1-D device mesh (rank == chip), compiles each collective once
+as a ``shard_map`` program (cached per coll/op/dtype/shape), and dispatches
+it asynchronously — JAX's async dispatch *is* the nonblocking post/test
+contract, so ``test()`` maps to output-array readiness instead of a host
+progress loop.
+
+Execution model (rendezvous dispatch): every team rank is a UCC context;
+the ranks of one process share an ``XlaTeamShared`` object. ``post()``
+deposits the rank's local buffer; the last local rank to post launches the
+compiled program over the global array built from the per-device shards
+(``make_array_from_single_device_arrays`` — the same call pattern scales
+to multi-host jax.distributed, where each process holds its local shards).
+Device claim: the i-th context of a process owns ``jax.local_devices()[i]``;
+a context without a device fails XLA team create, and the CL falls back to
+host TLs (the reference's team-create fallback chain, ucc_team.c:295-317).
+
+Buffer convention for MemoryType.TPU: jax.Arrays are immutable, so the
+result is delivered by REBINDING ``args.dst.buffer`` to the output array
+(the TPU-native analog of writing into dst memory; donation-style).
+MemoryType.HOST buffers are staged via device_put and copied back.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api.types import BufferInfo, BufferInfoV
+from ..constants import (COLL_TYPE_ALL, CollType, MemoryType, ReductionOp,
+                         dt_numpy)
+from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
+from ..schedule.task import CollTask
+from ..score.score import CollScore
+from ..status import Status, UccError
+from ..utils.config import (ConfigField, ConfigTable, parse_string,
+                            register_table)
+from ..utils.ep_map import EpMap
+from ..utils.log import get_logger
+from .base import AlgSpec, TlTeamBase, binfo_typed, build_scores
+
+logger = get_logger("tl_xla")
+
+TL_XLA_CONFIG = register_table(ConfigTable(
+    prefix="TL_XLA_", name="tl/xla", fields=[
+        ConfigField("DEVICE_KIND", "", "restrict to a device platform "
+                    "(tpu/cpu); empty = default backend", parse_string),
+    ]))
+
+
+# ---------------------------------------------------------------------------
+# context: device claim
+# ---------------------------------------------------------------------------
+
+class TlXlaContext(BaseContext):
+    def __init__(self, comp_lib, core_context, config):
+        super().__init__(comp_lib, core_context, config)
+        import jax
+        self.jax = jax
+        kind = config.device_kind if config else ""
+        self.local_devices = jax.local_devices() if not kind else [
+            d for d in jax.local_devices() if d.platform == kind]
+        self.device = None           # claimed after address exchange
+        self.peer_devices: Dict[int, int] = {}   # ctx rank -> global dev id
+        self._my_pid_ordinal = 0
+
+    def pack_address(self) -> bytes:
+        import os
+
+        from ..topo.proc_info import host_hash
+        # pids are only unique per host: identify processes by
+        # (host_hash, pid) so multi-host jobs with colliding pids work
+        return pickle.dumps(((host_hash(), os.getpid()),
+                             [d.id for d in self.local_devices]))
+
+    def unpack_addresses(self, addrs: Dict[int, bytes]) -> None:
+        per_proc_counter: Dict[tuple, int] = {}
+        infos = {}
+        for rank in sorted(addrs):
+            if not addrs[rank]:
+                continue
+            proc, dev_ids = pickle.loads(addrs[rank])
+            ordinal = per_proc_counter.get(proc, 0)
+            per_proc_counter[proc] = ordinal + 1
+            infos[rank] = (proc, ordinal, dev_ids)
+        for rank, (proc, ordinal, dev_ids) in infos.items():
+            if ordinal < len(dev_ids):
+                self.peer_devices[rank] = dev_ids[ordinal]
+            if rank == self.core_context.rank:
+                self._my_pid_ordinal = ordinal
+                if ordinal < len(self.local_devices):
+                    self.device = self.local_devices[ordinal]
+
+    def ensure_single_rank_device(self) -> None:
+        """No OOB exchange happened (1-rank context): claim device 0."""
+        if self.device is None and not self.peer_devices and \
+                self.local_devices:
+            self.device = self.local_devices[0]
+            self.peer_devices[self.core_context.rank] = self.device.id
+
+
+# ---------------------------------------------------------------------------
+# shared per-team state (process-global rendezvous)
+# ---------------------------------------------------------------------------
+
+_SHARED: Dict[Any, "XlaTeamShared"] = {}
+_SHARED_LOCK = threading.Lock()
+
+
+class XlaTeamShared:
+    def __init__(self, key, mesh, devices, n_local: int):
+        self.key = key
+        self.mesh = mesh
+        self.devices = devices          # team rank -> jax.Device
+        self.n_local = n_local
+        self.lock = threading.Lock()
+        self.programs: Dict[Any, Any] = {}
+        #: tag -> {team_rank: (shard_np_or_jax, task)}
+        self.pending: Dict[int, Dict[int, Tuple[Any, "XlaCollTask"]]] = {}
+        self.refcount = 0
+
+    @classmethod
+    def get_or_create(cls, key, mesh_fn) -> "XlaTeamShared":
+        with _SHARED_LOCK:
+            shared = _SHARED.get(key)
+            if shared is None:
+                shared = _SHARED[key] = mesh_fn()
+            shared.refcount += 1
+            return shared
+
+    def put(self) -> None:
+        with _SHARED_LOCK:
+            self.refcount -= 1
+            if self.refcount <= 0:
+                _SHARED.pop(self.key, None)
+
+    # ------------------------------------------------------------------
+    def deposit(self, tag, team_rank: int, shard, task: "XlaCollTask") -> None:
+        with self.lock:
+            slot = self.pending.setdefault(tag, {})
+            slot[team_rank] = (shard, task)
+            ready = len(slot) == self.n_local
+            if ready:
+                del self.pending[tag]
+        if ready:
+            self._launch(slot)
+
+    def _launch(self, slot) -> None:
+        import jax
+        try:
+            # deterministic proto: the lowest team rank's task (the program
+            # must not depend on deposit order)
+            proto = slot[min(slot)][1]
+            program, count_padded = proto.build_program(self)
+            n = len(self.devices)
+            nd = proto.np_dtype
+            global_shape = (n, count_padded)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sharding = NamedSharding(self.mesh, P("r", None))
+            shards = []
+            for rank, (buf, task) in sorted(slot.items()):
+                row = task.shard_for_launch(buf, count_padded)
+                shards.append(jax.device_put(row, self.devices[rank]))
+            garr = jax.make_array_from_single_device_arrays(
+                global_shape, sharding, shards)
+            out = program(garr)
+            for rank, (_, task) in slot.items():
+                task.set_result(out)
+        except Exception as e:  # noqa: BLE001 - compile/dispatch failure
+            logger.exception("xla collective launch failed")
+            for rank, (_, task) in slot.items():
+                task.status = Status.ERR_NO_MESSAGE
+
+
+# ---------------------------------------------------------------------------
+# tasks
+# ---------------------------------------------------------------------------
+
+class XlaCollTask(CollTask):
+    """One rank's view of a dispatched XLA collective."""
+
+    def __init__(self, init_args, team: "TlXlaTeam", alg: str = "xla"):
+        super().__init__(team=team, args=init_args.args)
+        self.init_args = init_args
+        self.tl_team = team
+        self.alg = alg
+        self.tag = team.next_coll_tag()
+        self.result_array = None
+        self._out = None
+        args = init_args.args
+        self.np_dtype = dt_numpy((args.src or args.dst).datatype)
+        self.coll = args.coll_type
+
+    # -- launch plumbing -------------------------------------------------
+    def local_src(self):
+        args = self.args
+        bi = args.src if args.src is not None and not args.is_inplace else args.dst
+        if self.coll == CollType.BARRIER or bi is None or bi.buffer is None:
+            # contribution-less ranks (scatter non-root, barrier, dst-only)
+            # deposit typed zero padding
+            return np.zeros(1, dtype=self.np_dtype)
+        if bi.mem_type == MemoryType.TPU:
+            return bi.buffer    # jax array, stays on device
+        return binfo_typed(bi)
+
+    def src_count(self) -> int:
+        """Per-rank launch count — MUST be identical on every team rank
+        (the program cache key and the global array shape depend on it)."""
+        args = self.args
+        n = self.tl_team.size
+        if self.coll == CollType.SCATTER:
+            # non-roots have no src; everyone launches with the total
+            if args.src is not None and args.src.buffer is not None:
+                return int(args.src.count)
+            return int(args.dst.count) * n
+        if self.coll in (CollType.ALLGATHERV, CollType.GATHERV):
+            vc = self._vkey()
+            if vc is None:
+                # the launch shape and compiled program derive from the
+                # counts vector, so every rank must pass it (dst BufferInfoV
+                # with counts; buffer needed only at root)
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               "tl/xla gatherv/allgatherv requires the "
+                               "counts vector on every rank")
+            return max(int(c) for c in vc)
+        s = self.local_src()
+        return int(np.prod(s.shape)) if s is not None else 0
+
+    def shard_for_launch(self, buf, count_padded: int):
+        import jax.numpy as jnp
+        if isinstance(buf, np.ndarray):
+            flat = buf.reshape(-1)
+        else:
+            flat = jnp.ravel(buf)
+        if flat.size > count_padded:
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           f"rank contribution ({flat.size}) exceeds the "
+                           f"launch shape ({count_padded}): per-rank counts "
+                           "are inconsistent across the team")
+        if flat.size < count_padded:
+            pad = (np.pad if isinstance(flat, np.ndarray) else jnp.pad)
+            flat = pad(flat, (0, count_padded - flat.size))
+        return flat[None, :count_padded]
+
+    def build_program(self, shared: XlaTeamShared):
+        """Compiled shard_map program + padded per-rank count (cached)."""
+        args = self.args
+        n = len(shared.devices)
+        count = self.src_count()
+        key = (self.coll, args.op, self.np_dtype.str, count, self.alg,
+               int(args.root) if args.is_rooted else 0, self._vkey())
+        cached = shared.programs.get(key)
+        if cached is not None:
+            return cached
+        program, padded = _build_xla_program(
+            shared.mesh, n, self.coll, args, self.np_dtype, count, self.alg)
+        shared.programs[key] = (program, padded)
+        return program, padded
+
+    def _vkey(self):
+        for bi in (self.args.src, self.args.dst):
+            if isinstance(bi, BufferInfoV) and bi.counts is not None:
+                return tuple(int(c) for c in bi.counts)
+        return None
+
+    # -- lifecycle --------------------------------------------------------
+    def post_fn(self) -> Status:
+        shared = self.tl_team.shared
+        shard = self.local_src()
+        if isinstance(shard, np.ndarray):
+            shard = shard.copy()   # snapshot: user may reuse src immediately
+        shared.deposit(self.tag, self.tl_team.rank, shard, self)
+        return Status.OK
+
+    def set_result(self, out) -> None:
+        self._out = out
+
+    def progress_fn(self) -> None:
+        if self.status != Status.IN_PROGRESS:
+            return
+        if self._out is None:
+            return  # not launched yet (other local ranks haven't posted)
+        try:
+            ready = self._out.is_ready() if hasattr(self._out, "is_ready") \
+                else True
+        except Exception:  # noqa: BLE001
+            ready = True
+        if not ready:
+            return
+        try:
+            self._copy_out()
+            self.status = Status.OK
+        except UccError as e:
+            self.status = e.status
+        except Exception:  # noqa: BLE001
+            logger.exception("xla collective copy-out failed")
+            self.status = Status.ERR_NO_MESSAGE
+
+    # -- output landing ----------------------------------------------------
+    def _my_out_np(self) -> np.ndarray:
+        """This rank's row of the output global array."""
+        dev = self.tl_team.shared.devices[self.tl_team.rank]
+        for shard in self._out.addressable_shards:
+            if shard.device == dev:
+                return np.asarray(shard.data)[0]
+        # replicated output: any shard works
+        return np.asarray(self._out.addressable_shards[0].data)[0]
+
+    def _my_out_jax(self):
+        dev = self.tl_team.shared.devices[self.tl_team.rank]
+        for shard in self._out.addressable_shards:
+            if shard.device == dev:
+                return shard.data[0]
+        return self._out.addressable_shards[0].data[0]
+
+    def _copy_out(self) -> None:
+        args = self.args
+        coll = self.coll
+        me = self.tl_team.rank
+        n = self.tl_team.size
+        if coll in (CollType.BARRIER, CollType.FANIN, CollType.FANOUT):
+            return
+        if coll in (CollType.REDUCE, CollType.GATHER, CollType.GATHERV) and \
+                me != int(args.root):
+            return
+        dst = args.dst if args.dst is not None else args.src  # inplace/bcast
+        if dst is None or (dst.buffer is None and
+                           dst.mem_type != MemoryType.TPU):
+            return
+        off = 0
+        rsv_want = None
+        if coll == CollType.REDUCE_SCATTERV and isinstance(dst, BufferInfoV):
+            # program returns the full reduced vector; slice my v-block
+            counts = [int(c) for c in dst.counts]
+            off = int(dst.displacements[me]) if dst.displacements is not None \
+                else sum(counts[:me])
+            rsv_want = counts[me]
+        if dst.mem_type == MemoryType.TPU:
+            out = self._my_out_jax()
+            if rsv_want is not None:
+                dst.buffer = out[off:off + rsv_want]
+            else:
+                dst.buffer = self._unpad_jax(out, dst)
+            self.result_array = dst.buffer
+            return
+        row = self._my_out_np()
+        view = binfo_typed(dst, count=rsv_want) if rsv_want is not None \
+            else binfo_typed(dst)
+        view[:] = row[off:off + view.size]
+
+    def _unpad_jax(self, out, dst) -> Any:
+        want = int(dst.count) if isinstance(dst, BufferInfo) else \
+            sum(int(c) for c in dst.counts)
+        return out[:want] if out.shape[-1] != want else out
+
+    def finalize_fn(self) -> Status:
+        return Status.OK
+
+
+# ---------------------------------------------------------------------------
+# program construction
+# ---------------------------------------------------------------------------
+
+def _build_xla_program(mesh, n: int, coll: CollType, args, nd, count: int,
+                       alg: str):
+    """Build + jit the shard_map program for one (coll, shape) instance.
+    Returns (callable, padded_per_rank_count)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .. import ops
+
+    shard_map = jax.shard_map if hasattr(jax, "shard_map") else None
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map  # type: ignore
+
+    op = args.op if args.op is not None else ReductionOp.SUM
+    root = int(args.root)
+    padded = max(count, 1)
+
+    # pad so every blockish coll divides evenly
+    if coll in (CollType.ALLTOALL, CollType.SCATTER, CollType.SCATTERV,
+                CollType.REDUCE_SCATTER, CollType.REDUCE_SCATTERV) or \
+            alg == "ring":
+        rem = padded % n
+        if rem:
+            padded += n - rem
+
+    vcounts = None
+    for bi in (args.src, args.dst):
+        if isinstance(bi, BufferInfoV) and bi.counts is not None:
+            vcounts = [int(c) for c in bi.counts]
+
+    def body(x):          # x: (1, padded) shard-local
+        if coll == CollType.ALLREDUCE:
+            if alg == "ring" and op in (ReductionOp.SUM, ReductionOp.AVG):
+                return ops.allreduce_ring(x, op)
+            return ops.allreduce(x, op)
+        if coll == CollType.REDUCE:
+            return ops.reduce(x, root, op)
+        if coll == CollType.BCAST:
+            return ops.bcast(x, root)
+        if coll == CollType.BARRIER or coll == CollType.FANIN or \
+                coll == CollType.FANOUT:
+            return ops.barrier()
+        if coll == CollType.ALLGATHER or coll == CollType.GATHER:
+            return ops.allgather(x)
+        if coll == CollType.ALLGATHERV or coll == CollType.GATHERV:
+            g = ops.allgather(x)            # (1, n*padded)
+            rows = g.reshape(n, padded)
+            parts = [rows[i, :vcounts[i]] for i in range(n)]
+            return jnp.concatenate(parts)[None, :]
+        if coll == CollType.ALLTOALL:
+            return ops.alltoall(x)
+        if coll == CollType.REDUCE_SCATTER or coll == CollType.REDUCE_SCATTERV:
+            if vcounts is None:
+                return ops.reduce_scatter(x, op)
+            full = ops.allreduce(x, op)      # exact v-block split below
+            return full
+        if coll == CollType.SCATTER:
+            return ops.scatter(x, root)
+        raise UccError(Status.ERR_NOT_SUPPORTED,
+                       f"tl/xla does not build {coll}")
+
+    in_specs = P("r", None)
+    if coll in (CollType.ALLGATHER, CollType.GATHER, CollType.ALLGATHERV,
+                CollType.GATHERV):
+        out_specs = P(None, None)     # replicated full result
+    elif coll in (CollType.REDUCE_SCATTER, CollType.REDUCE_SCATTERV) and \
+            vcounts is not None:
+        out_specs = P(None, None)
+    else:
+        out_specs = P("r", None)
+
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False) if _accepts_check_vma(shard_map) else \
+        shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+    program = jax.jit(fn)
+    return program, padded
+
+
+def _accepts_check_vma(shard_map) -> bool:
+    import inspect
+    try:
+        return "check_vma" in inspect.signature(shard_map).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# team
+# ---------------------------------------------------------------------------
+
+class TlXlaTeam(TlTeamBase):
+    NAME = "xla"
+    TL_CLS: Any = None
+
+    def __init__(self, comp_context: TlXlaContext, core_team, scope="cl"):
+        super().__init__(comp_context, core_team, scope)
+        import os
+
+        import jax
+        from jax.sharding import Mesh
+
+        ctx = comp_context
+        if core_team.size == 1:
+            ctx.ensure_single_rank_device()
+        if ctx.device is None:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "tl/xla: context has no claimed device")
+        ctx_map = core_team.ctx_map or EpMap.full(core_team.size)
+        dev_by_id = {d.id: d for d in ctx.jax.devices()}
+        devices = []
+        for gr in range(self.size):
+            cr = ctx_map.eval(gr)
+            if cr == core_team.context.rank:
+                dev_id = ctx.device.id
+            else:
+                dev_id = ctx.peer_devices.get(cr)
+            if dev_id is None or dev_id not in dev_by_id:
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               f"tl/xla: no device for team rank {gr}")
+            devices.append(dev_by_id[dev_id])
+        if len({d.id for d in devices}) != len(devices):
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "tl/xla: device collision across team ranks")
+        self._coll_tag = 0
+        key = (core_team.team_key, scope, "xla")
+        mesh = Mesh(np.array(devices), ("r",))
+        n_local = sum(1 for gr in range(self.size)
+                      if ctx_map.eval(gr) in _local_ctx_ranks(core_team))
+        self.shared = XlaTeamShared.get_or_create(
+            key, lambda: XlaTeamShared(key, mesh, devices, n_local))
+
+    def next_coll_tag(self) -> int:
+        self._coll_tag += 1
+        return self._coll_tag
+
+    # ------------------------------------------------------------------
+    def alg_table(self) -> Dict[CollType, List[AlgSpec]]:
+        def spec(i, name, **kw):
+            def init(ia, team, _kw=kw):
+                return XlaCollTask(ia, self, **_kw)
+            return AlgSpec(i, name, init)
+
+        table = {ct: [spec(0, "xla")] for ct in (
+            CollType.ALLREDUCE, CollType.REDUCE, CollType.BCAST,
+            CollType.BARRIER, CollType.FANIN, CollType.FANOUT,
+            CollType.ALLGATHER, CollType.ALLGATHERV, CollType.GATHER,
+            CollType.GATHERV, CollType.ALLTOALL, CollType.REDUCE_SCATTER,
+            CollType.REDUCE_SCATTERV, CollType.SCATTER)}
+        table[CollType.ALLREDUCE].append(spec(1, "ring", alg="ring"))
+        return table
+
+    def get_scores(self) -> CollScore:
+        return build_scores(self, TlXla.DEFAULT_SCORE, self.alg_table(),
+                            TlXla.SUPPORTED_MEM_TYPES,
+                            tune_env="UCC_TL_XLA_TUNE")
+
+    def destroy(self) -> None:
+        self.shared.put()
+
+
+def _local_ctx_ranks(core_team) -> set:
+    """Ctx ranks living in this process ((host, pid) match via the
+    proc-info table gathered at context address exchange)."""
+    import os
+
+    from ..topo.proc_info import host_hash
+    me = (host_hash(), os.getpid())
+    out = set()
+    storage = core_team.context.addr_storage
+    for r, entry in enumerate(storage):
+        if (entry["proc"].host_hash, entry["proc"].pid) == me:
+            out.add(r)
+    return out
+
+
+@register_tl
+class TlXla(TransportLayer):
+    NAME = "xla"
+    DEFAULT_SCORE = 40            # accelerator-fabric prior (tl_cuda.h:28)
+    SUPPORTED_COLLS = (CollType.ALLREDUCE | CollType.REDUCE | CollType.BCAST
+                       | CollType.BARRIER | CollType.FANIN | CollType.FANOUT
+                       | CollType.ALLGATHER | CollType.ALLGATHERV
+                       | CollType.GATHER | CollType.GATHERV
+                       | CollType.ALLTOALL | CollType.REDUCE_SCATTER
+                       | CollType.REDUCE_SCATTERV | CollType.SCATTER)
+    SUPPORTED_MEM_TYPES = (MemoryType.TPU,)
+    SERVICE_CAPABLE = False
+    CONTEXT_CONFIG = TL_XLA_CONFIG
+    lib_cls = BaseLib
+    context_cls = TlXlaContext
+    team_cls = TlXlaTeam
+
+
+TlXlaTeam.TL_CLS = TlXla
