@@ -1,0 +1,179 @@
+"""CL/HIER tests — hierarchical collectives over a simulated multi-node
+topology (UCC_TOPO_FAKE_PPN groups in-process ranks into virtual nodes,
+playing the role the reference's simulated-topology gtest fixtures play).
+Covers RAB allreduce (incl. pipelined + AVG), split_rail, 2step bcast/
+reduce, hierarchical barrier, and selection precedence over cl/basic."""
+import os
+
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, CollArgs, CollArgsFlags, CollType, DataType,
+                     ReductionOp, Status)
+from ucc_tpu.topo.sbgp import SbgpType
+
+from harness import UccJob
+
+
+@pytest.fixture(scope="module")
+def job():
+    os.environ["UCC_TOPO_FAKE_PPN"] = "4"   # 8 ranks -> 2 nodes x 4
+    j = UccJob(8)
+    yield j
+    j.cleanup()
+    os.environ.pop("UCC_TOPO_FAKE_PPN", None)
+
+
+@pytest.fixture(scope="module")
+def teams(job):
+    return job.create_team()
+
+
+def hier_team_of(team):
+    for clt in team.cl_teams:
+        if clt.name == "hier":
+            return clt
+    return None
+
+
+class TestHierTopology:
+    def test_hier_team_created(self, teams):
+        assert hier_team_of(teams[0]) is not None
+
+    def test_sbgps(self, teams):
+        ht = hier_team_of(teams[0])   # rank 0: leader of node 0
+        assert ht.sbgp(SbgpType.NODE).sbgp.size == 4
+        assert ht.sbgp(SbgpType.NODE_LEADERS) is not None
+        assert ht.sbgp(SbgpType.NODE_LEADERS).sbgp.size == 2
+        ht3 = hier_team_of(teams[3])  # rank 3: not a leader
+        assert ht3.sbgp(SbgpType.NODE_LEADERS) is None
+        # NET rails exist (equal ppn)
+        assert ht.sbgp(SbgpType.NET) is not None
+
+    def test_hier_wins_selection(self, teams):
+        cands = teams[0].score_map.lookup(CollType.ALLREDUCE,
+                                          ucc_tpu.MemoryType.HOST, 1 << 20)
+        assert cands[0].alg_name in ("rab", "split_rail")
+
+
+class TestHierAllreduce:
+    @pytest.mark.parametrize("count", [1, 40, 4096])
+    def test_rab_sum(self, job, teams, count):
+        n = 8
+        srcs = [np.full(count, r + 1.0, np.float32) for r in range(n)]
+        dsts = [np.zeros(count, np.float32) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+            op=ReductionOp.SUM))
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], 36.0)
+
+    def test_rab_avg(self, job, teams):
+        n, count = 8, 33
+        srcs = [np.full(count, float(r), np.float64) for r in range(n)]
+        dsts = [np.zeros(count, np.float64) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+            op=ReductionOp.AVG))
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], 3.5)
+
+    def test_rab_inplace(self, job, teams):
+        n, count = 8, 16
+        bufs = [np.full(count, r + 1.0, np.float32) for r in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            dst=BufferInfo(bufs[r], count, DataType.FLOAT32),
+            op=ReductionOp.SUM, flags=CollArgsFlags.IN_PLACE))
+        for r in range(n):
+            np.testing.assert_allclose(bufs[r], 36.0)
+
+    def test_split_rail_via_tune(self, monkeypatch):
+        monkeypatch.setenv("UCC_TOPO_FAKE_PPN", "4")
+        monkeypatch.setenv("UCC_CL_HIER_TUNE", "")  # reserved
+        job = UccJob(8)
+        try:
+            teams = job.create_team()
+            ht = hier_team_of(teams[0])
+            count = 64
+            srcs = [np.full(count, r + 1.0, np.float64) for r in range(8)]
+            dsts = [np.zeros(count, np.float64) for _ in range(8)]
+            # drive split_rail directly through the hier score entries
+            from ucc_tpu.core.coll import InitArgs
+            from ucc_tpu.cl.hier.algs import split_rail_init
+            reqs = []
+            for r in range(8):
+                args = CollArgs(
+                    coll_type=CollType.ALLREDUCE,
+                    src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+                    dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+                    op=ReductionOp.SUM)
+                ia = InitArgs(args=args, team=teams[r],
+                              mem_type=ucc_tpu.MemoryType.HOST,
+                              msgsize=count * 8)
+                task = split_rail_init(ia, hier_team_of(teams[r]))
+                task.progress_queue = job.contexts[r].progress_queue
+                reqs.append(task)
+            for t in reqs:
+                t.post()
+            job.progress_until(lambda: all(t.is_completed() for t in reqs))
+            for r in range(8):
+                assert reqs[r].super_status == Status.OK
+                np.testing.assert_allclose(dsts[r], 36.0)
+        finally:
+            job.cleanup()
+
+    def test_rab_pipelined(self, monkeypatch):
+        monkeypatch.setenv("UCC_TOPO_FAKE_PPN", "2")
+        monkeypatch.setenv("UCC_CL_HIER_ALLREDUCE_RAB_PIPELINE",
+                           "thresh=64:fragsize=256:nfrags=4:pdepth=2:sequential")
+        job = UccJob(4)
+        try:
+            teams = job.create_team()
+            count = 1000   # 4000 bytes -> ~16 fragments of 256B
+            srcs = [np.arange(count, dtype=np.float32) * (r + 1)
+                    for r in range(4)]
+            dsts = [np.zeros(count, np.float32) for _ in range(4)]
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+                dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+                op=ReductionOp.SUM))
+            expect = np.arange(count, dtype=np.float32) * 10
+            for r in range(4):
+                np.testing.assert_allclose(dsts[r], expect, rtol=1e-5)
+        finally:
+            job.cleanup()
+
+
+class TestHierRootedAndBarrier:
+    @pytest.mark.parametrize("root", [0, 5])   # leader and non-leader roots
+    def test_bcast_2step(self, job, teams, root):
+        n, count = 8, 50
+        bufs = [(np.arange(count, dtype=np.int32) if r == root else
+                 np.zeros(count, np.int32)) for r in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.BCAST, root=root,
+            src=BufferInfo(bufs[r], count, DataType.INT32)))
+        for r in range(n):
+            np.testing.assert_array_equal(bufs[r], np.arange(count))
+
+    @pytest.mark.parametrize("root", [0, 6])
+    def test_reduce_2step(self, job, teams, root):
+        n, count = 8, 24
+        srcs = [np.full(count, r + 1.0, np.float32) for r in range(n)]
+        dst = np.zeros(count, np.float32)
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.REDUCE, root=root,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dst, count, DataType.FLOAT32) if r == root else None,
+            op=ReductionOp.SUM))
+        np.testing.assert_allclose(dst, 36.0)
+
+    def test_barrier(self, job, teams):
+        job.run_coll(teams, lambda r: CollArgs(coll_type=CollType.BARRIER))

@@ -1,0 +1,181 @@
+"""MC/EC component tests — mirrors reference gtest core/test_mc.cc and
+ec tests: reductions across ops × dtypes, strided/multi-dst/copy task
+types, alpha scaling, executor semantics. EC/TPU pallas kernels run in
+interpret mode on the CPU backend."""
+import numpy as np
+import pytest
+
+from ucc_tpu.constants import DataType, MemoryType, ReductionOp
+from ucc_tpu.ec.base import EXECUTOR_NUM_BUFS, create_executor
+from ucc_tpu.ec.cpu import EcCpu
+from ucc_tpu.mc.base import detect_mem_type, get_mc
+from ucc_tpu.status import Status, UccError
+
+
+class TestMcCpu:
+    def test_alloc_memcpy_memset(self):
+        mc = get_mc(MemoryType.HOST)
+        buf = mc.alloc(64)
+        mc.memset(buf, 7, 64)
+        assert (buf == 7).all()
+        dst = mc.alloc(64)
+        mc.memcpy(dst, buf, 64)
+        assert (dst == 7).all()
+
+    def test_detect(self):
+        assert detect_mem_type(np.zeros(4)) == MemoryType.HOST
+        assert detect_mem_type(b"abc") == MemoryType.HOST
+
+
+class TestMcTpu:
+    def test_query_and_staging(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        from ucc_tpu.mc.tpu import McTpu
+        mc = McTpu()
+        arr = jnp.arange(8, dtype=jnp.float32)
+        attr = mc.mem_query(arr)
+        assert attr is not None and attr.mem_type == MemoryType.TPU
+        assert detect_mem_type(arr) == MemoryType.TPU
+        # HBM alloc + pool recycle
+        a = mc.alloc(1024, dtype=np.float32)
+        assert a.shape == (256,)
+        mc.free(a)
+        b = mc.alloc(1024, dtype=np.float32)
+        assert b is a   # recycled
+        # host <- device staging
+        host = np.zeros(8, np.float32)
+        mc.memcpy(host, arr, 32)
+        np.testing.assert_array_equal(host, np.arange(8, dtype=np.float32))
+
+
+class TestEcCpu:
+    @pytest.mark.parametrize("op,ref", [
+        (ReductionOp.SUM, lambda a: np.sum(a, axis=0)),
+        (ReductionOp.PROD, lambda a: np.prod(np.stack(a), axis=0)),
+        (ReductionOp.MAX, lambda a: np.maximum.reduce(a)),
+        (ReductionOp.MIN, lambda a: np.minimum.reduce(a)),
+        (ReductionOp.BAND, lambda a: np.bitwise_and.reduce(a)),
+        (ReductionOp.BXOR, lambda a: np.bitwise_xor.reduce(a)),
+        (ReductionOp.LAND, lambda a: np.logical_and.reduce(a).astype(a[0].dtype)),
+    ])
+    def test_reduce_int(self, op, ref):
+        ec = EcCpu()
+        srcs = [np.arange(1, 33, dtype=np.int32) + i for i in range(3)]
+        dst = np.zeros(32, np.int32)
+        ec.reduce(dst, srcs, 32, DataType.INT32, op)
+        np.testing.assert_array_equal(dst, ref(srcs))
+
+    def test_avg_alpha(self):
+        ec = EcCpu()
+        srcs = [np.ones(8, np.float32) * (i + 1) for i in range(4)]
+        dst = np.zeros(8, np.float32)
+        ec.reduce(dst, srcs, 8, DataType.FLOAT32, ReductionOp.AVG, alpha=0.25)
+        np.testing.assert_allclose(dst, 2.5)
+
+    def test_reduce_strided(self):
+        ec = EcCpu()
+        src1 = np.ones(4, np.float32)
+        base = np.arange(12, dtype=np.float32)   # 3 strided srcs of 4
+        dst = np.zeros(4, np.float32)
+        ec.reduce_strided(dst, src1, base, 16, 3, 4, DataType.FLOAT32,
+                          ReductionOp.SUM)
+        np.testing.assert_allclose(dst, 1 + base[0:4] + base[4:8] + base[8:12])
+
+    def test_num_bufs_cap(self):
+        ec = EcCpu()
+        srcs = [np.ones(2, np.float32)] * (EXECUTOR_NUM_BUFS + 1)
+        with pytest.raises(UccError):
+            ec.reduce(np.zeros(2, np.float32), srcs, 2, DataType.FLOAT32,
+                      ReductionOp.SUM)
+
+    def test_band_on_float_rejected(self):
+        ec = EcCpu()
+        with pytest.raises(UccError):
+            ec.reduce(np.zeros(2, np.float32), [np.ones(2, np.float32)] * 2,
+                      2, DataType.FLOAT32, ReductionOp.BAND)
+
+
+class TestEcTpu:
+    @pytest.fixture(scope="class")
+    def ec(self):
+        pytest.importorskip("jax")
+        return create_executor(MemoryType.TPU)
+
+    @pytest.mark.parametrize("op,ref", [
+        (ReductionOp.SUM, lambda a: np.sum(a, axis=0)),
+        (ReductionOp.PROD, lambda a: np.prod(np.stack(a), axis=0)),
+        (ReductionOp.MAX, lambda a: np.maximum.reduce(a)),
+        (ReductionOp.MIN, lambda a: np.minimum.reduce(a)),
+    ])
+    @pytest.mark.parametrize("count", [7, 128, 1000])
+    def test_reduce_f32(self, ec, op, ref, count):
+        srcs = [np.random.default_rng(i).random(count).astype(np.float32) + 1
+                for i in range(4)]
+        t = ec.reduce(None, srcs, count, DataType.FLOAT32, op)
+        while ec.task_test(t) == Status.IN_PROGRESS:
+            pass
+        np.testing.assert_allclose(np.asarray(t.array), ref(srcs), rtol=1e-5)
+
+    def test_reduce_bitwise_int(self, ec):
+        srcs = [(np.arange(64) + i * 3).astype(np.int32) for i in range(3)]
+        t = ec.reduce(None, srcs, 64, DataType.INT32, ReductionOp.BXOR)
+        while ec.task_test(t) == Status.IN_PROGRESS:
+            pass
+        np.testing.assert_array_equal(np.asarray(t.array),
+                                      np.bitwise_xor.reduce(srcs))
+
+    def test_bf16_accumulates_f32(self, ec):
+        import ml_dtypes
+        nd = np.dtype(ml_dtypes.bfloat16)
+        srcs = [np.full(256, 0.1, dtype=nd) for _ in range(8)]
+        t = ec.reduce(None, srcs, 256, DataType.BFLOAT16, ReductionOp.SUM)
+        while ec.task_test(t) == Status.IN_PROGRESS:
+            pass
+        out = np.asarray(t.array).astype(np.float32)
+        # bf16-accumulated would drift much further than f32-accumulated
+        np.testing.assert_allclose(out, 0.80078, rtol=3e-3)
+
+    def test_avg_with_alpha(self, ec):
+        srcs = [np.full(64, float(i + 1), np.float32) for i in range(4)]
+        t = ec.reduce(None, srcs, 64, DataType.FLOAT32, ReductionOp.AVG,
+                      alpha=0.25)
+        while ec.task_test(t) == Status.IN_PROGRESS:
+            pass
+        np.testing.assert_allclose(np.asarray(t.array), 2.5)
+
+    def test_minloc(self, ec):
+        pairs = 8
+        srcs = []
+        for r in range(3):
+            arr = np.empty(pairs * 2, np.float32)
+            arr[0::2] = np.random.default_rng(r).random(pairs)
+            arr[1::2] = r
+            srcs.append(arr)
+        t = ec.reduce(None, srcs, pairs * 2, DataType.FLOAT32,
+                      ReductionOp.MINLOC)
+        while ec.task_test(t) == Status.IN_PROGRESS:
+            pass
+        out = np.asarray(t.array)
+        vals = np.stack([s[0::2] for s in srcs])
+        np.testing.assert_allclose(out[0::2], vals.min(axis=0))
+        np.testing.assert_array_equal(out[1::2].astype(int),
+                                      vals.argmin(axis=0))
+
+    def test_reduce_strided(self, ec):
+        src1 = np.ones(16, np.float32)
+        base = np.arange(48, dtype=np.float32)
+        t = ec.reduce_strided(None, src1, base, 64, 3, 16, DataType.FLOAT32,
+                              ReductionOp.SUM)
+        while ec.task_test(t) == Status.IN_PROGRESS:
+            pass
+        np.testing.assert_allclose(
+            np.asarray(t.array),
+            1 + base[:16] + base[16:32] + base[32:48])
+
+    def test_copy(self, ec):
+        src = np.arange(32, dtype=np.int64)
+        t = ec.copy(None, src, 32 * 8)
+        while ec.task_test(t) == Status.IN_PROGRESS:
+            pass
+        np.testing.assert_array_equal(np.asarray(t.array), src)

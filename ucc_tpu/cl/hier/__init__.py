@@ -1,0 +1,40 @@
+"""CL/HIER registration + config (cl_hier.h:48-57 knobs)."""
+from __future__ import annotations
+
+from ...core.components import (BaseContext, BaseLib, CollectiveLayer,
+                                register_cl)
+from ...utils.config import (ConfigField, ConfigTable, parse_list,
+                             parse_string, register_table)
+from .team import ClHierTeam
+
+CL_HIER_CONFIG = register_table(ConfigTable(
+    prefix="CL_HIER_", name="cl/hier", fields=[
+        ConfigField("NODE_TLS", "shm,xla,self",
+                    "TLs for the intra-node (ICI-slice) unit", parse_list),
+        ConfigField("NODE_LEADERS_TLS", "socket,shm,self",
+                    "TLs for the inter-node (DCN) unit", parse_list),
+        ConfigField("NET_TLS", "socket,shm,self",
+                    "TLs for the per-rail NET unit", parse_list),
+        ConfigField("FULL_TLS", "all", "TLs for the FULL unit", parse_list),
+        ConfigField("ALLREDUCE_RAB_PIPELINE", "n",
+                    "pipeline spec for RAB allreduce, e.g. "
+                    "thresh=64K:fragsize=1M:nfrags=4:pdepth=2:ordered",
+                    parse_string),
+        ConfigField("A2AV_NODE_THRESH", "1k",
+                    "alltoallv node-aggregation threshold (reserved)",
+                    parse_string),
+    ]))
+
+
+class ClHierContext(BaseContext):
+    pass
+
+
+@register_cl
+class ClHier(CollectiveLayer):
+    NAME = "hier"
+    DEFAULT_SCORE = 55
+    CONTEXT_CONFIG = CL_HIER_CONFIG
+    lib_cls = BaseLib
+    context_cls = ClHierContext
+    team_cls = ClHierTeam

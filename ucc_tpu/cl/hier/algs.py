@@ -1,0 +1,516 @@
+"""CL/HIER algorithms — hierarchical schedules of sub-collectives.
+
+Ports the semantics of the reference hierarchy algorithms:
+
+  - allreduce **RAB** (= Reduce + Allreduce + Bcast,
+    cl_hier/allreduce/allreduce_rab.c:80, frag_setup :42-78): reduce to the
+    node leader, allreduce across leaders (DCN), bcast back down the node —
+    optionally pipelined through the fragmentation engine so DCN transfers
+    of fragment k overlap intra-node work of fragment k+1.
+  - allreduce **split_rail** (allreduce_split_rail.c:163-197):
+    reduce_scatter inside the node, per-rail allreduce across nodes (every
+    local rank drives its own NET rail concurrently — all ICI+DCN links
+    busy), allgather inside the node.
+  - bcast/reduce **2step** (bcast/bcast_2step.c, reduce/reduce_2step.c)
+  - barrier: fanin(node) -> barrier(leaders) -> fanout(node)
+
+All compose through the Schedule/PipelinedSchedule DAG engine
+(SURVEY §2.3); sub-collective tasks come from each unit's own score map, so
+tuning strings apply per hierarchy level.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ...api.types import BufferInfo, CollArgs
+from ...constants import (CollArgsFlags, CollType, MemoryType, ReductionOp,
+                          dt_numpy)
+from ...ec.cpu import reduce_arrays
+from ...schedule.pipelined import (PipelinedSchedule, PipelineOrder,
+                                   parse_pipeline_params)
+from ...schedule.schedule import Schedule
+from ...schedule.task import CollTask
+from ...constants import EventType
+from ...score.score import CollScore
+from ...status import Status, UccError
+from ...topo.sbgp import SbgpType
+from ...utils.log import get_logger
+from ...utils.mathutils import block_count, block_offset
+
+logger = get_logger("cl_hier")
+
+HIER_SCORE = 55     # above TL priors so hier wins multi-node (cl_hier.h:29)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _buf(arr: np.ndarray, dt, mem=MemoryType.HOST) -> BufferInfo:
+    return BufferInfo(arr, arr.size, dt, mem_type=mem)
+
+
+class _ScaleTask(CollTask):
+    """Multiply a buffer view by alpha (AVG post-scale at the leader)."""
+
+    def __init__(self, view_fn, alpha: float):
+        super().__init__()
+        self.view_fn = view_fn
+        self.alpha = alpha
+
+    def post_fn(self) -> Status:
+        v = self.view_fn()
+        # out-of-place multiply + cast back so integer dtypes work
+        # (in-place float multiply on an int view raises UFuncTypeError)
+        v[:] = (v * self.alpha).astype(v.dtype)
+        self.status = Status.OK
+        return Status.OK
+
+
+def _dst_view(args: CollArgs, dt):
+    from ...tl.base import binfo_typed
+    return binfo_typed(args.dst)
+
+
+# ---------------------------------------------------------------------------
+# allreduce RAB
+# ---------------------------------------------------------------------------
+
+def allreduce_rab_build(hier_team, init_args) -> CollTask:
+    """RAB with optional pipelining over fragments."""
+    args = init_args.args
+    cfg = hier_team.comp_context.config
+    pp = None
+    if cfg is not None:
+        try:
+            pp = parse_pipeline_params(cfg.get("ALLREDUCE_RAB_PIPELINE"))
+        except KeyError:
+            pp = None
+    count = int(args.dst.count)
+    dt = args.dst.datatype
+    esz = dt_numpy(dt).itemsize
+    n_frags, pdepth = (1, 1) if pp is None else pp.nfrags_pdepth(count * esz)
+
+    if n_frags <= 1:
+        sched = Schedule(team=hier_team, args=args)
+        _rab_fill_frag(hier_team, sched, args, dt, 0, count)
+        return sched
+
+    from ...tl.base import binfo_typed
+    full_dst = binfo_typed(args.dst)
+    full_src = full_dst if args.is_inplace else binfo_typed(args.src)
+
+    def frag_init(sched_p, idx):
+        frag = Schedule(team=hier_team)
+        _rab_fill_frag(hier_team, frag, _frag_args(args, full_src, full_dst,
+                                                   dt, 0, count, n_frags, 0),
+                       dt, 0, count // n_frags or 1)
+        return frag
+
+    def frag_setup(sched_p, frag, frag_num):
+        fa = _frag_args(args, full_src, full_dst, dt, 0, count, n_frags,
+                        frag_num)
+        _rab_retarget_frag(hier_team, frag, fa, dt)
+        return Status.OK
+
+    return PipelinedSchedule(team=hier_team, args=args, frag_init=frag_init,
+                             frag_setup=frag_setup, n_frags=pdepth,
+                             n_frags_total=n_frags,
+                             order=pp.order if pp else PipelineOrder.SEQUENTIAL)
+
+
+def _frag_args(args, full_src, full_dst, dt, base, count, n_frags, frag_num):
+    off = block_offset(count, n_frags, frag_num)
+    cnt = block_count(count, n_frags, frag_num)
+    fa = CollArgs(coll_type=CollType.ALLREDUCE,
+                  src=_buf(full_src[off:off + cnt], dt),
+                  dst=_buf(full_dst[off:off + cnt], dt),
+                  op=args.op, flags=args.flags & ~CollArgsFlags.PERSISTENT)
+    if args.is_inplace:
+        fa.src = fa.dst
+    return fa
+
+
+def _rab_fill_frag(hier_team, sched: Schedule, args: CollArgs, dt,
+                   base: int, count: int) -> None:
+    """Build the reduce -> (leaders allreduce [-> scale]) -> bcast chain for
+    one fragment's args."""
+    node = hier_team.sbgp(SbgpType.NODE)
+    leaders = hier_team.sbgp(SbgpType.NODE_LEADERS)
+    op = args.op if args.op is not None else ReductionOp.SUM
+    inner_op = ReductionOp.SUM if op == ReductionOp.AVG else op
+    team_size = hier_team.core_team.size
+    msg = int(args.dst.count) * dt_numpy(dt).itemsize
+
+    is_leader = node.sbgp.group_rank == 0
+
+    red_args = CollArgs(coll_type=CollType.REDUCE, root=0,
+                        src=args.dst if args.is_inplace else args.src,
+                        dst=args.dst if is_leader else None,
+                        op=inner_op,
+                        flags=CollArgsFlags.IN_PLACE if args.is_inplace
+                        else CollArgsFlags(0))
+    t_red = node.coll_init(red_args, MemoryType.HOST, msg)
+    sched.add_task(t_red)
+    sched.add_dep_on_schedule_start(t_red)
+    prev = t_red
+
+    if is_leader and leaders is not None and leaders.sbgp.is_member:
+        ar_args = CollArgs(coll_type=CollType.ALLREDUCE,
+                           dst=args.dst, op=inner_op,
+                           flags=CollArgsFlags.IN_PLACE)
+        ar_args.src = args.dst
+        t_ar = leaders.coll_init(ar_args, MemoryType.HOST, msg)
+        sched.add_task(t_ar)
+        t_ar.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+        prev = t_ar
+        if op == ReductionOp.AVG:
+            # capture the allreduce task's args: frag retargeting mutates
+            # them in place, so the scale always hits the live fragment
+            t_scale = _ScaleTask(lambda a=ar_args, d=dt: _dst_view(a, d),
+                                 1.0 / team_size)
+            sched.add_task(t_scale)
+            t_scale.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+            prev = t_scale
+
+    bc_args = CollArgs(coll_type=CollType.BCAST, root=0, src=args.dst)
+    t_bc = node.coll_init(bc_args, MemoryType.HOST, msg)
+    sched.add_task(t_bc)
+    t_bc.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+
+
+def _rab_retarget_frag(hier_team, frag: Schedule, fa: CollArgs, dt) -> None:
+    """Rebind the fragment tasks' buffer views (frag_setup,
+    allreduce_rab.c:42-78)."""
+    for t in frag.tasks:
+        targs = t.args
+        if targs is None:
+            continue
+        if targs.coll_type == CollType.REDUCE:
+            targs.src = fa.src if not fa.is_inplace else fa.dst
+            if targs.dst is not None:
+                targs.dst = fa.dst
+            _retarget_task_counts(t, targs)
+        elif targs.coll_type == CollType.ALLREDUCE:
+            targs.src = fa.dst
+            targs.dst = fa.dst
+            _retarget_task_counts(t, targs)
+        elif targs.coll_type == CollType.BCAST:
+            targs.src = fa.dst
+            _retarget_task_counts(t, targs)
+
+
+def _retarget_task_counts(task, targs) -> None:
+    bi = targs.dst if targs.dst is not None else targs.src
+    if hasattr(task, "count") and bi is not None:
+        task.count = int(bi.count)
+
+
+# ---------------------------------------------------------------------------
+# allreduce split_rail
+# ---------------------------------------------------------------------------
+
+class SplitRailAllreduce(CollTask):
+    """reduce_scatter(NODE) -> allreduce(NET rail) -> allgather(NODE)
+    (allreduce_split_rail.c:163-197). Driven as a generator-ish chain of
+    three sub-tasks built lazily (block sizes depend on node size)."""
+
+    def __init__(self, hier_team, init_args):
+        super().__init__(team=hier_team, args=init_args.args)
+        self.hier_team = hier_team
+        self.init_args = init_args
+        self._stage = 0
+        self._sub: Optional[CollTask] = None
+        self._work: Optional[np.ndarray] = None
+
+    def post_fn(self) -> Status:
+        from ...tl.base import binfo_typed
+        args = self.args
+        node = self.hier_team.sbgp(SbgpType.NODE)
+        self._node_n = node.sbgp.size
+        self._me = node.sbgp.group_rank
+        self._count = int(args.dst.count)
+        self._dt = args.dst.datatype
+        dst = binfo_typed(args.dst)
+        if not args.is_inplace:
+            dst[:] = binfo_typed(args.src)[:self._count]
+        self._dst = dst
+        self._stage = 0
+        self._sub = None
+        self._advance()
+        return Status.OK
+
+    def progress_fn(self) -> None:
+        self._advance()
+
+    # each stage posts one sub-collective on a unit team
+    def _advance(self) -> None:
+        if self._sub is not None:
+            if not self._sub.is_completed():
+                return
+            if self._sub.super_status.is_error:
+                self.status = self._sub.super_status
+                return
+            self._sub = None
+            self._stage += 1
+        node = self.hier_team.sbgp(SbgpType.NODE)
+        net = self.hier_team.sbgp(SbgpType.NET)
+        op = self.args.op if self.args.op is not None else ReductionOp.SUM
+        inner = ReductionOp.SUM if op == ReductionOp.AVG else op
+        n, me = self._node_n, self._me
+        blk_off = block_offset(self._count, n, me)
+        blk_cnt = block_count(self._count, n, me)
+        esz = dt_numpy(self._dt).itemsize
+        if self._stage == 0:
+            rs_args = CollArgs(
+                coll_type=CollType.REDUCE_SCATTER, op=inner,
+                dst=_buf(self._dst, self._dt),
+                flags=CollArgsFlags.IN_PLACE)
+            rs_args.src = rs_args.dst
+            self._sub = node.coll_init(rs_args, MemoryType.HOST,
+                                       self._count * esz)
+            self._post_sub()
+        elif self._stage == 1:
+            my_block = self._dst[blk_off:blk_off + blk_cnt]
+            ar_args = CollArgs(coll_type=CollType.ALLREDUCE, op=inner,
+                               dst=_buf(my_block, self._dt),
+                               flags=CollArgsFlags.IN_PLACE)
+            ar_args.src = ar_args.dst
+            self._sub = net.coll_init(ar_args, MemoryType.HOST,
+                                      blk_cnt * esz)
+            self._post_sub()
+        elif self._stage == 2:
+            if op == ReductionOp.AVG:
+                my_block = self._dst[blk_off:blk_off + blk_cnt]
+                my_block[:] = (my_block / self.hier_team.core_team.size
+                               ).astype(my_block.dtype)
+            ag_args = CollArgs(
+                coll_type=CollType.ALLGATHER,
+                dst=_buf(self._dst, self._dt),
+                flags=CollArgsFlags.IN_PLACE)
+            ag_args.src = _buf(self._dst[blk_off:blk_off + blk_cnt],
+                               self._dt)
+            self._sub = node.coll_init(ag_args, MemoryType.HOST,
+                                       self._count * esz)
+            self._post_sub()
+        else:
+            self.status = Status.OK
+
+    def _post_sub(self) -> None:
+        self._sub.progress_queue = self.progress_queue
+        self._sub.post()
+
+
+def split_rail_build(hier_team, init_args) -> CollTask:
+    node = hier_team.sbgp(SbgpType.NODE)
+    net = hier_team.sbgp(SbgpType.NET)
+    if node is None or net is None:
+        raise UccError(Status.ERR_NOT_SUPPORTED,
+                       "split_rail requires NODE and NET units (equal ppn)")
+    # in-place reduce_scatter with near-equal splits requires count >= ppn
+    if int(init_args.args.dst.count) < node.sbgp.size:
+        raise UccError(Status.ERR_NOT_SUPPORTED,
+                       "split_rail needs count >= node size")
+    return SplitRailAllreduce(hier_team, init_args)
+
+
+def allreduce_rab_init(init_args, team) -> CollTask:
+    return allreduce_rab_build(team, init_args)
+
+
+def split_rail_init(init_args, team) -> CollTask:
+    return split_rail_build(team, init_args)
+
+
+# ---------------------------------------------------------------------------
+# bcast / reduce 2step, barrier
+# ---------------------------------------------------------------------------
+
+def bcast_2step_init(init_args, hier_team) -> CollTask:
+    """root's node bcast -> leaders bcast -> other nodes' bcast
+    (bcast/bcast_2step.c)."""
+    args = init_args.args
+    node = hier_team.sbgp(SbgpType.NODE)
+    leaders = hier_team.sbgp(SbgpType.NODE_LEADERS)
+    root = int(args.root)
+    topo = hier_team.core_team.topo
+    msg = init_args.msgsize
+    sched = Schedule(team=hier_team, args=args)
+
+    my_node_ranks = [node.sbgp.map.eval(i) for i in range(node.sbgp.size)]
+    root_in_my_node = root in my_node_ranks
+    prev = None
+    if root_in_my_node:
+        b1 = CollArgs(coll_type=CollType.BCAST,
+                      root=my_node_ranks.index(root), src=args.src)
+        t1 = node.coll_init(b1, MemoryType.HOST, msg)
+        sched.add_task(t1)
+        sched.add_dep_on_schedule_start(t1)
+        prev = t1
+    if leaders is not None and leaders.sbgp.is_member:
+        # leaders bcast rooted at root's node-leader
+        root_leader_idx = _leader_index_of(hier_team, root)
+        b2 = CollArgs(coll_type=CollType.BCAST, root=root_leader_idx,
+                      src=args.src)
+        t2 = leaders.coll_init(b2, MemoryType.HOST, msg)
+        sched.add_task(t2)
+        if prev is not None:
+            t2.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+        else:
+            sched.add_dep_on_schedule_start(t2)
+        prev = t2
+    if not root_in_my_node:
+        b3 = CollArgs(coll_type=CollType.BCAST, root=0, src=args.src)
+        t3 = node.coll_init(b3, MemoryType.HOST, msg)
+        sched.add_task(t3)
+        if prev is not None:
+            t3.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+        else:
+            sched.add_dep_on_schedule_start(t3)
+    return sched
+
+
+def _leader_index_of(hier_team, team_rank: int) -> int:
+    """Index within NODE_LEADERS of the leader of team_rank's node."""
+    topo = hier_team.core_team.topo
+    leaders_sbgp = topo.get_sbgp(SbgpType.NODE_LEADERS)
+    lead_ranks = [leaders_sbgp.map.eval(i)
+                  for i in range(leaders_sbgp.size)]
+    target = topo._proc(team_rank).host_hash
+    for i, lr in enumerate(lead_ranks):
+        if topo._proc(lr).host_hash == target:
+            return i
+    raise UccError(Status.ERR_NOT_FOUND, "no leader for rank's node")
+
+
+def reduce_2step_init(init_args, hier_team) -> CollTask:
+    """node reduce (to leader) -> leaders reduce (to root's leader) ->
+    handoff to root via a node bcast when root is not its node's leader
+    (reduce_2step.c). AVG runs SUM internally with a post-scale at root."""
+    args = init_args.args
+    node = hier_team.sbgp(SbgpType.NODE)
+    leaders = hier_team.sbgp(SbgpType.NODE_LEADERS)
+    root = int(args.root)
+    team_rank = hier_team.core_team.rank
+    msg = init_args.msgsize
+    op = args.op if args.op is not None else ReductionOp.SUM
+    inner = ReductionOp.SUM if op == ReductionOp.AVG else op
+    sched = Schedule(team=hier_team, args=args)
+    my_node_ranks = [node.sbgp.map.eval(i) for i in range(node.sbgp.size)]
+    root_in_my_node = root in my_node_ranks
+    is_leader = node.sbgp.group_rank == 0
+    is_root = team_rank == root
+    root_is_leader_of_its_node = _root_is_leader(hier_team, root)
+    dt = (args.src or args.dst).datatype
+    nd = dt_numpy(dt)
+    count = int((args.src or args.dst).count)
+    # the node representative accumulates in scratch (or straight into dst
+    # when the root itself is the representative)
+    use_dst_directly = is_root and is_leader
+    scratch = None
+    if is_leader and not use_dst_directly:
+        scratch = np.zeros(count, dtype=nd)
+
+    # stage 1: intra-node reduce to the leader
+    r1 = CollArgs(coll_type=CollType.REDUCE, root=0,
+                  src=args.dst if args.is_inplace else args.src,
+                  dst=(args.dst if use_dst_directly
+                       else (_buf(scratch, dt) if is_leader else None)),
+                  op=inner,
+                  flags=CollArgsFlags.IN_PLACE if (args.is_inplace and
+                                                   use_dst_directly)
+                  else CollArgsFlags(0))
+    t1 = node.coll_init(r1, MemoryType.HOST, msg)
+    sched.add_task(t1)
+    sched.add_dep_on_schedule_start(t1)
+    prev = t1
+
+    # stage 2: leaders reduce to root's leader
+    if leaders is not None and leaders.sbgp.is_member:
+        root_leader_idx = _leader_index_of(hier_team, root)
+        at_final = leaders.sbgp.group_rank == root_leader_idx
+        r2 = CollArgs(coll_type=CollType.REDUCE, root=root_leader_idx,
+                      src=(args.dst if use_dst_directly else
+                           _buf(scratch, dt)),
+                      dst=(args.dst if (at_final and use_dst_directly) else
+                           (_buf(scratch, dt) if at_final else None)),
+                      op=inner,
+                      flags=CollArgsFlags.IN_PLACE if at_final else
+                      CollArgsFlags(0))
+        t2 = leaders.coll_init(r2, MemoryType.HOST, msg)
+        sched.add_task(t2)
+        t2.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+        prev = t2
+
+    # stage 3: leader -> root handoff within root's node (node bcast)
+    if root_in_my_node and not root_is_leader_of_its_node:
+        hand_buf = args.dst if is_root else \
+            (_buf(scratch, dt) if scratch is not None
+             else _buf(np.zeros(count, dtype=nd), dt))
+        b = CollArgs(coll_type=CollType.BCAST, root=0, src=hand_buf)
+        t3 = node.coll_init(b, MemoryType.HOST, msg)
+        sched.add_task(t3)
+        t3.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+        prev = t3
+
+    if op == ReductionOp.AVG and is_root:
+        t4 = _ScaleTask(lambda a=args, d=dt: _dst_view(a, d),
+                        1.0 / hier_team.core_team.size)
+        sched.add_task(t4)
+        t4.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+    return sched
+
+
+def _root_is_leader(hier_team, root: int) -> bool:
+    topo = hier_team.core_team.topo
+    nl = topo.get_sbgp(SbgpType.NODE_LEADERS)
+    return any(nl.map.eval(i) == root for i in range(nl.size))
+
+
+def barrier_init(init_args, hier_team) -> CollTask:
+    """fanin(node) -> barrier(leaders) -> fanout(node)."""
+    node = hier_team.sbgp(SbgpType.NODE)
+    leaders = hier_team.sbgp(SbgpType.NODE_LEADERS)
+    sched = Schedule(team=hier_team, args=init_args.args)
+    t1 = node.coll_init(CollArgs(coll_type=CollType.FANIN, root=0),
+                        MemoryType.HOST, 0)
+    sched.add_task(t1)
+    sched.add_dep_on_schedule_start(t1)
+    prev = t1
+    if leaders is not None and leaders.sbgp.is_member:
+        t2 = leaders.coll_init(CollArgs(coll_type=CollType.BARRIER),
+                               MemoryType.HOST, 0)
+        sched.add_task(t2)
+        t2.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+        prev = t2
+    t3 = node.coll_init(CollArgs(coll_type=CollType.FANOUT, root=0),
+                        MemoryType.HOST, 0)
+    sched.add_task(t3)
+    t3.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# scores
+# ---------------------------------------------------------------------------
+
+def build_hier_scores(hier_team) -> CollScore:
+    from ...utils.config import SIZE_INF
+    s = CollScore()
+    mem = MemoryType.HOST
+
+    def add(coll, score, init, name):
+        s.add_range(coll, mem, 0, SIZE_INF, score,
+                    lambda ia, t, fn=init: fn(ia, hier_team), hier_team,
+                    name)
+
+    add(CollType.ALLREDUCE, HIER_SCORE, allreduce_rab_init, "rab")
+    if hier_team.sbgp(SbgpType.NET) is not None:
+        add(CollType.ALLREDUCE, HIER_SCORE - 1, split_rail_init,
+            "split_rail")
+    add(CollType.BCAST, HIER_SCORE, bcast_2step_init, "2step")
+    add(CollType.REDUCE, HIER_SCORE, reduce_2step_init, "2step")
+    add(CollType.BARRIER, HIER_SCORE, barrier_init, "knomial_hier")
+    return s

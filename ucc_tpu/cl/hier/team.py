@@ -1,0 +1,180 @@
+"""CL/HIER team — hierarchical composition of TL teams over subgroups.
+
+Re-design of /root/reference/src/components/cl/hier (3788 LoC): the team
+builds hierarchy units NODE / NODE_LEADERS / NET / FULL (cl_hier.h:38-44),
+each an ``HierSbgp`` = topo subgroup + TL teams + its own score map
+(cl_hier.h:86-101), with per-unit TL allow-lists
+(``UCC_CL_HIER_{NODE,NODE_LEADERS,NET,FULL}_TLS``, cl_hier.h:48-52).
+
+TPU reading of the hierarchy (SURVEY §2.9): NODE ≡ the host's ICI-connected
+slice (fast domain: TL/SHM in-process, TL/XLA on chips), NODE_LEADERS ≡ one
+rank per host over DCN (TL/SOCKET). Algorithms are schedules of
+sub-collectives on these units (allreduce_rab.py etc.).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ...api.types import CollArgs
+from ...constants import CollType, MemoryType
+from ...core.components import BaseContext, BaseLib, BaseTeam
+from ...score.score import CollScore
+from ...score.score_map import ScoreMap
+from ...status import Status, UccError
+from ...topo.sbgp import SbgpStatus, SbgpType
+from ...utils.ep_map import EpMap
+from ...utils.log import get_logger
+
+logger = get_logger("cl_hier")
+
+#: hierarchy units (cl_hier.h:38-44)
+HIER_SBGPS = (SbgpType.NODE, SbgpType.NODE_LEADERS, SbgpType.NET,
+              SbgpType.FULL)
+
+
+class SbgpCoreTeamFacade:
+    """Core-team-like view of a subgroup, handed to TL team constructors.
+
+    TL teams only touch: ctx_map, rank, size, team_key, context — this
+    facade scopes them to the subgroup (sbgp rank space -> ctx ranks via
+    map composition, the reference's sbgp->team->ctx chain).
+    """
+
+    def __init__(self, core_team, sbgp_type: SbgpType, sbgp):
+        self.parent = core_team
+        self.context = core_team.context
+        self.ctx_map = core_team.ctx_map.compose(sbgp.map)
+        self.rank = sbgp.group_rank
+        self.size = sbgp.size
+        # the ctx-rank tuple disambiguates sibling units of the same type
+        # (e.g. each node's NODE team) sharing one process
+        self.team_key = (core_team.team_key, "hier", int(sbgp_type),
+                         tuple(int(self.ctx_map.eval(i))
+                               for i in range(self.size)))
+        self.id = core_team.id
+
+
+class HierSbgp:
+    """ucc_hier_sbgp_t (cl_hier.h:86-101): sbgp + TL teams + score map."""
+
+    def __init__(self, sbgp_type: SbgpType, sbgp, core_team,
+                 tl_allow: List[str]):
+        self.type = sbgp_type
+        self.sbgp = sbgp
+        self.tl_teams: List[Any] = []
+        self._pending: List[Any] = []
+        self.score_map: Optional[ScoreMap] = None
+        self.facade = SbgpCoreTeamFacade(core_team, sbgp_type, sbgp)
+        ctx = core_team.context
+        for name, handle in ctx.tl_contexts.items():
+            if tl_allow != ["all"] and name not in tl_allow:
+                continue
+            try:
+                self._pending.append(handle.tl_lib.tl_cls.team_cls(
+                    handle.obj, self.facade, scope=f"hier_{int(sbgp_type)}"))
+            except UccError:
+                continue
+
+    def create_test(self) -> Status:
+        still = []
+        for t in self._pending:
+            st = t.create_test()
+            if st == Status.IN_PROGRESS:
+                still.append(t)
+            elif st.is_error:
+                t.destroy()
+            else:
+                self.tl_teams.append(t)
+        self._pending = still
+        if still:
+            return Status.IN_PROGRESS
+        if not self.tl_teams:
+            return Status.ERR_NO_RESOURCE
+        merged = CollScore()
+        for t in self.tl_teams:
+            merged = merged.merge(t.get_scores())
+        self.score_map = ScoreMap(merged)
+        return Status.OK
+
+    def coll_init(self, args: CollArgs, mem_type: MemoryType, msgsize: int):
+        """Init a sub-collective on this unit via its score map."""
+        from ...core.coll import InitArgs
+        ia = InitArgs(args=args, team=self.facade, mem_type=mem_type,
+                      msgsize=msgsize)
+        task, _ = self.score_map.init_coll(args.coll_type, mem_type,
+                                           msgsize, ia)
+        return task
+
+    def destroy(self) -> None:
+        for t in self.tl_teams + self._pending:
+            t.destroy()
+
+
+class ClHierTeam(BaseTeam):
+    NAME = "hier"
+
+    def __init__(self, comp_context: BaseContext, core_team):
+        super().__init__(comp_context, core_team)
+        topo = _team_topo(core_team)
+        if topo.n_nodes < 2:
+            # single node: hierarchy adds nothing; let cl/basic serve
+            # (reference cl_hier team create bails similarly)
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "cl/hier requires a multi-node team")
+        self.core_team = core_team
+        cfg = comp_context.config
+        self.sbgps: Dict[SbgpType, HierSbgp] = {}
+        for st in HIER_SBGPS:
+            sbgp = topo.get_sbgp(st)
+            if sbgp.status != SbgpStatus.ENABLED or not sbgp.is_member:
+                continue
+            allow = ["all"]
+            if cfg is not None:
+                try:
+                    allow = cfg.get(f"{st.name}_TLS")
+                except KeyError:
+                    pass
+            self.sbgps[st] = HierSbgp(st, sbgp, core_team, allow)
+
+    def create_test(self) -> Status:
+        any_in_progress = False
+        for st in list(self.sbgps):
+            s = self.sbgps[st].create_test()
+            if s == Status.IN_PROGRESS:
+                any_in_progress = True
+            elif s.is_error:
+                if st in (SbgpType.NODE, SbgpType.NODE_LEADERS):
+                    return s       # hierarchy needs its core units
+                self.sbgps[st].destroy()
+                del self.sbgps[st]
+        if any_in_progress:
+            return Status.IN_PROGRESS
+        if SbgpType.NODE not in self.sbgps and \
+                SbgpType.NODE_LEADERS not in self.sbgps:
+            return Status.ERR_NO_RESOURCE
+        return Status.OK
+
+    # ------------------------------------------------------------------
+    def get_scores(self) -> CollScore:
+        from .algs import build_hier_scores
+        return build_hier_scores(self)
+
+    def sbgp(self, st: SbgpType) -> Optional[HierSbgp]:
+        return self.sbgps.get(st)
+
+    @property
+    def is_node_leader(self) -> bool:
+        nl = self.sbgps.get(SbgpType.NODE_LEADERS)
+        return nl is not None and nl.sbgp.is_member
+
+    def destroy(self) -> None:
+        for s in self.sbgps.values():
+            s.destroy()
+
+
+def _team_topo(core_team):
+    if core_team.topo is not None:
+        return core_team.topo
+    from ...topo.topo import TeamTopo
+    return TeamTopo(core_team.context.topo, core_team.ctx_map
+                    or EpMap.full(core_team.size), core_team.rank)

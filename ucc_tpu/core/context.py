@@ -60,6 +60,19 @@ class Context:
         self.rank = oob.oob_ep if oob else 0
         self.size = oob.n_oob_eps if oob else 1
         self.proc_info = local_proc_info()
+        # test hook: UCC_TOPO_FAKE_PPN=N groups ranks into virtual "nodes"
+        # of N so hierarchy paths (CL/HIER node/node_leaders/net) are
+        # exercisable in a single-host in-process job — the same role the
+        # reference's simulated-topology gtest fixtures play
+        import os as _os
+        fake_ppn = _os.environ.get("UCC_TOPO_FAKE_PPN", "")
+        if fake_ppn:
+            import dataclasses
+            import zlib
+            node = self.rank // max(1, int(fake_ppn))
+            self.proc_info = dataclasses.replace(
+                self.proc_info,
+                host_hash=zlib.crc32(f"fake-node-{node}".encode()))
 
         if lib.params.thread_mode == ThreadMode.MULTIPLE:
             self.progress_queue = ProgressQueueMT()

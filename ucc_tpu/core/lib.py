@@ -23,7 +23,7 @@ logger = get_logger("core")
 
 #: global config table (ucc_global_opts.c:35-121)
 GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
-    ConfigField("CLS", "basic", "comma-separated CL list ('all' for every "
+    ConfigField("CLS", "basic,hier", "comma-separated CL list ('all' for every "
                 "available CL)", parse_list),
     ConfigField("TLS", "all", "comma-separated TL allow-list", parse_list),
     ConfigField("LOG_LEVEL", "warn", "ucc log level", parse_string),

@@ -215,8 +215,12 @@ class Team:
                 try:
                     self._cl_current = cl_cls.team_cls(handle.obj, self)
                 except UccError as e:
-                    logger.warning("CL %s team create failed: %s; falling "
-                                   "back", cl_cls.NAME, e)
+                    # NOT_SUPPORTED is the normal "this CL doesn't apply to
+                    # this team shape" path (e.g. hier on one node) — only
+                    # real failures deserve a warning
+                    lvl = logger.debug if e.status == Status.ERR_NOT_SUPPORTED \
+                        else logger.warning
+                    lvl("CL %s team create skipped: %s", cl_cls.NAME, e)
                     continue
             st = self._cl_current.create_test()
             if st == Status.IN_PROGRESS:

@@ -109,3 +109,8 @@ def _ensure_defaults() -> None:
     if MemoryType.HOST not in _executors:
         from .cpu import EcCpu
         register_ec(MemoryType.HOST, EcCpu)
+    if MemoryType.TPU not in _executors:
+        try:
+            from . import tpu  # noqa: F401 - registers EcTpu on import
+        except ImportError:  # jax genuinely unavailable
+            pass

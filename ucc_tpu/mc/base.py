@@ -96,3 +96,8 @@ def _ensure_defaults() -> None:
     if MemoryType.HOST not in _components:
         from .cpu import McCpu
         register_mc(McCpu())
+    if MemoryType.TPU not in _components:
+        try:
+            from . import tpu  # noqa: F401 - registers McTpu on import
+        except ImportError:  # jax genuinely unavailable
+            pass

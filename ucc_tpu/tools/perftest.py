@@ -1,0 +1,291 @@
+"""ucc_perftest — collective benchmark CLI.
+
+Mirrors /root/reference/tools/perf (ucc_perftest, ucc_pt_config.h:34-75,
+ucc_pt_benchmark.cc:139-171, 392-397): exponential size sweep ``-b..-e``,
+warmup + iterations, per-size min/avg/max latency reduced across ranks, and
+Bus Bandwidth with ``-F``. Bootstrap differs TPU-natively: instead of
+MPI/UCX bootstrap, ranks are either in-process (``-p N``, the default — one
+rank per chip via TL/XLA or host ranks via TL/SHM) or multi-process via the
+TCP store (``--store host:port --rank R --np N``).
+
+Examples::
+
+    python -m ucc_tpu.tools.perftest -c allreduce -b 8 -e 1M -p 4
+    python -m ucc_tpu.tools.perftest -c alltoall -m tpu -F
+    python -m ucc_tpu.tools.perftest -c allreduce --store h:29500 --rank 0 --np 8
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, CollArgs, CollArgsFlags, CollType, Context,
+                     ContextParams, DataType, MemoryType, ReductionOp, Status,
+                     TcpStoreOob, TeamParams, ThreadOobWorld)
+from ucc_tpu.constants import coll_type_str, dt_numpy, dt_size
+from ucc_tpu.utils.config import memunits_str, parse_memunits
+
+COLLS = {coll_type_str(c): c for c in CollType}
+OPS = {o.name.lower(): o for o in ReductionOp}
+DTS = {d.name.lower(): d for d in DataType}
+
+
+def busbw_factor(coll: CollType, n: int) -> float:
+    """Bus-bandwidth factors (ucc_pt_benchmark.cc bus bw computation)."""
+    if n <= 1:
+        return 1.0
+    if coll == CollType.ALLREDUCE:
+        return 2.0 * (n - 1) / n
+    if coll in (CollType.ALLGATHER, CollType.ALLGATHERV,
+                CollType.REDUCE_SCATTER, CollType.REDUCE_SCATTERV):
+        return float(n - 1) / n
+    if coll in (CollType.ALLTOALL, CollType.ALLTOALLV):
+        return float(n - 1) / n
+    return 1.0
+
+
+def make_args(coll: CollType, rank: int, n: int, count: int, dt: DataType,
+              op: ReductionOp, mem: MemoryType, inplace: bool, root: int,
+              persistent: bool, devices=None) -> CollArgs:
+    nd = dt_numpy(dt)
+    flags = CollArgsFlags(0)
+    if inplace:
+        flags |= CollArgsFlags.IN_PLACE
+    if persistent:
+        flags |= CollArgsFlags.PERSISTENT
+
+    def host(shape_count):
+        return np.ones(shape_count, dtype=nd)
+
+    def buf(shape_count):
+        if mem == MemoryType.TPU:
+            import jax
+            arr = jax.device_put(host(shape_count),
+                                 devices[rank] if devices else None)
+            return BufferInfo(arr, shape_count, dt, mem_type=MemoryType.TPU)
+        return BufferInfo(host(shape_count), shape_count, dt,
+                          mem_type=MemoryType.HOST)
+
+    def out(shape_count):
+        if mem == MemoryType.TPU:
+            return BufferInfo(None, shape_count, dt, mem_type=MemoryType.TPU)
+        return BufferInfo(np.zeros(shape_count, dtype=nd), shape_count, dt,
+                          mem_type=MemoryType.HOST)
+
+    if coll == CollType.BARRIER:
+        return CollArgs(coll_type=coll, flags=flags)
+    if coll == CollType.ALLREDUCE:
+        a = CollArgs(coll_type=coll, op=op, flags=flags)
+        if inplace:
+            a.dst = buf(count)
+            a.src = a.dst
+        else:
+            a.src = buf(count)
+            a.dst = out(count)
+        return a
+    if coll == CollType.ALLGATHER:
+        return CollArgs(coll_type=coll, src=buf(count), dst=out(count * n),
+                        flags=flags)
+    if coll == CollType.ALLTOALL:
+        return CollArgs(coll_type=coll, src=buf(count * n),
+                        dst=out(count * n), flags=flags)
+    if coll == CollType.BCAST:
+        return CollArgs(coll_type=coll, root=root, src=buf(count),
+                        flags=flags)
+    if coll == CollType.REDUCE:
+        return CollArgs(coll_type=coll, root=root, op=op, src=buf(count),
+                        dst=out(count) if rank == root else None, flags=flags)
+    if coll == CollType.REDUCE_SCATTER:
+        return CollArgs(coll_type=coll, op=op, src=buf(count * n),
+                        dst=out(count), flags=flags)
+    if coll == CollType.GATHER:
+        return CollArgs(coll_type=coll, root=root, src=buf(count),
+                        dst=out(count * n) if rank == root else None,
+                        flags=flags)
+    if coll == CollType.SCATTER:
+        return CollArgs(coll_type=coll, root=root,
+                        src=buf(count * n) if rank == root else None,
+                        dst=out(count), flags=flags)
+    raise SystemExit(f"perftest: coll {coll_type_str(coll)} not wired")
+
+
+class InProcJob:
+    persistent_capable = True
+
+    def __init__(self, n: int):
+        self.n = n
+        world = ThreadOobWorld(n)
+        self.libs = [ucc_tpu.init() for _ in range(n)]
+        self.contexts: List[Optional[Context]] = [None] * n
+
+        def mk(r):
+            self.contexts[r] = Context(self.libs[r],
+                                       ContextParams(oob=world.endpoint(r)))
+
+        ths = [threading.Thread(target=mk, args=(r,)) for r in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        tw = ThreadOobWorld(n)
+        self.teams = [c.create_team_post(TeamParams(oob=tw.endpoint(i)))
+                      for i, c in enumerate(self.contexts)]
+        while True:
+            sts = [t.create_test() for t in self.teams]
+            if all(s == Status.OK for s in sts):
+                break
+            if any(s.is_error for s in sts):
+                raise SystemExit("team create failed")
+            for c in self.contexts:
+                c.progress()
+
+    def init_reqs(self, argses):
+        return [self.teams[r].collective_init(argses[r])
+                for r in range(self.n)]
+
+    def post_and_wait(self, reqs) -> None:
+        for rq in reqs:
+            rq.post()
+        while any(rq.test() == Status.IN_PROGRESS for rq in reqs):
+            for c in self.contexts:
+                c.progress()
+        for rq in reqs:
+            if rq.test().is_error:
+                raise SystemExit(f"collective failed: {rq.test()}")
+
+    def run_round(self, argses) -> None:
+        self.post_and_wait(self.init_reqs(argses))
+
+
+class StoreJob:
+    """One rank of a multi-process run."""
+
+    def __init__(self, host: str, port: int, rank: int, n: int):
+        self.n = 1
+        self.rank = rank
+        oob = TcpStoreOob(rank, n, host=host, port=port)
+        self.lib = ucc_tpu.init()
+        self.ctx = Context(self.lib, ContextParams(oob=oob))
+        team_oob = TcpStoreOob(rank, n, host=host, port=port + 1)
+        self.team = self.ctx.create_team(TeamParams(oob=team_oob))
+        self.world_n = n
+
+    persistent_capable = True
+
+    def init_reqs(self, argses):
+        return [self.team.collective_init(argses[0])]
+
+    def post_and_wait(self, reqs) -> None:
+        reqs[0].post()
+        reqs[0].wait(timeout=120)
+
+    def run_round(self, argses) -> None:
+        self.post_and_wait(self.init_reqs(argses))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ucc_perftest")
+    p.add_argument("-c", "--coll", default="allreduce", choices=sorted(COLLS))
+    p.add_argument("-b", "--begin", default="8", help="min size (bytes)")
+    p.add_argument("-e", "--end", default="1M", help="max size (bytes)")
+    p.add_argument("-n", "--iters", type=int, default=20)
+    p.add_argument("-w", "--warmup", type=int, default=5)
+    p.add_argument("-m", "--mem", default="host",
+                   help="memory type: host/tpu (cuda aliases tpu)")
+    p.add_argument("-d", "--dtype", default="float32", choices=sorted(DTS))
+    p.add_argument("-o", "--op", default="sum", choices=sorted(OPS))
+    p.add_argument("-r", "--root", type=int, default=0)
+    p.add_argument("-i", "--inplace", action="store_true")
+    p.add_argument("-F", "--full", action="store_true",
+                   help="print bus bandwidth column")
+    p.add_argument("-p", "--nprocs", type=int, default=0,
+                   help="in-process ranks (default: one per device for tpu "
+                        "mem, else 4)")
+    p.add_argument("--persistent", action="store_true",
+                   help="persistent collectives (init once, post many)")
+    p.add_argument("--store", default="", help="host:port for multi-process")
+    p.add_argument("--rank", type=int, default=0)
+    p.add_argument("--np", type=int, dest="world", default=1)
+    args = p.parse_args(argv)
+
+    coll = COLLS[args.coll]
+    dt = DTS[args.dtype]
+    op = OPS[args.op]
+    mem = MemoryType.parse(args.mem)
+    bmin = parse_memunits(args.begin)
+    bmax = parse_memunits(args.end)
+    esz = dt_size(dt)
+
+    devices = None
+    if mem == MemoryType.TPU:
+        import jax
+        devices = jax.devices()
+
+    if args.store:
+        host, port_s = args.store.rsplit(":", 1)
+        job = StoreJob(host, int(port_s), args.rank, args.world)
+        n = job.world_n
+        ranks = [args.rank]
+        is_lead = args.rank == 0
+    else:
+        n = args.nprocs or (len(devices) if devices else 4)
+        job = InProcJob(n)
+        ranks = list(range(n))
+        is_lead = True
+
+    if is_lead:
+        hdr = f"{'count':>12} {'size':>10} {'time avg(us)':>14} " \
+              f"{'min(us)':>10} {'max(us)':>10}"
+        if args.full:
+            hdr += f" {'bus bw(GB/s)':>14}"
+        print(f"# ucc_perftest: {args.coll} {args.dtype} {args.op} "
+              f"mem={args.mem} ranks={n}")
+        print(hdr)
+
+    size = max(bmin, esz)
+    while size <= bmax:
+        count = max(1, size // esz)
+        lats = []
+        rounds = args.warmup + args.iters
+        persistent_reqs = None
+        if args.persistent:
+            # init once, post many (ucc.h:1674 persistent semantics);
+            # measured time then excludes collective_init
+            argses = [make_args(coll, r, n, count, dt, op, mem,
+                                args.inplace, args.root, True, devices)
+                      for r in ranks]
+            persistent_reqs = job.init_reqs(argses)
+        for it in range(rounds):
+            t0 = time.perf_counter()
+            if persistent_reqs is not None:
+                job.post_and_wait(persistent_reqs)
+            else:
+                argses = [make_args(coll, r, n, count, dt, op, mem,
+                                    args.inplace, args.root, False,
+                                    devices) for r in ranks]
+                t0 = time.perf_counter()
+                job.run_round(argses)
+            dt_s = time.perf_counter() - t0
+            if it >= args.warmup:
+                lats.append(dt_s)
+        lats = np.array(lats)
+        if is_lead:
+            avg = lats.mean() * 1e6
+            line = f"{count:>12} {memunits_str(size):>10} {avg:>14.2f} " \
+                   f"{lats.min() * 1e6:>10.2f} {lats.max() * 1e6:>10.2f}"
+            if args.full:
+                bw = busbw_factor(coll, n) * size / lats.mean() / 1e9
+                line += f" {bw:>14.3f}"
+            print(line, flush=True)
+        size *= 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
