@@ -1,0 +1,275 @@
+// ucc_tpu native runtime core.
+//
+// The host-side hot paths of the framework, in C++ (the role the reference's
+// C core plays for its progress engine and UCX's matching engine plays for
+// tl/ucp — SURVEY §2.5, tl_ucp_sendrecv.h):
+//
+//   * tagged-message mailbox: unexpected-message queues + posted-receive
+//     matching with per-mailbox sharded locks. Matched receives copy
+//     payloads directly into the destination buffer (single memcpy).
+//   * bounded MPMC queue (the ucc_lock_free_queue.h analog,
+//     /root/reference/src/utils/ucc_lock_free_queue.h) for multi-threaded
+//     producers/consumers of task handles.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+// Handle-based API: requests are uint64 ids; Python polls test() — the same
+// nonblocking contract the Python mailbox implements.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Request {
+    std::atomic<int> done{0};
+    size_t nbytes = 0;
+    // send side: owned payload when unexpected; recv side: dst pointer
+    std::vector<uint8_t> owned;
+    void* dst = nullptr;
+    size_t dst_cap = 0;
+};
+
+struct PendingSend {
+    uint64_t req_id;
+};
+
+struct PendingRecv {
+    uint64_t req_id;
+};
+
+constexpr int kShards = 16;
+
+struct Shard {
+    std::mutex mu;
+    std::unordered_map<std::string, std::deque<uint64_t>> unexpected;
+    std::unordered_map<std::string, std::deque<uint64_t>> posted;
+};
+
+struct Mailbox {
+    Shard shards[kShards];
+    std::mutex req_mu;
+    std::unordered_map<uint64_t, Request*> requests;
+    std::atomic<uint64_t> next_id{1};
+
+    uint64_t new_request(Request** out) {
+        auto* r = new Request();
+        uint64_t id = next_id.fetch_add(1, std::memory_order_relaxed);
+        {
+            std::lock_guard<std::mutex> g(req_mu);
+            requests[id] = r;
+        }
+        *out = r;
+        return id;
+    }
+
+    Request* get(uint64_t id) {
+        std::lock_guard<std::mutex> g(req_mu);
+        auto it = requests.find(id);
+        return it == requests.end() ? nullptr : it->second;
+    }
+
+    void drop(uint64_t id) {
+        Request* r = nullptr;
+        {
+            std::lock_guard<std::mutex> g(req_mu);
+            auto it = requests.find(id);
+            if (it == requests.end()) return;
+            r = it->second;
+            requests.erase(it);
+        }
+        delete r;
+    }
+
+    Shard& shard_for(const std::string& key) {
+        size_t h = std::hash<std::string>{}(key);
+        return shards[h % kShards];
+    }
+};
+
+void deliver(Request* send_req, Request* recv_req) {
+    size_t n = send_req->nbytes < recv_req->dst_cap ? send_req->nbytes
+                                                    : recv_req->dst_cap;
+    if (n && recv_req->dst) {
+        std::memcpy(recv_req->dst, send_req->owned.data(), n);
+    }
+    recv_req->nbytes = n;
+    recv_req->done.store(1, std::memory_order_release);
+    send_req->done.store(1, std::memory_order_release);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ucc_mailbox_create() { return new Mailbox(); }
+
+void ucc_mailbox_destroy(void* mbp) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    {
+        // free requests under the lock, then release it BEFORE deleting
+        // the mailbox (unlocking a destroyed mutex is UB)
+        std::lock_guard<std::mutex> g(mb->req_mu);
+        for (auto& kv : mb->requests) delete kv.second;
+        mb->requests.clear();
+    }
+    delete mb;
+}
+
+// Push a message: copies data (eager). Returns the send request id
+// (already complete — the copy decouples the sender's buffer).
+uint64_t ucc_mailbox_push(void* mbp, const char* key, size_t keylen,
+                          const void* data, size_t len) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    std::string k(key, keylen);
+    Request* sreq = nullptr;
+    uint64_t sid = mb->new_request(&sreq);
+    sreq->owned.assign(static_cast<const uint8_t*>(data),
+                       static_cast<const uint8_t*>(data) + len);
+    sreq->nbytes = len;
+
+    Shard& sh = mb->shard_for(k);
+    uint64_t rid = 0;
+    {
+        std::lock_guard<std::mutex> g(sh.mu);
+        auto it = sh.posted.find(k);
+        if (it != sh.posted.end() && !it->second.empty()) {
+            rid = it->second.front();
+            it->second.pop_front();
+            if (it->second.empty()) sh.posted.erase(it);
+        } else {
+            sh.unexpected[k].push_back(sid);
+            return sid;  // parked as unexpected; send complete after copy
+        }
+    }
+    Request* rreq = mb->get(rid);
+    if (rreq) deliver(sreq, rreq);
+    sreq->done.store(1, std::memory_order_release);
+    return sid;
+}
+
+// Post a receive into dst (capacity cap bytes). Returns request id.
+uint64_t ucc_mailbox_post_recv(void* mbp, const char* key, size_t keylen,
+                               void* dst, size_t cap) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    std::string k(key, keylen);
+    Request* rreq = nullptr;
+    uint64_t rid = mb->new_request(&rreq);
+    rreq->dst = dst;
+    rreq->dst_cap = cap;
+
+    Shard& sh = mb->shard_for(k);
+    uint64_t sid = 0;
+    {
+        std::lock_guard<std::mutex> g(sh.mu);
+        auto it = sh.unexpected.find(k);
+        if (it != sh.unexpected.end() && !it->second.empty()) {
+            sid = it->second.front();
+            it->second.pop_front();
+            if (it->second.empty()) sh.unexpected.erase(it);
+        } else {
+            sh.posted[k].push_back(rid);
+            return rid;
+        }
+    }
+    Request* sreq = mb->get(sid);
+    if (sreq) deliver(sreq, rreq);
+    return rid;
+}
+
+int ucc_req_test(void* mbp, uint64_t id) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    Request* r = mb->get(id);
+    if (!r) return 1;  // freed == complete
+    return r->done.load(std::memory_order_acquire) ? 1 : 0;
+}
+
+uint64_t ucc_req_nbytes(void* mbp, uint64_t id) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    Request* r = mb->get(id);
+    return r ? r->nbytes : 0;
+}
+
+void ucc_req_free(void* mbp, uint64_t id) {
+    static_cast<Mailbox*>(mbp)->drop(id);
+}
+
+// ---------------------------------------------------------------------------
+// bounded MPMC queue (ucc_lock_free_queue.h analog): CAS ring of uint64.
+// ---------------------------------------------------------------------------
+
+struct MpmcCell {
+    std::atomic<uint64_t> seq;
+    uint64_t value;
+};
+
+struct MpmcQueue {
+    std::unique_ptr<MpmcCell[]> cells;   // atomics are not movable: raw array
+    size_t mask;
+    std::atomic<uint64_t> head{0};
+    std::atomic<uint64_t> tail{0};
+
+    explicit MpmcQueue(size_t capacity) {
+        size_t cap = 1;
+        while (cap < capacity) cap <<= 1;
+        cells = std::make_unique<MpmcCell[]>(cap);
+        mask = cap - 1;
+        for (size_t i = 0; i < cap; ++i)
+            cells[i].seq.store(i, std::memory_order_relaxed);
+    }
+};
+
+void* ucc_mpmc_create(uint64_t capacity) { return new MpmcQueue(capacity); }
+void ucc_mpmc_destroy(void* q) { delete static_cast<MpmcQueue*>(q); }
+
+int ucc_mpmc_push(void* qp, uint64_t v) {
+    auto* q = static_cast<MpmcQueue*>(qp);
+    uint64_t pos = q->tail.load(std::memory_order_relaxed);
+    for (;;) {
+        MpmcCell& c = q->cells[pos & q->mask];
+        uint64_t seq = c.seq.load(std::memory_order_acquire);
+        intptr_t dif = (intptr_t)seq - (intptr_t)pos;
+        if (dif == 0) {
+            if (q->tail.compare_exchange_weak(pos, pos + 1,
+                                              std::memory_order_relaxed)) {
+                c.value = v;
+                c.seq.store(pos + 1, std::memory_order_release);
+                return 1;
+            }
+        } else if (dif < 0) {
+            return 0;  // full
+        } else {
+            pos = q->tail.load(std::memory_order_relaxed);
+        }
+    }
+}
+
+int ucc_mpmc_pop(void* qp, uint64_t* out) {
+    auto* q = static_cast<MpmcQueue*>(qp);
+    uint64_t pos = q->head.load(std::memory_order_relaxed);
+    for (;;) {
+        MpmcCell& c = q->cells[pos & q->mask];
+        uint64_t seq = c.seq.load(std::memory_order_acquire);
+        intptr_t dif = (intptr_t)seq - (intptr_t)(pos + 1);
+        if (dif == 0) {
+            if (q->head.compare_exchange_weak(pos, pos + 1,
+                                              std::memory_order_relaxed)) {
+                *out = c.value;
+                c.seq.store(pos + q->mask + 1, std::memory_order_release);
+                return 1;
+            }
+        } else if (dif < 0) {
+            return 0;  // empty
+        } else {
+            pos = q->head.load(std::memory_order_relaxed);
+        }
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,189 @@
+"""Aux subsystem tests: EE/triggered post, generic datatypes, datatype
+consistency checking, profiling, mem_map — mirrors reference gtest
+core/test_service_coll.cc, core/test_mem_map.cc and the EE/event paths."""
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType, Ee,
+                     GenericDataType, ReductionOp, Status, UccEvent)
+from ucc_tpu.constants import EeType
+
+from harness import UccJob
+
+
+class TestTriggeredPost:
+    def test_cpu_thread_ee(self):
+        job = UccJob(2)
+        try:
+            teams = job.create_team()
+            count = 8
+            srcs = [np.full(count, r + 1.0, np.float32) for r in range(2)]
+            dsts = [np.zeros(count, np.float32) for _ in range(2)]
+            reqs = [teams[r].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+                dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+                op=ReductionOp.SUM)) for r in range(2)]
+            ees = [Ee(teams[r], EeType.CPU_THREAD) for r in range(2)]
+            evs = [UccEvent() for _ in range(2)]
+            for r in range(2):
+                ees[r].triggered_post(evs[r], reqs[r])
+            time.sleep(0.05)
+            # nothing ran yet: events not fired
+            assert all(rq.test() == Status.OPERATION_INITIALIZED
+                       for rq in reqs)
+            for ev in evs:
+                ev.set()
+            deadline = time.monotonic() + 10
+            while not all(rq.test() == Status.OK for rq in reqs):
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            for r in range(2):
+                np.testing.assert_allclose(dsts[r], 3.0)
+            # completion events observable
+            deadline = time.monotonic() + 5
+            seen = 0
+            while seen < 2 and time.monotonic() < deadline:
+                ev = ees[0].get_event()
+                if ev is not None:
+                    seen += 1
+            assert seen == 2  # collective_post + collective_complete
+            for ee in ees:
+                ee.destroy()
+        finally:
+            job.cleanup()
+
+
+class TestGenericDatatype:
+    def test_bcast_generic(self):
+        """Data movement of a user struct dtype (12-byte records)."""
+        job = UccJob(3)
+        try:
+            teams = job.create_team()
+            gdt = GenericDataType(12, name="record12")
+            n_rec = 5
+            root_data = np.arange(60, dtype=np.uint8)
+            bufs = [root_data.copy() if r == 0 else np.zeros(60, np.uint8)
+                    for r in range(3)]
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.BCAST, root=0,
+                src=BufferInfo(bufs[r], n_rec, gdt)))
+            for r in range(3):
+                np.testing.assert_array_equal(bufs[r], root_data)
+        finally:
+            job.cleanup()
+
+    def test_generic_reduce_cb(self):
+        """EC reduce through a user reduce callback (pairwise struct sum)."""
+        from ucc_tpu.ec.cpu import EcCpu
+
+        def reduce_cb(a: bytes, b: bytes, count: int) -> bytes:
+            av = np.frombuffer(a, np.float32)
+            bv = np.frombuffer(b, np.float32)
+            return (av + bv).tobytes()
+
+        gdt = GenericDataType(8, reduce_cb=reduce_cb, name="vec2f")
+        ec = EcCpu()
+        srcs = [np.full(4, float(i + 1), np.float32) for i in range(3)]
+        dst = np.zeros(4, np.float32)
+        ec.reduce(dst, srcs, 2, gdt, ReductionOp.SUM)   # 2 records of 8B
+        np.testing.assert_allclose(dst, 6.0)
+
+    def test_generic_without_reduce_cb_rejected(self):
+        from ucc_tpu.ec.cpu import EcCpu
+        from ucc_tpu.status import UccError
+        gdt = GenericDataType(8, name="opaque")
+        with pytest.raises(UccError):
+            EcCpu().reduce(np.zeros(8, np.uint8),
+                           [np.zeros(8, np.uint8)] * 2, 1, gdt,
+                           ReductionOp.SUM)
+
+
+class TestDtConsistency:
+    """Scoped to gather/scatter family, opt-in via UCC_CHECK_ASYMMETRIC_DT
+    (reference defaults it off for performance, ucc_global_opts.c:112)."""
+
+    def test_asymmetric_dtype_detected(self):
+        job = UccJob(2, lib_overrides={"CHECK_ASYMMETRIC_DT": "y"})
+        try:
+            teams = job.create_team()
+            count = 4
+            dts = [DataType.FLOAT32, DataType.INT32]
+            nds = [np.float32, np.int32]
+            reqs = []
+            for r in range(2):
+                reqs.append(teams[r].collective_init(CollArgs(
+                    coll_type=CollType.GATHER, root=0,
+                    src=BufferInfo(np.ones(count, nds[r]), count, dts[r]),
+                    dst=BufferInfo(np.zeros(count * 2, nds[r]), count * 2,
+                                   dts[r]) if r == 0 else None)))
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs), timeout=15)
+            assert reqs[0].test() == Status.ERR_INVALID_PARAM
+            assert reqs[1].test() == Status.ERR_INVALID_PARAM
+        finally:
+            job.cleanup()
+
+    def test_symmetric_passes(self):
+        job = UccJob(2, lib_overrides={"CHECK_ASYMMETRIC_DT": "y"})
+        try:
+            teams = job.create_team()
+            count = 4
+            dst = np.zeros(count * 2, np.float32)
+            reqs = [teams[r].collective_init(CollArgs(
+                coll_type=CollType.GATHER, root=0,
+                src=BufferInfo(np.ones(count, np.float32), count,
+                               DataType.FLOAT32),
+                dst=BufferInfo(dst, count * 2, DataType.FLOAT32) if r == 0
+                else None)) for r in range(2)]
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs))
+            assert all(rq.test() == Status.OK for rq in reqs)
+            np.testing.assert_allclose(dst, 1.0)
+        finally:
+            job.cleanup()
+
+
+class TestMemMap:
+    def test_export_import_roundtrip(self):
+        lib = ucc_tpu.init()
+        ctx = ucc_tpu.Context(lib)
+        buf = np.arange(16, dtype=np.float64)
+        handle = ctx.mem_map(buf)
+        assert isinstance(handle, bytes)
+        desc = ctx.mem_import(handle)
+        assert desc["nbytes"] == 128
+        assert desc["buffer"] is buf        # same-process fast path
+        assert ctx.mem_unmap(handle) == Status.OK
+        assert ctx.mem_import(handle)["buffer"] is None
+        ctx.destroy()
+
+
+class TestProfiling:
+    def test_profile_log(self, tmp_path, monkeypatch):
+        # profiling reads env at import; reload the module with env set
+        import importlib
+        prof_file = tmp_path / "trace.json"
+        monkeypatch.setenv("UCC_PROFILE_MODE", "log")
+        monkeypatch.setenv("UCC_PROFILE_FILE", str(prof_file))
+        from ucc_tpu.utils import profiling
+        importlib.reload(profiling)
+        assert profiling.ENABLED
+        profiling.request_new("allreduce", 1)
+        profiling.request_complete("allreduce", 1, status="OK")
+        import json
+        lines = [json.loads(line) for line in
+                 prof_file.read_text().splitlines()]
+        assert lines[0]["name"] == "coll_allreduce" and lines[0]["ph"] == "B"
+        assert lines[1]["ph"] == "E"
+        monkeypatch.delenv("UCC_PROFILE_MODE")
+        importlib.reload(profiling)

@@ -42,5 +42,7 @@ from .core.context import Context  # noqa: F401
 from .core.team import Team, TeamState  # noqa: F401
 from .core.coll import CollRequest, collective_init  # noqa: F401
 from .core.oob import SubsetOob, TcpStoreOob, ThreadOob, ThreadOobWorld  # noqa: F401
+from .core.ee import Ee, UccEvent  # noqa: F401
+from . import ops  # noqa: F401
 
 __version__ = "0.1.0"

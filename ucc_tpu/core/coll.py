@@ -10,18 +10,58 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
+
+import numpy as np
 from typing import Any, Optional
 
 from ..api.types import (BufferInfo, BufferInfoV, CollArgs,
                          coll_args_msgsize)
 from ..constants import (CollArgsFlags, CollType, MemoryType, coll_type_str)
 from ..mc.base import detect_mem_type
+from ..schedule.schedule import Schedule
 from ..schedule.task import CollTask
 from ..status import Status, UccError
+from ..utils import profiling
 from ..utils.log import get_logger
 from .team import Team
 
 logger = get_logger("coll")
+
+
+class _DtCheckTask(CollTask):
+    """Datatype-consistency validation for rooted collectives
+    (ucc_service_coll.c:231+, design comment ucc_schedule.h:68-94): a
+    service allreduce(MIN) over [dt, -dt, mem, -mem]; if min(dt) != -min(-dt)
+    some rank passed a different datatype and the collective errors out
+    instead of corrupting data."""
+
+    def __init__(self, team: Team, dt_id: int, mem_id: int):
+        super().__init__(team=team)
+        self.core_team = team
+        self.vec = np.array([dt_id, -dt_id, mem_id, -mem_id], dtype=np.int64)
+        self._svc = None
+
+    def post_fn(self) -> Status:
+        from ..constants import ReductionOp
+        self._svc = self.core_team.service_team.service_allreduce(
+            self.vec, ReductionOp.MIN)
+        self._svc.post()
+        return Status.OK
+
+    def progress_fn(self) -> None:
+        svc = self._svc
+        if svc is None or not svc.is_completed():
+            return
+        if svc.super_status.is_error:
+            self.status = svc.super_status
+            return
+        r = svc.result
+        if int(r[0]) != -int(r[1]) or int(r[2]) != -int(r[3]):
+            logger.error("asymmetric datatype/memtype detected across team "
+                         "%s ranks", self.core_team.id)
+            self.status = Status.ERR_INVALID_PARAM
+            return
+        self.status = Status.OK
 
 
 @dataclass
@@ -156,8 +196,53 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
         logger.info("coll init: %s/%s msgsize %d -> %s (score %d) team %s",
                     coll_type_str(ct), mem_type.name.lower(), msgsize,
                     chosen.alg_name or chosen.team, chosen.score, team.id)
+    task = _maybe_wrap_dt_check(task, args, team, mem_type)
     _attach_user_opts(task, args)
+    if profiling.ENABLED:
+        _attach_profiling(task, ct)
     return CollRequest(task, team, args)
+
+
+def _maybe_wrap_dt_check(task: CollTask, args: CollArgs, team: Team,
+                         mem_type: MemoryType) -> CollTask:
+    """Rooted colls optionally get a dt-validation schedule prefix
+    (ucc_coll.c:274-289)."""
+    from ..constants import DataType, EventType, GenericDataType
+    # scoped to the gather/scatter family like the reference; note the
+    # zero-size fast path means a rank posting all-zero counts skips the
+    # check (same property as ucc_coll.c:191 vs :274)
+    checked = (CollType.GATHER | CollType.GATHERV | CollType.SCATTER
+               | CollType.SCATTERV)
+    if not (args.coll_type & checked) or team.size <= 1:
+        return task
+    if not team.context.lib.config.check_asymmetric_dt:
+        return task
+    if team.service_team is None or \
+            not hasattr(team.service_team, "service_allreduce"):
+        return task
+    bi = args.src if args.src is not None else args.dst
+    if bi is None or isinstance(bi.datatype, GenericDataType):
+        return task
+    sched = Schedule(team=team, args=args)
+    chk = _DtCheckTask(team, int(DataType(bi.datatype)) + 1,
+                       int(mem_type) + 1)
+    sched.add_task(chk)
+    sched.add_dep_on_schedule_start(chk)
+    sched.add_task(task)
+    task.subscribe_dep(chk, EventType.EVENT_COMPLETED)
+    return sched
+
+
+def _attach_profiling(task: CollTask, ct: CollType) -> None:
+    name = coll_type_str(ct)
+    profiling.request_new(name, task.seq_num)
+    prev = task.cb
+
+    def cb(t, st):
+        profiling.request_complete(name, t.seq_num, status=st.name)
+        if prev is not None:
+            prev(t, st)
+    task.cb = cb
 
 
 def _attach_user_opts(task: CollTask, args: CollArgs) -> None:

@@ -116,6 +116,9 @@ class Context:
             h.obj.create_epilog()
 
         self._team_id_counter = 1
+        import uuid as _uuid
+        self._ctx_uid = _uuid.uuid4().hex
+        self._mem_maps = {}
         self._destroyed = False
 
     # ------------------------------------------------------------------
@@ -135,6 +138,45 @@ class Context:
             if progress_others:
                 progress_others()
         return team
+
+    # ------------------------------------------------------------------
+    # memory map export/import (ucc_mem_map, ucc.h:2265-2320 /
+    # ucc_context.c:1250-1559). On TPU hosts there is no RDMA rkey to
+    # exchange; the handle carries enough metadata for a future one-sided
+    # DCN path and already supports local validation + re-import.
+    def mem_map(self, buffer, mode: str = "export") -> bytes:
+        """Returns an opaque exported memory handle (pickled descriptor)."""
+        import pickle as _pickle
+
+        from ..mc.base import detect_mem_type
+        mt = detect_mem_type(buffer)
+        nbytes = getattr(buffer, "nbytes", len(buffer))
+        desc = {"ctx_rank": self.rank, "ctx_uid": self._ctx_uid,
+                "mem_type": int(mt), "nbytes": int(nbytes), "mode": mode,
+                "addr_id": id(buffer)}
+        self._mem_maps[desc["addr_id"]] = buffer
+        return _pickle.dumps(desc)
+
+    def mem_unmap(self, handle: bytes) -> Status:
+        import pickle as _pickle
+        desc = _pickle.loads(handle)
+        self._mem_maps.pop(desc.get("addr_id"), None)
+        return Status.OK
+
+    def mem_import(self, handle: bytes):
+        """Import a peer's exported handle -> descriptor dict. Same-process
+        handles resolve to the live buffer (the shm fast path); remote
+        handles carry metadata only (one-sided DCN transport: future)."""
+        import pickle as _pickle
+        desc = _pickle.loads(handle)
+        # only resolve to a live buffer when the handle was exported by
+        # THIS context (id() reuse across contexts/processes would
+        # otherwise alias unrelated buffers)
+        if desc.get("ctx_uid") == self._ctx_uid:
+            desc["buffer"] = self._mem_maps.get(desc.get("addr_id"))
+        else:
+            desc["buffer"] = None
+        return desc
 
     def destroy(self) -> Status:
         if self._destroyed:

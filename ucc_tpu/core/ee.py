@@ -1,0 +1,130 @@
+"""Execution engines + triggered collectives.
+
+Reference: /root/reference/src/core/ucc_ee.c + ucc.h:2050-2260 — an EE is
+an execution context bound to a team (CUDA stream / CPU thread) with
+in/out event queues; ``ucc_collective_triggered_post`` defers the post
+until an event fires on the EE, and completion pushes an event back.
+
+TPU mapping (two worlds):
+
+  - ``EeType.TPU_STREAM``: the compiled world. On TPU the "stream" is the
+    XLA program itself — a triggered collective is one embedded in a jitted
+    step via ``ucc_tpu.ops`` (see ops.py). This EE type exists for API
+    parity and carries the event-queue bookkeeping; the actual execution
+    is the dispatched program.
+  - ``EeType.CPU_THREAD``: a host progress thread. Triggered posts wait on
+    a UccEvent; the EE thread drives the context progress queue so the
+    user needn't poll — the reference's CPU-thread EE semantics.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+from ..constants import EeType
+from ..status import Status, UccError
+from ..utils.log import get_logger
+
+logger = get_logger("ee")
+
+
+class UccEvent:
+    """ucc_ev_t: a signalable event with an optional payload."""
+
+    def __init__(self, ev_type: str = "compute_complete", payload=None):
+        self.ev_type = ev_type
+        self.payload = payload
+        self._set = threading.Event()
+
+    def set(self) -> None:
+        self._set.set()
+
+    def is_set(self) -> bool:
+        return self._set.is_set()
+
+
+class Ee:
+    """ucc_ee_h. Create via team.ee_create()."""
+
+    def __init__(self, team, ee_type: EeType = EeType.CPU_THREAD):
+        self.team = team
+        self.ee_type = ee_type
+        self.event_in: Deque[UccEvent] = deque()
+        self.event_out: Deque[UccEvent] = deque()
+        self._pending: List[Tuple[UccEvent, object]] = []
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if ee_type == EeType.CPU_THREAD:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+    def triggered_post(self, event: UccEvent, req) -> Status:
+        """ucc_collective_triggered_post (ucc.h:2246): post `req` when
+        `event` fires; a COLLECTIVE_POST event lands on event_out."""
+        with self._lock:
+            self._pending.append((event, req))
+        if self._thread is None:
+            self.progress()   # TPU_STREAM EEs progress inline
+        return Status.OK
+
+    def get_event(self) -> Optional[UccEvent]:
+        """ucc_ee_get_event: pop a completion event."""
+        self.progress()
+        with self._lock:
+            return self.event_out.popleft() if self.event_out else None
+
+    def ack_event(self, ev: UccEvent) -> Status:
+        return Status.OK
+
+    def set_event(self, ev: UccEvent) -> Status:
+        """ucc_ee_set_event: external signal into the EE."""
+        ev.set()
+        self.event_in.append(ev)
+        if self._thread is None:
+            self.progress()
+        return Status.OK
+
+    # ------------------------------------------------------------------
+    def progress(self) -> None:
+        fired = []
+        with self._lock:
+            still = []
+            for ev, req in self._pending:
+                if ev.is_set():
+                    fired.append((ev, req))
+                else:
+                    still.append((ev, req))
+            self._pending = still
+        for ev, req in fired:
+            # chain the completion event BEFORE posting: a fast collective
+            # may complete synchronously inside post()
+            req.task.cb = _chain_cb(req.task.cb, self, req)
+            out = UccEvent("collective_post", payload=req)
+            with self._lock:
+                self.event_out.append(out)
+            req.post()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.progress()
+            self.team.context.progress()
+            time.sleep(0)
+
+    def destroy(self) -> Status:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return Status.OK
+
+
+def _chain_cb(prev_cb, ee: Ee, req):
+    def cb(task, status):
+        if prev_cb is not None:
+            prev_cb(task, status)
+        with ee._lock:
+            ee.event_out.append(UccEvent("collective_complete", payload=req))
+    return cb

@@ -34,8 +34,11 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
     ConfigField("PROFILE_LOG_SIZE", "4m", "profiling buffer size", parse_string),
     ConfigField("TEAM_IDS_POOL_SIZE", "32", "team id pool size per context",
                 parse_uint),
-    ConfigField("CHECK_ASYMMETRIC_DT", "y", "validate datatype consistency "
-                "for rooted colls", parse_bool),
+    ConfigField("CHECK_ASYMMETRIC_DT", "n", "validate datatype consistency "
+                "for gather(v)/scatter(v) via a service allreduce before "
+                "the collective (off by default for performance, matching "
+                "the reference ucc_global_opts.c:112-119; requires every "
+                "rank to post with nonzero counts)", parse_bool),
 ]))
 
 

@@ -119,6 +119,27 @@ class EcCpu(Executor):
         if len(srcs) > EXECUTOR_NUM_BUFS:
             raise UccError(Status.ERR_INVALID_PARAM,
                            f"reduce takes at most {EXECUTOR_NUM_BUFS} bufs")
+        from ..constants import GenericDataType
+        if isinstance(dt, GenericDataType):
+            # user datatype: fold via the reduce callback over raw bytes
+            # (ucc_dt_create_generic reduce semantics, ucc.h:289-433)
+            if dt.reduce_cb is None:
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               "generic datatype has no reduce callback")
+            acc = bytearray(np.asarray(srcs[0]).reshape(-1)
+                            .view(np.uint8)[:count * dt.size].tobytes())
+            for s in srcs[1:]:
+                sb = np.asarray(s).reshape(-1).view(np.uint8)
+                acc = bytearray(dt.reduce_cb(bytes(acc),
+                                             sb[:count * dt.size].tobytes(),
+                                             count))
+            out = np.frombuffer(bytes(acc), dtype=np.uint8)
+            if isinstance(dst, np.ndarray):
+                if not dst.flags["C_CONTIGUOUS"]:
+                    raise UccError(Status.ERR_INVALID_PARAM,
+                                   "generic-dtype dst must be contiguous")
+                dst.reshape(-1).view(np.uint8)[:out.size] = out
+            return ExecutorTask(ExecutorTaskType.REDUCE, Status.OK)
         nd = dt_numpy(dt)
         typed = [_as_typed(s, count, nd) for s in srcs]
         res = reduce_arrays(typed, op, dt, alpha)

@@ -1,0 +1,215 @@
+"""ctypes bindings for the native runtime core (native/ucc_tpu_core.cc).
+
+Auto-builds the shared library on first use when a toolchain is present
+(the reference ships autotools-built .so components; here one ``make`` in
+native/). Everything degrades gracefully: if the library can't be built or
+loaded, callers fall back to the pure-Python implementations.
+
+``NativeMailbox`` implements the same push/post_recv contract as
+tl/host/transport.Mailbox, with matching + payload copies in C++ (the
+tl/ucp tag-matching hot loop, done native). Selected via
+``UCC_TL_SHM_NATIVE`` (default: on when available).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .utils.log import get_logger
+
+logger = get_logger("native")
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libucc_tpu_core.so")
+
+
+def _build() -> bool:
+    if not os.path.isdir(_NATIVE_DIR):
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.isfile(_SO_PATH)
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.debug("native core build failed: %s", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native core; None when unavailable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("UCC_NATIVE", "y").lower() in ("n", "no", "0",
+                                                         "off"):
+            return None
+        if not os.path.isfile(_SO_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            logger.warning("native core load failed: %s", e)
+            return None
+        lib.ucc_mailbox_create.restype = ctypes.c_void_p
+        lib.ucc_mailbox_destroy.argtypes = [ctypes.c_void_p]
+        lib.ucc_mailbox_push.restype = ctypes.c_uint64
+        lib.ucc_mailbox_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_size_t]
+        lib.ucc_mailbox_post_recv.restype = ctypes.c_uint64
+        lib.ucc_mailbox_post_recv.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_size_t]
+        lib.ucc_req_test.restype = ctypes.c_int
+        lib.ucc_req_test.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ucc_req_nbytes.restype = ctypes.c_uint64
+        lib.ucc_req_nbytes.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ucc_req_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ucc_mpmc_create.restype = ctypes.c_void_p
+        lib.ucc_mpmc_create.argtypes = [ctypes.c_uint64]
+        lib.ucc_mpmc_destroy.argtypes = [ctypes.c_void_p]
+        lib.ucc_mpmc_push.restype = ctypes.c_int
+        lib.ucc_mpmc_push.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ucc_mpmc_pop.restype = ctypes.c_int
+        lib.ucc_mpmc_pop.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_uint64)]
+        _LIB = lib
+        logger.info("native runtime core loaded: %s", _SO_PATH)
+        return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# native requests/mailbox with the python transport's interface
+# ---------------------------------------------------------------------------
+
+class NativeSendReq:
+    __slots__ = ("mb", "rid", "_done")
+
+    def __init__(self, mb: "NativeMailbox", rid: int):
+        self.mb = mb
+        self.rid = rid
+        self._done = False
+
+    @property
+    def done(self) -> bool:
+        return self.test()
+
+    def test(self) -> bool:
+        if self._done:
+            return True
+        if self.mb.ptr is None:       # mailbox destroyed mid-flight
+            self._done = True
+            return True
+        if self.mb.lib.ucc_req_test(self.mb.ptr, self.rid):
+            self.mb.lib.ucc_req_free(self.mb.ptr, self.rid)
+            self._done = True
+        return self._done
+
+
+class NativeRecvReq:
+    __slots__ = ("mb", "rid", "dst_keepalive", "_done", "nbytes")
+
+    def __init__(self, mb: "NativeMailbox", rid: int, dst: np.ndarray):
+        self.mb = mb
+        self.rid = rid
+        self.dst_keepalive = dst     # pin the buffer the C side writes into
+        self._done = False
+        self.nbytes = 0
+
+    @property
+    def done(self) -> bool:
+        return self.test()
+
+    def test(self) -> bool:
+        if self._done:
+            return True
+        if self.mb.ptr is None:       # mailbox destroyed mid-flight
+            self._done = True
+            return True
+        if self.mb.lib.ucc_req_test(self.mb.ptr, self.rid):
+            self.nbytes = int(self.mb.lib.ucc_req_nbytes(self.mb.ptr,
+                                                         self.rid))
+            self.mb.lib.ucc_req_free(self.mb.ptr, self.rid)
+            self._done = True
+        return self._done
+
+
+class NativeMailbox:
+    """C++ tag matcher behind the Mailbox interface."""
+
+    def __init__(self):
+        self.lib = get_lib()
+        if self.lib is None:
+            raise RuntimeError("native core unavailable")
+        self.ptr = self.lib.ucc_mailbox_create()
+        self._key_cache: Dict[Any, bytes] = {}
+
+    def _key_bytes(self, key) -> bytes:
+        kb = self._key_cache.get(key)
+        if kb is None:
+            kb = pickle.dumps(key)
+            if len(self._key_cache) < 65536:
+                self._key_cache[key] = kb
+        return kb
+
+    def push_native(self, key, data: np.ndarray) -> NativeSendReq:
+        kb = self._key_bytes(key)
+        data = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+        rid = self.lib.ucc_mailbox_push(
+            self.ptr, kb, len(kb),
+            data.ctypes.data_as(ctypes.c_void_p), data.nbytes)
+        return NativeSendReq(self, rid)
+
+    def post_recv_native(self, key, dst: np.ndarray) -> NativeRecvReq:
+        kb = self._key_bytes(key)
+        dst_u8 = dst.reshape(-1).view(np.uint8)
+        rid = self.lib.ucc_mailbox_post_recv(
+            self.ptr, kb, len(kb),
+            dst_u8.ctypes.data_as(ctypes.c_void_p), dst_u8.nbytes)
+        return NativeRecvReq(self, rid, dst_u8)
+
+    def destroy(self) -> None:
+        if self.ptr:
+            self.lib.ucc_mailbox_destroy(self.ptr)
+            self.ptr = None
+
+
+class NativeMpmcQueue:
+    """Bounded MPMC queue of uint64 handles (ucc_lock_free_queue analog)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.lib = get_lib()
+        if self.lib is None:
+            raise RuntimeError("native core unavailable")
+        self.ptr = self.lib.ucc_mpmc_create(capacity)
+
+    def push(self, v: int) -> bool:
+        return bool(self.lib.ucc_mpmc_push(self.ptr, v))
+
+    def pop(self) -> Optional[int]:
+        out = ctypes.c_uint64()
+        if self.lib.ucc_mpmc_pop(self.ptr, ctypes.byref(out)):
+            return int(out.value)
+        return None
+
+    def destroy(self) -> None:
+        if self.ptr:
+            self.lib.ucc_mpmc_destroy(self.ptr)
+            self.ptr = None

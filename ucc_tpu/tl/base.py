@@ -54,7 +54,24 @@ def binfo_u8(bi, offset: int = 0, nbytes: Optional[int] = None) -> np.ndarray:
 
 
 def binfo_typed(bi, count: Optional[int] = None, elem_offset: int = 0) -> np.ndarray:
-    """Typed 1-D view of `count` elements starting at elem_offset."""
+    """Typed 1-D view of `count` elements starting at elem_offset.
+
+    Generic user datatypes (ucc_dt_create_generic analog) have no numpy
+    compute type; data-movement colls view them as raw bytes of
+    count*size (pack/unpack callbacks apply at the user boundary)."""
+    from ..constants import GenericDataType
+    if isinstance(bi.datatype, GenericDataType):
+        esz = bi.datatype.size
+        if count is None:
+            count = int(bi.count) if isinstance(bi, BufferInfo) else \
+                sum(int(c) for c in (bi.counts or []))
+        buf = bi.buffer
+        if isinstance(buf, np.ndarray):
+            _require_contiguous(buf)
+            flat = buf.reshape(-1).view(np.uint8)
+        else:
+            flat = np.frombuffer(buf, dtype=np.uint8)
+        return flat[elem_offset * esz:(elem_offset + count) * esz]
     nd = dt_numpy(bi.datatype)
     buf = bi.buffer
     if isinstance(buf, np.ndarray):

@@ -109,46 +109,73 @@ def _deliver(req: RecvReq, ps: _PendingSend) -> None:
 # in-process transport
 # ---------------------------------------------------------------------------
 
-#: process-global endpoint registry: uid -> Mailbox (the "shared memory
-#: segment"; cf. reference tl_cuda SysV shm control segment
+#: process-global endpoint registry: uid -> InProcTransport (the "shared
+#: memory segment"; cf. reference tl_cuda SysV shm control segment
 #: tl_cuda_team.c:141-181 — same role, in-process)
-_SHM_WORLD: Dict[str, Mailbox] = {}
+_SHM_WORLD: Dict[str, "InProcTransport"] = {}
 _SHM_LOCK = threading.Lock()
 
 
 class InProcTransport:
-    """One endpoint per core context."""
+    """One endpoint per core context. Uses the native C++ tag matcher
+    (ucc_tpu.native) when built; pure-Python mailbox otherwise."""
 
     EAGER_THRESHOLD = 8192
 
-    def __init__(self):
+    def __init__(self, use_native: Optional[bool] = None):
         self.uid = uuid.uuid4().hex
         self.mailbox = Mailbox()
+        self.native = None
+        if use_native is None:
+            import os
+            # measured on this machine: the ctypes-bound C++ matcher is
+            # ~2x slower than the in-GIL python matcher for single-threaded
+            # progress (per-call ffi + key serialization dominate), and the
+            # python path additionally does zero-copy rendezvous for large
+            # messages. The native matcher's value is GIL-released matching
+            # under ThreadMode.MULTIPLE with many progress threads -> opt-in.
+            use_native = os.environ.get("UCC_TL_SHM_NATIVE", "n").lower() \
+                in ("y", "yes", "1", "on")
+        if use_native:
+            try:
+                from ...native import NativeMailbox, available
+                if available():
+                    self.native = NativeMailbox()
+            except Exception:  # noqa: BLE001 - fall back to python matcher
+                self.native = None
         with _SHM_LOCK:
-            _SHM_WORLD[self.uid] = self.mailbox
+            _SHM_WORLD[self.uid] = self
 
     # -- address plumbing ---------------------------------------------
     def pack_address(self) -> bytes:
         return self.uid.encode()
 
     @staticmethod
-    def resolve(addr: bytes) -> Optional[Mailbox]:
+    def resolve(addr: bytes) -> Optional["InProcTransport"]:
         with _SHM_LOCK:
             return _SHM_WORLD.get(addr.decode())
 
     # -- data path -----------------------------------------------------
-    def send_nb(self, peer_mailbox: Mailbox, key: TagKey,
+    def send_nb(self, peer: "InProcTransport", key: TagKey,
                 data: np.ndarray) -> SendReq:
+        if peer.native is not None:
+            # matching lives in the RECEIVER's mailbox: route by the peer's
+            # matcher only (a mixed pair must not split send/recv across
+            # python and native matchers)
+            return peer.native.push_native(key, data)
         data = data.reshape(-1).view(np.uint8)
         if data.nbytes <= self.EAGER_THRESHOLD:
             ps = _PendingSend(data.copy(), SendReq(), copied=True)
             ps.req.done = True        # eager: sender buffer free immediately
         else:
             ps = _PendingSend(data, SendReq(), copied=False)
-        peer_mailbox.push(key, ps)
+        peer.mailbox.push(key, ps)
         return ps.req
 
     def recv_nb(self, key: TagKey, dst: np.ndarray) -> RecvReq:
+        if self.native is not None:
+            return self.native.post_recv_native(key, dst)
+        # (peers route sends by OUR matcher, so python recv is consistent)
         req = RecvReq(dst.reshape(-1).view(np.uint8))
         self.mailbox.post_recv(key, req)
         return req
@@ -159,3 +186,6 @@ class InProcTransport:
     def close(self) -> None:
         with _SHM_LOCK:
             _SHM_WORLD.pop(self.uid, None)
+        if self.native is not None:
+            self.native.destroy()
+            self.native = None

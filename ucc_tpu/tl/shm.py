@@ -62,24 +62,24 @@ class TlShmContext(BaseContext):
         info = self.peer_info.get(ctx_rank)
         return bool(info) and info[0] == os.getpid()
 
-    def _mailbox(self, ctx_rank: int):
-        mb = self._mailboxes.get(ctx_rank)
-        if mb is None:
+    def _peer(self, ctx_rank: int):
+        peer = self._mailboxes.get(ctx_rank)
+        if peer is None:
             info = self.peer_info.get(ctx_rank)
             if info is None:
                 raise UccError(Status.ERR_NOT_FOUND,
                                f"no shm address for ctx rank {ctx_rank}")
-            mb = InProcTransport.resolve(info[1].encode()
-                                         if isinstance(info[1], str)
-                                         else info[1])
-            if mb is None:
+            peer = InProcTransport.resolve(info[1].encode()
+                                           if isinstance(info[1], str)
+                                           else info[1])
+            if peer is None:
                 raise UccError(Status.ERR_NOT_FOUND,
                                f"shm peer {ctx_rank} endpoint gone")
-            self._mailboxes[ctx_rank] = mb
-        return mb
+            self._mailboxes[ctx_rank] = peer
+        return peer
 
     def send_to(self, peer_ctx_rank: int, key, data: np.ndarray):
-        return self.transport.send_nb(self._mailbox(peer_ctx_rank), key, data)
+        return self.transport.send_nb(self._peer(peer_ctx_rank), key, data)
 
     def destroy(self) -> None:
         self.transport.close()
