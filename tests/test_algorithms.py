@@ -1,0 +1,210 @@
+"""Per-algorithm correctness sweep via the TUNE DSL — mirrors the
+reference's alg-variant coverage (each tl_ucp alg id tested across team
+sizes): every algorithm forced via UCC_TL_SHM_TUNE and validated against
+numpy expectations, including NOT_SUPPORTED fallback behavior."""
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType, ReductionOp,
+                     Status)
+
+from harness import UccJob
+
+
+def run_with_tune(tune, n, make_args, check, monkeypatch):
+    monkeypatch.setenv("UCC_TL_SHM_TUNE", tune)
+    job = UccJob(n)
+    try:
+        teams = job.create_team()
+        reqs = job.run_coll(teams, make_args)
+        check()
+    finally:
+        job.cleanup()
+
+
+class TestAllgatherAlgs:
+    @pytest.mark.parametrize("alg", ["ring", "bruck", "neighbor", "linear"])
+    @pytest.mark.parametrize("n", [2, 4, 6, 8])
+    def test_allgather(self, alg, n, monkeypatch):
+        per = 7
+        srcs = [np.arange(per, dtype=np.int64) + 100 * r for r in range(n)]
+        dsts = [np.zeros(per * n, dtype=np.int64) for _ in range(n)]
+        expect = np.concatenate(srcs)
+
+        def check():
+            for r in range(n):
+                np.testing.assert_array_equal(dsts[r], expect)
+
+        run_with_tune(f"allgather:@{alg}:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLGATHER,
+            src=BufferInfo(srcs[r], per, DataType.INT64),
+            dst=BufferInfo(dsts[r], per * n, DataType.INT64)),
+            check, monkeypatch)
+
+    def test_neighbor_odd_falls_back(self, monkeypatch):
+        """Odd team size: neighbor raises NOT_SUPPORTED, fallback chain
+        must pick another algorithm and still complete correctly."""
+        n, per = 5, 4
+        srcs = [np.full(per, r, np.int32) for r in range(n)]
+        dsts = [np.zeros(per * n, np.int32) for _ in range(n)]
+
+        def check():
+            expect = np.concatenate(srcs)
+            for r in range(n):
+                np.testing.assert_array_equal(dsts[r], expect)
+
+        run_with_tune("allgather:@neighbor:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLGATHER,
+            src=BufferInfo(srcs[r], per, DataType.INT32),
+            dst=BufferInfo(dsts[r], per * n, DataType.INT32)),
+            check, monkeypatch)
+
+
+class TestBcastAlgs:
+    @pytest.mark.parametrize("alg", ["knomial", "sag_knomial", "dbt"])
+    @pytest.mark.parametrize("n", [2, 3, 5, 8])
+    @pytest.mark.parametrize("root", [0, 1])
+    def test_bcast(self, alg, n, root, monkeypatch):
+        if root >= n:
+            pytest.skip("root out of range")
+        count = 64
+        bufs = [(np.arange(count, dtype=np.float32) * 3 if r == root else
+                 np.zeros(count, np.float32)) for r in range(n)]
+        expect = np.arange(count, dtype=np.float32) * 3
+
+        def check():
+            for r in range(n):
+                np.testing.assert_array_equal(bufs[r], expect)
+
+        run_with_tune(f"bcast:@{alg}:inf", n, lambda r: CollArgs(
+            coll_type=CollType.BCAST, root=root,
+            src=BufferInfo(bufs[r], count, DataType.FLOAT32)),
+            check, monkeypatch)
+
+    def test_sag_small_count_falls_back(self, monkeypatch):
+        # count < team size: sag raises NOT_SUPPORTED; knomial serves
+        n = 4
+        bufs = [(np.ones(2, np.int32) * 9 if r == 0 else
+                 np.zeros(2, np.int32)) for r in range(n)]
+
+        def check():
+            for r in range(n):
+                np.testing.assert_array_equal(bufs[r], 9)
+
+        run_with_tune("bcast:@sag_knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.BCAST, root=0,
+            src=BufferInfo(bufs[r], 2, DataType.INT32)),
+            check, monkeypatch)
+
+
+class TestReduceAlgs:
+    @pytest.mark.parametrize("alg", ["knomial", "dbt"])
+    @pytest.mark.parametrize("n", [2, 3, 5, 8])
+    def test_reduce(self, alg, n, monkeypatch):
+        count = 50
+        root = n - 1
+        srcs = [np.full(count, r + 1.0, np.float64) for r in range(n)]
+        dst = np.zeros(count, np.float64)
+
+        def check():
+            np.testing.assert_allclose(dst, n * (n + 1) / 2)
+
+        run_with_tune(f"reduce:@{alg}:inf", n, lambda r: CollArgs(
+            coll_type=CollType.REDUCE, root=root,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+            dst=BufferInfo(dst, count, DataType.FLOAT64) if r == root
+            else None, op=ReductionOp.SUM), check, monkeypatch)
+
+    def test_reduce_dbt_avg(self, monkeypatch):
+        n, count = 4, 33
+        srcs = [np.full(count, float(r), np.float64) for r in range(n)]
+        dst = np.zeros(count, np.float64)
+
+        def check():
+            np.testing.assert_allclose(dst, 1.5)
+
+        run_with_tune("reduce:@dbt:inf", n, lambda r: CollArgs(
+            coll_type=CollType.REDUCE, root=0,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+            dst=BufferInfo(dst, count, DataType.FLOAT64) if r == 0
+            else None, op=ReductionOp.AVG), check, monkeypatch)
+
+
+class TestGatherScatterKnomial:
+    @pytest.mark.parametrize("coll,alg", [(CollType.GATHER, "knomial"),
+                                          (CollType.SCATTER, "knomial")])
+    @pytest.mark.parametrize("n", [2, 3, 5, 8])
+    @pytest.mark.parametrize("root", [0, 2])
+    def test_tree(self, coll, alg, n, root, monkeypatch):
+        if root >= n:
+            pytest.skip("root out of range")
+        per = 6
+        name = "gather" if coll == CollType.GATHER else "scatter"
+        if coll == CollType.GATHER:
+            srcs = [np.arange(per, dtype=np.int32) + 10 * r
+                    for r in range(n)]
+            dst = np.zeros(per * n, np.int32)
+
+            def make(r):
+                return CollArgs(coll_type=coll, root=root,
+                                src=BufferInfo(srcs[r], per, DataType.INT32),
+                                dst=BufferInfo(dst, per * n, DataType.INT32)
+                                if r == root else None)
+
+            def check():
+                np.testing.assert_array_equal(dst, np.concatenate(srcs))
+        else:
+            src = np.arange(per * n, dtype=np.int32)
+            dsts = [np.zeros(per, np.int32) for _ in range(n)]
+
+            def make(r):
+                return CollArgs(coll_type=coll, root=root,
+                                src=BufferInfo(src, per * n, DataType.INT32)
+                                if r == root else None,
+                                dst=BufferInfo(dsts[r], per, DataType.INT32))
+
+            def check():
+                for r in range(n):
+                    np.testing.assert_array_equal(
+                        dsts[r], src[r * per:(r + 1) * per])
+
+        run_with_tune(f"{name}:@{alg}:inf", n, make, check, monkeypatch)
+
+
+class TestReduceScatterKnomial:
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_pow2(self, n, monkeypatch):
+        per = 8
+        total = per * n
+        srcs = [np.arange(total, dtype=np.float32) * (r + 1)
+                for r in range(n)]
+        dsts = [np.zeros(per, np.float32) for _ in range(n)]
+        expect = np.sum(srcs, axis=0)
+
+        def check():
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r],
+                                           expect[r * per:(r + 1) * per])
+
+        run_with_tune("reduce_scatter:@knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.REDUCE_SCATTER,
+            src=BufferInfo(srcs[r], total, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], per, DataType.FLOAT32),
+            op=ReductionOp.SUM), check, monkeypatch)
+
+    def test_non_pow2_falls_back(self, monkeypatch):
+        n, per = 3, 5
+        total = per * n
+        srcs = [np.ones(total, np.float32) * (r + 1) for r in range(n)]
+        dsts = [np.zeros(per, np.float32) for _ in range(n)]
+
+        def check():
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r], 6.0)
+
+        run_with_tune("reduce_scatter:@knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.REDUCE_SCATTER,
+            src=BufferInfo(srcs[r], total, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], per, DataType.FLOAT32),
+            op=ReductionOp.SUM), check, monkeypatch)

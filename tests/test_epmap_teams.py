@@ -1,0 +1,101 @@
+"""Teams created from ep_maps alone (no per-team OOB) — the reference's
+ep_map FULL/STRIDED/ARRAY team creation (ucc.h:1337-1357) riding internal
+service collectives instead of a user OOB round."""
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType, ReductionOp,
+                     Status, TeamParams)
+from ucc_tpu.utils.ep_map import EpMap
+
+from harness import UccJob
+
+
+@pytest.fixture(scope="module")
+def job():
+    j = UccJob(6)
+    yield j
+    j.cleanup()
+
+
+def create_epmap_teams(job, ranks):
+    emap = EpMap.from_array(ranks)
+    teams = [job.contexts[r].create_team_post(TeamParams(ep_map=emap))
+             for r in ranks]
+    job.progress_until(lambda: all(
+        [t.create_test() != Status.IN_PROGRESS for t in teams]))
+    for t in teams:
+        assert t.create_test() == Status.OK
+    return teams
+
+
+class TestEpMapTeams:
+    def test_full_world(self, job):
+        teams = create_epmap_teams(job, list(range(6)))
+        count = 10
+        dsts = [np.zeros(count, np.float32) for _ in range(6)]
+        reqs = [teams[i].collective_init(CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(np.full(count, i + 1.0, np.float32), count,
+                           DataType.FLOAT32),
+            dst=BufferInfo(dsts[i], count, DataType.FLOAT32),
+            op=ReductionOp.SUM)) for i in range(6)]
+        for rq in reqs:
+            rq.post()
+        job.progress_until(lambda: all(
+            rq.test() != Status.IN_PROGRESS for rq in reqs))
+        for i in range(6):
+            np.testing.assert_allclose(dsts[i], 21.0)
+
+    def test_strided_subset(self, job):
+        ranks = [1, 3, 5]
+        teams = create_epmap_teams(job, ranks)
+        assert [t.rank for t in teams] == [0, 1, 2]
+        assert len({t.id for t in teams}) == 1
+        count = 4
+        dsts = [np.zeros(count, np.int32) for _ in range(3)]
+        reqs = [teams[i].collective_init(CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(np.full(count, 10 * (i + 1), np.int32), count,
+                           DataType.INT32),
+            dst=BufferInfo(dsts[i], count, DataType.INT32),
+            op=ReductionOp.SUM)) for i in range(3)]
+        for rq in reqs:
+            rq.post()
+        job.progress_until(lambda: all(
+            rq.test() != Status.IN_PROGRESS for rq in reqs))
+        for i in range(3):
+            np.testing.assert_array_equal(dsts[i], 60)
+
+    def test_two_identical_membership_teams_isolated(self, job):
+        """The per-membership counter must keep two same-member teams'
+        traffic separate."""
+        ranks = [0, 2]
+        t_a = create_epmap_teams(job, ranks)
+        t_b = create_epmap_teams(job, ranks)
+        assert t_a[0].team_key != t_b[0].team_key
+        count = 4
+        a_dst = [np.zeros(count, np.int32) for _ in range(2)]
+        b_dst = [np.zeros(count, np.int32) for _ in range(2)]
+        reqs = []
+        for i in range(2):
+            reqs.append(t_a[i].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(np.full(count, 1, np.int32), count,
+                               DataType.INT32),
+                dst=BufferInfo(a_dst[i], count, DataType.INT32),
+                op=ReductionOp.SUM)))
+            reqs.append(t_b[i].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(np.full(count, 100, np.int32), count,
+                               DataType.INT32),
+                dst=BufferInfo(b_dst[i], count, DataType.INT32),
+                op=ReductionOp.SUM)))
+        for rq in reqs:
+            rq.post()
+        job.progress_until(lambda: all(
+            rq.test() != Status.IN_PROGRESS for rq in reqs))
+        for i in range(2):
+            np.testing.assert_array_equal(a_dst[i], 2)
+            np.testing.assert_array_equal(b_dst[i], 200)

@@ -1,0 +1,70 @@
+"""Thread modes (ucc.h:493-497): MULTIPLE-mode world where every rank is
+driven concurrently from its own OS thread (the deployment shape of a
+one-process-per-host pod runner) over the MT progress queue."""
+import threading
+
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, CollArgs, CollType, Context, ContextParams,
+                     DataType, LibParams, ReductionOp, Status, TeamParams,
+                     ThreadMode, ThreadOobWorld)
+from ucc_tpu.schedule.progress import ProgressQueueMT
+
+
+class TestThreadModeMultiple:
+    def test_concurrent_rank_threads(self):
+        n = 4
+        iters = 5
+        world = ThreadOobWorld(n)
+        libs = [ucc_tpu.init(LibParams(thread_mode=ThreadMode.MULTIPLE))
+                for _ in range(n)]
+        ctxs = [None] * n
+
+        def mk(r):
+            ctxs[r] = Context(libs[r], ContextParams(oob=world.endpoint(r)))
+
+        ths = [threading.Thread(target=mk, args=(r,)) for r in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert all(isinstance(c.progress_queue, ProgressQueueMT)
+                   for c in ctxs)
+
+        tw = ThreadOobWorld(n)
+        teams = [None] * n
+        errors = []
+        results = [[None] * iters for _ in range(n)]
+
+        def rank_main(r):
+            try:
+                team = ctxs[r].create_team(TeamParams(oob=tw.endpoint(r)))
+                teams[r] = team
+                count = 256
+                for it in range(iters):
+                    src = np.full(count, (r + 1) * (it + 1), np.float64)
+                    dst = np.zeros(count, np.float64)
+                    req = team.collective_init(CollArgs(
+                        coll_type=CollType.ALLREDUCE,
+                        src=BufferInfo(src, count, DataType.FLOAT64),
+                        dst=BufferInfo(dst, count, DataType.FLOAT64),
+                        op=ReductionOp.SUM))
+                    req.post()
+                    req.wait(timeout=60)
+                    results[r][it] = float(dst[0])
+            except Exception as e:  # noqa: BLE001
+                errors.append((r, e))
+
+        ths = [threading.Thread(target=rank_main, args=(r,))
+               for r in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+        assert not errors, errors
+        for it in range(iters):
+            expect = (it + 1) * n * (n + 1) / 2
+            for r in range(n):
+                assert results[r][it] == expect, (r, it)

@@ -61,7 +61,17 @@ class Team:
             self.size = self.oob.n_oob_eps
         elif p.ep_map is not None:
             self.ep_map = p.ep_map
-            self.rank = p.ep if p.ep is not None else 0
+            # my team rank: explicit ep, else position of my ctx rank in
+            # the map (ucc team ep resolution)
+            if p.ep is not None:
+                self.rank = p.ep
+            else:
+                try:
+                    self.rank = p.ep_map.local_rank(context.rank)
+                except KeyError:
+                    raise UccError(Status.ERR_INVALID_PARAM,
+                                   f"context rank {context.rank} is not in "
+                                   "the team ep_map") from None
             self.size = p.ep_map.ep_num
         else:
             self.rank = 0
@@ -94,11 +104,22 @@ class Team:
                                     self.context.proc_info.pid))
             self._pending_req = self.oob.allgather(payload)
         else:
-            # no per-team OOB: ctx_map from params or trivial
+            # no per-team OOB: the ep_map alone defines membership
+            # (UCC_INTERNAL_OOB-style creation, ucc_team.c ep_map path +
+            # internal OOB over service colls, ucc_service_coll.c:160-210).
+            # The team key must be identical on every member WITHOUT
+            # communication: derive it from the membership tuple plus a
+            # per-membership creation counter — consistent because UCC
+            # requires ordered team creation across ranks.
             self.ctx_map = getattr(self, "ep_map", None) or EpMap.full(self.size)
-            self.team_key = ("local", id(self.context),
-                             self.context._team_id_counter)
-            self.context._team_id_counter += 1
+            members = tuple(int(self.ctx_map.eval(i))
+                            for i in range(self.size))
+            counters = getattr(self.context, "_epmap_team_counters", None)
+            if counters is None:
+                counters = self.context._epmap_team_counters = {}
+            seq = counters.get(members, 0)
+            counters[members] = seq + 1
+            self.team_key = ("epmap", members, seq)
             self.state = TeamState.SERVICE_TEAM
 
     def create_test(self) -> Status:

@@ -1,0 +1,162 @@
+"""Additional allgather algorithms.
+
+Ports the semantics of /root/reference/src/components/tl/ucp/allgather/
+(alg list tl_ucp_coll.c:207-233): Bruck (log-round, latency-optimal for
+small messages), neighbor-exchange (even team sizes; halves the rounds of
+ring for medium messages), and linear (everyone-to-everyone, tiny teams).
+Ring lives in ring.py.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import numpy as np
+
+from ...constants import dt_numpy
+from ...status import Status, UccError
+from ..base import binfo_typed
+from .task import HostCollTask
+
+
+def _require_divisible(init_args, gsize: int) -> None:
+    """These algorithms address equal blocks; near-equal splits are the
+    ring's job — reject at INIT so the fallback chain reaches it."""
+    if gsize > 0 and int(init_args.args.dst.count) % gsize != 0:
+        raise UccError(Status.ERR_NOT_SUPPORTED,
+                       "needs dst.count divisible by team size")
+
+
+class AllgatherBruck(HostCollTask):
+    """Bruck allgather: work starts with my block at slot 0; round k ships
+    the first min(k, n-k) accumulated blocks to (me-k); final rotation
+    unspins the slots (allgather_bruck.c)."""
+
+    def __init__(self, init_args, team, subset=None):
+        super().__init__(init_args, team, subset)
+        _require_divisible(init_args, self.gsize)
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        total = int(args.dst.count)
+        blk = total // size
+        nd = dt_numpy(args.dst.datatype)
+        dst = binfo_typed(args.dst, total)
+        work = np.empty(total, dtype=nd)
+        if args.is_inplace:
+            work[0:blk] = dst[me * blk:(me + 1) * blk]
+        else:
+            work[0:blk] = binfo_typed(args.src, blk)
+        if size == 1:
+            dst[:blk] = work[:blk]
+            return
+        k = 1
+        rnd = 0
+        while k < size:
+            nblocks = min(k, size - k)
+            to = (me - k) % size
+            frm = (me + k) % size
+            yield from self.sendrecv(
+                to, work[:nblocks * blk],
+                frm, work[k * blk:(k + nblocks) * blk], slot=110 + rnd)
+            k *= 2
+            rnd += 1
+        # unrotate: work[i] holds block of rank (me + i) % n
+        for i in range(size):
+            p = (me + i) % size
+            dst[p * blk:(p + 1) * blk] = work[i * blk:(i + 1) * blk]
+
+
+class AllgatherNeighbor(HostCollTask):
+    """Neighbor-exchange allgather (allgather_neighbor.c): even team sizes
+    only — odd sizes return NOT_SUPPORTED and the score-map fallback picks
+    the next algorithm (ucc_coll_score_map.c:136 behavior)."""
+
+    def __init__(self, init_args, team, subset=None):
+        super().__init__(init_args, team, subset)
+        if self.gsize % 2 != 0 and self.gsize > 1:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "neighbor-exchange needs an even team size")
+        _require_divisible(init_args, self.gsize)
+
+    @staticmethod
+    @functools.lru_cache(maxsize=64)
+    def _schedule(size: int):
+        """Deterministic per-rank (partner, blocks_sent) schedule. Both ends
+        of every exchange derive the block ids by running this same
+        simulation, so no metadata travels with the payloads. Pure function
+        of team size -> cached (O(size^2) to build)."""
+        def neighbor(rank, i):
+            first = rank + 1 if rank % 2 == 0 else rank - 1
+            second = rank - 1 if rank % 2 == 0 else rank + 1
+            if i == 0:
+                return first % size
+            return (second if i % 2 == 1 else first) % size
+
+        n_rounds = size // 2
+        sent = [[None] * n_rounds for _ in range(size)]
+        recv = [[None] * n_rounds for _ in range(size)]
+        for r in range(size):
+            sent[r][0] = [r]
+        for r in range(size):
+            recv[r][0] = sent[neighbor(r, 0)][0]
+        for i in range(1, n_rounds):
+            for r in range(size):
+                sent[r][i] = ([r] + recv[r][0]) if i == 1 else recv[r][i - 1]
+            for r in range(size):
+                recv[r][i] = sent[neighbor(r, i)][i]
+        return neighbor, sent, recv
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        total = int(args.dst.count)
+        blk = total // size
+        dst = binfo_typed(args.dst, total)
+
+        def bview(b):
+            return dst[(b % size) * blk:((b % size) + 1) * blk]
+
+        if not args.is_inplace:
+            bview(me)[:] = binfo_typed(args.src, blk)
+        if size == 1:
+            return
+        neighbor, sent, recv = self._schedule(size)
+        for i in range(size // 2):
+            peer = neighbor(me, i)
+            sblocks = sent[me][i]
+            rblocks = recv[me][i]
+            sbuf = np.concatenate([bview(b) for b in sblocks]) \
+                if len(sblocks) > 1 else bview(sblocks[0])
+            rbuf = np.empty(len(rblocks) * blk, dtype=dst.dtype)
+            yield from self.sendrecv(peer, sbuf, peer, rbuf, slot=120 + i)
+            for n_, b in enumerate(rblocks):
+                bview(b)[:] = rbuf[n_ * blk:(n_ + 1) * blk]
+
+
+class AllgatherLinear(HostCollTask):
+    """Everyone sends to everyone (allgather_linear.c) — lowest latency for
+    very small teams/messages at O(n^2) messages."""
+
+    def __init__(self, init_args, team, subset=None):
+        super().__init__(init_args, team, subset)
+        _require_divisible(init_args, self.gsize)
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        total = int(args.dst.count)
+        blk = total // size
+        dst = binfo_typed(args.dst, total)
+        own = dst[me * blk:(me + 1) * blk]
+        if not args.is_inplace:
+            own[:] = binfo_typed(args.src, blk)
+        reqs: List = []
+        for p in range(size):
+            if p == me:
+                continue
+            reqs.append(self.send_nb(p, own, slot=130))
+            reqs.append(self.recv_nb(p, dst[p * blk:(p + 1) * blk],
+                                     slot=130))
+        yield from self.wait(*reqs)

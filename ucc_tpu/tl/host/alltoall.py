@@ -28,6 +28,13 @@ from .task import HostCollTask
 class AlltoallPairwise(HostCollTask):
     WINDOW = 4   # in-flight exchanges (pairwise num_posts flavor)
 
+    def __init__(self, init_args, team, subset=None):
+        super().__init__(init_args, team, subset)
+        if self.gsize and int(init_args.args.dst.count) % self.gsize != 0:
+            from ...status import Status, UccError
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           "alltoall needs count divisible by team size")
+
     def run(self):
         args = self.args
         size, me = self.gsize, self.grank

@@ -1,0 +1,203 @@
+"""Double binary tree (DBT) algorithms.
+
+Ports the semantics of /root/reference/src/components/tl/ucp/
+coll_patterns/double_binary_tree.h:15-25 and its users
+(bcast/bcast_dbt.c, reduce/reduce_dbt.c, allreduce via DBT): the message
+splits in half and the halves flow through two complementary binary trees
+built over the non-root ranks — tree2 is the mirror of tree1, so a rank
+that is interior in one tree tends to be a leaf in the other, roughly
+doubling usable bandwidth vs a single tree while keeping O(log N) depth.
+
+Tree 1 is the in-order binary search tree over virtual ranks; tree 2 is
+its mirror. Both trees run concurrently inside one generator (recvs posted
+up front, forwarding as halves arrive).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...constants import ReductionOp, dt_numpy
+from ...ec.cpu import reduce_arrays
+from ..base import binfo_typed
+from .task import HostCollTask
+
+
+def inorder_tree(m: int) -> Tuple[Optional[int], Dict[int, Optional[int]],
+                                  Dict[int, List[int]]]:
+    """In-order BST over [0, m): (root, parent map, children map)."""
+    parent: Dict[int, Optional[int]] = {}
+    children: Dict[int, List[int]] = {i: [] for i in range(m)}
+    if m == 0:
+        return None, parent, children
+
+    def build(lo: int, hi: int, par: Optional[int]) -> None:
+        if lo >= hi:
+            return
+        mid = (lo + hi) // 2
+        parent[mid] = par
+        if par is not None:
+            children[par].append(mid)
+        build(lo, mid, mid)
+        build(mid + 1, hi, mid)
+
+    build(0, m, None)
+    root = (0 + m) // 2
+    return root, parent, children
+
+
+class _DbtBase(HostCollTask):
+    def _setup(self):
+        args = self.args
+        self.root = int(args.root)
+        self.count = int((args.src or args.dst).count)
+        self.dt = (args.src or args.dst).datatype
+        p = self.gsize
+        m = p - 1
+        t1_root, t1_parent, t1_children = inorder_tree(m)
+        self.trees = []
+        for t in range(2):
+            if t == 0:
+                rootv, par, ch = t1_root, t1_parent, t1_children
+            else:
+                # mirror: node i of tree2 == tree1 node (m-1-i)
+                rootv = m - 1 - t1_root if t1_root is not None else None
+                par = {m - 1 - k: (m - 1 - v if v is not None else None)
+                       for k, v in t1_parent.items()}
+                ch = {m - 1 - k: [m - 1 - c for c in v]
+                      for k, v in t1_children.items()}
+            self.trees.append((rootv, par, ch))
+        half = self.count // 2
+        self.halves = [(0, half), (half, self.count)]
+
+    def v_of(self, rank: int) -> int:
+        return (rank - self.root - 1) % self.gsize
+
+    def rank_of(self, v: int) -> int:
+        return (v + self.root + 1) % self.gsize
+
+
+class BcastDbt(_DbtBase):
+    def run(self):
+        self._setup()
+        args = self.args
+        buf = binfo_typed(args.src, self.count)
+        if self.gsize == 1:
+            return
+        me = self.grank
+        if me == self.root:
+            reqs = []
+            for t, (rootv, _, _) in enumerate(self.trees):
+                lo, hi = self.halves[t]
+                if hi > lo and rootv is not None:
+                    reqs.append(self.send_nb(self.rank_of(rootv),
+                                             buf[lo:hi], slot=140 + t))
+            yield from self.wait(*reqs)
+            return
+        v = self.v_of(me)
+        recvs = {}
+        for t, (rootv, parent, _) in enumerate(self.trees):
+            lo, hi = self.halves[t]
+            if hi <= lo:
+                continue
+            src_rank = self.root if v == rootv else \
+                self.rank_of(parent[v]) if parent.get(v) is not None else \
+                self.root
+            recvs[t] = self.recv_nb(src_rank, buf[self.halves[t][0]:
+                                                  self.halves[t][1]],
+                                    slot=140 + t)
+        forwarded = set()
+        while len(forwarded) < len(recvs):
+            progressed = False
+            for t, rreq in recvs.items():
+                if t in forwarded or not rreq.test():
+                    continue
+                lo, hi = self.halves[t]
+                sends = [self.send_nb(self.rank_of(c), buf[lo:hi],
+                                      slot=140 + t)
+                         for c in self.trees[t][2].get(v, [])]
+                yield from self.wait(*sends)
+                forwarded.add(t)
+                progressed = True
+            if len(forwarded) < len(recvs) and not progressed:
+                yield
+
+
+class ReduceDbt(_DbtBase):
+    """Reverse flow: leaves up to each tree root, tree roots to coll root.
+    Non-root ranks contribute src; root lands the halves in dst."""
+
+    def run(self):
+        self._setup()
+        args = self.args
+        op = args.op if args.op is not None else ReductionOp.SUM
+        red_op = ReductionOp.SUM if op == ReductionOp.AVG else op
+        nd = dt_numpy(self.dt)
+        me = self.grank
+        p = self.gsize
+        if p == 1:
+            dst = binfo_typed(args.dst, self.count)
+            if not args.is_inplace:
+                dst[:] = binfo_typed(args.src, self.count)
+            if op == ReductionOp.AVG:
+                dst[:] = reduce_arrays([dst], ReductionOp.SUM, self.dt,
+                                       alpha=1.0)
+            return
+        if me == self.root:
+            dst = binfo_typed(args.dst, self.count)
+            if not args.is_inplace:
+                dst[:] = binfo_typed(args.src, self.count)
+            recvs = []
+            scratch = np.empty(self.count, dtype=nd)
+            for t, (rootv, _, _) in enumerate(self.trees):
+                lo, hi = self.halves[t]
+                if hi > lo and rootv is not None:
+                    recvs.append((t, self.recv_nb(self.rank_of(rootv),
+                                                  scratch[lo:hi],
+                                                  slot=150 + t)))
+            yield from self.wait(*[r for _, r in recvs])
+            for t, _ in recvs:
+                lo, hi = self.halves[t]
+                dst[lo:hi] = reduce_arrays([dst[lo:hi], scratch[lo:hi]],
+                                           red_op, self.dt)
+            if op == ReductionOp.AVG:
+                dst[:] = reduce_arrays([dst], ReductionOp.SUM, self.dt,
+                                       alpha=1.0 / p)
+            return
+        v = self.v_of(me)
+        src = binfo_typed(args.src, self.count)
+        acc = src.copy()
+        # post BOTH trees' child receives up front so the two half-message
+        # pipelines overlap (the point of DBT), then drain each as it lands
+        pending = {}
+        for t, (rootv, parent, children) in enumerate(self.trees):
+            lo, hi = self.halves[t]
+            if hi <= lo:
+                continue
+            kids = children.get(v, [])
+            kid_buf = np.empty((len(kids), hi - lo), dtype=nd) if kids \
+                else None
+            reqs = [self.recv_nb(self.rank_of(c), kid_buf[i], slot=150 + t)
+                    for i, c in enumerate(kids)]
+            pending[t] = (reqs, kid_buf, kids)
+        done = set()
+        while len(done) < len(pending):
+            progressed = False
+            for t, (reqs, kid_buf, kids) in pending.items():
+                if t in done or not all(r.test() for r in reqs):
+                    continue
+                rootv, parent, _ = self.trees[t]
+                lo, hi = self.halves[t]
+                if kids:
+                    acc[lo:hi] = reduce_arrays(
+                        [acc[lo:hi]] + [kid_buf[i]
+                                        for i in range(len(kids))],
+                        red_op, self.dt)
+                up = self.root if v == rootv else self.rank_of(parent[v])
+                yield from self.wait(self.send_nb(up, acc[lo:hi],
+                                                  slot=150 + t))
+                done.add(t)
+                progressed = True
+            if len(done) < len(pending) and not progressed:
+                yield

@@ -19,11 +19,15 @@ from ...score.score import CollScore
 from ...status import Status, UccError
 from ...utils.ep_map import EpMap, Subset
 from ..base import AlgSpec, TlTeamBase, build_scores
+from .allgather import (AllgatherBruck, AllgatherLinear, AllgatherNeighbor)
 from .alltoall import (AlltoallBruck, AlltoallLinear, AlltoallPairwise,
                        AlltoallvPairwise)
+from .dbt import BcastDbt, ReduceDbt
 from .knomial import (AllreduceKnomial, BarrierKnomial, BcastKnomial,
                       FaninKnomial, FanoutKnomial, GatherLinear,
                       ReduceKnomial, ScatterLinear)
+from .knomial2 import (BcastSagKnomial, GatherKnomial, ReduceScatterKnomial,
+                       ScatterKnomial)
 from .ring import (AllgatherRing, AllgathervRing, AllreduceRing,
                    ReduceScatterRing, ReduceScattervRing)
 from .sra import AllreduceSraKnomial
@@ -111,7 +115,15 @@ class HostTlTeam(TlTeamBase):
                      sel=f"0-4k:{S - 6},4k-inf:{S + 4}"),
             ],
             CollType.ALLGATHER: [
-                spec(0, "ring", AllgatherRing),
+                # bruck for small msgs, neighbor for medium even teams,
+                # ring for large (tl_ucp_coll.c:207-233 alg list)
+                spec(0, "ring", AllgatherRing,
+                     sel=f"0-8k:{S - 2},8k-inf:{S + 5}"),
+                spec(1, "bruck", AllgatherBruck,
+                     sel=f"0-8k:{S + 5},8k-inf:{S - 2}"),
+                spec(2, "neighbor", AllgatherNeighbor,
+                     sel=f"0-8k:{S - 4},8k-inf:{S + 3}"),
+                spec(3, "linear", AllgatherLinear),
             ],
             CollType.ALLGATHERV: [
                 spec(0, "ring", AllgathervRing),
@@ -130,7 +142,12 @@ class HostTlTeam(TlTeamBase):
                 spec(0, "knomial", BarrierKnomial),
             ],
             CollType.BCAST: [
-                spec(0, "knomial", BcastKnomial),
+                spec(0, "knomial", BcastKnomial,
+                     sel=f"0-8k:{S + 5},8k-inf:{S - 3}"),
+                spec(1, "sag_knomial", BcastSagKnomial,
+                     sel=f"0-8k:{S - 3},8k-inf:{S + 5}"),
+                spec(2, "dbt", BcastDbt,
+                     sel=f"0-8k:{S - 4},8k-inf:{S + 3}"),
             ],
             CollType.FANIN: [
                 spec(0, "knomial", FaninKnomial),
@@ -139,22 +156,31 @@ class HostTlTeam(TlTeamBase):
                 spec(0, "knomial", FanoutKnomial),
             ],
             CollType.GATHER: [
-                spec(0, "linear", GatherLinear),
+                spec(0, "knomial", GatherKnomial,
+                     sel=f"0-inf:{S + 2}"),
+                spec(1, "linear", GatherLinear),
             ],
             CollType.GATHERV: [
                 spec(0, "linear", GatherLinear),
             ],
             CollType.REDUCE: [
-                spec(0, "knomial", ReduceKnomial),
+                spec(0, "knomial", ReduceKnomial,
+                     sel=f"0-8k:{S + 5},8k-inf:{S - 3}"),
+                spec(1, "dbt", ReduceDbt,
+                     sel=f"0-8k:{S - 3},8k-inf:{S + 5}"),
             ],
             CollType.REDUCE_SCATTER: [
                 spec(0, "ring", ReduceScatterRing),
+                spec(1, "knomial", ReduceScatterKnomial,
+                     sel=f"0-8k:{S + 3},8k-inf:{S - 2}"),
             ],
             CollType.REDUCE_SCATTERV: [
                 spec(0, "ring", ReduceScattervRing),
             ],
             CollType.SCATTER: [
-                spec(0, "linear", ScatterLinear),
+                spec(0, "knomial", ScatterKnomial,
+                     sel=f"0-inf:{S + 2}"),
+                spec(1, "linear", ScatterLinear),
             ],
             CollType.SCATTERV: [
                 spec(0, "linear", ScatterLinear),
