@@ -23,6 +23,16 @@ def _busbw(nbytes: int, n: int, seconds: float) -> float:
 
 
 def main() -> None:
+    import os
+    if os.environ.get("UCC_BENCH_CPU"):
+        # force the virtual CPU mesh via runtime config: on this box the
+        # env-var path (JAX_PLATFORMS=cpu) can hang in PJRT plugin
+        # discovery when the accelerator tunnel is wedged, while the
+        # runtime config update is safe (backends init lazily)
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+            " --xla_force_host_platform_device_count=8"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     import jax
     import jax.numpy as jnp
 
@@ -36,6 +46,9 @@ def main() -> None:
     on_accel = devices[0].platform not in ("cpu",)
     count = (16 << 20) if on_accel else (1 << 18)   # 64 MiB / 1 MiB f32
     nbytes = count * 4
+    # modest iteration counts: each dispatch crosses the axon tunnel on
+    # this box and the driver bounds bench wall-time; single-chip latency
+    # numbers carry ~20-30% run-to-run noise at these microsecond scales
     iters = 20 if on_accel else 5
     warmup = 5 if on_accel else 2
 
@@ -166,5 +179,49 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def _run_guarded() -> None:
+    """Driver entry: run the measurement in a child process with a timeout
+    so a hung accelerator (the axon tunnel can wedge) still yields a JSON
+    line — falling back to the virtual 8-device CPU mesh."""
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("UCC_BENCH_CHILD"):
+        main()
+        return
+    env = dict(os.environ, UCC_BENCH_CHILD="1")
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=240)
+        for line in r.stdout.splitlines():
+            if line.startswith("{"):
+                print(line)
+                return
+    except subprocess.TimeoutExpired:
+        pass
+    # accelerator wedged or failed: measure on the virtual CPU mesh
+    import json as _json
+    env["UCC_BENCH_CPU"] = "1"
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=420)
+        for line in r.stdout.splitlines():
+            if line.startswith("{"):
+                rec = _json.loads(line)
+                rec.setdefault("detail", {})["note"] = \
+                    "accelerator unavailable/hung; measured on virtual " \
+                    "CPU mesh"
+                print(_json.dumps(rec))
+                return
+    except subprocess.TimeoutExpired:
+        pass
+    print(_json.dumps({"metric": "allreduce_busbw_GBps", "value": 0.0,
+                       "unit": "GB/s/chip", "vs_baseline": 0.0,
+                       "detail": {"error": "bench failed on all backends"}}))
+
+
 if __name__ == "__main__":
-    main()
+    _run_guarded()

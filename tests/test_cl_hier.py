@@ -177,3 +177,49 @@ class TestHierRootedAndBarrier:
 
     def test_barrier(self, job, teams):
         job.run_coll(teams, lambda r: CollArgs(coll_type=CollType.BARRIER))
+
+
+class TestHierAllgatherv:
+    def test_allgatherv_unpack(self, job, teams):
+        """node gatherv -> leaders allgatherv -> node bcast -> unpack
+        (cl_hier allgatherv w/ unpack step)."""
+        n = 8
+        counts = [2, 5, 1, 3, 4, 2, 6, 1]
+        displs = list(np.cumsum([0] + counts[:-1]))
+        total = sum(counts)
+        srcs = [np.arange(counts[r], dtype=np.float32) + 100 * r
+                for r in range(n)]
+        dsts = [np.zeros(total, np.float32) for _ in range(n)]
+        job.run_coll(teams, lambda r: ucc_tpu.CollArgs(
+            coll_type=CollType.ALLGATHERV,
+            src=BufferInfo(srcs[r], counts[r], DataType.FLOAT32),
+            dst=ucc_tpu.BufferInfoV(dsts[r], counts, displs,
+                                    DataType.FLOAT32)))
+        expect = np.concatenate(srcs)
+        for r in range(n):
+            np.testing.assert_array_equal(dsts[r], expect)
+
+    def test_allgatherv_selected_by_hier(self, teams):
+        cands = teams[0].score_map.lookup(CollType.ALLGATHERV,
+                                          ucc_tpu.MemoryType.HOST, 1 << 16)
+        assert cands[0].alg_name == "unpack"
+
+    def test_allgatherv_gapped_displacements(self, job, teams):
+        """MPI-legal gaps between dst blocks must be preserved."""
+        n = 8
+        counts = [2] * n
+        displs = [3 * r for r in range(n)]       # stride-3 gaps
+        span = displs[-1] + counts[-1]
+        srcs = [np.full(2, r + 1, np.int32) for r in range(n)]
+        dsts = [np.full(span, -1, np.int32) for _ in range(n)]
+        job.run_coll(teams, lambda r: ucc_tpu.CollArgs(
+            coll_type=CollType.ALLGATHERV,
+            src=BufferInfo(srcs[r], 2, DataType.INT32),
+            dst=ucc_tpu.BufferInfoV(dsts[r], counts, displs,
+                                    DataType.INT32)))
+        for r in range(n):
+            for p in range(n):
+                np.testing.assert_array_equal(
+                    dsts[r][displs[p]:displs[p] + 2], p + 1)
+            # gap bytes untouched
+            assert dsts[r][2] == -1

@@ -492,6 +492,114 @@ def barrier_init(init_args, hier_team) -> CollTask:
     return sched
 
 
+class _UnpackTask(CollTask):
+    """Reorder the node-grouped gather result into the user's dst layout
+    (the reference's allgatherv unpack step, cl_hier/allgatherv/unpack.c)."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self.fn = fn
+
+    def post_fn(self) -> Status:
+        self.fn()
+        self.status = Status.OK
+        return Status.OK
+
+
+def allgatherv_hier_init(init_args, hier_team) -> CollTask:
+    """node gatherv -> leaders allgatherv -> node bcast -> unpack."""
+    from ...api.types import BufferInfo, BufferInfoV
+    from ...tl.base import binfo_typed
+
+    args = init_args.args
+    node = hier_team.sbgp(SbgpType.NODE)
+    leaders = hier_team.sbgp(SbgpType.NODE_LEADERS)
+    topo = hier_team.core_team.topo
+    team_size = hier_team.core_team.size
+    dstv = args.dst
+    counts = [int(c) for c in dstv.counts]
+    displs = [int(d) for d in dstv.displacements] \
+        if dstv.displacements is not None else \
+        list(np.cumsum([0] + counts[:-1]))
+    total = sum(counts)
+    # user dst may have GAPS between blocks (MPI-legal displacements):
+    # the view must span the furthest block end, not just sum(counts)
+    dst_span = max((displs[r] + counts[r] for r in range(len(counts))),
+                   default=0)
+    dt = dstv.datatype
+    nd = dt_numpy(dt)
+    msg = total * nd.itemsize
+
+    # grouped order: nodes in NODE_LEADERS order, members in NODE order
+    nl = topo.get_sbgp(SbgpType.NODE_LEADERS)
+    node_leader_ranks = [nl.map.eval(i) for i in range(nl.size)]
+    by_node = []          # list of lists of team ranks
+    for lr in node_leader_ranks:
+        hh = topo._proc(lr).host_hash
+        by_node.append([r for r in range(team_size)
+                        if topo._proc(r).host_hash == hh])
+    grouped_order = [r for grp in by_node for r in grp]
+    g_off = {}
+    off = 0
+    for r in grouped_order:
+        g_off[r] = off
+        off += counts[r]
+
+    scratch = np.zeros(total, dtype=nd)
+    my_node_ranks = [node.sbgp.map.eval(i) for i in range(node.sbgp.size)]
+    node_counts = [counts[r] for r in my_node_ranks]
+    node_total = sum(node_counts)
+    is_leader = node.sbgp.group_rank == 0
+    # my node's region within the grouped layout
+    node_base = g_off[my_node_ranks[0]]
+
+    sched = Schedule(team=hier_team, args=args)
+
+    # stage 1: gatherv within the node into the node's grouped region
+    node_region = scratch[node_base:node_base + node_total]
+    my_rank = hier_team.core_team.rank
+    src_bi = args.src if not args.is_inplace else BufferInfo(
+        binfo_typed(dstv, counts[my_rank], displs[my_rank]),
+        counts[my_rank], dt)
+    g1 = CollArgs(coll_type=CollType.GATHERV, root=0, src=src_bi,
+                  dst=BufferInfoV(node_region, node_counts, None, dt)
+                  if is_leader else None)
+    t1 = node.coll_init(g1, MemoryType.HOST, msg)
+    sched.add_task(t1)
+    sched.add_dep_on_schedule_start(t1)
+    prev = t1
+
+    # stage 2: leaders allgatherv of whole-node regions
+    if leaders is not None and leaders.sbgp.is_member:
+        per_node_counts = [sum(counts[r] for r in grp) for grp in by_node]
+        a2 = CollArgs(
+            coll_type=CollType.ALLGATHERV,
+            src=BufferInfo(node_region, node_total, dt),
+            dst=BufferInfoV(scratch, per_node_counts, None, dt))
+        t2 = leaders.coll_init(a2, MemoryType.HOST, msg)
+        sched.add_task(t2)
+        t2.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+        prev = t2
+
+    # stage 3: node bcast of the full grouped buffer
+    b3 = CollArgs(coll_type=CollType.BCAST, root=0,
+                  src=BufferInfo(scratch, total, dt))
+    t3 = node.coll_init(b3, MemoryType.HOST, msg)
+    sched.add_task(t3)
+    t3.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+
+    # stage 4: unpack grouped order -> user dst layout
+    def unpack():
+        dst_flat = binfo_typed(dstv, dst_span)
+        for r in range(team_size):
+            dst_flat[displs[r]:displs[r] + counts[r]] = \
+                scratch[g_off[r]:g_off[r] + counts[r]]
+    t4 = _UnpackTask(unpack)
+    sched.add_task(t4)
+    t4.subscribe_dep(t3, EventType.EVENT_COMPLETED)
+    return sched
+
+
 # ---------------------------------------------------------------------------
 # scores
 # ---------------------------------------------------------------------------
@@ -511,6 +619,7 @@ def build_hier_scores(hier_team) -> CollScore:
         add(CollType.ALLREDUCE, HIER_SCORE - 1, split_rail_init,
             "split_rail")
     add(CollType.BCAST, HIER_SCORE, bcast_2step_init, "2step")
+    add(CollType.ALLGATHERV, HIER_SCORE, allgatherv_hier_init, "unpack")
     add(CollType.REDUCE, HIER_SCORE, reduce_2step_init, "2step")
     add(CollType.BARRIER, HIER_SCORE, barrier_init, "knomial_hier")
     return s

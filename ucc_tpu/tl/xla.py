@@ -160,9 +160,12 @@ class XlaTeamShared:
             program, count_padded = proto.build_program(self)
             n = len(self.devices)
             nd = proto.np_dtype
-            global_shape = (n, count_padded)
+            # 1-D layout: shards are the ranks' flat arrays AS-IS — no
+            # eager reshape/slice per shard (each would dispatch an XLA
+            # primitive; measured as the dominant dispatch cost)
+            global_shape = (n * count_padded,)
             from jax.sharding import NamedSharding, PartitionSpec as P
-            sharding = NamedSharding(self.mesh, P("r", None))
+            sharding = NamedSharding(self.mesh, P("r"))
             shards = []
             for rank, (buf, task) in sorted(slot.items()):
                 row = task.shard_for_launch(buf, count_padded)
@@ -237,7 +240,7 @@ class XlaCollTask(CollTask):
         if isinstance(buf, np.ndarray):
             flat = buf.reshape(-1)
         else:
-            flat = jnp.ravel(buf)
+            flat = jnp.ravel(buf) if buf.ndim != 1 else buf
         if flat.size > count_padded:
             raise UccError(Status.ERR_INVALID_PARAM,
                            f"rank contribution ({flat.size}) exceeds the "
@@ -246,7 +249,7 @@ class XlaCollTask(CollTask):
         if flat.size < count_padded:
             pad = (np.pad if isinstance(flat, np.ndarray) else jnp.pad)
             flat = pad(flat, (0, count_padded - flat.size))
-        return flat[None, :count_padded]
+        return flat   # 1-D shard, used as-is
 
     def build_program(self, shared: XlaTeamShared):
         """Compiled shard_map program + padded per-rank count (cached)."""
@@ -304,20 +307,20 @@ class XlaCollTask(CollTask):
 
     # -- output landing ----------------------------------------------------
     def _my_out_np(self) -> np.ndarray:
-        """This rank's row of the output global array."""
+        """This rank's shard of the (flat) output global array."""
         dev = self.tl_team.shared.devices[self.tl_team.rank]
         for shard in self._out.addressable_shards:
             if shard.device == dev:
-                return np.asarray(shard.data)[0]
+                return np.asarray(shard.data)
         # replicated output: any shard works
-        return np.asarray(self._out.addressable_shards[0].data)[0]
+        return np.asarray(self._out.addressable_shards[0].data)
 
     def _my_out_jax(self):
         dev = self.tl_team.shared.devices[self.tl_team.rank]
         for shard in self._out.addressable_shards:
             if shard.device == dev:
-                return shard.data[0]
-        return self._out.addressable_shards[0].data[0]
+                return shard.data          # already flat
+        return self._out.addressable_shards[0].data
 
     def _copy_out(self) -> None:
         args = self.args
@@ -398,7 +401,7 @@ def _build_xla_program(mesh, n: int, coll: CollType, args, nd, count: int,
         if isinstance(bi, BufferInfoV) and bi.counts is not None:
             vcounts = [int(c) for c in bi.counts]
 
-    def body(x):          # x: (1, padded) shard-local
+    def body_2d(x):       # x: (1, padded) shard-local
         if coll == CollType.ALLREDUCE:
             if alg == "ring" and op in (ReductionOp.SUM, ReductionOp.AVG):
                 return ops.allreduce_ring(x, op)
@@ -429,15 +432,18 @@ def _build_xla_program(mesh, n: int, coll: CollType, args, nd, count: int,
         raise UccError(Status.ERR_NOT_SUPPORTED,
                        f"tl/xla does not build {coll}")
 
-    in_specs = P("r", None)
+    def body(x):          # x: (padded,) flat shard; 2-D view inside jit
+        return body_2d(x[None, :])[0]
+
+    in_specs = P("r")
     if coll in (CollType.ALLGATHER, CollType.GATHER, CollType.ALLGATHERV,
                 CollType.GATHERV):
-        out_specs = P(None, None)     # replicated full result
+        out_specs = P(None)           # replicated full result
     elif coll in (CollType.REDUCE_SCATTER, CollType.REDUCE_SCATTERV) and \
             vcounts is not None:
-        out_specs = P(None, None)
+        out_specs = P(None)
     else:
-        out_specs = P("r", None)
+        out_specs = P("r")
 
     fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                    check_vma=False) if _accepts_check_vma(shard_map) else \
