@@ -1,0 +1,67 @@
+"""Sequence-parallel attention (ring + Ulysses) — the long-context
+first-class workload, validated exactly against unsharded attention."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ucc_tpu.examples.ring_attention import (  # noqa: E402
+    make_ring_attention, make_ulysses_attention, reference_attention)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return jax.make_mesh((8,), ("sp",))
+
+
+def _inputs(heads, seq, d, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (heads, seq, d), jnp.float32)
+    k = jax.random.normal(ks[1], (heads, seq, d), jnp.float32)
+    v = jax.random.normal(ks[2], (heads, seq, d), jnp.float32)
+    return q, k, v
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("seq", [64, 256])
+    def test_exact_vs_reference(self, mesh, seq):
+        heads, d = 4, 16
+        q, k, v = _inputs(heads, seq, d)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P(None, "sp", None))
+        qs, ks_, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        ring = make_ring_attention(mesh)
+        out = np.asarray(jax.device_get(ring(qs, ks_, vs)))
+        expect = np.asarray(reference_attention(q, k, v))
+        np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-5)
+
+    def test_memory_scaling_shape(self, mesh):
+        # each shard sees only seq/8 of K/V at a time: the jitted program
+        # must accept a sequence too large to attend monolithically if
+        # materialized as (seq, seq) scores on one shard boundary check
+        heads, seq, d = 2, 512, 8
+        q, k, v = _inputs(heads, seq, d, seed=3)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P(None, "sp", None))
+        ring = make_ring_attention(mesh)
+        out = ring(*(jax.device_put(x, sh) for x in (q, k, v)))
+        assert out.shape == (heads, seq, d)
+        expect = np.asarray(reference_attention(q, k, v))
+        np.testing.assert_allclose(np.asarray(jax.device_get(out)), expect,
+                                   rtol=2e-4, atol=2e-5)
+
+
+class TestUlyssesAttention:
+    def test_exact_vs_reference(self, mesh):
+        heads, seq, d = 8, 128, 16   # heads % 8 == 0
+        q, k, v = _inputs(heads, seq, d, seed=1)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P(None, "sp", None))
+        qs, ks_, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        uly = make_ulysses_attention(mesh)
+        out = np.asarray(jax.device_get(uly(qs, ks_, vs)))
+        expect = np.asarray(reference_attention(q, k, v))
+        np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-5)

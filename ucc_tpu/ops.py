@@ -11,9 +11,10 @@ ways:
    serve the eager init/post/test API over a team Mesh.
 
 All functions operate on a named mesh axis (default ``"r"`` = team ranks)
-and take shard-local arrays of shape ``(1, count)`` — one row per rank —
-matching TL/XLA's global layout ``(n_ranks, count)`` with
-``PartitionSpec('r', None)``.
+on shard-local arrays whose LAST axis is the data (``(..., count)``).
+TL/XLA feeds them flat 1-D shards (global layout ``(n_ranks*count,)`` with
+``PartitionSpec('r')`` — used as-is, no per-shard eager ops) through a
+``x[None, :]`` view inside its jitted body.
 
 Op mapping (the TL/NCCL dt/op tables analog, tl_nccl_coll.c:21-75):
 SUM/AVG/MAX/MIN ride the native psum/pmax/pmin collectives (ICI-optimized
@@ -196,3 +197,12 @@ def scatter(x_full, root: int, axis_name: str = "r"):
 
 def barrier(axis_name: str = "r"):
     return lax.psum(jnp.ones((1, 1), jnp.int32), axis_name)
+
+
+def ring_shift(x, axis_name: str = "r", shift: int = 1):
+    """Rotate shards around the ring: rank r's block goes to r+shift.
+    The building block of ring/sequence-parallel pipelines (the ppermute
+    pattern of the pallas guide's ring collectives)."""
+    n = axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)

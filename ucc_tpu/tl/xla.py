@@ -51,7 +51,39 @@ TL_XLA_CONFIG = register_table(ConfigTable(
     prefix="TL_XLA_", name="tl/xla", fields=[
         ConfigField("DEVICE_KIND", "", "restrict to a device platform "
                     "(tpu/cpu); empty = default backend", parse_string),
+        ConfigField("DEVICE_TIMEOUT", "60", "seconds to wait for backend "
+                    "device discovery before disabling tl/xla (a wedged "
+                    "accelerator tunnel must not hang host-side teams)",
+                    parse_string),
     ]))
+
+
+def _discover_devices_guarded(timeout_s: float):
+    """jax.local_devices() in a worker thread with a timeout: cold backend
+    init can block indefinitely when the accelerator tunnel is down, and
+    that must disable TL/XLA (CL fallback covers host colls), not wedge
+    context creation."""
+    import threading
+    result = {}
+
+    def probe():
+        try:
+            import jax
+            result["devices"] = jax.local_devices()
+        except Exception as e:  # noqa: BLE001
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if t.is_alive():
+        raise UccError(Status.ERR_NO_RESOURCE,
+                       f"jax device discovery did not complete in "
+                       f"{timeout_s}s (accelerator tunnel wedged?)")
+    if "error" in result:
+        raise UccError(Status.ERR_NO_RESOURCE,
+                       f"jax device discovery failed: {result['error']}")
+    return result.get("devices", [])
 
 
 # ---------------------------------------------------------------------------
@@ -64,8 +96,10 @@ class TlXlaContext(BaseContext):
         import jax
         self.jax = jax
         kind = config.device_kind if config else ""
-        self.local_devices = jax.local_devices() if not kind else [
-            d for d in jax.local_devices() if d.platform == kind]
+        timeout_s = float(config.device_timeout) if config else 60.0
+        devices = _discover_devices_guarded(timeout_s)
+        self.local_devices = devices if not kind else [
+            d for d in devices if d.platform == kind]
         self.device = None           # claimed after address exchange
         self.peer_devices: Dict[int, int] = {}   # ctx rank -> global dev id
         self._my_pid_ordinal = 0
@@ -380,9 +414,7 @@ def _build_xla_program(mesh, n: int, coll: CollType, args, nd, count: int,
 
     from .. import ops
 
-    shard_map = jax.shard_map if hasattr(jax, "shard_map") else None
-    if shard_map is None:
-        from jax.experimental.shard_map import shard_map  # type: ignore
+    from ..utils.jaxshim import shard_map_compat
 
     op = args.op if args.op is not None else ReductionOp.SUM
     root = int(args.root)
@@ -445,20 +477,8 @@ def _build_xla_program(mesh, n: int, coll: CollType, args, nd, count: int,
     else:
         out_specs = P("r")
 
-    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   check_vma=False) if _accepts_check_vma(shard_map) else \
-        shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=False)
-    program = jax.jit(fn)
+    program = jax.jit(shard_map_compat(body, mesh, in_specs, out_specs))
     return program, padded
-
-
-def _accepts_check_vma(shard_map) -> bool:
-    import inspect
-    try:
-        return "check_vma" in inspect.signature(shard_map).parameters
-    except (TypeError, ValueError):
-        return False
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,23 @@ from ucc_tpu.constants import coll_type_str, dt_numpy, dt_size
 from ucc_tpu.utils.config import memunits_str, parse_memunits
 
 COLLS = {coll_type_str(c): c for c in CollType}
+_TRAFFIC_MATRIX = None
+
+
+def gen_traffic_matrix(kind: str, n: int, count: int, seed: int):
+    """Per-(src,dst) element counts. 'moe' draws a skewed expert-routing
+    style distribution (few hot destinations per source), 'uniform' splits
+    evenly — the reference's matrix generators (ucc_pt_config.h:98-108)."""
+    rng = np.random.default_rng(seed)
+    if kind == "moe":
+        m = np.zeros((n, n), dtype=np.int64)
+        for src in range(n):
+            hot = rng.choice(n, size=max(1, n // 4), replace=False)
+            weights = rng.dirichlet(np.ones(len(hot)) * 0.5)
+            for h, w in zip(hot, weights):
+                m[src][h] = int(round(w * count * n))
+        return m
+    return np.full((n, n), count, dtype=np.int64)
 OPS = {o.name.lower(): o for o in ReductionOp}
 DTS = {d.name.lower(): d for d in DataType}
 
@@ -78,6 +95,24 @@ def make_args(coll: CollType, rank: int, n: int, count: int, dt: DataType,
         return BufferInfo(np.zeros(shape_count, dtype=nd), shape_count, dt,
                           mem_type=MemoryType.HOST)
 
+    if coll == CollType.ALLTOALLV:
+        # per-pair counts from the traffic matrix (row = what I send)
+        from ucc_tpu import BufferInfoV
+        if mem == MemoryType.TPU:
+            raise SystemExit("perftest: alltoallv over tpu memory is not "
+                             "wired (TL/XLA gap; use -m host)")
+        if inplace:
+            raise SystemExit("perftest: -i is not supported for alltoallv")
+        m = _TRAFFIC_MATRIX
+        scounts = [int(c) for c in m[rank]]
+        rcounts = [int(m[p][rank]) for p in range(n)]
+        sdispl = list(np.cumsum([0] + scounts[:-1]))
+        rdispl = list(np.cumsum([0] + rcounts[:-1]))
+        return CollArgs(
+            coll_type=coll, flags=flags,
+            src=BufferInfoV(host(sum(scounts) or 1), scounts, sdispl, dt),
+            dst=BufferInfoV(np.zeros(sum(rcounts) or 1, dtype=nd), rcounts,
+                            rdispl, dt))
     if coll == CollType.BARRIER:
         return CollArgs(coll_type=coll, flags=flags)
     if coll == CollType.ALLREDUCE:
@@ -113,6 +148,16 @@ def make_args(coll: CollType, rank: int, n: int, count: int, dt: DataType,
                         src=buf(count * n) if rank == root else None,
                         dst=out(count), flags=flags)
     raise SystemExit(f"perftest: coll {coll_type_str(coll)} not wired")
+
+
+def _wait_reqs(job, reqs) -> None:
+    from ucc_tpu import Status as _St
+    while any(rq.test() == _St.IN_PROGRESS for rq in reqs):
+        for c in job.contexts:
+            c.progress()
+    for rq in reqs:
+        if rq.test().is_error:
+            raise SystemExit(f"collective failed: {rq.test()}")
 
 
 class InProcJob:
@@ -172,6 +217,7 @@ class StoreJob:
         oob = TcpStoreOob(rank, n, host=host, port=port)
         self.lib = ucc_tpu.init()
         self.ctx = Context(self.lib, ContextParams(oob=oob))
+        self.contexts = [self.ctx]
         team_oob = TcpStoreOob(rank, n, host=host, port=port + 1)
         self.team = self.ctx.create_team(TeamParams(oob=team_oob))
         self.world_n = n
@@ -209,11 +255,20 @@ def main(argv=None) -> int:
                         "mem, else 4)")
     p.add_argument("--persistent", action="store_true",
                    help="persistent collectives (init once, post many)")
+    p.add_argument("-S", "--streaming", action="store_true",
+                   help="streaming mode: post every iteration before "
+                        "waiting (throughput), vs default isolated mode "
+                        "(per-op latency) — ucc_pt_config.h:72-75")
+    p.add_argument("--matrix", default="", choices=["", "uniform", "moe"],
+                   help="alltoallv traffic-matrix generator "
+                        "(ucc_pt_config.h:98-108 MoE-style skew)")
+    p.add_argument("--seed", type=int, default=7)
     p.add_argument("--store", default="", help="host:port for multi-process")
     p.add_argument("--rank", type=int, default=0)
     p.add_argument("--np", type=int, dest="world", default=1)
     args = p.parse_args(argv)
 
+    global _TRAFFIC_MATRIX
     coll = COLLS[args.coll]
     dt = DTS[args.dtype]
     op = OPS[args.op]
@@ -251,6 +306,9 @@ def main(argv=None) -> int:
     size = max(bmin, esz)
     while size <= bmax:
         count = max(1, size // esz)
+        if coll == CollType.ALLTOALLV:
+            _TRAFFIC_MATRIX = gen_traffic_matrix(args.matrix or "uniform",
+                                                 n, count, args.seed)
         lats = []
         rounds = args.warmup + args.iters
         persistent_reqs = None
@@ -261,19 +319,39 @@ def main(argv=None) -> int:
                                 args.inplace, args.root, True, devices)
                       for r in ranks]
             persistent_reqs = job.init_reqs(argses)
-        for it in range(rounds):
+        if args.streaming and persistent_reqs is None:
+            # streaming: init+post everything, single wait at the end;
+            # reported number is per-op amortized time
+            all_argses = [[make_args(coll, r, n, count, dt, op, mem,
+                                     args.inplace, args.root, False,
+                                     devices) for r in ranks]
+                          for _ in range(rounds)]
+            all_reqs = [job.init_reqs(a) for a in all_argses[:args.warmup]]
+            for reqs_ in all_reqs:
+                job.post_and_wait(reqs_)
             t0 = time.perf_counter()
-            if persistent_reqs is not None:
-                job.post_and_wait(persistent_reqs)
-            else:
-                argses = [make_args(coll, r, n, count, dt, op, mem,
-                                    args.inplace, args.root, False,
-                                    devices) for r in ranks]
+            inflight = [job.init_reqs(a) for a in all_argses[args.warmup:]]
+            for reqs_ in inflight:
+                for rq in reqs_:
+                    rq.post()
+            for reqs_ in inflight:
+                _wait_reqs(job, reqs_)
+            total = time.perf_counter() - t0
+            lats = np.array([total / max(1, args.iters)])
+        else:
+            for it in range(rounds):
                 t0 = time.perf_counter()
-                job.run_round(argses)
-            dt_s = time.perf_counter() - t0
-            if it >= args.warmup:
-                lats.append(dt_s)
+                if persistent_reqs is not None:
+                    job.post_and_wait(persistent_reqs)
+                else:
+                    argses = [make_args(coll, r, n, count, dt, op, mem,
+                                        args.inplace, args.root, False,
+                                        devices) for r in ranks]
+                    t0 = time.perf_counter()
+                    job.run_round(argses)
+                dt_s = time.perf_counter() - t0
+                if it >= args.warmup:
+                    lats.append(dt_s)
         lats = np.array(lats)
         if is_lead:
             avg = lats.mean() * 1e6

@@ -1,0 +1,22 @@
+"""JAX API compatibility shims (single home for version probes)."""
+from __future__ import annotations
+
+
+def get_shard_map():
+    import jax
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map with replication checking disabled across jax versions
+    (check_vma in new jax, check_rep in older)."""
+    sm = get_shard_map()
+    try:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except TypeError:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
