@@ -191,7 +191,7 @@ class XlaTeamShared:
             # deterministic proto: the lowest team rank's task (the program
             # must not depend on deposit order)
             proto = slot[min(slot)][1]
-            program, count_padded = proto.build_program(self)
+            program, count_padded = proto.build_program(self, slot)
             n = len(self.devices)
             nd = proto.np_dtype
             # 1-D layout: shards are the ranks' flat arrays AS-IS — no
@@ -233,6 +233,13 @@ class XlaCollTask(CollTask):
         args = init_args.args
         self.np_dtype = dt_numpy((args.src or args.dst).datatype)
         self.coll = args.coll_type
+        if self.coll == CollType.ALLTOALLV and (
+                not isinstance(args.src, BufferInfoV) or
+                args.src.counts is None or
+                not isinstance(args.dst, BufferInfoV) or
+                args.dst.counts is None):
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "tl/xla alltoallv requires src and dst counts")
 
     # -- launch plumbing -------------------------------------------------
     def local_src(self):
@@ -285,10 +292,20 @@ class XlaCollTask(CollTask):
             flat = pad(flat, (0, count_padded - flat.size))
         return flat   # 1-D shard, used as-is
 
-    def build_program(self, shared: XlaTeamShared):
-        """Compiled shard_map program + padded per-rank count (cached)."""
+    def build_program(self, shared: XlaTeamShared, slot=None):
+        """Compiled shard_map program + padded per-rank count (cached).
+
+        For ALLTOALLV the per-pair counts matrix is assembled from the
+        rendezvous slot (every local task's args) — possible because in the
+        rank==context model all team ranks of a process deposit before
+        launch. Teams spanning processes never get an ALLTOALLV entry in
+        alg_table (n_local != size gating), so selection falls through to
+        host TLs for host memory and errors cleanly for device memory.
+        """
         args = self.args
         n = len(shared.devices)
+        if self.coll == CollType.ALLTOALLV:
+            return self._build_a2av_program(shared, slot)
         count = self.src_count()
         key = (self.coll, args.op, self.np_dtype.str, count, self.alg,
                int(args.root) if args.is_rooted else 0, self._vkey())
@@ -305,6 +322,80 @@ class XlaCollTask(CollTask):
             if isinstance(bi, BufferInfoV) and bi.counts is not None:
                 return tuple(int(c) for c in bi.counts)
         return None
+
+    # -- alltoallv ------------------------------------------------------
+    def _build_a2av_program(self, shared: XlaTeamShared, slot):
+        """Pack -> all_to_all -> unpack, ALL inside the jitted body via
+        static per-rank gather-index maps derived from the slot-assembled
+        counts/displacements (no eager per-rank dispatches — the same rule
+        the 1-D shard layout enforces). The input shard is the rank's raw
+        flat src padded to the max send total; the output shard is the
+        rank's dst layout (with displacement gaps) padded to the max span.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..utils.jaxshim import shard_map_compat
+
+        n = len(shared.devices)
+
+        def _vec(bi):
+            counts = [int(c) for c in bi.counts]
+            if bi.displacements is not None:
+                displs = [int(d) for d in bi.displacements]
+            else:
+                displs = list(np.cumsum([0] + counts[:-1]))
+            return counts, displs
+
+        rows = []      # per src rank: (scounts, sdispls)
+        for r in sorted(slot):
+            rows.append(_vec(slot[r][1].args.src))
+        dsts = [_vec(slot[r][1].args.dst) for r in sorted(slot)]
+        key = (self.coll, self.np_dtype.str,
+               tuple((tuple(c), tuple(d)) for c, d in rows),
+               tuple((tuple(c), tuple(d)) for c, d in dsts))
+        cached = shared.programs.get(key)
+        if cached is not None:
+            return cached
+
+        maxblk = max((c for sc, _ in rows for c in sc), default=1) or 1
+        max_src = max((sum(sc) for sc, _ in rows), default=1) or 1
+        max_span = max((max((dd[p] + dc[p] for p in range(n)), default=0)
+                        for dc, dd in dsts), default=1) or 1
+
+        # pack index: PIDX[r][p*maxblk+j] = sdispl[r][p]+j (or -1 pad)
+        pidx = np.full((n, n * maxblk), -1, dtype=np.int32)
+        for r, (sc, sd) in enumerate(rows):
+            for p in range(n):
+                pidx[r, p * maxblk:p * maxblk + sc[p]] = \
+                    np.arange(sd[p], sd[p] + sc[p])
+        # unpack index over exchanged rows (row p = data from rank p):
+        # UIDX[r][ddispl[r][p]+j] = p*maxblk + j
+        uidx = np.full((n, max_span), -1, dtype=np.int32)
+        for r, (dc, dd) in enumerate(dsts):
+            for p in range(n):
+                uidx[r, dd[p]:dd[p] + dc[p]] = \
+                    np.arange(p * maxblk, p * maxblk + dc[p])
+
+        pidx_c = jnp.asarray(pidx)
+        uidx_c = jnp.asarray(uidx)
+
+        def body(x):                 # (max_src,) raw flat send buffer
+            me = jax.lax.axis_index("r")
+            pi = pidx_c[me]
+            packed = jnp.where(pi >= 0, x[jnp.clip(pi, 0, max_src - 1)], 0)
+            y = jax.lax.all_to_all(packed.reshape(n, maxblk), "r",
+                                   split_axis=0, concat_axis=0, tiled=False)
+            flat_rows = y.reshape(n * maxblk)
+            ui = uidx_c[me]
+            return jnp.where(ui >= 0,
+                             flat_rows[jnp.clip(ui, 0, n * maxblk - 1)], 0)
+
+        program = jax.jit(shard_map_compat(body, shared.mesh, P("r"),
+                                           P("r")))
+        shared.programs[key] = (program, max_src)
+        return program, max_src
 
     # -- lifecycle --------------------------------------------------------
     def post_fn(self) -> Status:
@@ -370,6 +461,9 @@ class XlaCollTask(CollTask):
         if dst is None or (dst.buffer is None and
                            dst.mem_type != MemoryType.TPU):
             return
+        if coll == CollType.ALLTOALLV:
+            self._a2av_copy_out()
+            return
         off = 0
         rsv_want = None
         if coll == CollType.REDUCE_SCATTERV and isinstance(dst, BufferInfoV):
@@ -390,6 +484,25 @@ class XlaCollTask(CollTask):
         view = binfo_typed(dst, count=rsv_want) if rsv_want is not None \
             else binfo_typed(dst)
         view[:] = row[off:off + view.size]
+
+    def _a2av_copy_out(self) -> None:
+        n = self.tl_team.size
+        dstv = self.args.dst
+        rcounts = [int(c) for c in dstv.counts]
+        rdispls = [int(d) for d in dstv.displacements] \
+            if dstv.displacements is not None else \
+            list(np.cumsum([0] + rcounts[:-1]))
+        dst_span = max((rdispls[p] + rcounts[p] for p in range(n)),
+                       default=0)
+        if dstv.mem_type == MemoryType.TPU:
+            out = self._my_out_jax()
+            dstv.buffer = out[:dst_span] if out.shape[-1] != dst_span \
+                else out
+            self.result_array = dstv.buffer
+            return
+        row = self._my_out_np()
+        view = binfo_typed(dstv, count=dst_span)
+        view[:] = row[:dst_span]
 
     def _unpad_jax(self, out, dst) -> Any:
         want = int(dst.count) if isinstance(dst, BufferInfo) else \
@@ -544,6 +657,12 @@ class TlXlaTeam(TlTeamBase):
             CollType.GATHERV, CollType.ALLTOALL, CollType.REDUCE_SCATTER,
             CollType.REDUCE_SCATTERV, CollType.SCATTER)}
         table[CollType.ALLREDUCE].append(spec(1, "ring", alg="ring"))
+        shared = getattr(self, "shared", None)
+        if shared is None or shared.n_local == getattr(self, "size", 0):
+            # the a2av counts matrix is assembled from the rendezvous slot,
+            # which only covers the full team when all ranks are local
+            # (shared is None only for the ucc_info -A listing stub)
+            table[CollType.ALLTOALLV] = [spec(0, "xla")]
         return table
 
     def get_scores(self) -> CollScore:
@@ -578,7 +697,8 @@ class TlXla(TransportLayer):
                        | CollType.BARRIER | CollType.FANIN | CollType.FANOUT
                        | CollType.ALLGATHER | CollType.ALLGATHERV
                        | CollType.GATHER | CollType.GATHERV
-                       | CollType.ALLTOALL | CollType.REDUCE_SCATTER
+                       | CollType.ALLTOALL | CollType.ALLTOALLV
+                       | CollType.REDUCE_SCATTER
                        | CollType.REDUCE_SCATTERV | CollType.SCATTER)
     SUPPORTED_MEM_TYPES = (MemoryType.TPU,)
     SERVICE_CAPABLE = False
