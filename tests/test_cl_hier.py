@@ -223,3 +223,36 @@ class TestHierAllgatherv:
                     dsts[r][displs[p]:displs[p] + 2], p + 1)
             # gap bytes untouched
             assert dsts[r][2] == -1
+
+
+class TestTopoOrderedRing:
+    def test_allreduce_ring_reorders_on_multinode(self, job, teams,
+                                                  monkeypatch):
+        """Ring allreduce over FULL_HOST_ORDERED: correctness unchanged,
+        and the subset actually reorders when team ranks interleave
+        hosts."""
+        count = 4096    # large -> ring/sra range
+        monkeypatch.setenv("UCC_TL_SHM_TUNE", "allreduce:@ring:inf")
+        if True:
+            # interleaved membership: team ranks alternate fake nodes
+            sub2 = job.create_team([0, 4, 1, 5])
+            srcs = [np.full(count, i + 1.0, np.float32) for i in range(4)]
+            dsts = [np.zeros(count, np.float32) for _ in range(4)]
+            job.run_coll(sub2, lambda r: CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+                dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+                op=ReductionOp.SUM))
+            for r in range(4):
+                np.testing.assert_allclose(dsts[r], 10.0)
+            # the reorder map is non-identity for this membership
+            shm = None
+            for clt in sub2[0].cl_teams:
+                if clt.name == "basic":
+                    for t in clt.tl_teams:
+                        if t.name == "shm":
+                            shm = t
+            assert shm is not None
+            ss = shm.topo_ordered_subset()
+            assert ss is not None
+            assert ss.map.to_array().tolist() != [0, 1, 2, 3]

@@ -30,6 +30,19 @@ from ..base import binfo_typed, binfo_v_block
 from .task import HostCollTask
 
 
+class _TopoOrderedRingTask(HostCollTask):
+    """Ring base that remaps ranks through FULL_HOST_ORDERED on
+    multi-node teams (block ownership follows GROUP rank, which the
+    buffer conventions of allreduce rings tolerate because every rank
+    ends with the full vector; plain allgather/reduce_scatter keep team
+    ranks since their output placement is rank-addressed)."""
+
+    def __init__(self, init_args, team, subset=None):
+        if subset is None and hasattr(team, "topo_ordered_subset"):
+            subset = team.topo_ordered_subset()
+        super().__init__(init_args, team, subset)
+
+
 class AllgatherRing(HostCollTask):
     def run(self):
         args = self.args
@@ -165,10 +178,10 @@ class ReduceScattervRing(HostCollTask):
         out_block[:] = mine
 
 
-class AllreduceRing(HostCollTask):
+class AllreduceRing(_TopoOrderedRingTask):
     """Bandwidth allreduce: reduce-scatter ring then allgather ring inline
     (the reference builds this as a schedule; one generator is equivalent
-    and cheaper host-side)."""
+    and cheaper host-side). Runs host-ordered on multi-node teams."""
 
     def run(self):
         args = self.args

@@ -17,7 +17,7 @@ from ...constants import CollType, DataType, MemoryType, ReductionOp
 from ...schedule.task import CollTask
 from ...score.score import CollScore
 from ...status import Status, UccError
-from ...utils.ep_map import EpMap, Subset
+from ...utils.ep_map import EpMap, EpMapType, Subset
 from ..base import AlgSpec, TlTeamBase, build_scores
 from .allgather import (AllgatherBruck, AllgatherLinear, AllgatherNeighbor)
 from .alltoall import (AlltoallBruck, AlltoallLinear, AlltoallPairwise,
@@ -52,6 +52,36 @@ class HostTlTeam(TlTeamBase):
     # ------------------------------------------------------------------
     def full_subset(self) -> Subset:
         return Subset(EpMap.full(self.size), self.rank)
+
+    def topo_ordered_subset(self):
+        """FULL_HOST_ORDERED subset when the team spans nodes: ring
+        neighbors become host-local so n-1 of n hops ride the fast
+        intra-node path (the reference's rank reorder,
+        allreduce_knomial.c:239-243 via ucc_sbgp FULL_HOST_ORDERED).
+        Returns None when reordering would change nothing. Cached: the
+        result is a pure function of the team (facade teams would
+        otherwise rebuild a TeamTopo per collective)."""
+        if hasattr(self, "_topo_subset"):
+            return self._topo_subset
+        self._topo_subset = self._compute_topo_subset()
+        return self._topo_subset
+
+    def _compute_topo_subset(self):
+        core = self.core_team
+        topo = getattr(core, "topo", None)
+        if topo is None:
+            ctx_topo = core.context.topo if hasattr(core, "context") else None
+            if ctx_topo is None or ctx_topo.nnodes < 2:
+                return None
+            from ...topo.topo import TeamTopo
+            topo = TeamTopo(ctx_topo, self.ctx_map, self.rank)
+        if topo.n_nodes < 2:
+            return None
+        from ...topo.sbgp import SbgpType
+        sbgp = topo.get_sbgp(SbgpType.FULL_HOST_ORDERED)
+        if sbgp.map is None or sbgp.map.type == EpMapType.FULL:
+            return None   # identity: reordering changes nothing
+        return Subset(sbgp.map, sbgp.group_rank)
 
     def next_coll_tag(self) -> int:
         self._coll_tag += 1
