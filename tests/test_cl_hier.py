@@ -256,3 +256,70 @@ class TestTopoOrderedRing:
             ss = shm.topo_ordered_subset()
             assert ss is not None
             assert ss.map.to_array().tolist() != [0, 1, 2, 3]
+
+
+class TestHierAlltoallNodeAgg:
+    def test_alltoall_small_uses_node_agg(self, job, teams):
+        cands = teams[0].score_map.lookup(CollType.ALLTOALL,
+                                          ucc_tpu.MemoryType.HOST, 256)
+        assert cands[0].alg_name == "node_agg"
+        # above the threshold, flat algorithms win
+        cands_big = teams[0].score_map.lookup(CollType.ALLTOALL,
+                                              ucc_tpu.MemoryType.HOST,
+                                              1 << 20)
+        assert cands_big[0].alg_name != "node_agg"
+
+    @pytest.mark.parametrize("blk", [1, 3])
+    def test_alltoall_node_agg_correct(self, job, teams, blk):
+        n = 8
+        total = n * blk
+        srcs = [np.arange(total, dtype=np.int32) + 1000 * r
+                for r in range(n)]
+        dsts = [np.zeros(total, np.int32) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=BufferInfo(srcs[r], total, DataType.INT32),
+            dst=BufferInfo(dsts[r], total, DataType.INT32)))
+        for r in range(n):
+            expect = np.concatenate(
+                [srcs[p][r * blk:(r + 1) * blk] for p in range(n)])
+            np.testing.assert_array_equal(dsts[r], expect)
+
+    def test_alltoall_inplace_node_agg(self, job, teams):
+        n, blk = 8, 2
+        total = n * blk
+        bufs = [np.arange(total, dtype=np.float32) + 100 * r
+                for r in range(n)]
+        origs = [b.copy() for b in bufs]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALL,
+            dst=BufferInfo(bufs[r], total, DataType.FLOAT32),
+            flags=CollArgsFlags.IN_PLACE))
+        for r in range(n):
+            expect = np.concatenate(
+                [origs[p][r * blk:(r + 1) * blk] for p in range(n)])
+            np.testing.assert_array_equal(bufs[r], expect)
+
+    def test_alltoall_inplace_persistent_repost(self, job, teams):
+        """Persistent in-place node-agg alltoall must snapshot per POST,
+        not per init (re-posts read fresh data)."""
+        n, blk = 8, 1
+        total = n * blk
+        bufs = [np.zeros(total, np.float32) for _ in range(n)]
+        reqs = [teams[r].collective_init(CollArgs(
+            coll_type=CollType.ALLTOALL,
+            dst=BufferInfo(bufs[r], total, DataType.FLOAT32),
+            flags=CollArgsFlags.IN_PLACE | CollArgsFlags.PERSISTENT))
+            for r in range(n)]
+        for it in (1, 2):
+            for r in range(n):
+                bufs[r][:] = np.arange(total) + 100 * r + 1000 * it
+            origs = [b.copy() for b in bufs]
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs))
+            for r in range(n):
+                expect = np.concatenate(
+                    [origs[p][r * blk:(r + 1) * blk] for p in range(n)])
+                np.testing.assert_array_equal(bufs[r], expect)

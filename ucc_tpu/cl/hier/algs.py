@@ -492,6 +492,20 @@ def barrier_init(init_args, hier_team) -> CollTask:
     return sched
 
 
+def _nodes_by_leader(topo, team_size: int):
+    """(node_leader_ranks, by_node): nodes in NODE_LEADERS order, members
+    in ascending team-rank order — the grouped layout every hierarchical
+    data movement in this module agrees on."""
+    nl = topo.get_sbgp(SbgpType.NODE_LEADERS)
+    node_leader_ranks = [nl.map.eval(i) for i in range(nl.size)]
+    by_node = []
+    for lr in node_leader_ranks:
+        hh = topo._proc(lr).host_hash
+        by_node.append([r for r in range(team_size)
+                        if topo._proc(r).host_hash == hh])
+    return node_leader_ranks, by_node
+
+
 class _UnpackTask(CollTask):
     """Reorder the node-grouped gather result into the user's dst layout
     (the reference's allgatherv unpack step, cl_hier/allgatherv/unpack.c)."""
@@ -531,13 +545,7 @@ def allgatherv_hier_init(init_args, hier_team) -> CollTask:
     msg = total * nd.itemsize
 
     # grouped order: nodes in NODE_LEADERS order, members in NODE order
-    nl = topo.get_sbgp(SbgpType.NODE_LEADERS)
-    node_leader_ranks = [nl.map.eval(i) for i in range(nl.size)]
-    by_node = []          # list of lists of team ranks
-    for lr in node_leader_ranks:
-        hh = topo._proc(lr).host_hash
-        by_node.append([r for r in range(team_size)
-                        if topo._proc(r).host_hash == hh])
+    node_leader_ranks, by_node = _nodes_by_leader(topo, team_size)
     grouped_order = [r for grp in by_node for r in grp]
     g_off = {}
     off = 0
@@ -600,6 +608,142 @@ def allgatherv_hier_init(init_args, hier_team) -> CollTask:
     return sched
 
 
+def alltoall_hier_init(init_args, hier_team) -> CollTask:
+    """Node-aggregated alltoall for small messages (cl_hier/alltoallv node
+    aggregation, a2av_node_thresh cl_hier.h:53): members funnel their whole
+    send buffers to the node leader, leaders exchange per-node aggregates
+    (one big message per node pair instead of p*p small ones over DCN),
+    then leaders scatter and members unpack. All sizes are static for the
+    equal-block alltoall, so the whole pipeline is one schedule.
+    """
+    from ...api.types import BufferInfo, BufferInfoV
+    from ...tl.base import binfo_typed
+
+    args = init_args.args
+    node = hier_team.sbgp(SbgpType.NODE)
+    leaders = hier_team.sbgp(SbgpType.NODE_LEADERS)
+    topo = hier_team.core_team.topo
+    N = hier_team.core_team.size
+    total = int(args.dst.count)
+    if total % N != 0:
+        raise UccError(Status.ERR_NOT_SUPPORTED,
+                       "alltoall needs count divisible by team size")
+    blk = total // N
+    dt = args.dst.datatype
+    nd = dt_numpy(dt)
+    msg = total * nd.itemsize
+
+    node_leader_ranks, by_node = _nodes_by_leader(topo, N)
+    my_node_ranks = [node.sbgp.map.eval(i) for i in range(node.sbgp.size)]
+    p_me = len(my_node_ranks)
+    is_leader = node.sbgp.group_rank == 0
+
+    sched = Schedule(team=hier_team, args=args)
+    if args.is_inplace:
+        # snapshot the buffer at POST time (a schedule-start task), not at
+        # init: persistent re-posts must read fresh data
+        src_flat = np.zeros(total, dtype=nd)
+
+        def snapshot():
+            src_flat[:] = binfo_typed(args.dst, total)
+
+        t_snap = _UnpackTask(snapshot)
+        sched.add_task(t_snap)
+        sched.add_dep_on_schedule_start(t_snap)
+    else:
+        src_flat = binfo_typed(args.src, total)
+
+    # stage 1: node gatherv of members' full send buffers -> leader
+    G = np.zeros(p_me * total, dtype=nd) if is_leader else None
+    g1 = CollArgs(coll_type=CollType.GATHERV, root=0,
+                  src=BufferInfo(src_flat, total, dt),
+                  dst=BufferInfoV(G, [total] * p_me, None, dt)
+                  if is_leader else None)
+    t1 = node.coll_init(g1, MemoryType.HOST, msg)
+    sched.add_task(t1)
+    if args.is_inplace:
+        t1.subscribe_dep(t_snap, EventType.EVENT_COMPLETED)
+    else:
+        sched.add_dep_on_schedule_start(t1)
+    prev = t1
+
+    # leader-side stages
+    R_member = np.zeros(total, dtype=nd)      # my eventual recv (grouped)
+    if is_leader and leaders is not None and leaders.sbgp.is_member:
+        scounts = [len(grp) * p_me * blk for grp in by_node]
+        rcounts = [p_me * len(grp) * blk for grp in by_node]
+        A_out = np.zeros(sum(scounts), dtype=nd)
+        A_in = np.zeros(sum(rcounts), dtype=nd)
+        M = np.zeros(p_me * total, dtype=nd)   # per-member scatter payloads
+
+        def pack():
+            # A_out: for dst node D: for t in D: for s in mine: block s->t
+            off = 0
+            for grp in by_node:
+                for t_rank in grp:
+                    for s in range(p_me):
+                        seg = G[s * total + t_rank * blk:
+                                s * total + t_rank * blk + blk]
+                        A_out[off:off + blk] = seg
+                        off += blk
+
+        t_pack = _UnpackTask(pack)
+        sched.add_task(t_pack)
+        t_pack.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+
+        a2 = CollArgs(coll_type=CollType.ALLTOALLV,
+                      src=BufferInfoV(A_out, scounts, None, dt),
+                      dst=BufferInfoV(A_in, rcounts, None, dt))
+        t_a2 = leaders.coll_init(a2, MemoryType.HOST, msg)
+        sched.add_task(t_a2)
+        t_a2.subscribe_dep(t_pack, EventType.EVENT_COMPLETED)
+
+        def repack():
+            # A_in: for src node S: for t in mine: for s in S: block ->
+            # M: for t in mine: for S: for s in S: block (grouped src order)
+            node_off = 0
+            g_off = 0
+            for grp in by_node:
+                p_S = len(grp)
+                sect = A_in[node_off:node_off + p_me * p_S * blk]
+                for t in range(p_me):
+                    chunk = sect[t * p_S * blk:(t + 1) * p_S * blk]
+                    M[t * total + g_off:
+                      t * total + g_off + p_S * blk] = chunk
+                node_off += p_me * p_S * blk
+                g_off += p_S * blk
+
+        t_rep = _UnpackTask(repack)
+        sched.add_task(t_rep)
+        t_rep.subscribe_dep(t_a2, EventType.EVENT_COMPLETED)
+        prev = t_rep
+
+        s3_src = BufferInfoV(M, [total] * p_me, None, dt)
+    else:
+        s3_src = None
+
+    # stage 3: node scatterv of per-member grouped payloads
+    s3 = CollArgs(coll_type=CollType.SCATTERV, root=0, src=s3_src,
+                  dst=BufferInfo(R_member, total, dt))
+    t3 = node.coll_init(s3, MemoryType.HOST, msg)
+    sched.add_task(t3)
+    t3.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+
+    # stage 4: grouped (node, member) order -> dst by src team rank
+    grouped_order = [r for grp in by_node for r in grp]
+
+    def unpack():
+        dst_flat = binfo_typed(args.dst, total)
+        for pos, r in enumerate(grouped_order):
+            dst_flat[r * blk:(r + 1) * blk] = \
+                R_member[pos * blk:(pos + 1) * blk]
+
+    t4 = _UnpackTask(unpack)
+    sched.add_task(t4)
+    t4.subscribe_dep(t3, EventType.EVENT_COMPLETED)
+    return sched
+
+
 # ---------------------------------------------------------------------------
 # scores
 # ---------------------------------------------------------------------------
@@ -620,6 +764,19 @@ def build_hier_scores(hier_team) -> CollScore:
             "split_rail")
     add(CollType.BCAST, HIER_SCORE, bcast_2step_init, "2step")
     add(CollType.ALLGATHERV, HIER_SCORE, allgatherv_hier_init, "unpack")
+    # node aggregation pays off for small messages over DCN; gate by the
+    # a2av_node_thresh knob (cl_hier.h:53)
+    thresh = 1024
+    cfg = hier_team.comp_context.config
+    if cfg is not None:
+        try:
+            from ...utils.config import parse_memunits
+            thresh = parse_memunits(cfg.get("A2AV_NODE_THRESH"))
+        except (KeyError, ValueError):
+            pass
+    s.add_range(CollType.ALLTOALL, mem, 0, thresh, HIER_SCORE,
+                lambda ia, t: alltoall_hier_init(ia, hier_team), hier_team,
+                "node_agg")
     add(CollType.REDUCE, HIER_SCORE, reduce_2step_init, "2step")
     add(CollType.BARRIER, HIER_SCORE, barrier_init, "knomial_hier")
     return s
