@@ -20,3 +20,85 @@ def shard_map_compat(fn, mesh, in_specs, out_specs):
     except TypeError:
         return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                   check_rep=False)
+
+
+_backend_probe_result = {}
+
+
+def ensure_live_backend(virtual_cpu_devices: int = 0,
+                        probe_timeout: float = 100.0) -> str:
+    """Make sure this process can reach a jax backend without hanging.
+
+    The axon TPU tunnel can wedge so that device discovery blocks forever
+    (and a hung in-process probe poisons jax's backend-init lock). Strategy:
+
+    - if a backend is already initialized in-process, trust it;
+    - else probe `jax.devices()` in a SUBPROCESS (no shared lock) with a
+      hard timeout, reaping without an unbounded wait (a child stuck in an
+      uninterruptible ioctl ignores SIGKILL);
+    - on failure, log loudly and switch this process to the CPU platform
+      before any backend touch (the runtime-config route is safe even when
+      the plugin's env route hangs).
+
+    `virtual_cpu_devices > 0` additionally ensures XLA_FLAGS carries
+    --xla_force_host_platform_device_count so the CPU platform has enough
+    devices (must happen before backend init). Returns "accel" or "cpu".
+    Memoized per process. bench.py keeps its own standalone copy of this
+    pattern (it must work even if ucc_tpu fails to import).
+    """
+    import os
+    import subprocess
+    import sys
+    import time
+
+    if "result" in _backend_probe_result:
+        return _backend_probe_result["result"]
+
+    if virtual_cpu_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                        f"{virtual_cpu_devices}").strip()
+
+    import jax
+    try:
+        from jax._src import xla_bridge
+        if xla_bridge.backends_are_initialized():
+            _backend_probe_result["result"] = "accel"
+            return "accel"
+    except Exception:  # noqa: BLE001 - private API drift
+        pass
+
+    ok = False
+    try:
+        p = subprocess.Popen([sys.executable, "-c",
+                              "import jax; jax.devices()"],
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + probe_timeout
+        while time.monotonic() < deadline:
+            if p.poll() is not None:
+                ok = p.returncode == 0
+                break
+            time.sleep(0.5)
+        else:
+            p.kill()
+        if not ok:
+            try:
+                p.wait(timeout=5)   # bounded reap; a D-state child is left
+            except Exception:  # noqa: BLE001
+                pass
+    except OSError:
+        ok = False
+    if ok:
+        _backend_probe_result["result"] = "accel"
+        return "accel"
+    print("ucc_tpu: accelerator backend probe failed or timed out; "
+          "falling back to the CPU platform", file=sys.stderr)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 - backend already initialized
+        pass
+    _backend_probe_result["result"] = "cpu"
+    return "cpu"
