@@ -30,6 +30,7 @@ namespace {
 struct Request {
     std::atomic<int> done{0};
     size_t nbytes = 0;
+    int truncated = 0;   // recv side: matched send exceeded dst capacity
     // send side: owned payload when unexpected; recv side: dst pointer
     std::vector<uint8_t> owned;
     void* dst = nullptr;
@@ -100,6 +101,7 @@ void deliver(Request* send_req, Request* recv_req) {
         std::memcpy(recv_req->dst, send_req->owned.data(), n);
     }
     recv_req->nbytes = n;
+    recv_req->truncated = send_req->nbytes > recv_req->dst_cap ? 1 : 0;
     recv_req->done.store(1, std::memory_order_release);
     send_req->done.store(1, std::memory_order_release);
 }
@@ -194,6 +196,12 @@ uint64_t ucc_req_nbytes(void* mbp, uint64_t id) {
     auto* mb = static_cast<Mailbox*>(mbp);
     Request* r = mb->get(id);
     return r ? r->nbytes : 0;
+}
+
+int ucc_req_truncated(void* mbp, uint64_t id) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    Request* r = mb->get(id);
+    return r ? r->truncated : 0;
 }
 
 void ucc_req_free(void* mbp, uint64_t id) {

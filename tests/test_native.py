@@ -127,3 +127,32 @@ class TestCollectivesOverNative:
                 np.testing.assert_allclose(dsts[r], 10.0)
         finally:
             job.cleanup()
+
+
+class TestNativeTruncation:
+    """The C matcher must flag sends larger than the recv capacity
+    (parity with the python Mailbox's truncation detection)."""
+
+    def test_truncated_send_sets_error(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            dst = np.zeros(4, np.uint8)
+            rreq = mb.post_recv_native(("k", 1), dst)
+            sreq = mb.push_native(("k", 1), np.arange(10, dtype=np.uint8))
+            assert rreq.test() and sreq.test()
+            assert rreq.error is not None and "truncated" in rreq.error
+        finally:
+            mb.destroy()
+
+    def test_exact_size_no_error(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            dst = np.zeros(8, np.uint8)
+            rreq = mb.post_recv_native(("k", 2), dst)
+            mb.push_native(("k", 2), np.arange(8, dtype=np.uint8))
+            assert rreq.test()
+            assert rreq.error is None and rreq.nbytes == 8
+        finally:
+            mb.destroy()

@@ -118,3 +118,41 @@ class TestBadInput:
             assert reqs[1].test() == Status.ERR_TIMED_OUT
         finally:
             job.cleanup()
+
+
+class TestTransportTruncation:
+    """ADVICE r1: a send larger than the posted recv buffer must surface
+    as an error on the recv request (and fail the task via wait()), not
+    silently truncate."""
+
+    def test_deliver_flags_truncation(self):
+        from ucc_tpu.tl.host.transport import (Mailbox, RecvReq, SendReq,
+                                               _PendingSend)
+        mb = Mailbox()
+        key = ("t", 1, 0, 0)
+        req = RecvReq(np.zeros(4, np.float32))
+        mb.post_recv(key, req)
+        ps = _PendingSend(np.arange(10, dtype=np.float32), SendReq(), False)
+        mb.push(key, ps)
+        assert req.done and ps.req.done
+        assert req.error is not None and "truncated" in req.error
+
+    def test_smaller_send_is_fine(self):
+        from ucc_tpu.tl.host.transport import (Mailbox, RecvReq, SendReq,
+                                               _PendingSend)
+        mb = Mailbox()
+        key = ("t", 2, 0, 0)
+        req = RecvReq(np.zeros(8, np.float32))
+        mb.post_recv(key, req)
+        mb.push(key, _PendingSend(np.ones(3, np.float32), SendReq(), False))
+        assert req.done and req.error is None and req.nbytes == 3
+
+    def test_wait_raises_on_truncation(self):
+        from ucc_tpu.tl.host.task import HostCollTask
+        from ucc_tpu.tl.host.transport import RecvReq
+        req = RecvReq(np.zeros(2, np.float32))
+        req.done = True
+        req.error = "message truncated: test"
+        task = object.__new__(HostCollTask)
+        with pytest.raises(UccError):
+            list(task.wait(req))

@@ -315,3 +315,39 @@ class TestXlaAlltoallv:
             expect = np.concatenate(
                 [srcs[p][r * 2:(r + 1) * 2] for p in range(n)])
             np.testing.assert_array_equal(dsts[r], expect)
+
+
+class TestXlaRemainderConventions:
+    """ADVICE r1 (high): non-divisible reduce_scatter must follow the
+    near-equal split convention (remainder in the FIRST blocks,
+    ucc_buffer_block_count), not equal padded blocks."""
+
+    def test_reduce_scatter_remainder(self, job, teams):
+        from ucc_tpu.utils.mathutils import block_count, block_offset
+        n, total = 4, 10           # blocks 3,3,2,2
+        srcs = [np.arange(total, dtype=np.float32) * 10.0 * (r + 1)
+                for r in range(n)]
+        argses = [CollArgs(
+            coll_type=CollType.REDUCE_SCATTER,
+            src=tpu_buf(job, r, srcs[r], DataType.FLOAT32),
+            dst=BufferInfo(None, block_count(total, n, r), DataType.FLOAT32,
+                           mem_type=MemoryType.TPU),
+            op=ReductionOp.SUM) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        expect = np.sum(srcs, axis=0)
+        for r in range(n):
+            off = block_offset(total, n, r)
+            cnt = block_count(total, n, r)
+            np.testing.assert_allclose(np.asarray(argses[r].dst.buffer),
+                                       expect[off:off + cnt])
+
+    def test_scatter_non_divisible_rejected(self, job, teams):
+        from ucc_tpu import UccError
+        src = np.arange(10, dtype=np.float32)    # 10 % 4 != 0
+        args = CollArgs(
+            coll_type=CollType.SCATTER, root=0,
+            src=tpu_buf(job, 0, src, DataType.FLOAT32),
+            dst=BufferInfo(None, 3, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU))
+        with pytest.raises(UccError):
+            teams[0].collective_init(args)

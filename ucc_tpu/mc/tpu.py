@@ -38,6 +38,9 @@ class McTpu(MemoryComponent):
         return None
 
     def alloc(self, size_bytes: int, dtype=np.uint8, device=None) -> Any:
+        """Returns UNINITIALIZED memory (like cudaMalloc): recycled pool
+        buffers keep their previous contents, and the cold path makes no
+        zeroing promise either — callers must not rely on zeroed data."""
         import jax.numpy as jnp
         nd = np.dtype(dtype)
         count = size_bytes // nd.itemsize
@@ -47,7 +50,7 @@ class McTpu(MemoryComponent):
         pool = self._pool.get(key)
         if pool:
             return pool.pop()
-        arr = jnp.zeros((count,), dtype=nd)
+        arr = jnp.empty((count,), dtype=nd)
         return self.jax.device_put(arr, dev)
 
     def free(self, buf: Any) -> None:

@@ -77,6 +77,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.ucc_req_test.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.ucc_req_nbytes.restype = ctypes.c_uint64
         lib.ucc_req_nbytes.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        try:
+            lib.ucc_req_truncated.restype = ctypes.c_int
+            lib.ucc_req_truncated.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_uint64]
+        except AttributeError:   # stale .so without the symbol
+            lib.ucc_req_truncated = None
         lib.ucc_req_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.ucc_mpmc_create.restype = ctypes.c_void_p
         lib.ucc_mpmc_create.argtypes = [ctypes.c_uint64]
@@ -124,7 +130,7 @@ class NativeSendReq:
 
 
 class NativeRecvReq:
-    __slots__ = ("mb", "rid", "dst_keepalive", "_done", "nbytes")
+    __slots__ = ("mb", "rid", "dst_keepalive", "_done", "nbytes", "error")
 
     def __init__(self, mb: "NativeMailbox", rid: int, dst: np.ndarray):
         self.mb = mb
@@ -132,6 +138,7 @@ class NativeRecvReq:
         self.dst_keepalive = dst     # pin the buffer the C side writes into
         self._done = False
         self.nbytes = 0
+        self.error = None
 
     @property
     def done(self) -> bool:
@@ -146,6 +153,10 @@ class NativeRecvReq:
         if self.mb.lib.ucc_req_test(self.mb.ptr, self.rid):
             self.nbytes = int(self.mb.lib.ucc_req_nbytes(self.mb.ptr,
                                                          self.rid))
+            trunc_fn = getattr(self.mb.lib, "ucc_req_truncated", None)
+            if trunc_fn is not None and trunc_fn(self.mb.ptr, self.rid):
+                self.error = (f"message truncated: send exceeded the "
+                              f"{self.dst_keepalive.size}-byte recv buffer")
             self.mb.lib.ucc_req_free(self.mb.ptr, self.rid)
             self._done = True
         return self._done

@@ -98,11 +98,15 @@ class HostCollTask(CollTask):
                                     dst)
 
     def wait(self, *reqs):
-        """Yield until all requests complete."""
+        """Yield until all requests complete; fail on delivery errors."""
         pending: List = [r for r in reqs if not r.test()]
         while pending:
             yield
             pending = [r for r in pending if not r.test()]
+        for r in reqs:
+            err = getattr(r, "error", None)
+            if err:
+                raise UccError(Status.ERR_NO_MESSAGE, err)
 
     def sendrecv(self, send_to: int, data: np.ndarray, recv_from: int,
                  dst: np.ndarray, slot: int = 0):

@@ -42,12 +42,13 @@ class SendReq:
 
 
 class RecvReq:
-    __slots__ = ("done", "dst", "nbytes")
+    __slots__ = ("done", "dst", "nbytes", "error")
 
     def __init__(self, dst: np.ndarray):
         self.done = False
         self.dst = dst
         self.nbytes = 0
+        self.error = None   # str reason when the matched send misbehaved
 
     def test(self) -> bool:
         return self.done
@@ -99,6 +100,12 @@ class Mailbox:
 
 def _deliver(req: RecvReq, ps: _PendingSend) -> None:
     n = min(req.dst.size, ps.data.size)
+    if ps.data.size > req.dst.size:
+        # truncation = algorithm geometry bug (inconsistent per-rank
+        # counts); surface it so the task can fail instead of completing
+        # with silently partial data (cf. UCS_ERR_MESSAGE_TRUNCATED)
+        req.error = (f"message truncated: sent {ps.data.size} elements "
+                     f"into a {req.dst.size}-element recv buffer")
     req.dst[:n] = ps.data[:n]
     req.nbytes = n
     req.done = True

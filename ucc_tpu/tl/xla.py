@@ -240,6 +240,15 @@ class XlaCollTask(CollTask):
                 args.dst.counts is None):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            "tl/xla alltoallv requires src and dst counts")
+        if self.coll == CollType.SCATTER and args.src is not None and \
+                args.src.buffer is not None and \
+                int(args.src.count) % team.size != 0:
+            # the equal-block program would shift non-root blocks by
+            # padded/n vs the host ScatterLinear count//n convention;
+            # non-divisible totals belong to scatterv
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "tl/xla scatter requires count % team_size == 0 "
+                           "(use scatterv for uneven blocks)")
 
     # -- launch plumbing -------------------------------------------------
     def local_src(self):
@@ -263,6 +272,12 @@ class XlaCollTask(CollTask):
             if args.src is not None and args.src.buffer is not None:
                 return int(args.src.count)
             return int(args.dst.count) * n
+        if self.coll == CollType.REDUCE_SCATTER:
+            # declared total is authoritative — _copy_out's divisibility
+            # branch must agree with the program build's (a padded src
+            # buffer must not flip the program to the equal-split variant)
+            bi = args.dst if args.is_inplace or args.src is None else args.src
+            return int(bi.count)
         if self.coll in (CollType.ALLGATHERV, CollType.GATHERV):
             vc = self._vkey()
             if vc is None:
@@ -472,6 +487,15 @@ class XlaCollTask(CollTask):
             off = int(dst.displacements[me]) if dst.displacements is not None \
                 else sum(counts[:me])
             rsv_want = counts[me]
+        elif coll == CollType.REDUCE_SCATTER:
+            total = int(args.dst.count) if args.is_inplace or \
+                args.src is None else int(args.src.count)
+            if total % n != 0:
+                # program replicated the full reduction; slice my
+                # near-equal block (remainder in the first blocks)
+                from ..utils.mathutils import block_count, block_offset
+                off = block_offset(total, n, me)
+                rsv_want = block_count(total, n, me)
         if dst.mem_type == MemoryType.TPU:
             out = self._my_out_jax()
             if rsv_want is not None:
@@ -568,9 +592,13 @@ def _build_xla_program(mesh, n: int, coll: CollType, args, nd, count: int,
         if coll == CollType.ALLTOALL:
             return ops.alltoall(x)
         if coll == CollType.REDUCE_SCATTER or coll == CollType.REDUCE_SCATTERV:
-            if vcounts is None:
+            if vcounts is None and count % n == 0:
                 return ops.reduce_scatter(x, op)
-            full = ops.allreduce(x, op)      # exact v-block split below
+            # v-counts or a non-divisible total: the equal padded-block
+            # split would shift tail ranks' data vs the near-equal
+            # convention (remainder in the first blocks) — reduce fully,
+            # replicate, and slice each rank's exact block in _copy_out
+            full = ops.allreduce(x, op)
             return full
         if coll == CollType.SCATTER:
             return ops.scatter(x, root)
@@ -585,7 +613,7 @@ def _build_xla_program(mesh, n: int, coll: CollType, args, nd, count: int,
                 CollType.GATHERV):
         out_specs = P(None)           # replicated full result
     elif coll in (CollType.REDUCE_SCATTER, CollType.REDUCE_SCATTERV) and \
-            vcounts is not None:
+            (vcounts is not None or count % n != 0):
         out_specs = P(None)
     else:
         out_specs = P("r")
