@@ -351,3 +351,71 @@ class TestXlaRemainderConventions:
                            mem_type=MemoryType.TPU))
         with pytest.raises(UccError):
             teams[0].collective_init(args)
+
+
+class TestXlaPersistent:
+    """Persistent collectives (ucc.h:1674): init once, post many. The TL
+    reuses its cached global array + AOT program when the buffers are
+    unchanged; rebinding src changes the buffers and must recompute."""
+
+    def test_repost_unchanged_buffers(self, job, teams):
+        from ucc_tpu import CollArgsFlags
+        n, count = 4, 32
+        srcs = [dev_array(job, r, np.full(count, r + 1.0, np.float32))
+                for r in range(n)]
+        argses = [CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU),
+            dst=BufferInfo(None, count, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU),
+            op=ReductionOp.SUM,
+            flags=CollArgsFlags.PERSISTENT) for r in range(n)]
+        reqs = [teams[r].collective_init(argses[r]) for r in range(n)]
+        xla_team = next(t for t in teams[0].cl_teams[0].tl_teams
+                        if t.name == "xla")
+        for _ in range(3):
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs))
+            for r in range(n):
+                assert reqs[r].test() == Status.OK
+                np.testing.assert_allclose(
+                    np.asarray(argses[r].dst.buffer), 10.0)
+        assert len(xla_team.shared.launch_cache) >= 1
+        for rq in reqs:
+            rq.finalize()
+        # finalize drops the cache entries
+        assert len(xla_team.shared.launch_cache) == 0
+
+    def test_repost_rebound_src(self, job, teams):
+        """Rebinding src between posts must produce the new result (the
+        identity check rejects the cached launch)."""
+        from ucc_tpu import CollArgsFlags
+        n, count = 4, 16
+        argses = [CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(dev_array(job, r, np.full(count, 1.0, np.float32)),
+                           count, DataType.FLOAT32, mem_type=MemoryType.TPU),
+            dst=BufferInfo(None, count, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU),
+            op=ReductionOp.SUM,
+            flags=CollArgsFlags.PERSISTENT) for r in range(n)]
+        reqs = [teams[r].collective_init(argses[r]) for r in range(n)]
+        for rq in reqs:
+            rq.post()
+        job.progress_until(lambda: all(
+            rq.test() != Status.IN_PROGRESS for rq in reqs))
+        np.testing.assert_allclose(np.asarray(argses[0].dst.buffer), 4.0)
+        for r in range(n):
+            argses[r].src.buffer = dev_array(
+                job, r, np.full(count, 2.0, np.float32))
+        for rq in reqs:
+            rq.post()
+        job.progress_until(lambda: all(
+            rq.test() != Status.IN_PROGRESS for rq in reqs))
+        for r in range(n):
+            np.testing.assert_allclose(np.asarray(argses[r].dst.buffer), 8.0)
+        for rq in reqs:
+            rq.finalize()

@@ -157,6 +157,13 @@ class XlaTeamShared:
         self.programs: Dict[Any, Any] = {}
         #: tag -> {team_rank: (shard_np_or_jax, task)}
         self.pending: Dict[int, Dict[int, Tuple[Any, "XlaCollTask"]]] = {}
+        #: persistent-collective launch cache: tag -> (bufs, garr, program)
+        #: (strong refs to bufs keep ids stable for the identity check)
+        self.launch_cache: Dict[int, Tuple[tuple, Any, Any]] = {}
+        #: AOT-compiled executables keyed by id(jit program) — valid
+        #: because shared.programs pins the jit objects for the team's
+        #: lifetime, and a program key fixes the global shape
+        self.aot_programs: Dict[int, Any] = {}
         self.refcount = 0
 
     @classmethod
@@ -191,6 +198,21 @@ class XlaTeamShared:
             # deterministic proto: the lowest team rank's task (the program
             # must not depend on deposit order)
             proto = slot[min(slot)][1]
+            bufs = tuple(buf for _, (buf, _t) in sorted(slot.items()))
+            cached = self.launch_cache.get(proto.tag)
+            if cached is not None and len(cached[0]) == len(bufs) and \
+                    all(a is b for a, b in zip(cached[0], bufs)):
+                # persistent re-post on unchanged device buffers: the
+                # global array and compiled program are reusable as-is
+                # (jax arrays are immutable) — skip per-shard device_put
+                # and array assembly entirely (ucc_perftest's init-once/
+                # post-many contract, ucc.h:1674)
+                _, garr, program = cached
+                out = program(garr)
+                by_dev = {s.device: s.data for s in out.addressable_shards}
+                for rank, (_, task) in slot.items():
+                    task.set_result(out, by_dev)
+                return
             program, count_padded = proto.build_program(self, slot)
             n = len(self.devices)
             nd = proto.np_dtype
@@ -207,8 +229,22 @@ class XlaTeamShared:
             garr = jax.make_array_from_single_device_arrays(
                 global_shape, sharding, shards)
             out = program(garr)
+            if proto.args.is_persistent:
+                # AOT-compile for re-posts: the Compiled object's dispatch
+                # skips jit's python-side signature matching (~100us/call).
+                # Cached per program so identity-miss re-posts (rebound or
+                # host-staged buffers) never pay a re-lower/re-compile.
+                launch_prog = self.aot_programs.get(id(program))
+                if launch_prog is None:
+                    try:
+                        launch_prog = program.lower(garr).compile()
+                    except Exception:  # noqa: BLE001 - keep jit dispatch
+                        launch_prog = program
+                    self.aot_programs[id(program)] = launch_prog
+                self.launch_cache[proto.tag] = (bufs, garr, launch_prog)
+            by_dev = {s.device: s.data for s in out.addressable_shards}
             for rank, (_, task) in slot.items():
-                task.set_result(out)
+                task.set_result(out, by_dev)
         except Exception as e:  # noqa: BLE001 - compile/dispatch failure
             logger.exception("xla collective launch failed")
             for rank, (_, task) in slot.items():
@@ -227,9 +263,9 @@ class XlaCollTask(CollTask):
         self.init_args = init_args
         self.tl_team = team
         self.alg = alg
-        self.tag = team.next_coll_tag()
         self.result_array = None
         self._out = None
+        self._out_by_dev = None
         args = init_args.args
         self.np_dtype = dt_numpy((args.src or args.dst).datatype)
         self.coll = args.coll_type
@@ -240,6 +276,18 @@ class XlaCollTask(CollTask):
                 args.dst.counts is None):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            "tl/xla alltoallv requires src and dst counts")
+        # Device-memory collectives complete at dispatch (stream-ordered
+        # semantics, the reference's triggered-post/EE contract for device
+        # TLs): dst.buffer is rebound to an async jax future, so any
+        # consumer orders on it via data dependence, and
+        # jax.block_until_ready(dst.buffer) is the hard-completion point.
+        # Host-staged dsts and barriers keep hard completion (polled
+        # readiness) — a barrier's only meaning IS program completion.
+        dst_bi = args.dst if args.dst is not None else args.src
+        self._eager_complete = (
+            self.coll not in (CollType.BARRIER, CollType.FANIN,
+                              CollType.FANOUT)
+            and (dst_bi is None or dst_bi.mem_type == MemoryType.TPU))
         if self.coll == CollType.SCATTER and args.src is not None and \
                 args.src.buffer is not None and \
                 int(args.src.count) % team.size != 0:
@@ -249,6 +297,10 @@ class XlaCollTask(CollTask):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            "tl/xla scatter requires count % team_size == 0 "
                            "(use scatterv for uneven blocks)")
+        # tag allocation LAST: a validation error above must not consume a
+        # team tag, or this rank's tag sequence desyncs from its peers and
+        # every later rendezvous deposits into mismatched slots
+        self.tag = team.next_coll_tag()
 
     # -- launch plumbing -------------------------------------------------
     def local_src(self):
@@ -421,8 +473,30 @@ class XlaCollTask(CollTask):
         shared.deposit(self.tag, self.tl_team.rank, shard, self)
         return Status.OK
 
-    def set_result(self, out) -> None:
+    def reset(self) -> None:
+        """Persistent re-post: clear the previous launch's result (the
+        launch cache in XlaTeamShared keeps the device-resident input
+        array when the rebound buffers are unchanged)."""
+        super().reset()
+        self._out = None
+        self._out_by_dev = None
+        self.result_array = None
+
+    def set_result(self, out, by_dev=None) -> None:
         self._out = out
+        # per-launch device->shard map, computed once for all local tasks
+        # (addressable_shards builds Shard objects per call — O(n) each)
+        self._out_by_dev = by_dev
+        if self._eager_complete:
+            # rebind dst to the (async) result and mark OK. complete()
+            # itself is NOT called here: set_result may run on the
+            # last-depositing rank's thread, and completing a peer task
+            # cross-thread would race its own post() path (double
+            # complete in THREAD_MULTIPLE). Setting status is enough —
+            # the owner's post() or its next progress pass completes the
+            # task exactly once and pops it from the queue.
+            self._copy_out()
+            self.status = Status.OK
 
     def progress_fn(self) -> None:
         if self.status != Status.IN_PROGRESS:
@@ -448,19 +522,20 @@ class XlaCollTask(CollTask):
     # -- output landing ----------------------------------------------------
     def _my_out_np(self) -> np.ndarray:
         """This rank's shard of the (flat) output global array."""
-        dev = self.tl_team.shared.devices[self.tl_team.rank]
-        for shard in self._out.addressable_shards:
-            if shard.device == dev:
-                return np.asarray(shard.data)
-        # replicated output: any shard works
-        return np.asarray(self._out.addressable_shards[0].data)
+        return np.asarray(self._my_out_jax())
 
     def _my_out_jax(self):
         dev = self.tl_team.shared.devices[self.tl_team.rank]
-        for shard in self._out.addressable_shards:
+        if self._out_by_dev is not None:
+            mine = self._out_by_dev.get(dev)
+            if mine is not None:
+                return mine
+            return next(iter(self._out_by_dev.values()))
+        shards = self._out.addressable_shards
+        for shard in shards:
             if shard.device == dev:
                 return shard.data          # already flat
-        return self._out.addressable_shards[0].data
+        return shards[0].data
 
     def _copy_out(self) -> None:
         args = self.args
@@ -534,6 +609,7 @@ class XlaCollTask(CollTask):
         return out[:want] if out.shape[-1] != want else out
 
     def finalize_fn(self) -> Status:
+        self.tl_team.shared.launch_cache.pop(self.tag, None)
         return Status.OK
 
 
