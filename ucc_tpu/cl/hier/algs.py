@@ -61,10 +61,15 @@ class _ScaleTask(CollTask):
         self.alpha = alpha
 
     def post_fn(self) -> Status:
-        v = self.view_fn()
-        # out-of-place multiply + cast back so integer dtypes work
-        # (in-place float multiply on an int view raises UFuncTypeError)
-        v[:] = (v * self.alpha).astype(v.dtype)
+        try:
+            v = self.view_fn()
+            # out-of-place multiply + cast back so integer dtypes work
+            # (in-place float multiply on an int view raises UFuncTypeError)
+            v[:] = (v * self.alpha).astype(v.dtype)
+        except Exception:  # noqa: BLE001 - fail the task, not the caller's
+            logger.exception("hier scale step failed")   # progress loop
+            self.status = Status.ERR_NO_MESSAGE
+            return Status.ERR_NO_MESSAGE
         self.status = Status.OK
         return Status.OK
 
@@ -515,7 +520,12 @@ class _UnpackTask(CollTask):
         self.fn = fn
 
     def post_fn(self) -> Status:
-        self.fn()
+        try:
+            self.fn()
+        except Exception:  # noqa: BLE001 - fail the task, not the caller's
+            logger.exception("hier pack/unpack step failed")
+            self.status = Status.ERR_NO_MESSAGE
+            return Status.ERR_NO_MESSAGE
         self.status = Status.OK
         return Status.OK
 
@@ -750,6 +760,7 @@ def alltoall_hier_init(init_args, hier_team) -> CollTask:
 
 def build_hier_scores(hier_team) -> CollScore:
     from ...utils.config import SIZE_INF
+    from .tpu import allreduce_rab_tpu_init, staged_init
     s = CollScore()
     mem = MemoryType.HOST
 
@@ -757,6 +768,16 @@ def build_hier_scores(hier_team) -> CollScore:
         s.add_range(coll, mem, 0, SIZE_INF, score,
                     lambda ia, t, fn=init: fn(ia, hier_team), hier_team,
                     name)
+
+    def add_tpu(coll, score, init, name, staged=True):
+        """TPU-memory row: on-device node stages where the alg supports
+        them, else the generic D2H/H2D staging wrapper (cl/hier/tpu.py)."""
+        if staged:
+            fn = lambda ia, t, f=init: staged_init(ia, hier_team, f)  # noqa: E731
+        else:
+            fn = lambda ia, t, f=init: f(ia, hier_team)               # noqa: E731
+        s.add_range(coll, MemoryType.TPU, 0, SIZE_INF, score, fn,
+                    hier_team, name)
 
     add(CollType.ALLREDUCE, HIER_SCORE, allreduce_rab_init, "rab")
     if hier_team.sbgp(SbgpType.NET) is not None:
@@ -779,4 +800,20 @@ def build_hier_scores(hier_team) -> CollScore:
                 "node_agg")
     add(CollType.REDUCE, HIER_SCORE, reduce_2step_init, "2step")
     add(CollType.BARRIER, HIER_SCORE, barrier_init, "knomial_hier")
+
+    # TPU-memory (HBM) rows: the pod path. allreduce runs its node stages
+    # on device via the unit's TL/XLA team (rab_tpu); the others stage at
+    # the hierarchy boundary. Matches cl_hier's CUDA-memory registration
+    # (cl_hier_team.c score map covers CUDA memtypes via memtype-capable
+    # TLs per sbgp).
+    add_tpu(CollType.ALLREDUCE, HIER_SCORE, allreduce_rab_tpu_init,
+            "rab_tpu", staged=False)
+    add_tpu(CollType.BCAST, HIER_SCORE, bcast_2step_init, "2step_staged")
+    add_tpu(CollType.REDUCE, HIER_SCORE, reduce_2step_init, "2step_staged")
+    add_tpu(CollType.ALLGATHERV, HIER_SCORE, allgatherv_hier_init,
+            "unpack_staged")
+    add_tpu(CollType.ALLTOALL, HIER_SCORE, alltoall_hier_init,
+            "node_agg_staged")
+    add_tpu(CollType.BARRIER, HIER_SCORE, barrier_init, "knomial_hier",
+            staged=False)
     return s

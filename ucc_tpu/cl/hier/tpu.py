@@ -1,0 +1,294 @@
+"""CL/HIER for TPU-memory (HBM) buffers — the pod serving path.
+
+The reference CL/HIER composes CUDA-memory TLs per sbgp
+(/root/reference/src/components/cl/hier/cl_hier.h:86-122,
+allreduce/allreduce_rab.c:80). The TPU build mirrors that two ways:
+
+1. **On-device NODE stages** (``allreduce_rab_tpu``): when the NODE unit
+   has a TL/XLA team (all node-local ranks claimed chips), the intra-node
+   reduce and bcast run ON DEVICE over ICI via compiled XLA programs; only
+   the node leaders' inter-node allreduce stages through host memory for
+   the DCN transport (socket TL). HBM<->host staging happens exactly once
+   per direction, at the leader, on the already-reduced vector.
+
+2. **Generic staging wrapper** (``staged_init``): every other hier
+   collective serves MemoryType.TPU by staging HBM->host scratch at post
+   time, running the existing (tested) host hierarchy schedule, and
+   landing the result back on the rank's device (rebinding ``dst.buffer``
+   per the framework's immutable-array convention). This is the
+   correctness path that also covers hosts where chips are spread over
+   processes (no node-local XLA team).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ...api.types import BufferInfo, BufferInfoV, CollArgs
+from ...constants import (CollArgsFlags, CollType, EventType, MemoryType,
+                          ReductionOp, dt_numpy)
+from ...schedule.schedule import Schedule
+from ...schedule.task import CollTask
+from ...status import Status, UccError
+from ...topo.sbgp import SbgpType
+from ...utils.log import get_logger
+
+logger = get_logger("cl_hier")
+
+
+# ---------------------------------------------------------------------------
+# staging primitives
+# ---------------------------------------------------------------------------
+
+def _rank_device(hier_team, args: CollArgs):
+    """The device results land on: the buffer's own device when present,
+    else this rank's claimed chip (TL/XLA context)."""
+    for bi in (args.dst, args.src):
+        if bi is not None and bi.buffer is not None and \
+                bi.mem_type == MemoryType.TPU:
+            try:
+                devs = list(bi.buffer.devices())
+                if len(devs) == 1:
+                    return devs[0]
+            except Exception:  # noqa: BLE001 - not a jax array
+                pass
+    h = hier_team.core_team.context.tl_contexts.get("xla")
+    return h.obj.device if h is not None else None
+
+
+def _span(bi) -> int:
+    if isinstance(bi, BufferInfoV):
+        counts = [int(c) for c in bi.counts]
+        if bi.displacements is not None:
+            displs = [int(d) for d in bi.displacements]
+            return max((d + c for d, c in zip(displs, counts)), default=0)
+        return sum(counts)
+    return int(bi.count)
+
+
+def _shadow(bi):
+    """Host-scratch mirror of a (possibly device-memory) buffer info."""
+    if bi is None:
+        return None
+    nd = dt_numpy(bi.datatype)
+    arr = np.zeros(_span(bi), dtype=nd)
+    if isinstance(bi, BufferInfoV):
+        return BufferInfoV(arr, list(bi.counts),
+                           list(bi.displacements)
+                           if bi.displacements is not None else None,
+                           bi.datatype, mem_type=MemoryType.HOST)
+    return BufferInfo(arr, int(bi.count), bi.datatype,
+                      mem_type=MemoryType.HOST)
+
+
+def _d2h(bi, shadow) -> None:
+    """Device -> host-scratch snapshot (np.asarray blocks until the async
+    source is ready — the staging sync point)."""
+    if bi is None or shadow is None or bi.buffer is None:
+        return
+    src = np.asarray(bi.buffer).reshape(-1)
+    dst = shadow.buffer
+    n = min(src.size, dst.size)
+    dst[:n] = src[:n]
+
+
+class _FnTask(CollTask):
+    """Run a host callable as a schedule task (staging steps). A failing
+    callback must fail THIS task (peers then see the error through the
+    schedule), not raise out of whichever rank's progress loop triggered
+    the dependency chain."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self.fn = fn
+
+    def post_fn(self) -> Status:
+        try:
+            self.fn()
+        except UccError as e:
+            logger.exception("hier staging step failed")
+            self.status = e.status
+            return e.status
+        except Exception:  # noqa: BLE001
+            logger.exception("hier staging step failed")
+            self.status = Status.ERR_NO_MESSAGE
+            return Status.ERR_NO_MESSAGE
+        self.status = Status.OK
+        return Status.OK
+
+
+# ---------------------------------------------------------------------------
+# generic staged wrapper
+# ---------------------------------------------------------------------------
+
+def staged_init(init_args, hier_team, host_init_fn) -> CollTask:
+    """D2H -> host hierarchy schedule -> H2D (dst rebind).
+
+    cf. the reference's CUDA-memory hier path, which similarly runs the
+    hierarchy over memory the TLs can transport (cl_hier composes
+    memtype-capable TLs per sbgp); here the DCN TLs are host-memory, so
+    device buffers stage at the hierarchy boundary.
+    """
+    import jax
+
+    args = init_args.args
+    coll = args.coll_type
+    if coll in (CollType.BARRIER, CollType.FANIN, CollType.FANOUT):
+        return host_init_fn(init_args, hier_team)
+
+    dev = _rank_device(hier_team, args)
+    s_src = _shadow(args.src) if not args.is_inplace else None
+    s_dst = _shadow(args.dst)
+    shadow_args = dataclasses.replace(
+        args,
+        src=(s_dst if args.is_inplace else s_src),
+        dst=s_dst)
+
+    inner_ia = dataclasses.replace(init_args, args=shadow_args,
+                                   mem_type=MemoryType.HOST)
+    inner = host_init_fn(inner_ia, hier_team)
+
+    def stage_in():
+        if args.is_inplace:
+            _d2h(args.dst, s_dst)
+        else:
+            _d2h(args.src, s_src)
+
+    def stage_out():
+        # land the result on-device and rebind the user's buffer info
+        # (bcast delivers via src: dst is None by UCC convention)
+        out_bi = args.dst if args.dst is not None else args.src
+        out_sh = s_dst if args.dst is not None else s_src
+        if out_bi is None or out_sh is None:
+            return
+        if coll in (CollType.REDUCE, CollType.GATHER, CollType.GATHERV) \
+                and hier_team.core_team.rank != int(args.root):
+            return
+        if out_bi.mem_type == MemoryType.TPU:
+            out_bi.buffer = jax.device_put(out_sh.buffer, dev)
+        else:
+            from ...tl.base import binfo_typed
+            binfo_typed(out_bi, out_sh.buffer.size)[:] = out_sh.buffer
+
+    sched = Schedule(team=hier_team, args=args)
+    t_in = _FnTask(stage_in)
+    sched.add_task(t_in)
+    sched.add_dep_on_schedule_start(t_in)
+    sched.add_task(inner)
+    inner.subscribe_dep(t_in, EventType.EVENT_COMPLETED)
+    t_out = _FnTask(stage_out)
+    sched.add_task(t_out)
+    t_out.subscribe_dep(inner, EventType.EVENT_COMPLETED)
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# allreduce RAB with on-device NODE stages
+# ---------------------------------------------------------------------------
+
+def _node_has_xla(hier_team) -> bool:
+    node = hier_team.sbgp(SbgpType.NODE)
+    return node is not None and any(
+        getattr(t, "NAME", "") == "xla" for t in node.tl_teams)
+
+
+def allreduce_rab_tpu_init(init_args, hier_team) -> CollTask:
+    """RAB over HBM buffers: node reduce (TL/XLA, ICI) -> leader D2H ->
+    leaders allreduce (host, DCN) -> leader H2D -> node bcast (TL/XLA).
+
+    Matches allreduce_rab.c:80 with the reference's CUDA TLs replaced by
+    compiled XLA programs for the intra-node stages. Falls back to the
+    fully-staged wrapper when the node unit has no XLA team (chips spread
+    across processes).
+    """
+    import jax
+
+    from .algs import allreduce_rab_init
+
+    if not _node_has_xla(hier_team):
+        return staged_init(init_args, hier_team, allreduce_rab_init)
+
+    args = init_args.args
+    node = hier_team.sbgp(SbgpType.NODE)
+    leaders = hier_team.sbgp(SbgpType.NODE_LEADERS)
+    count = int(args.dst.count)
+    dt = args.dst.datatype
+    nd = dt_numpy(dt)
+    esz = nd.itemsize
+    msg = count * esz
+    op = args.op if args.op is not None else ReductionOp.SUM
+    inner_op = ReductionOp.SUM if op == ReductionOp.AVG else op
+    team_size = hier_team.core_team.size
+    is_leader = node.sbgp.group_rank == 0
+    dev = _rank_device(hier_team, args)
+
+    sched = Schedule(team=hier_team, args=args)
+
+    # stage 1: on-device node reduce to the leader (ICI)
+    red_dst = BufferInfo(None, count, dt, mem_type=MemoryType.TPU)
+    red_args = CollArgs(coll_type=CollType.REDUCE, root=0,
+                        src=args.dst if args.is_inplace else args.src,
+                        dst=red_dst if is_leader else None,
+                        op=inner_op)
+    t_red = node.coll_init(red_args, MemoryType.TPU, msg)
+    sched.add_task(t_red)
+    sched.add_dep_on_schedule_start(t_red)
+    prev = t_red
+
+    # stages 2-4 (leader only): D2H, leaders host allreduce over DCN, H2D
+    if is_leader and leaders is not None and leaders.sbgp.is_member:
+        scratch = np.zeros(count, dtype=nd)
+
+        def d2h():
+            scratch[:] = np.asarray(red_dst.buffer).reshape(-1)[:count]
+
+        t_d2h = _FnTask(d2h)
+        sched.add_task(t_d2h)
+        t_d2h.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+
+        ar_args = CollArgs(coll_type=CollType.ALLREDUCE, op=inner_op,
+                           dst=BufferInfo(scratch, count, dt,
+                                          mem_type=MemoryType.HOST),
+                           flags=CollArgsFlags.IN_PLACE)
+        ar_args.src = ar_args.dst
+        t_ar = leaders.coll_init(ar_args, MemoryType.HOST, msg)
+        sched.add_task(t_ar)
+        t_ar.subscribe_dep(t_d2h, EventType.EVENT_COMPLETED)
+
+        def h2d():
+            buf = scratch
+            if op == ReductionOp.AVG:
+                buf = (buf / team_size).astype(nd)
+            red_dst.buffer = jax.device_put(buf, dev)
+
+        t_h2d = _FnTask(h2d)
+        sched.add_task(t_h2d)
+        t_h2d.subscribe_dep(t_ar, EventType.EVENT_COMPLETED)
+        prev = t_h2d
+    elif is_leader:
+        # single leader in its unit (degenerate): result already reduced
+        if op == ReductionOp.AVG:
+            def scale():
+                red_dst.buffer = (red_dst.buffer / team_size).astype(nd)
+            t_s = _FnTask(scale)
+            sched.add_task(t_s)
+            t_s.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+            prev = t_s
+
+    # stage 5: on-device node bcast from the leader into the user's dst
+    # (TL/XLA rebinds args.dst.buffer on every node member)
+    bc_src = args.dst
+    if is_leader:
+        def seed_dst():
+            args.dst.buffer = red_dst.buffer
+        t_seed = _FnTask(seed_dst)
+        sched.add_task(t_seed)
+        t_seed.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+        prev = t_seed
+    bc_args = CollArgs(coll_type=CollType.BCAST, root=0, src=bc_src)
+    t_bc = node.coll_init(bc_args, MemoryType.TPU, msg)
+    sched.add_task(t_bc)
+    t_bc.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+    return sched

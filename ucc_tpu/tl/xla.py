@@ -780,7 +780,10 @@ class TlXlaTeam(TlTeamBase):
 
 def _local_ctx_ranks(core_team) -> set:
     """Ctx ranks living in this process ((host, pid) match via the
-    proc-info table gathered at context address exchange)."""
+    proc-info table gathered at context address exchange). Uses the
+    PHYSICAL host identity — UCC_TOPO_FAKE_PPN rewrites the topology
+    host_hash to simulate multi-node teams, but the device rendezvous
+    cares about which ranks actually share this process."""
     import os
 
     from ..topo.proc_info import host_hash
@@ -788,7 +791,7 @@ def _local_ctx_ranks(core_team) -> set:
     out = set()
     storage = core_team.context.addr_storage
     for r, entry in enumerate(storage):
-        if (entry["proc"].host_hash, entry["proc"].pid) == me:
+        if (entry["proc"].phys_host_hash, entry["proc"].pid) == me:
             out.add(r)
     return out
 

@@ -23,9 +23,19 @@ class ProcInfo:
     numa_id: int = 0
     #: jax process index (multi-host pods); -1 when jax not initialized
     jax_process: int = -1
+    #: PHYSICAL host identity. host_hash above is the TOPOLOGY identity,
+    #: which UCC_TOPO_FAKE_PPN rewrites to simulate multi-node teams;
+    #: process-locality decisions (which ranks share this process's
+    #: device rendezvous) must use the real one. -1 = same as host_hash.
+    real_host_hash: int = -1
 
     def same_host(self, other: "ProcInfo") -> bool:
         return self.host_hash == other.host_hash
+
+    @property
+    def phys_host_hash(self) -> int:
+        return self.real_host_hash if self.real_host_hash != -1 \
+            else self.host_hash
 
 
 def host_hash(name: str = "") -> int:
@@ -48,5 +58,6 @@ def local_proc_info() -> ProcInfo:
                 jax_proc = jax.process_index()
         except Exception:  # noqa: BLE001
             jax_proc = -1
-    return ProcInfo(host_hash=host_hash(), pid=os.getpid(),
-                    jax_process=jax_proc)
+    hh = host_hash()
+    return ProcInfo(host_hash=hh, pid=os.getpid(), jax_process=jax_proc,
+                    real_host_hash=hh)
