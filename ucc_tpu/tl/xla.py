@@ -198,6 +198,11 @@ class XlaTeamShared:
             # deterministic proto: the lowest team rank's task (the program
             # must not depend on deposit order)
             proto = slot[min(slot)][1]
+            if proto.coll in (CollType.GATHER, CollType.GATHERV,
+                              CollType.SCATTER, CollType.REDUCE) and \
+                    len(self.devices) > 1:
+                self._launch_rooted(slot, proto)
+                return
             bufs = tuple(buf for _, (buf, _t) in sorted(slot.items()))
             cached = self.launch_cache.get(proto.tag)
             if cached is not None and len(cached[0]) == len(bufs) and \
@@ -249,6 +254,90 @@ class XlaTeamShared:
             logger.exception("xla collective launch failed")
             for rank, (_, task) in slot.items():
                 task.status = Status.ERR_NO_MESSAGE
+
+    # ------------------------------------------------------------------
+    def _launch_rooted(self, slot, proto) -> None:
+        """Rooted collectives as explicit data placement — the TPU-native
+        rooted algorithms (XLA collectives are all-variants; device_put IS
+        the point-to-point transfer primitive):
+
+        - gather(v): each rank's shard lands on the ROOT's device only —
+          (n-1)*count inbound at root, nothing anywhere else (the previous
+          replicated allgather moved n*count to EVERY rank);
+        - scatter: root's blocks are copied out O(count) total (previously
+          a whole-buffer bcast, n*count);
+        - reduce: psum_scatter program (each link carries (n-1)/n*count)
+          + reduced blocks concatenated on root only (the previous full
+          allreduce replicated the result everywhere).
+
+        Matches tl_ucp's rooted knomial algorithms in traffic shape
+        (gather/gather_knomial.c, scatter semantics, reduce dbt)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        args = proto.args
+        coll = proto.coll
+        n = len(self.devices)
+        root = int(args.root)
+        root_dev = self.devices[root]
+        nd = proto.np_dtype
+
+        def _flat(buf):
+            if isinstance(buf, np.ndarray):
+                return jnp.asarray(buf.reshape(-1))
+            return jnp.ravel(buf) if buf.ndim != 1 else buf
+
+        if coll in (CollType.GATHER, CollType.GATHERV):
+            vc = proto._vkey()
+            parts = []
+            for rank, (buf, task) in sorted(slot.items()):
+                flat = _flat(buf)
+                want = int(vc[rank]) if vc is not None else flat.size
+                if flat.size != want:
+                    flat = flat[:want] if flat.size > want else jnp.pad(
+                        flat, (0, want - flat.size))
+                parts.append(jax.device_put(flat, root_dev))
+            out = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            by_dev = {root_dev: out}
+        elif coll == CollType.SCATTER:
+            rbuf = _flat(slot[root][0])
+            blk = rbuf.size // n
+            shards = [jax.device_put(rbuf[i * blk:(i + 1) * blk],
+                                     self.devices[i]) for i in range(n)]
+            out = jax.make_array_from_single_device_arrays(
+                (n * blk,), NamedSharding(self.mesh, P("r")), shards)
+            by_dev = {d: s for d, s in zip(self.devices, shards)}
+        else:   # REDUCE: psum_scatter program + root-only block gather
+            from .. import ops
+            count = proto.src_count()
+            padded = count + (n - count % n if count % n else 0)
+            op = args.op if args.op is not None else ReductionOp.SUM
+            key = ("rooted_rs", op, nd.str, padded)
+            program = self.programs.get(key)
+            if program is None:
+                from ..utils.jaxshim import shard_map_compat
+
+                def body(x):
+                    return ops.reduce_scatter(x[None, :], op)[0]
+
+                program = jax.jit(shard_map_compat(
+                    body, self.mesh, P("r"), P("r")))
+                self.programs[key] = program
+            sharding = NamedSharding(self.mesh, P("r"))
+            shards = [jax.device_put(t.shard_for_launch(buf, padded),
+                                     self.devices[r])
+                      for r, (buf, t) in sorted(slot.items())]
+            garr = jax.make_array_from_single_device_arrays(
+                (n * padded,), sharding, shards)
+            rs_out = program(garr)
+            rs_by_dev = {s.device: s.data for s in rs_out.addressable_shards}
+            parts = [jax.device_put(rs_by_dev[d], root_dev)
+                     for d in self.devices]
+            out = jnp.concatenate(parts)[:count]
+            by_dev = {root_dev: out}
+        for rank, (_, task) in slot.items():
+            task.set_result(out, by_dev)
 
 
 # ---------------------------------------------------------------------------
