@@ -825,7 +825,7 @@ class TlXlaTeam(TlTeamBase):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            "tl/xla: device collision across team ranks")
         self._coll_tag = 0
-        key = (core_team.team_key, scope, "xla")
+        key = (core_team.team_key, scope, self.NAME)
         mesh = Mesh(np.array(devices), ("r",))
         n_local = sum(1 for gr in range(self.size)
                       if ctx_map.eval(gr) in _local_ctx_ranks(core_team))
