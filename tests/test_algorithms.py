@@ -24,7 +24,7 @@ def run_with_tune(tune, n, make_args, check, monkeypatch):
 
 
 class TestAllgatherAlgs:
-    @pytest.mark.parametrize("alg", ["ring", "bruck", "neighbor", "linear"])
+    @pytest.mark.parametrize("alg", ["ring", "bruck", "neighbor", "linear", "sparbit", "knomial"])
     @pytest.mark.parametrize("n", [2, 4, 6, 8])
     def test_allgather(self, alg, n, monkeypatch):
         per = 7
@@ -99,7 +99,7 @@ class TestBcastAlgs:
 
 
 class TestReduceAlgs:
-    @pytest.mark.parametrize("alg", ["knomial", "dbt"])
+    @pytest.mark.parametrize("alg", ["knomial", "dbt", "srg_knomial"])
     @pytest.mark.parametrize("n", [2, 3, 5, 8])
     def test_reduce(self, alg, n, monkeypatch):
         count = 50
@@ -208,3 +208,113 @@ class TestReduceScatterKnomial:
             src=BufferInfo(srcs[r], total, DataType.FLOAT32),
             dst=BufferInfo(dsts[r], per, DataType.FLOAT32),
             op=ReductionOp.SUM), check, monkeypatch)
+
+
+class TestNewRound2Algs:
+    """Round-2 algorithm gap closures (VERDICT missing #5): knomial
+    allgatherv, bidirectional reduce_scatter ring, hybrid alltoallv."""
+
+    @pytest.mark.parametrize("n", [2, 3, 5, 8])
+    def test_allgatherv_knomial(self, n, monkeypatch):
+        from ucc_tpu import BufferInfoV
+        counts = [(r % 3) + 1 for r in range(n)]
+        srcs = [np.arange(counts[r], dtype=np.int32) + 100 * r
+                for r in range(n)]
+        dsts = [np.zeros(sum(counts), np.int32) for _ in range(n)]
+
+        def check():
+            expect = np.concatenate(srcs)
+            for r in range(n):
+                np.testing.assert_array_equal(dsts[r], expect)
+
+        run_with_tune("allgatherv:@knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLGATHERV,
+            src=BufferInfo(srcs[r], counts[r], DataType.INT32),
+            dst=BufferInfoV(dsts[r], counts, None, DataType.INT32)),
+            check, monkeypatch)
+
+    @pytest.mark.parametrize("n", [2, 3, 4, 7, 8])
+    @pytest.mark.parametrize("count", [16, 37])
+    def test_reduce_scatter_ring_bidirectional(self, n, count, monkeypatch):
+        from ucc_tpu.utils.mathutils import block_count, block_offset
+        if count < n:
+            pytest.skip("count < team size")
+        srcs = [np.arange(count, dtype=np.float64) * (r + 1)
+                for r in range(n)]
+        dsts = [np.zeros(block_count(count, n, r), np.float64)
+                for r in range(n)]
+
+        def check():
+            expect = np.sum(srcs, axis=0)
+            for r in range(n):
+                off = block_offset(count, n, r)
+                np.testing.assert_allclose(
+                    dsts[r], expect[off:off + block_count(count, n, r)])
+
+        run_with_tune("reduce_scatter:@ring_bidirectional:inf", n,
+                      lambda r: CollArgs(
+                          coll_type=CollType.REDUCE_SCATTER,
+                          src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+                          dst=BufferInfo(dsts[r], dsts[r].size,
+                                         DataType.FLOAT64),
+                          op=ReductionOp.SUM), check, monkeypatch)
+
+    def test_reduce_scatter_bidir_avg(self, monkeypatch):
+        n, count = 4, 24
+        srcs = [np.full(count, r + 1.0, np.float64) for r in range(n)]
+        dsts = [np.zeros(count // n, np.float64) for _ in range(n)]
+
+        def check():
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r], 2.5)
+
+        run_with_tune("reduce_scatter:@ring_bidirectional:inf", n,
+                      lambda r: CollArgs(
+                          coll_type=CollType.REDUCE_SCATTER,
+                          src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+                          dst=BufferInfo(dsts[r], count // n,
+                                         DataType.FLOAT64),
+                          op=ReductionOp.AVG), check, monkeypatch)
+
+    @pytest.mark.parametrize("n", [2, 3, 5, 8])
+    def test_alltoallv_hybrid(self, n, monkeypatch):
+        """Mixed small/large pairs: larges go pairwise, smalls via the
+        Bruck forwarding phase."""
+        from ucc_tpu import BufferInfoV
+        from ucc_tpu.tl.host.alltoall import AlltoallvHybrid
+        rng = np.random.default_rng(7)
+        thresh = AlltoallvHybrid.SMALL_THRESH
+        # counts[s][d]: small (<= thresh) and large (> thresh) mixed
+        m = np.zeros((n, n), dtype=int)
+        for s_ in range(n):
+            for d in range(n):
+                m[s_][d] = int(rng.integers(0, 5)) if (s_ + d) % 2 == 0 \
+                    else thresh + int(rng.integers(1, 50))
+        srcs, dsts = [], []
+        for r in range(n):
+            scounts = [int(c) for c in m[r]]
+            rcounts = [int(m[p][r]) for p in range(n)]
+            srcs.append(np.arange(sum(scounts), dtype=np.int64) + 1000 * r)
+            dsts.append(np.zeros(sum(rcounts), np.int64))
+
+        def make(r):
+            scounts = [int(c) for c in m[r]]
+            rcounts = [int(m[p][r]) for p in range(n)]
+            return CollArgs(
+                coll_type=CollType.ALLTOALLV,
+                src=BufferInfoV(srcs[r], scounts, None, DataType.INT64),
+                dst=BufferInfoV(dsts[r], rcounts, None, DataType.INT64))
+
+        def check():
+            for r in range(n):
+                off = 0
+                for p in range(n):
+                    c = int(m[p][r])
+                    sd = int(np.sum(m[p][:r]))
+                    expect = (np.arange(int(np.sum(m[p])), dtype=np.int64)
+                              + 1000 * p)[sd:sd + c]
+                    np.testing.assert_array_equal(dsts[r][off:off + c],
+                                                  expect)
+                    off += c
+
+        run_with_tune("alltoallv:@hybrid:inf", n, make, check, monkeypatch)

@@ -16,6 +16,7 @@ import numpy as np
 from ...constants import dt_numpy
 from ...status import Status, UccError
 from ..base import binfo_typed
+from .knomial import largest_pow
 from .task import HostCollTask
 
 
@@ -160,3 +161,194 @@ class AllgatherLinear(HostCollTask):
             reqs.append(self.recv_nb(p, dst[p * blk:(p + 1) * blk],
                                      slot=130))
         yield from self.wait(*reqs)
+
+
+class AllgatherSparbit(HostCollTask):
+    """Sparbit allgather (allgather_sparbit.c, OMPI-derived): ceil(log2 n)
+    rounds with HALVING distances; at round i each rank ships all blocks
+    it has accumulated so far (minus an exclusion correction that makes
+    non-power-of-two sizes exact) to (me + distance). Latency-optimal like
+    Bruck but needs no final rotation — blocks land in place."""
+
+    def __init__(self, init_args, team, subset=None):
+        super().__init__(init_args, team, subset)
+        _require_divisible(init_args, self.gsize)
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        total = int(args.dst.count)
+        blk = total // size
+        dst = binfo_typed(args.dst, total)
+        if not args.is_inplace:
+            dst[me * blk:(me + 1) * blk] = binfo_typed(args.src, blk)
+        if size == 1:
+            return
+        tsize_log = (size - 1).bit_length()
+        last_ignore = (size & -size).bit_length() - 1   # ctz
+        ignore_steps = (~(size >> last_ignore) | 1) << last_ignore
+        data_expected = 1
+        for i in range(tsize_log):
+            distance = (1 << (tsize_log - 1)) >> i
+            sendto = (me + distance) % size
+            recvfrom = (me - distance) % size
+            exclusion = int((distance & ignore_steps) == distance)
+            reqs = []
+            for tc in range(data_expected - exclusion):
+                sb = (me - 2 * tc * distance) % size
+                rb = (me - (2 * tc + 1) * distance) % size
+                reqs.append(self.send_nb(
+                    sendto, dst[sb * blk:(sb + 1) * blk], slot=140 + i))
+                reqs.append(self.recv_nb(
+                    recvfrom, dst[rb * blk:(rb + 1) * blk], slot=140 + i))
+            yield from self.wait(*reqs)
+            data_expected = (data_expected << 1) - exclusion
+
+
+class _KnomialAllgatherBase(HostCollTask):
+    """Radix-k recursive-multiplying allgather over per-vrank segments —
+    one core for both the equal-block and the v variant
+    (allgather_knomial.c's GET_LOCAL_COUNT duality). Non-power-of-radix
+    sizes fold extra ranks onto proxies (knomial EXTRA/PROXY pattern);
+    a proxy's vrank segment carries both blocks, contiguous in a scratch
+    laid out by vrank, so every round moves contiguous ranges."""
+
+    RADIX = 2
+
+    def _counts(self) -> List[int]:
+        raise NotImplementedError
+
+    def _finish(self, scratch, v_offsets, vrank_of_team) -> None:
+        raise NotImplementedError
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        counts = self._counts()
+        nd = dt_numpy(args.dst.datatype)
+        radix = self.RADIX
+        full = largest_pow(size, radix)
+        if size - full > full:       # fold needs n_extra <= full
+            radix = 2
+            full = largest_pow(size, 2)
+        n_extra = size - full
+
+        my_cnt = counts[me]
+        my_src = np.empty(my_cnt, dtype=nd)
+        if args.is_inplace:
+            from ..base import binfo_v_block
+            if hasattr(args.dst, "counts"):
+                my_src[:] = binfo_v_block(args.dst, me)
+            else:
+                blk = int(args.dst.count) // size
+                my_src[:] = binfo_typed(args.dst)[me * blk:(me + 1) * blk]
+        else:
+            my_src[:] = binfo_typed(args.src, my_cnt)
+
+        if size == 1:
+            self._finish(my_src, [0, my_cnt], [0])
+            return
+
+        # vrank space: full ranks keep their id; extra e folds onto
+        # proxy e - full, whose vrank segment is [proxy blk][extra blk]
+        is_extra = me >= full
+        proxy = me - full if is_extra else None
+        v_counts = [counts[v] + (counts[full + v] if v < n_extra else 0)
+                    for v in range(full)]
+        v_offsets = list(np.cumsum([0] + v_counts))
+        total_v = v_offsets[-1]
+        scratch = np.empty(total_v, dtype=nd)
+
+        if is_extra:
+            yield from self.wait(self.send_nb(proxy, my_src, slot=150))
+            yield from self.wait(self.recv_nb(proxy, scratch, slot=151))
+            self._finish(scratch, v_offsets, list(range(full)))
+            return
+
+        seg_lo = v_offsets[me]
+        scratch[seg_lo:seg_lo + my_cnt] = my_src
+        if me < n_extra:
+            ex = np.empty(counts[full + me], dtype=nd)
+            yield from self.wait(self.recv_nb(full + me, ex, slot=150))
+            scratch[seg_lo + my_cnt:seg_lo + v_counts[me]] = ex
+
+        d = 1
+        rnd = 0
+        while d < full:
+            digit = (me // d) % radix
+            base = me - (me % (d * radix))
+            own_lo = base + digit * d
+            reqs = []
+            for j in range(radix):
+                if j == digit:
+                    continue
+                peer = base + j * d + (me % d)
+                p_lo = base + j * d
+                reqs.append(self.send_nb(
+                    peer, scratch[v_offsets[own_lo]:
+                                  v_offsets[min(own_lo + d, full)]],
+                    slot=152 + rnd))
+                reqs.append(self.recv_nb(
+                    peer, scratch[v_offsets[p_lo]:
+                                  v_offsets[min(p_lo + d, full)]],
+                    slot=152 + rnd))
+            yield from self.wait(*reqs)
+            d *= radix
+            rnd += 1
+
+        if me < n_extra:
+            yield from self.wait(self.send_nb(full + me, scratch, slot=151))
+        self._finish(scratch, v_offsets, list(range(full)))
+
+
+class AllgatherKnomial(_KnomialAllgatherBase):
+    """Equal-block radix-k allgather (allgather_knomial.c)."""
+
+    def __init__(self, init_args, team, subset=None, radix: int = 4):
+        super().__init__(init_args, team, subset)
+        _require_divisible(init_args, self.gsize)
+        self.RADIX = max(2, radix)
+
+    def _counts(self) -> List[int]:
+        blk = int(self.args.dst.count) // self.gsize
+        return [blk] * self.gsize
+
+    def _finish(self, scratch, v_offsets, vranks) -> None:
+        args = self.args
+        size = self.gsize
+        blk = int(args.dst.count) // size
+        dst = binfo_typed(args.dst, int(args.dst.count))
+        full = len(vranks)
+        for v in range(full):
+            seg = scratch[v_offsets[v]:v_offsets[v + 1]]
+            dst[v * blk:(v + 1) * blk] = seg[:blk]
+            if seg.size > blk:                      # proxy carried extra
+                e = full + v
+                dst[e * blk:(e + 1) * blk] = seg[blk:]
+
+
+class AllgathervKnomial(_KnomialAllgatherBase):
+    """Per-rank-count radix-k allgatherv (allgather_knomial.c with
+    KN_PATTERN_ALLGATHERV counts; tl_ucp_coll.c:207-233)."""
+
+    def __init__(self, init_args, team, subset=None, radix: int = 4):
+        super().__init__(init_args, team, subset)
+        if self.args.dst.counts is None:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "allgatherv requires dst counts")
+        self.RADIX = max(2, radix)
+
+    def _counts(self) -> List[int]:
+        return [int(c) for c in self.args.dst.counts]
+
+    def _finish(self, scratch, v_offsets, vranks) -> None:
+        from ..base import binfo_v_block
+        args = self.args
+        size = self.gsize
+        counts = self._counts()
+        full = len(vranks)
+        for v in range(full):
+            seg = scratch[v_offsets[v]:v_offsets[v + 1]]
+            binfo_v_block(args.dst, v)[:] = seg[:counts[v]]
+            if seg.size > counts[v]:
+                binfo_v_block(args.dst, full + v)[:] = seg[counts[v]:]

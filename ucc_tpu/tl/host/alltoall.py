@@ -144,3 +144,108 @@ class AlltoallvPairwise(HostCollTask):
                 reqs = []
         if reqs:
             yield from self.wait(*reqs)
+
+
+class AlltoallvHybrid(HostCollTask):
+    """Hybrid alltoallv (alltoallv_hybrid.c): per-pair routing split by a
+    size threshold. LARGE pairs exchange directly (pairwise, one message,
+    bandwidth-bound); SMALL pairs travel Bruck-style — log2(n) forwarding
+    rounds where rank me ships every pending small payload whose remaining
+    route has bit k set to (me + 2^k), aggregating many tiny messages into
+    one per round (latency-bound regime). This is the DCN-friendly shape:
+    few large flows plus O(log n) aggregated small flows instead of n*n
+    tiny ones.
+
+    Each forwarding round sends a metadata vector (int64 triples
+    (origin, dest, count)) and one concatenated payload; receivers land
+    finished payloads in dst and keep forwarding the rest.
+    """
+
+    #: per-pair element-count threshold below which messages are
+    #: aggregated through the Bruck phase
+    SMALL_THRESH = 256
+
+    def __init__(self, init_args, team, subset=None,
+                 thresh: int = None):
+        super().__init__(init_args, team, subset)
+        if self.args.is_inplace:
+            from ...status import Status, UccError
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "hybrid alltoallv: in-place not supported "
+                           "(pairwise serves it)")
+        self.thresh = thresh if thresh is not None else self.SMALL_THRESH
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        srcv: BufferInfoV = args.src
+        dstv: BufferInfoV = args.dst
+        nd = dt_numpy(dstv.datatype)
+        scounts = [int(c) for c in srcv.counts]
+        rcounts = [int(c) for c in dstv.counts]
+
+        # own block
+        own = binfo_v_block(srcv, me)
+        binfo_v_block(dstv, me)[:own.size] = own
+
+        # phase 1: direct pairwise for LARGE pairs (both ends derive the
+        # routing from their own counts — sender checks scount, receiver
+        # rcount; the threshold rule makes them agree)
+        reqs: List = []
+        for step in range(1, size):
+            to = (me + step) % size
+            frm = (me - step) % size
+            if scounts[to] > self.thresh:
+                reqs.append(self.send_nb(to, binfo_v_block(srcv, to),
+                                         slot=240))
+            if rcounts[frm] > self.thresh:
+                reqs.append(self.recv_nb(frm, binfo_v_block(dstv, frm),
+                                         slot=240))
+        yield from self.wait(*reqs)
+
+        # phase 2: Bruck forwarding of SMALL pairs
+        pending: List = []          # (origin, dest, np payload)
+        for p in range(size):
+            if p != me and 0 < scounts[p] <= self.thresh:
+                pending.append((me, p, np.ascontiguousarray(
+                    binfo_v_block(srcv, p))))
+        n_rounds = max(1, (size - 1).bit_length())
+        for k in range(n_rounds):
+            hop = 1 << k
+            to = (me + hop) % size
+            frm = (me - hop) % size
+            ship = [t for t in pending
+                    if (((t[1] - me) % size) >> k) & 1]
+            pending = [t for t in pending
+                       if not (((t[1] - me) % size) >> k) & 1]
+            meta = np.empty(1 + 3 * len(ship), dtype=np.int64)
+            meta[0] = len(ship)
+            payloads = []
+            for i, (orig, dest, data) in enumerate(ship):
+                meta[1 + 3 * i:4 + 3 * i] = (orig, dest, data.size)
+                payloads.append(data)
+            payload = np.concatenate(payloads) if payloads else \
+                np.empty(0, dtype=nd)
+            # metadata first (bounded recv + nbytes), then exact payload
+            meta_recv = np.empty(1 + 3 * size * size, dtype=np.int64)
+            sreq_m = self.send_nb(to, meta, slot=241 + 2 * k)
+            rreq_m = self.recv_nb(frm, meta_recv, slot=241 + 2 * k)
+            sreq_p = self.send_nb(to, payload, slot=242 + 2 * k)
+            yield from self.wait(sreq_m, rreq_m)
+            m = int(meta_recv[0])
+            in_total = int(sum(meta_recv[3 + 3 * i] for i in range(m)))
+            payload_in = np.empty(in_total, dtype=nd)
+            rreq_p = self.recv_nb(frm, payload_in, slot=242 + 2 * k)
+            yield from self.wait(sreq_p, rreq_p)
+            off = 0
+            for i in range(m):
+                orig, dest, cnt = (int(meta_recv[1 + 3 * i]),
+                                   int(meta_recv[2 + 3 * i]),
+                                   int(meta_recv[3 + 3 * i]))
+                data = payload_in[off:off + cnt]
+                off += cnt
+                if dest == me:
+                    binfo_v_block(dstv, orig)[:cnt] = data
+                else:
+                    pending.append((orig, dest, data.copy()))
+        assert not pending, "hybrid a2av: undelivered payloads"

@@ -228,3 +228,72 @@ def _blk_view(arr: np.ndarray, total: int, size: int, block: int) -> np.ndarray:
     off = block_offset(total, size, block)
     cnt = block_count(total, size, block)
     return arr[off:off + cnt]
+
+
+class ReduceScatterRingBidirectional(HostCollTask):
+    """Bidirectional reduce_scatter ring (the tl_ucp.h:82 bidirectional
+    ring): each rank-block is split in two sub-vectors; the first halves
+    reduce around a CLOCKWISE ring while the second halves reduce
+    COUNTER-CLOCKWISE, both directions of every full-duplex link busy each
+    step — halving the number of serial steps vs the one-way ring."""
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        op = args.op if args.op is not None else ReductionOp.SUM
+        red_op = ReductionOp.SUM if op == ReductionOp.AVG else op
+        if args.is_inplace:
+            total = int(args.dst.count)
+            work = binfo_typed(args.dst, total).copy()
+            out_block = _blk_view(binfo_typed(args.dst, total), total, size,
+                                  me)
+        else:
+            total = int(args.src.count)
+            work = binfo_typed(args.src, total).copy()
+            out_block = binfo_typed(args.dst, block_count(total, size, me))
+        dt = (args.src or args.dst).datatype
+        nd = dt_numpy(dt)
+        if size == 1:
+            res = work
+            if op == ReductionOp.AVG:
+                res = reduce_arrays([work], ReductionOp.SUM, dt, alpha=1.0)
+            out_block[:] = res[:out_block.size]
+            return
+
+        # sub-block b of rank-block r: A = first half (cw ring),
+        # B = second half (ccw ring); A_r + B_r tile total-block r exactly
+        def sub(block, half):
+            v = _blk_view(work, total, size, block)
+            mid = v.size // 2
+            return v[:mid] if half == 0 else v[mid:]
+
+        right = (me + 1) % size
+        left = (me - 1) % size
+        max_half = max(block_count(total, size, b) for b in range(size))
+        buf_a = np.empty(max_half, dtype=nd)
+        buf_b = np.empty(max_half, dtype=nd)
+        for step in range(size - 1):
+            # cw: block indices walk down (classic ring)
+            sa = (me - 1 - step) % size
+            ra = (me - 2 - step) % size
+            # ccw: mirror image — indices walk up
+            sb = (me + 1 + step) % size
+            rb = (me + 2 + step) % size
+            va = buf_a[:sub(ra, 0).size]
+            vb = buf_b[:sub(rb, 1).size]
+            reqs = [
+                self.send_nb(right, sub(sa, 0), slot=200 + step),
+                self.recv_nb(left, va, slot=200 + step),
+                self.send_nb(left, sub(sb, 1), slot=230 + step),
+                self.recv_nb(right, vb, slot=230 + step),
+            ]
+            yield from self.wait(*reqs)
+            acc_a = sub(ra, 0)
+            acc_a[:] = reduce_arrays([acc_a, va], red_op, dt)
+            acc_b = sub(rb, 1)
+            acc_b[:] = reduce_arrays([acc_b, vb], red_op, dt)
+        mine = _blk_view(work, total, size, me)
+        if op == ReductionOp.AVG:
+            mine = reduce_arrays([mine], ReductionOp.SUM, dt,
+                                 alpha=1.0 / size)
+        out_block[:] = mine

@@ -113,3 +113,119 @@ class AllreduceSraKnomial(HostCollTask):
         # PROXY unfold
         if me < n_extra:
             yield from self.wait(self.send_nb(full + me, dst, slot=1))
+
+
+class ReduceSrgKnomial(HostCollTask):
+    """SRG reduce (reduce_srg_knomial.c): Scatter-Reduce + Gather — the
+    bandwidth-optimal rooted reduce for large vectors. Phase 1 is the same
+    recursive vector-halving reduce-scatter SRA uses; phase 2 gathers the
+    reduced segments to the root instead of allgathering them. AVG runs
+    SUM with each owner scaling its segment before the gather."""
+
+    def __init__(self, init_args, team, subset=None):
+        super().__init__(init_args, team, subset)
+        args = init_args.args
+        src_bi = args.dst if args.is_inplace or args.src is None else args.src
+        self.count = int(src_bi.count)
+        self.dt = src_bi.datatype
+        self.op = args.op if args.op is not None else ReductionOp.SUM
+        self.root = int(args.root)
+
+    @staticmethod
+    def _segment_of(rank: int, count: int, full: int) -> Tuple[int, int]:
+        """Replay the halving splits: the (lo, hi) segment `rank` owns
+        after the reduce-scatter phase (pure function, both ends agree)."""
+        lo, hi = 0, count
+        dist = full // 2
+        while dist >= 1:
+            mid = lo + (hi - lo) // 2
+            lo, hi = (lo, mid) if rank & dist == 0 else (mid, hi)
+            dist //= 2
+        return lo, hi
+
+    def run(self):
+        from ..base import binfo_typed
+        args = self.args
+        size, me = self.gsize, self.grank
+        nd = dt_numpy(self.dt)
+        op = ReductionOp.SUM if self.op == ReductionOp.AVG else self.op
+        is_root = me == self.root
+
+        # workspace: root reduces straight into dst; others into scratch
+        if is_root and args.dst is not None and args.dst.buffer is not None \
+                and not args.is_inplace:
+            work = binfo_typed(args.dst, self.count)
+            work[:] = binfo_typed(args.src, self.count)
+        elif is_root and args.is_inplace:
+            work = binfo_typed(args.dst, self.count)
+        else:
+            work = np.empty(self.count, dtype=nd)
+            src_bi = args.dst if args.is_inplace else args.src
+            work[:] = binfo_typed(src_bi, self.count)
+
+        if size == 1:
+            if self.op == ReductionOp.AVG:
+                work[:] = reduce_arrays([work], ReductionOp.SUM, self.dt,
+                                        alpha=1.0)
+            return
+
+        full = largest_pow(size, 2)
+        n_extra = size - full
+
+        # EXTRA fold (knomial pattern): extras hand their vector to the
+        # proxy; an extra ROOT receives the final result back
+        if me >= full:
+            proxy = me - full
+            yield from self.wait(self.send_nb(proxy, work, slot=170))
+            if is_root:
+                yield from self.wait(self.recv_nb(proxy, work, slot=171))
+            return
+        if me < n_extra:
+            extra = np.empty(self.count, dtype=nd)
+            yield from self.wait(self.recv_nb(full + me, extra, slot=170))
+            work[:] = reduce_arrays([work, extra], op, self.dt)
+
+        # phase 1: recursive vector halving reduce-scatter
+        lo, hi = 0, self.count
+        dist = full // 2
+        scratch = np.empty((self.count + 1) // 2, dtype=nd)
+        rnd = 0
+        while dist >= 1:
+            partner = me ^ dist
+            mid = lo + (hi - lo) // 2
+            if me & dist == 0:
+                keep, give = (lo, mid), (mid, hi)
+            else:
+                keep, give = (mid, hi), (lo, mid)
+            rview = scratch[:keep[1] - keep[0]]
+            yield from self.sendrecv(partner, work[give[0]:give[1]],
+                                     partner, rview, slot=172 + rnd)
+            seg = work[keep[0]:keep[1]]
+            seg[:] = reduce_arrays([seg, rview], op, self.dt)
+            lo, hi = keep
+            dist //= 2
+            rnd += 1
+
+        if self.op == ReductionOp.AVG and hi > lo:
+            work[lo:hi] = reduce_arrays([work[lo:hi]], ReductionOp.SUM,
+                                        self.dt, alpha=1.0 / size)
+
+        # phase 2: gather segments to the root (root's proxy when the
+        # root is an extra rank)
+        sink = self.root if self.root < full else self.root - full
+        if me == sink:
+            reqs = []
+            for p in range(full):
+                if p == sink:
+                    continue
+                plo, phi = self._segment_of(p, self.count, full)
+                if phi > plo:
+                    reqs.append(self.recv_nb(p, work[plo:phi], slot=190))
+            yield from self.wait(*reqs)
+            if self.root >= full:           # forward to the extra root
+                yield from self.wait(self.send_nb(self.root, work,
+                                                  slot=171))
+            elif not is_root:
+                pass
+        elif hi > lo:
+            yield from self.wait(self.send_nb(sink, work[lo:hi], slot=190))

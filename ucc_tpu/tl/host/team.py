@@ -19,9 +19,11 @@ from ...score.score import CollScore
 from ...status import Status, UccError
 from ...utils.ep_map import EpMap, EpMapType, Subset
 from ..base import AlgSpec, TlTeamBase, build_scores
-from .allgather import (AllgatherBruck, AllgatherLinear, AllgatherNeighbor)
+from .allgather import (AllgatherBruck, AllgatherKnomial, AllgatherLinear,
+                        AllgatherNeighbor, AllgatherSparbit,
+                        AllgathervKnomial)
 from .alltoall import (AlltoallBruck, AlltoallLinear, AlltoallPairwise,
-                       AlltoallvPairwise)
+                       AlltoallvHybrid, AlltoallvPairwise)
 from .dbt import BcastDbt, ReduceDbt
 from .knomial import (AllreduceKnomial, BarrierKnomial, BcastKnomial,
                       FaninKnomial, FanoutKnomial, GatherLinear,
@@ -29,8 +31,9 @@ from .knomial import (AllreduceKnomial, BarrierKnomial, BcastKnomial,
 from .knomial2 import (BcastSagKnomial, GatherKnomial, ReduceScatterKnomial,
                        ScatterKnomial)
 from .ring import (AllgatherRing, AllgathervRing, AllreduceRing,
-                   ReduceScatterRing, ReduceScattervRing)
-from .sra import AllreduceSraKnomial
+                   ReduceScatterRing, ReduceScatterRingBidirectional,
+                   ReduceScattervRing)
+from .sra import AllreduceSraKnomial, ReduceSrgKnomial
 from .task import HostCollTask
 from .transport import Mailbox, TagKey
 
@@ -154,9 +157,15 @@ class HostTlTeam(TlTeamBase):
                 spec(2, "neighbor", AllgatherNeighbor,
                      sel=f"0-8k:{S - 4},8k-inf:{S + 3}"),
                 spec(3, "linear", AllgatherLinear),
+                spec(4, "sparbit", AllgatherSparbit,
+                     sel=f"0-8k:{S + 4},8k-inf:{S - 3}"),
+                spec(5, "knomial", AllgatherKnomial,
+                     sel=f"0-8k:{S + 3},8k-inf:{S - 1}"),
             ],
             CollType.ALLGATHERV: [
                 spec(0, "ring", AllgathervRing),
+                spec(1, "knomial", AllgathervKnomial,
+                     sel=f"0-8k:{S + 2},8k-inf:{S - 1}"),
             ],
             CollType.ALLTOALL: [
                 spec(0, "pairwise", AlltoallPairwise,
@@ -167,6 +176,7 @@ class HostTlTeam(TlTeamBase):
             ],
             CollType.ALLTOALLV: [
                 spec(0, "pairwise", AlltoallvPairwise),
+                spec(1, "hybrid", AlltoallvHybrid),
             ],
             CollType.BARRIER: [
                 spec(0, "knomial", BarrierKnomial),
@@ -198,11 +208,16 @@ class HostTlTeam(TlTeamBase):
                      sel=f"0-8k:{S + 5},8k-inf:{S - 3}"),
                 spec(1, "dbt", ReduceDbt,
                      sel=f"0-8k:{S - 3},8k-inf:{S + 5}"),
+                spec(2, "srg_knomial", ReduceSrgKnomial,
+                     sel=f"0-8k:{S - 4},8k-inf:{S + 4}"),
             ],
             CollType.REDUCE_SCATTER: [
                 spec(0, "ring", ReduceScatterRing),
                 spec(1, "knomial", ReduceScatterKnomial,
                      sel=f"0-8k:{S + 3},8k-inf:{S - 2}"),
+                spec(2, "ring_bidirectional",
+                     ReduceScatterRingBidirectional,
+                     sel=f"0-8k:{S - 1},8k-inf:{S + 4}"),
             ],
             CollType.REDUCE_SCATTERV: [
                 spec(0, "ring", ReduceScattervRing),
