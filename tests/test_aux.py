@@ -105,8 +105,41 @@ class TestGenericDatatype:
 
 
 class TestDtConsistency:
-    """Scoped to gather/scatter family, opt-in via UCC_CHECK_ASYMMETRIC_DT
-    (reference defaults it off for performance, ucc_global_opts.c:112)."""
+    """Rooted colls (gather/scatter family + bcast/reduce), opt-in via
+    UCC_CHECK_ASYMMETRIC_DT (reference defaults it off for performance,
+    ucc_global_opts.c:112, and scopes it to gather/scatter only —
+    ucc_coll.c:274-277; we also wrap bcast/reduce)."""
+
+    @pytest.mark.parametrize("coll", [CollType.BCAST, CollType.REDUCE])
+    def test_asymmetric_dtype_detected_bcast_reduce(self, coll):
+        job = UccJob(2, lib_overrides={"CHECK_ASYMMETRIC_DT": "y"})
+        try:
+            teams = job.create_team()
+            count = 4
+            dts = [DataType.FLOAT32, DataType.INT32]
+            nds = [np.float32, np.int32]
+            reqs = []
+            for r in range(2):
+                if coll == CollType.BCAST:
+                    args = CollArgs(coll_type=coll, root=0,
+                                    src=BufferInfo(np.ones(count, nds[r]),
+                                                   count, dts[r]))
+                else:
+                    args = CollArgs(
+                        coll_type=coll, root=0, op=ReductionOp.SUM,
+                        src=BufferInfo(np.ones(count, nds[r]), count,
+                                       dts[r]),
+                        dst=BufferInfo(np.zeros(count, nds[r]), count,
+                                       dts[r]) if r == 0 else None)
+                reqs.append(teams[r].collective_init(args))
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs), timeout=15)
+            assert reqs[0].test() == Status.ERR_INVALID_PARAM
+            assert reqs[1].test() == Status.ERR_INVALID_PARAM
+        finally:
+            job.cleanup()
 
     def test_asymmetric_dtype_detected(self):
         job = UccJob(2, lib_overrides={"CHECK_ASYMMETRIC_DT": "y"})

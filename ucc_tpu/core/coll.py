@@ -208,12 +208,16 @@ def _maybe_wrap_dt_check(task: CollTask, args: CollArgs, team: Team,
     """Rooted colls optionally get a dt-validation schedule prefix
     (ucc_coll.c:274-289)."""
     from ..constants import DataType, EventType, GenericDataType
-    # scoped to the gather/scatter family like the reference; note the
-    # zero-size fast path means a rank posting all-zero counts skips the
-    # check (same property as ucc_coll.c:191 vs :274)
+    # the reference scopes this to the gather/scatter family
+    # (ucc_coll.c:274-277); we additionally wrap bcast/reduce — the same
+    # root-vs-leaf dt asymmetry can corrupt them. Note the zero-size fast
+    # path means a rank posting all-zero counts skips the check (same
+    # property as ucc_coll.c:191 vs :274). Active-set colls are excluded:
+    # only the subset posts, but the validation allreduce is team-wide.
     checked = (CollType.GATHER | CollType.GATHERV | CollType.SCATTER
-               | CollType.SCATTERV)
-    if not (args.coll_type & checked) or team.size <= 1:
+               | CollType.SCATTERV | CollType.BCAST | CollType.REDUCE)
+    if not (args.coll_type & checked) or team.size <= 1 or \
+            args.active_set is not None:
         return task
     if not team.context.lib.config.check_asymmetric_dt:
         return task
