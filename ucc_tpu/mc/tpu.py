@@ -62,17 +62,41 @@ class McTpu(MemoryComponent):
         self._pool.setdefault(key, []).append(buf)
 
     def memcpy(self, dst: Any, src: Any, size_bytes: int) -> Any:
-        """Host<->HBM staging with byte semantics matching McCpu:
-        exactly size_bytes move, landing in dst's shape/dtype. jax.Arrays
-        are immutable, so device destinations return the new array (caller
-        rebinds); host destinations are filled in place."""
+        """Byte semantics matching McCpu: exactly size_bytes move, landing
+        in dst's shape/dtype. jax.Arrays are immutable, so device
+        destinations return the new array (caller rebinds); host
+        destinations are filled in place.
+
+        Device destinations never round-trip the DESTINATION through host:
+        - full-buffer copy, same dtype: one device_put (D2D when src is on
+          another device, H2D when src is host memory);
+        - partial copy: the kept tail is sliced on device and concatenated
+          with the incoming prefix there (bitcast to bytes), so only the
+          src prefix ever crosses host<->device."""
         import jax
+        import jax.numpy as jnp
         if isinstance(dst, np.ndarray):
             host = np.asarray(src).reshape(-1).view(np.uint8)[:size_bytes]
             dst.reshape(-1).view(np.uint8)[:size_bytes] = host
             return dst
         dev = list(dst.devices())[0] if isinstance(dst, jax.Array) else \
             self.device
+        if size_bytes >= dst.nbytes and np.dtype(src.dtype) == \
+                np.dtype(dst.dtype):
+            flat = src if isinstance(src, jax.Array) else jnp.asarray(src)
+            flat = jnp.ravel(flat)[:dst.size]
+            return jax.device_put(flat.reshape(dst.shape), dev)
+        esz = np.dtype(dst.dtype).itemsize
+        if size_bytes % esz == 0 and np.dtype(src.dtype) == \
+                np.dtype(dst.dtype):
+            k = size_bytes // esz
+            prefix = jax.device_put(jnp.ravel(
+                src if isinstance(src, jax.Array) else jnp.asarray(src))[:k],
+                dev)
+            tail = jnp.ravel(dst)[k:]          # stays on device
+            out = jnp.concatenate([prefix, tail]) if tail.size else prefix
+            return out.reshape(dst.shape)
+        # odd byte counts: host staging fallback (rare; sub-element copy)
         dst_host = np.array(dst).reshape(-1)
         src_u8 = np.asarray(src).reshape(-1).view(np.uint8)[:size_bytes]
         dst_host.view(np.uint8)[:size_bytes] = src_u8
@@ -80,10 +104,23 @@ class McTpu(MemoryComponent):
 
     def memset(self, buf: Any, value: int, size_bytes: int) -> Any:
         import jax
+        import jax.numpy as jnp
         if isinstance(buf, np.ndarray):
             buf.reshape(-1).view(np.uint8)[:size_bytes] = value
             return buf
         dev = list(buf.devices())[0]
+        esz = np.dtype(buf.dtype).itemsize
+        if size_bytes % esz == 0:
+            # replicate the byte across one element host-side (esz bytes),
+            # then fill/concatenate ON DEVICE — no buffer-sized transfer
+            pat = np.frombuffer(bytes([value & 0xFF]) * esz,
+                                dtype=buf.dtype)[0]
+            k = size_bytes // esz
+            filled = jnp.full((k,), pat, dtype=buf.dtype)
+            tail = jnp.ravel(buf)[k:]
+            out = jnp.concatenate([jax.device_put(filled, dev), tail]) \
+                if tail.size else jax.device_put(filled, dev)
+            return out.reshape(buf.shape)
         host = np.array(buf).reshape(-1)
         host.view(np.uint8)[:size_bytes] = value
         return jax.device_put(host.reshape(buf.shape), dev)
