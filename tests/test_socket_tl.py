@@ -117,3 +117,222 @@ def test_socket_tl_three_processes():
             base = 100 * p
             expect += [base + r * 2, base + r * 2 + 1]
         assert results[r]["alltoall"] == expect
+
+
+# ---------------------------------------------------------------------------
+# round-2 sweep: colls x dtypes x sizes x team shapes over real processes
+# (the reference test/mpi matrix, main.cc:19-66)
+# ---------------------------------------------------------------------------
+
+def _sweep_cases(size):
+    """Case list; expectations computed by the parent with numpy."""
+    return [
+        {"coll": "allreduce", "dt": "f32", "count": 8, "op": "sum"},
+        {"coll": "allreduce", "dt": "f64", "count": 32768, "op": "avg"},
+        {"coll": "allreduce", "dt": "i32", "count": 1000, "op": "max"},
+        {"coll": "bcast", "dt": "i32", "count": 8, "root": 1 % size},
+        {"coll": "bcast", "dt": "f64", "count": 16384, "root": size - 1},
+        {"coll": "reduce", "dt": "f64", "count": 1000, "op": "sum",
+         "root": 0},
+        {"coll": "allgather", "dt": "i64", "count": 5},
+        {"coll": "allgatherv", "dt": "i32",
+         "counts": [(r % 3) + 1 for r in range(size)]},
+        {"coll": "alltoall", "dt": "i32", "count": 3 * size},
+        {"coll": "reduce_scatter", "dt": "f32", "count": 4 * size,
+         "op": "sum"},
+        {"coll": "gather", "dt": "i32", "count": 4, "root": 0},
+        {"coll": "scatter", "dt": "f32", "count": 3 * size,
+         "root": min(2, size - 1)},
+        {"coll": "barrier"},
+    ]
+
+
+_DTS = {"f32": ("FLOAT32", "float32"), "f64": ("FLOAT64", "float64"),
+        "i32": ("INT32", "int32"), "i64": ("INT64", "int64")}
+
+
+def _case_src(case, rank, size):
+    nd = np.dtype(_DTS[case["dt"]][1]) if "dt" in case else None
+    c = case.get("count", 0)
+    coll = case["coll"]
+    if coll in ("allreduce", "reduce", "reduce_scatter"):
+        return (np.arange(c) % 7 + rank + 1).astype(nd)
+    if coll == "bcast":
+        return (np.arange(c) * 3).astype(nd) if rank == case["root"] else \
+            np.zeros(c, nd)
+    if coll == "allgather":
+        return (np.arange(c) + 100 * rank).astype(nd)
+    if coll == "allgatherv":
+        return (np.arange(case["counts"][rank]) + 100 * rank).astype(nd)
+    if coll == "alltoall":
+        return (np.arange(c) + 100 * rank).astype(nd)
+    if coll == "gather":
+        return (np.arange(c) + 10 * rank).astype(nd)
+    if coll == "scatter":
+        return (np.arange(c) * 2).astype(nd)
+    return None
+
+
+def _sweep_worker(rank, size, port, q):
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["UCC_TLS"] = "socket,self"
+        import ucc_tpu
+        from ucc_tpu import (BufferInfo, BufferInfoV, CollArgs, CollType,
+                             ContextParams, DataType, ReductionOp,
+                             TcpStoreOob, TeamParams)
+        OPS = {"sum": ReductionOp.SUM, "avg": ReductionOp.AVG,
+               "max": ReductionOp.MAX}
+        COLLS = {"allreduce": CollType.ALLREDUCE, "bcast": CollType.BCAST,
+                 "reduce": CollType.REDUCE, "allgather": CollType.ALLGATHER,
+                 "allgatherv": CollType.ALLGATHERV,
+                 "alltoall": CollType.ALLTOALL,
+                 "reduce_scatter": CollType.REDUCE_SCATTER,
+                 "gather": CollType.GATHER, "scatter": CollType.SCATTER,
+                 "barrier": CollType.BARRIER}
+        oob = TcpStoreOob(rank, size, port=port)
+        lib = ucc_tpu.init()
+        ctx = ucc_tpu.Context(lib, ContextParams(oob=oob))
+        team = ctx.create_team(TeamParams(
+            oob=TcpStoreOob(rank, size, port=port + 1)))
+        results = {}
+        for i, case in enumerate(_sweep_cases(size)):
+            coll = case["coll"]
+            if coll == "barrier":
+                req = team.collective_init(CollArgs(
+                    coll_type=CollType.BARRIER))
+                req.post()
+                req.wait(timeout=90)
+                results[i] = "ok"
+                continue
+            dt = getattr(DataType, _DTS[case["dt"]][0])
+            nd = np.dtype(_DTS[case["dt"]][1])
+            src = _case_src(case, rank, size)
+            kw = {"coll_type": COLLS[coll]}
+            if "op" in case:
+                kw["op"] = OPS[case["op"]]
+            if "root" in case:
+                kw["root"] = case["root"]
+            out = None
+            if coll in ("allreduce",):
+                out = np.zeros(case["count"], nd)
+                kw["src"] = BufferInfo(src, src.size, dt)
+                kw["dst"] = BufferInfo(out, out.size, dt)
+            elif coll == "bcast":
+                kw["src"] = BufferInfo(src, src.size, dt)
+                out = src
+            elif coll == "reduce":
+                kw["src"] = BufferInfo(src, src.size, dt)
+                if rank == case["root"]:
+                    out = np.zeros(case["count"], nd)
+                    kw["dst"] = BufferInfo(out, out.size, dt)
+            elif coll == "allgather":
+                out = np.zeros(case["count"] * size, nd)
+                kw["src"] = BufferInfo(src, src.size, dt)
+                kw["dst"] = BufferInfo(out, out.size, dt)
+            elif coll == "allgatherv":
+                counts = case["counts"]
+                out = np.zeros(sum(counts), nd)
+                kw["src"] = BufferInfo(src, src.size, dt)
+                kw["dst"] = BufferInfoV(out, counts, None, dt)
+            elif coll == "alltoall":
+                out = np.zeros(case["count"], nd)
+                kw["src"] = BufferInfo(src, src.size, dt)
+                kw["dst"] = BufferInfo(out, out.size, dt)
+            elif coll == "reduce_scatter":
+                out = np.zeros(case["count"] // size, nd)
+                kw["src"] = BufferInfo(src, src.size, dt)
+                kw["dst"] = BufferInfo(out, out.size, dt)
+            elif coll == "gather":
+                kw["src"] = BufferInfo(src, src.size, dt)
+                if rank == case["root"]:
+                    out = np.zeros(case["count"] * size, nd)
+                    kw["dst"] = BufferInfo(out, out.size, dt)
+            elif coll == "scatter":
+                out = np.zeros(case["count"] // size, nd)
+                if rank == case["root"]:
+                    kw["src"] = BufferInfo(src, src.size, dt)
+                kw["dst"] = BufferInfo(out, out.size, dt)
+            req = team.collective_init(CollArgs(**kw))
+            req.post()
+            req.wait(timeout=90)
+            results[i] = out.tolist() if out is not None else "ok"
+        q.put((rank, results))
+        ctx.destroy()
+        if rank == 0:
+            oob.close()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        q.put((rank, {"error": f"{e}\n{traceback.format_exc()}"}))
+
+
+def _sweep_expect(case, size, rank):
+    if case["coll"] == "barrier":
+        return "ok"
+    nd = np.dtype(_DTS[case["dt"]][1])
+    srcs = [_case_src(case, r, size) for r in range(size)]
+    coll = case["coll"]
+    if coll == "allreduce":
+        if case["op"] == "sum":
+            return np.sum(srcs, axis=0).astype(nd).tolist()
+        if case["op"] == "avg":
+            return (np.sum(srcs, axis=0) / size).astype(nd).tolist()
+        return np.max(srcs, axis=0).astype(nd).tolist()
+    if coll == "bcast":
+        return srcs[case["root"]].tolist()
+    if coll == "reduce":
+        return np.sum(srcs, axis=0).astype(nd).tolist() \
+            if rank == case["root"] else None
+    if coll == "allgather":
+        return np.concatenate(srcs).tolist()
+    if coll == "allgatherv":
+        return np.concatenate(srcs).tolist()
+    if coll == "alltoall":
+        blk = case["count"] // size
+        return np.concatenate(
+            [srcs[p][rank * blk:(rank + 1) * blk] for p in range(size)]
+        ).tolist()
+    if coll == "reduce_scatter":
+        blk = case["count"] // size
+        full = np.sum(srcs, axis=0).astype(nd)
+        return full[rank * blk:(rank + 1) * blk].tolist()
+    if coll == "gather":
+        return np.concatenate(srcs).tolist() if rank == case["root"] \
+            else None
+    if coll == "scatter":
+        blk = case["count"] // size
+        return srcs[case["root"]][rank * blk:(rank + 1) * blk].tolist()
+    raise AssertionError(coll)
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_socket_tl_sweep(size):
+    """13 cases x {2,4}-process teams over real TCP: coll x dtype x size
+    matrix in the reference test/mpi style."""
+    port = _free_port_pair()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_sweep_worker, args=(r, size, port, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(size):
+        rank, res = q.get(timeout=240)
+        results[rank] = res
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    for r in range(size):
+        assert "error" not in results[r], results[r].get("error")
+    for i, case in enumerate(_sweep_cases(size)):
+        for r in range(size):
+            expect = _sweep_expect(case, size, r)
+            if expect is None:
+                continue
+            got = results[r][i]
+            if case.get("dt", "").startswith("f"):
+                np.testing.assert_allclose(got, expect, rtol=1e-6), (i, r)
+            else:
+                assert got == expect, (i, case, r)

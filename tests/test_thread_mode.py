@@ -68,3 +68,80 @@ class TestThreadModeMultiple:
             expect = (it + 1) * n * (n + 1) / 2
             for r in range(n):
                 assert results[r][it] == expect, (r, it)
+
+
+class TestThreadModeStress:
+    def test_concurrent_collectives_two_teams(self):
+        """MULTIPLE-mode stress: every rank thread keeps TWO collectives
+        in flight at once (one per team, posted before either is waited),
+        across mixed coll types and several iterations — exercises the MT
+        progress queue under genuine cross-thread concurrency."""
+        n, iters = 4, 6
+        world = ThreadOobWorld(n)
+        libs = [ucc_tpu.init(LibParams(thread_mode=ThreadMode.MULTIPLE))
+                for _ in range(n)]
+        ctxs = [None] * n
+
+        def mk(r):
+            ctxs[r] = Context(libs[r], ContextParams(oob=world.endpoint(r)))
+
+        ths = [threading.Thread(target=mk, args=(r,)) for r in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+
+        tw_a, tw_b = ThreadOobWorld(n), ThreadOobWorld(n)
+        errors = []
+        sums = [[None] * iters for _ in range(n)]
+        gathers = [[None] * iters for _ in range(n)]
+
+        def rank_main(r):
+            try:
+                team_a = ctxs[r].create_team(TeamParams(oob=tw_a.endpoint(r)))
+                team_b = ctxs[r].create_team(TeamParams(oob=tw_b.endpoint(r)))
+                count = 128
+                for it in range(iters):
+                    src_a = np.full(count, (r + 1) * (it + 1), np.float64)
+                    dst_a = np.zeros(count, np.float64)
+                    req_a = team_a.collective_init(CollArgs(
+                        coll_type=CollType.ALLREDUCE,
+                        src=BufferInfo(src_a, count, DataType.FLOAT64),
+                        dst=BufferInfo(dst_a, count, DataType.FLOAT64),
+                        op=ReductionOp.SUM))
+                    src_b = np.full(8, r * 10 + it, np.int64)
+                    dst_b = np.zeros(8 * n, np.int64)
+                    req_b = team_b.collective_init(CollArgs(
+                        coll_type=CollType.ALLGATHER,
+                        src=BufferInfo(src_b, 8, DataType.INT64),
+                        dst=BufferInfo(dst_b, 8 * n, DataType.INT64)))
+                    # both in flight before either completes
+                    req_a.post()
+                    req_b.post()
+                    req_b.wait(timeout=90)
+                    req_a.wait(timeout=90)
+                    sums[r][it] = float(dst_a[0])
+                    gathers[r][it] = dst_b.copy()
+                    # interleave a barrier on team A while team B idles
+                    bar = team_a.collective_init(CollArgs(
+                        coll_type=CollType.BARRIER))
+                    bar.post()
+                    bar.wait(timeout=90)
+            except Exception as e:  # noqa: BLE001
+                import traceback
+                errors.append((r, e, traceback.format_exc()))
+
+        ths = [threading.Thread(target=rank_main, args=(r,))
+               for r in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=240)
+        assert not errors, errors[0]
+        for it in range(iters):
+            expect_sum = (it + 1) * n * (n + 1) / 2
+            expect_g = np.concatenate(
+                [np.full(8, p * 10 + it, np.int64) for p in range(n)])
+            for r in range(n):
+                assert sums[r][it] == expect_sum, (r, it)
+                np.testing.assert_array_equal(gathers[r][it], expect_g)
