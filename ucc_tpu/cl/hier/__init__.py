@@ -20,8 +20,12 @@ CL_HIER_CONFIG = register_table(ConfigTable(
                     "pipeline spec for RAB allreduce, e.g. "
                     "thresh=64K:fragsize=1M:nfrags=4:pdepth=2:ordered",
                     parse_string),
+        ConfigField("ALLREDUCE_SPLIT_RAIL_PIPELINE", "n",
+                    "pipeline spec for split_rail allreduce (same syntax "
+                    "as ALLREDUCE_RAB_PIPELINE; cl_hier.h:54-57)",
+                    parse_string),
         ConfigField("A2AV_NODE_THRESH", "1k",
-                    "alltoallv node-aggregation threshold (reserved)",
+                    "alltoall(v) node-aggregation threshold",
                     parse_string),
     ]))
 

@@ -314,11 +314,152 @@ def split_rail_build(hier_team, init_args) -> CollTask:
     if node is None or net is None:
         raise UccError(Status.ERR_NOT_SUPPORTED,
                        "split_rail requires NODE and NET units (equal ppn)")
+    args = init_args.args
+    count = int(args.dst.count)
     # in-place reduce_scatter with near-equal splits requires count >= ppn
-    if int(init_args.args.dst.count) < node.sbgp.size:
+    if count < node.sbgp.size:
         raise UccError(Status.ERR_NOT_SUPPORTED,
                        "split_rail needs count >= node size")
-    return SplitRailAllreduce(hier_team, init_args)
+
+    # optional fragmentation pipeline (cl_hier.h:54-57: the reference
+    # pipelines per-alg; DCN transfers of fragment k overlap the node
+    # reduce_scatter/allgather of fragment k+1)
+    cfg = hier_team.comp_context.config
+    pp = None
+    if cfg is not None:
+        try:
+            pp = parse_pipeline_params(cfg.get("ALLREDUCE_SPLIT_RAIL_PIPELINE"))
+        except KeyError:
+            pp = None
+    dt = args.dst.datatype
+    esz = dt_numpy(dt).itemsize
+    n_frags, pdepth = (1, 1) if pp is None else pp.nfrags_pdepth(count * esz)
+    # align fragments: every fragment equal AND divisible by node size, so
+    # the sub-collective algorithms selected at frag build keep a stable
+    # geometry across retargets (a near-equal 31/32 split would invalidate
+    # e.g. knomial reduce_scatter's divisibility choice mid-pipeline)
+    ppn = node.sbgp.size
+    while n_frags > 1 and (count % n_frags or
+                           (count // n_frags) % max(1, ppn)):
+        n_frags -= 1
+    frag_cnt = count // n_frags if n_frags else count
+    if n_frags <= 1 or frag_cnt < node.sbgp.size:
+        return SplitRailAllreduce(hier_team, init_args)
+
+    from ...tl.base import binfo_typed
+    full_dst = binfo_typed(args.dst)
+    full_src = full_dst if args.is_inplace else binfo_typed(args.src)
+
+    def frag_init(sched_p, idx):
+        frag = Schedule(team=hier_team)
+        fa = _frag_args(args, full_src, full_dst, dt, 0, count, n_frags, 0)
+        _split_rail_fill_frag(hier_team, frag, fa, dt)
+        return frag
+
+    def frag_setup(sched_p, frag, frag_num):
+        fa = _frag_args(args, full_src, full_dst, dt, 0, count, n_frags,
+                        frag_num)
+        _split_rail_retarget_frag(hier_team, frag, fa, dt)
+        return Status.OK
+
+    return PipelinedSchedule(team=hier_team, args=args, frag_init=frag_init,
+                             frag_setup=frag_setup, n_frags=pdepth,
+                             n_frags_total=n_frags,
+                             order=pp.order if pp else
+                             PipelineOrder.SEQUENTIAL)
+
+
+def _split_rail_geometry(hier_team, fa, dt):
+    """Fragment-local views: (work = full frag dst, my node block)."""
+    from ...tl.base import binfo_typed
+    node = hier_team.sbgp(SbgpType.NODE)
+    n, me = node.sbgp.size, node.sbgp.group_rank
+    cnt = int(fa.dst.count)
+    work = binfo_typed(fa.dst)
+    off = block_offset(cnt, n, me)
+    blk = block_count(cnt, n, me)
+    return work, work[off:off + blk]
+
+
+def _split_rail_fill_frag(hier_team, sched: Schedule, fa: CollArgs,
+                          dt) -> None:
+    """Static per-fragment schedule: [copy] -> node reduce_scatter ->
+    rail allreduce [-> AVG scale] -> node allgather. Every sub-collective
+    is coll_init'd HERE (deterministic tag order across ranks — lazy
+    stage-transition inits would race under ordered/parallel pipelining),
+    and SEQUENTIAL cross-fragment deps overlap adjacent stages: fragment
+    k's rail/DCN transfer runs while k+1 does its node reduce_scatter."""
+    from ...tl.base import binfo_typed
+    node = hier_team.sbgp(SbgpType.NODE)
+    net = hier_team.sbgp(SbgpType.NET)
+    op = fa.op if fa.op is not None else ReductionOp.SUM
+    inner = ReductionOp.SUM if op == ReductionOp.AVG else op
+    team_size = hier_team.core_team.size
+    work, my_blk = _split_rail_geometry(hier_team, fa, dt)
+    cnt = int(fa.dst.count)
+    esz = dt_numpy(dt).itemsize
+    # live views, mutated by retarget; closures/args read through this
+    live = {"fa": fa, "work": work, "blk": my_blk}
+    sched._sr_live = live
+
+    def copy_in():
+        f = live["fa"]
+        if not f.is_inplace:
+            live["work"][:] = binfo_typed(f.src)[:live["work"].size]
+
+    t0 = _UnpackTask(copy_in)
+    sched.add_task(t0)
+    sched.add_dep_on_schedule_start(t0)
+
+    rs_args = CollArgs(coll_type=CollType.REDUCE_SCATTER, op=inner,
+                       dst=_buf(work, dt), flags=CollArgsFlags.IN_PLACE)
+    rs_args.src = rs_args.dst
+    t1 = node.coll_init(rs_args, MemoryType.HOST, cnt * esz)
+    sched.add_task(t1)
+    t1.subscribe_dep(t0, EventType.EVENT_COMPLETED)
+
+    ar_args = CollArgs(coll_type=CollType.ALLREDUCE, op=inner,
+                       dst=_buf(my_blk, dt), flags=CollArgsFlags.IN_PLACE)
+    ar_args.src = ar_args.dst
+    t2 = net.coll_init(ar_args, MemoryType.HOST, my_blk.size * esz)
+    sched.add_task(t2)
+    t2.subscribe_dep(t1, EventType.EVENT_COMPLETED)
+    prev = t2
+
+    if op == ReductionOp.AVG:
+        t_s = _ScaleTask(lambda: live["blk"], 1.0 / team_size)
+        sched.add_task(t_s)
+        t_s.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+        prev = t_s
+
+    ag_args = CollArgs(coll_type=CollType.ALLGATHER,
+                       dst=_buf(work, dt), flags=CollArgsFlags.IN_PLACE)
+    ag_args.src = _buf(my_blk, dt)
+    t3 = node.coll_init(ag_args, MemoryType.HOST, cnt * esz)
+    sched.add_task(t3)
+    t3.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+    sched._sr_colls = (rs_args, ar_args, ag_args)
+
+
+def _split_rail_retarget_frag(hier_team, frag: Schedule, fa: CollArgs,
+                              dt) -> None:
+    """Rebind the fragment's buffer views to the new fragment range."""
+    work, my_blk = _split_rail_geometry(hier_team, fa, dt)
+    live = frag._sr_live
+    live["fa"] = fa
+    live["work"] = work
+    live["blk"] = my_blk
+    rs_args, ar_args, ag_args = frag._sr_colls
+    rs_args.dst = _buf(work, dt)
+    rs_args.src = rs_args.dst
+    ar_args.dst = _buf(my_blk, dt)
+    ar_args.src = ar_args.dst
+    ag_args.dst = _buf(work, dt)
+    ag_args.src = _buf(my_blk, dt)
+    for t in frag.tasks:
+        targs = getattr(t, "args", None)
+        if targs is not None:
+            _retarget_task_counts(t, targs)
 
 
 def allreduce_rab_init(init_args, team) -> CollTask:
@@ -686,16 +827,36 @@ def alltoall_hier_init(init_args, hier_team) -> CollTask:
         A_in = np.zeros(sum(rcounts), dtype=nd)
         M = np.zeros(p_me * total, dtype=nd)   # per-member scatter payloads
 
+        # index maps precomputed ONCE at init: per-post pack/repack are a
+        # single fancy-index numpy op each, not O(nodes*ppn*ppn) python
+        # loops (the tl/xla a2av static-index-map technique)
+        pack_starts = np.array(
+            [s * total + t_rank * blk
+             for grp in by_node for t_rank in grp for s in range(p_me)],
+            dtype=np.intp)
+        pack_idx = (pack_starts[:, None] + np.arange(blk)).ravel()
+        # repack: M[t*total + g_off_S + s*blk + j] =
+        #         A_in[node_off_S + t*p_S*blk + s*blk + j]
+        m_starts, a_starts = [], []
+        node_off = g_off = 0
+        for grp in by_node:
+            p_S = len(grp)
+            for t in range(p_me):
+                m_starts.append(t * total + g_off)
+                a_starts.append(node_off + t * p_S * blk)
+            node_off += p_me * p_S * blk
+            g_off += p_S * blk
+        m_idx = np.concatenate(
+            [ms + np.arange(len(by_node[i // p_me]) * blk)
+             for i, ms in enumerate(m_starts)]) if m_starts else \
+            np.empty(0, np.intp)
+        a_idx = np.concatenate(
+            [as_ + np.arange(len(by_node[i // p_me]) * blk)
+             for i, as_ in enumerate(a_starts)]) if a_starts else \
+            np.empty(0, np.intp)
+
         def pack():
-            # A_out: for dst node D: for t in D: for s in mine: block s->t
-            off = 0
-            for grp in by_node:
-                for t_rank in grp:
-                    for s in range(p_me):
-                        seg = G[s * total + t_rank * blk:
-                                s * total + t_rank * blk + blk]
-                        A_out[off:off + blk] = seg
-                        off += blk
+            A_out[:] = G[pack_idx]
 
         t_pack = _UnpackTask(pack)
         sched.add_task(t_pack)
@@ -709,19 +870,7 @@ def alltoall_hier_init(init_args, hier_team) -> CollTask:
         t_a2.subscribe_dep(t_pack, EventType.EVENT_COMPLETED)
 
         def repack():
-            # A_in: for src node S: for t in mine: for s in S: block ->
-            # M: for t in mine: for S: for s in S: block (grouped src order)
-            node_off = 0
-            g_off = 0
-            for grp in by_node:
-                p_S = len(grp)
-                sect = A_in[node_off:node_off + p_me * p_S * blk]
-                for t in range(p_me):
-                    chunk = sect[t * p_S * blk:(t + 1) * p_S * blk]
-                    M[t * total + g_off:
-                      t * total + g_off + p_S * blk] = chunk
-                node_off += p_me * p_S * blk
-                g_off += p_S * blk
+            M[m_idx] = A_in[a_idx]
 
         t_rep = _UnpackTask(repack)
         sched.add_task(t_rep)
@@ -740,13 +889,14 @@ def alltoall_hier_init(init_args, hier_team) -> CollTask:
     t3.subscribe_dep(prev, EventType.EVENT_COMPLETED)
 
     # stage 4: grouped (node, member) order -> dst by src team rank
+    # (index map precomputed; per-post unpack is one fancy-index op)
     grouped_order = [r for grp in by_node for r in grp]
+    unp_starts = np.array([r * blk for r in grouped_order], dtype=np.intp)
+    unp_idx = (unp_starts[:, None] + np.arange(blk)).ravel()
 
     def unpack():
         dst_flat = binfo_typed(args.dst, total)
-        for pos, r in enumerate(grouped_order):
-            dst_flat[r * blk:(r + 1) * blk] = \
-                R_member[pos * blk:(pos + 1) * blk]
+        dst_flat[unp_idx] = R_member
 
     t4 = _UnpackTask(unpack)
     sched.add_task(t4)
@@ -754,20 +904,250 @@ def alltoall_hier_init(init_args, hier_team) -> CollTask:
     return sched
 
 
+class AlltoallvHierNodeAgg(CollTask):
+    """Node-aggregated alltoallv (cl_hier/alltoallv node aggregation,
+    cl_hier.h:53): per-pair counts are first allgathered over the FULL
+    unit (the reference's counts exchange), after which every aggregation
+    stage's geometry is locally computable:
+
+      1. members pack their send blocks (dst-rank order) and gatherv them
+         to the node leader;
+      2. the leader packs per-node aggregates (one fancy-index op) and
+         the leaders run ONE alltoallv — one big message per node pair
+         over DCN instead of ppn*ppn small ones;
+      3. the leader repacks per-member payloads, scattervs them, and
+         members unpack into dst by displacement.
+
+    Later stages' counts depend on stage-0 results, so this is a lazy
+    stage machine (the SplitRailAllreduce pattern), not a static DAG.
+    """
+
+    def __init__(self, hier_team, init_args):
+        super().__init__(team=hier_team, args=init_args.args)
+        from ...api.types import BufferInfoV
+        args = init_args.args
+        if not isinstance(args.src, BufferInfoV) or args.src.counts is None \
+                or not isinstance(args.dst, BufferInfoV) or \
+                args.dst.counts is None:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "hier a2av requires src and dst counts")
+        if args.is_inplace:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "hier a2av: in-place not supported")
+        if hier_team.sbgp(SbgpType.FULL) is None:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "hier a2av needs the FULL unit for the counts "
+                           "exchange")
+        self.hier_team = hier_team
+        self.init_args = init_args
+        self._stage = 0
+        self._sub: Optional[CollTask] = None
+
+    def post_fn(self) -> Status:
+        ht = self.hier_team
+        args = self.args
+        self.N = ht.core_team.size
+        self.me = ht.core_team.rank
+        node = ht.sbgp(SbgpType.NODE)
+        self.node = node
+        self.leaders = ht.sbgp(SbgpType.NODE_LEADERS)
+        self.full = ht.sbgp(SbgpType.FULL)
+        self.is_leader = node.sbgp.group_rank == 0
+        topo = ht.core_team.topo
+        self.node_leader_ranks, self.by_node = _nodes_by_leader(topo, self.N)
+        self.my_node_ranks = [node.sbgp.map.eval(i)
+                              for i in range(node.sbgp.size)]
+        self.nd = dt_numpy(args.dst.datatype)
+        self.dt = args.dst.datatype
+        self.scounts = np.array([int(c) for c in args.src.counts],
+                                dtype=np.int64)
+        self._stage = 0
+        self._sub = None
+        self._advance()
+        return Status.OK
+
+    def progress_fn(self) -> None:
+        self._advance()
+
+    def _post_sub(self) -> None:
+        self._sub.progress_queue = self.progress_queue
+        self._sub.post()
+
+    def _advance(self) -> None:   # noqa: PLR0915 - staged protocol
+        from ...api.types import BufferInfoV
+        from ...tl.base import binfo_typed, binfo_v_block
+        if self._sub is not None:
+            if not self._sub.is_completed():
+                return
+            if self._sub.super_status.is_error:
+                self.status = self._sub.super_status
+                return
+            self._sub = None
+            self._stage += 1
+        args = self.args
+        N, me = self.N, self.me
+        nd = self.nd
+        p_me = len(self.my_node_ranks)
+        msg = int(np.sum(self.scounts)) * nd.itemsize
+
+        if self._stage == 0:
+            # counts exchange over the FULL unit
+            from ...constants import DataType
+            self.m_flat = np.zeros(N * N, dtype=np.int64)
+            a = CollArgs(coll_type=CollType.ALLGATHER,
+                         src=_buf(self.scounts, DataType.INT64),
+                         dst=_buf(self.m_flat, DataType.INT64))
+            self._sub = self.full.coll_init(a, MemoryType.HOST, N * 8)
+            self._post_sub()
+            return
+
+        m = self.m_flat.reshape(N, N)
+        if self._stage == 1:
+            # member pack (dst-rank order) + node gatherv to the leader
+            packed = np.empty(int(np.sum(self.scounts)), dtype=nd)
+            off = 0
+            for p in range(N):
+                c = int(self.scounts[p])
+                packed[off:off + c] = binfo_v_block(args.src, p)
+                off += c
+            member_totals = [int(np.sum(m[s])) for s in self.my_node_ranks]
+            if self.is_leader:
+                self.G = np.empty(int(np.sum(member_totals)), dtype=nd)
+                gdst = BufferInfoV(self.G, member_totals, None, self.dt)
+            else:
+                self.G = None
+                gdst = None
+            g = CollArgs(coll_type=CollType.GATHERV, root=0,
+                         src=_buf(packed, self.dt), dst=gdst)
+            self._sub = self.node.coll_init(g, MemoryType.HOST, msg)
+            self._post_sub()
+            return
+
+        if self._stage == 2:
+            if self.is_leader and self.leaders is not None and \
+                    self.leaders.sbgp.is_member:
+                # leader pack: for dst node D: for t in D: for s in my
+                # node members (grouped order): block s->t. G layout is
+                # member-major (member s's packed row, dst-rank order).
+                g_off = {}
+                off = 0
+                for s in self.my_node_ranks:
+                    g_off[s] = off
+                    off += int(np.sum(m[s]))
+                row_displ = np.zeros((N, N), dtype=np.int64)
+                row_displ[:, 1:] = np.cumsum(m, axis=1)[:, :-1]
+                starts, lens = [], []
+                for grp in self.by_node:
+                    for t in grp:
+                        for s in self.my_node_ranks:
+                            starts.append(g_off[s] + int(row_displ[s, t]))
+                            lens.append(int(m[s, t]))
+                idx = np.concatenate(
+                    [st + np.arange(ln) for st, ln in zip(starts, lens)
+                     if ln]) if any(lens) else np.empty(0, np.intp)
+                self.A_out = self.G[idx] if idx.size else np.empty(0, nd)
+                scounts_l = [int(sum(m[s, t] for s in self.my_node_ranks
+                                     for t in grp))
+                             for grp in self.by_node]
+                rcounts_l = [int(sum(m[s, t] for s in grp
+                                     for t in self.my_node_ranks))
+                             for grp in self.by_node]
+                self.A_in = np.empty(int(np.sum(rcounts_l)), dtype=nd)
+                a2 = CollArgs(
+                    coll_type=CollType.ALLTOALLV,
+                    src=BufferInfoV(self.A_out, scounts_l, None, self.dt),
+                    dst=BufferInfoV(self.A_in, rcounts_l, None, self.dt))
+                self._sub = self.leaders.coll_init(a2, MemoryType.HOST,
+                                                   msg)
+                self._post_sub()
+                return                          # completion -> stage 3
+            self._stage = 3                     # non-leader: skip a2av
+
+        if self._stage == 3:
+            if self.is_leader:
+                # repack: A_in per src node S: for t in my node: for s in
+                # S: block s->t  ->  M per member t: grouped src order
+                member_rtotals = [int(sum(m[s, t] for s in range(N)))
+                                  for t in self.my_node_ranks]
+                m_off = {}
+                off = 0
+                for i, t in enumerate(self.my_node_ranks):
+                    m_off[t] = off
+                    off += member_rtotals[i]
+                self.M = np.empty(off, dtype=nd)
+                t_cursor = dict(m_off)
+                a_cursor = 0
+                m_starts, a_starts, lens = [], [], []
+                for grp in self.by_node:
+                    for t in self.my_node_ranks:
+                        for s in grp:
+                            ln = int(m[s, t])
+                            m_starts.append(t_cursor[t])
+                            a_starts.append(a_cursor)
+                            lens.append(ln)
+                            t_cursor[t] += ln
+                            a_cursor += ln
+                mi = np.concatenate([st + np.arange(ln) for st, ln in
+                                     zip(m_starts, lens) if ln]) \
+                    if any(lens) else np.empty(0, np.intp)
+                ai = np.concatenate([st + np.arange(ln) for st, ln in
+                                     zip(a_starts, lens) if ln]) \
+                    if any(lens) else np.empty(0, np.intp)
+                if mi.size:
+                    self.M[mi] = self.A_in[ai]
+                src = BufferInfoV(self.M, member_rtotals, None, self.dt)
+            else:
+                src = None
+            my_rtotal = int(sum(m[s, me] for s in range(N)))
+            self.R = np.empty(my_rtotal, dtype=nd)
+            s3 = CollArgs(coll_type=CollType.SCATTERV, root=0, src=src,
+                          dst=_buf(self.R, self.dt))
+            self._sub = self.node.coll_init(s3, MemoryType.HOST,
+                                            my_rtotal * nd.itemsize)
+            self._post_sub()
+            return                              # completion -> stage 4
+
+        if self._stage == 4:
+            # unpack R (grouped src order) -> dst at displacements
+            dstv = args.dst
+            rcounts = [int(c) for c in dstv.counts]
+            displs = [int(d) for d in dstv.displacements] \
+                if dstv.displacements is not None else \
+                list(np.cumsum([0] + rcounts[:-1]))
+            span = max((displs[p] + rcounts[p] for p in range(N)),
+                       default=0)
+            dst_flat = binfo_typed(dstv, span)
+            off = 0
+            for s in (x for grp in self.by_node for x in grp):
+                c = rcounts[s]
+                dst_flat[displs[s]:displs[s] + c] = self.R[off:off + c]
+                off += c
+            self.status = Status.OK
+            return
+        self.status = Status.OK
+
+
+def alltoallv_hier_init(init_args, hier_team) -> CollTask:
+    return AlltoallvHierNodeAgg(hier_team, init_args)
+
+
 # ---------------------------------------------------------------------------
 # scores
 # ---------------------------------------------------------------------------
 
 def build_hier_scores(hier_team) -> CollScore:
+    import os
+
     from ...utils.config import SIZE_INF
     from .tpu import allreduce_rab_tpu_init, staged_init
     s = CollScore()
     mem = MemoryType.HOST
+    by_name = {}    # (coll, name) -> init fn, for the TUNE resolver
 
     def add(coll, score, init, name):
-        s.add_range(coll, mem, 0, SIZE_INF, score,
-                    lambda ia, t, fn=init: fn(ia, hier_team), hier_team,
-                    name)
+        fn = lambda ia, t, f=init: f(ia, hier_team)   # noqa: E731
+        by_name[(coll, name)] = fn
+        s.add_range(coll, mem, 0, SIZE_INF, score, fn, hier_team, name)
 
     def add_tpu(coll, score, init, name, staged=True):
         """TPU-memory row: on-device node stages where the alg supports
@@ -776,6 +1156,7 @@ def build_hier_scores(hier_team) -> CollScore:
             fn = lambda ia, t, f=init: staged_init(ia, hier_team, f)  # noqa: E731
         else:
             fn = lambda ia, t, f=init: f(ia, hier_team)               # noqa: E731
+        by_name[(coll, name)] = fn
         s.add_range(coll, MemoryType.TPU, 0, SIZE_INF, score, fn,
                     hier_team, name)
 
@@ -795,9 +1176,14 @@ def build_hier_scores(hier_team) -> CollScore:
             thresh = parse_memunits(cfg.get("A2AV_NODE_THRESH"))
         except (KeyError, ValueError):
             pass
-    s.add_range(CollType.ALLTOALL, mem, 0, thresh, HIER_SCORE,
-                lambda ia, t: alltoall_hier_init(ia, hier_team), hier_team,
-                "node_agg")
+    a2a_fn = lambda ia, t: alltoall_hier_init(ia, hier_team)    # noqa: E731
+    a2av_fn = lambda ia, t: alltoallv_hier_init(ia, hier_team)  # noqa: E731
+    by_name[(CollType.ALLTOALL, "node_agg")] = a2a_fn
+    by_name[(CollType.ALLTOALLV, "node_agg")] = a2av_fn
+    s.add_range(CollType.ALLTOALL, mem, 0, thresh, HIER_SCORE, a2a_fn,
+                hier_team, "node_agg")
+    s.add_range(CollType.ALLTOALLV, mem, 0, thresh, HIER_SCORE, a2av_fn,
+                hier_team, "node_agg")
     add(CollType.REDUCE, HIER_SCORE, reduce_2step_init, "2step")
     add(CollType.BARRIER, HIER_SCORE, barrier_init, "knomial_hier")
 
@@ -816,4 +1202,12 @@ def build_hier_scores(hier_team) -> CollScore:
             "node_agg_staged")
     add_tpu(CollType.BARRIER, HIER_SCORE, barrier_init, "knomial_hier",
             staged=False)
+
+    tune = os.environ.get("UCC_CL_HIER_TUNE", "")
+    if tune:
+        def resolver(coll, alg):
+            return by_name.get((coll, alg))
+        st = s.update_from_str(tune, resolver, hier_team)
+        if st.is_error:
+            raise UccError(st, "bad tune string in UCC_CL_HIER_TUNE")
     return s

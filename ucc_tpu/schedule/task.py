@@ -182,6 +182,11 @@ class CollTask:
         st = self.status
         if st == Status.IN_PROGRESS:
             st = self.status = Status.OK
+        # mark completed BEFORE notifying: cyclically-subscribed tasks
+        # (pipeline fragment rings) re-enter complete() from the EVENT
+        # handlers, and the idempotence guard above must already see the
+        # final state or the error cascade recurses forever
+        self.super_status = st
         if st.is_error:
             if self.timeout and st == Status.ERR_TIMED_OUT:
                 logger.warning(
@@ -197,7 +202,6 @@ class CollTask:
                 pass
         if self.cb is not None:
             self.cb(self, st)
-        self.super_status = st
         if self.schedule is not None:
             self.schedule.child_completed(self)
         if self.flags_internal and self.schedule is None:
