@@ -210,3 +210,30 @@ class TestMPool:
         b = pool.get()
         assert b == {}  # reset ran
         assert pool.num_allocated == 4
+
+
+class TestPerftestModes:
+    """Smoke the perftest tool's bench modes through main() (the
+    reference's ucc_perftest lifecycle coverage): isolated, persistent,
+    triggered-post (EE), and the MoE traffic-matrix generator."""
+
+    def test_isolated_and_persistent(self, capsys):
+        from ucc_tpu.tools.perftest import main
+        assert main(["-c", "allreduce", "-p", "2", "-b", "8", "-e", "16",
+                     "-n", "2", "-w", "1"]) == 0
+        assert main(["-c", "allreduce", "-p", "2", "-b", "8", "-e", "8",
+                     "-n", "2", "-w", "1", "--persistent"]) == 0
+        out = capsys.readouterr().out
+        assert "ucc_perftest" in out
+
+    def test_triggered_post_mode(self, capsys):
+        from ucc_tpu.tools.perftest import main
+        assert main(["-c", "allreduce", "-p", "2", "-b", "8", "-e", "8",
+                     "-n", "2", "-w", "1", "-T"]) == 0
+        assert "ucc_perftest" in capsys.readouterr().out
+
+    def test_moe_matrix_alltoallv(self, capsys):
+        from ucc_tpu.tools.perftest import main
+        assert main(["-c", "alltoallv", "-p", "2", "-b", "64", "-e", "64",
+                     "-n", "2", "-w", "1", "--matrix", "moe", "-F"]) == 0
+        assert "ucc_perftest" in capsys.readouterr().out

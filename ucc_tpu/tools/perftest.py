@@ -207,6 +207,35 @@ class InProcJob:
     def run_round(self, argses) -> None:
         self.post_and_wait(self.init_reqs(argses))
 
+    # -- triggered-post mode (ucc_pt_benchmark.cc:217-246) ---------------
+    _ees = None
+
+    def post_and_wait_triggered(self, reqs) -> None:
+        """Post through execution engines: each rank's collective fires
+        off a compute_complete event (ucc_collective_triggered_post), the
+        timed region covering event signal -> EE dispatch -> completion."""
+        from ucc_tpu.core.ee import Ee, UccEvent
+        if self._ees is None:
+            self._ees = [Ee(t) for t in self.teams]
+        for r, rq in enumerate(reqs):
+            ev = UccEvent("compute_complete")
+            self._ees[r].triggered_post(ev, rq)
+            self._ees[r].set_event(ev)
+        while any(rq.test() == Status.IN_PROGRESS or
+                  rq.test() == Status.OPERATION_INITIALIZED
+                  for rq in reqs):
+            for c in self.contexts:
+                c.progress()
+        for rq in reqs:
+            if rq.test().is_error:
+                raise SystemExit(f"collective failed: {rq.test()}")
+
+    def destroy_ees(self) -> None:
+        if self._ees:
+            for ee in self._ees:
+                ee.destroy()
+            self._ees = None
+
 
 class StoreJob:
     """One rank of a multi-process run."""
@@ -262,6 +291,10 @@ def main(argv=None) -> int:
     p.add_argument("--matrix", default="", choices=["", "uniform", "moe"],
                    help="alltoallv traffic-matrix generator "
                         "(ucc_pt_config.h:98-108 MoE-style skew)")
+    p.add_argument("-T", "--triggered", action="store_true",
+                   help="post through execution engines (triggered-post "
+                        "lifecycle, ucc_pt_benchmark.cc:217-246; "
+                        "in-process jobs only)")
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--store", default="", help="host:port for multi-process")
     p.add_argument("--rank", type=int, default=0)
@@ -341,7 +374,19 @@ def main(argv=None) -> int:
         else:
             for it in range(rounds):
                 t0 = time.perf_counter()
-                if persistent_reqs is not None:
+                if args.triggered:
+                    # triggered-post lifecycle: fresh request dispatched
+                    # by an execution engine on an event signal; a fresh
+                    # request per round keeps the completion observable
+                    # (OPERATION_INITIALIZED -> OK) without racing the EE
+                    # thread (ucc_pt_benchmark.cc:217-246)
+                    argses = [make_args(coll, r, n, count, dt, op, mem,
+                                        args.inplace, args.root, False,
+                                        devices) for r in ranks]
+                    reqs_t = job.init_reqs(argses)
+                    t0 = time.perf_counter()
+                    job.post_and_wait_triggered(reqs_t)
+                elif persistent_reqs is not None:
                     job.post_and_wait(persistent_reqs)
                 else:
                     argses = [make_args(coll, r, n, count, dt, op, mem,
