@@ -180,6 +180,17 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
         # reference restriction (ucc_coll.c:210-214)
         raise UccError(Status.ERR_NOT_SUPPORTED,
                        "active sets supported for bcast only")
+    if args.global_work_buffer is not None or \
+            (args.flags & CollArgsFlags.MEM_MAPPED_BUFFERS):
+        # one-sided DCN collectives (global work buffer / mem-mapped
+        # peer buffers, ucc.h:1878-1887) are honestly rejected rather
+        # than silently ignored: TPU pods have no UCX-style host RDMA
+        # window over DCN; the device-initiated role is served on ICI by
+        # tl/ring_dma (see PARITY.md "one-sided capabilities")
+        raise UccError(Status.ERR_NOT_SUPPORTED,
+                       "one-sided (global_work_buffer / mem-mapped) "
+                       "collectives are not supported on the TPU DCN "
+                       "path; see PARITY.md")
     if _is_zero_size(args):
         task: CollTask = _StubTask()
         req = CollRequest(task, team, args)
