@@ -317,3 +317,41 @@ class TestNewRound2Algs:
                     off += c
 
         run_with_tune("alltoallv:@hybrid:inf", n, make, check, monkeypatch)
+
+
+class TestAllreduceDbt:
+    """Fused allreduce-DBT: both halves flow concurrently, each tree's
+    bcast starting when its half reaches the virtual root."""
+
+    @pytest.mark.parametrize("n", [2, 3, 5, 8])
+    @pytest.mark.parametrize("count", [10, 33, 257])
+    def test_sum(self, n, count, monkeypatch):
+        srcs = [np.arange(count, dtype=np.float64) * (r + 1)
+                for r in range(n)]
+        dsts = [np.zeros(count, np.float64) for _ in range(n)]
+
+        def check():
+            expect = np.sum(srcs, axis=0)
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r], expect)
+
+        run_with_tune("allreduce:@dbt:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+            op=ReductionOp.SUM), check, monkeypatch)
+
+    def test_avg_inplace(self, monkeypatch):
+        n, count = 6, 48
+        bufs = [np.full(count, r + 1.0, np.float64) for r in range(n)]
+
+        def check():
+            for r in range(n):
+                np.testing.assert_allclose(bufs[r], 3.5)
+
+        from ucc_tpu import CollArgsFlags
+        run_with_tune("allreduce:@dbt:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            dst=BufferInfo(bufs[r], count, DataType.FLOAT64),
+            op=ReductionOp.AVG,
+            flags=CollArgsFlags.IN_PLACE), check, monkeypatch)

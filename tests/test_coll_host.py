@@ -146,7 +146,7 @@ class TestAllreduce:
             np.testing.assert_allclose(dsts[r][0::2], np.min(vals, axis=0))
             np.testing.assert_array_equal(dsts[r][1::2].astype(int), which)
 
-    @pytest.mark.parametrize("alg", ["knomial", "sra_knomial", "ring"])
+    @pytest.mark.parametrize("alg", ["knomial", "sra_knomial", "ring", "dbt"])
     def test_alg_selection(self, alg, monkeypatch):
         # dedicated job so the TUNE env is picked up at team create
         monkeypatch.setenv("UCC_TL_SHM_TUNE", f"allreduce:@{alg}:inf")

@@ -201,3 +201,96 @@ class ReduceDbt(_DbtBase):
                 progressed = True
             if len(done) < len(pending) and not progressed:
                 yield
+
+
+class AllreduceDbt(_DbtBase):
+    """Fused allreduce over the double binary tree: each half reduces UP
+    its tree to the virtual root (rank `root`) and broadcasts back DOWN
+    the same tree, the two trees running concurrently and each tree's
+    down-phase starting the moment ITS half lands at the root — no
+    barrier between reduce and bcast (the reference's fused
+    allreduce-DBT; reduce_dbt.c + bcast_dbt.c flows over one task)."""
+
+    def run(self):
+        args = self.args
+        self.args.root = 0          # virtual root for the fused flow
+        self._setup()
+        op = args.op if args.op is not None else ReductionOp.SUM
+        red_op = ReductionOp.SUM if op == ReductionOp.AVG else op
+        nd = dt_numpy(self.dt)
+        work = binfo_typed(args.dst, self.count)
+        if not args.is_inplace:
+            work[:] = binfo_typed(args.src, self.count)
+        if self.gsize == 1:
+            if op == ReductionOp.AVG:
+                work[:] = reduce_arrays([work], ReductionOp.SUM, self.dt,
+                                        alpha=1.0)
+            return
+        me = self.grank
+        n = self.gsize
+
+        def tree_flow(t):
+            """Reduce up + bcast down for half t through tree t."""
+            rootv, parent, children = self.trees[t]
+            lo, hi = self.halves[t]
+            if hi <= lo:
+                return
+            half = work[lo:hi]
+            slot_up = 150 + t
+            slot_dn = 152 + t
+            if me == 0:                       # virtual root
+                if rootv is not None:
+                    tr = self.rank_of(rootv)
+                    buf = np.empty(hi - lo, dtype=nd)
+                    rreq = self.recv_nb(tr, buf, slot=slot_up)
+                    while not rreq.test():
+                        yield
+                    half[:] = reduce_arrays([half, buf], red_op, self.dt)
+                if op == ReductionOp.AVG:
+                    half[:] = reduce_arrays([half], ReductionOp.SUM,
+                                            self.dt, alpha=1.0 / n)
+                if rootv is not None:
+                    sreq = self.send_nb(self.rank_of(rootv), half,
+                                        slot=slot_dn)
+                    while not sreq.test():
+                        yield
+                return
+            v = self.v_of(me)
+            # up: accumulate children's halves, forward to parent/root
+            kids = children.get(v, [])
+            bufs = [np.empty(hi - lo, dtype=nd) for _ in kids]
+            rreqs = [self.recv_nb(self.rank_of(c), b, slot=slot_up)
+                     for c, b in zip(kids, bufs)]
+            while not all(r.test() for r in rreqs):
+                yield
+            for r in rreqs:
+                if getattr(r, "error", None):
+                    from ...status import UccError, Status
+                    raise UccError(Status.ERR_NO_MESSAGE, r.error)
+            if bufs:
+                half[:] = reduce_arrays([half] + bufs, red_op, self.dt)
+            up_to = 0 if v == rootv else self.rank_of(parent[v])
+            sreq = self.send_nb(up_to, half, slot=slot_up)
+            while not sreq.test():
+                yield
+            # down: receive the reduced half, forward to children
+            dn_from = 0 if v == rootv else self.rank_of(parent[v])
+            rreq = self.recv_nb(dn_from, half, slot=slot_dn)
+            while not rreq.test():
+                yield
+            sreqs = [self.send_nb(self.rank_of(c), half, slot=slot_dn)
+                     for c in kids]
+            while not all(r.test() for r in sreqs):
+                yield
+
+        gens = [tree_flow(0), tree_flow(1)]
+        done = [False, False]
+        while not all(done):
+            for i, g in enumerate(gens):
+                if not done[i]:
+                    try:
+                        next(g)
+                    except StopIteration:
+                        done[i] = True
+            if not all(done):
+                yield

@@ -24,7 +24,7 @@ from .allgather import (AllgatherBruck, AllgatherKnomial, AllgatherLinear,
                         AllgathervKnomial)
 from .alltoall import (AlltoallBruck, AlltoallLinear, AlltoallPairwise,
                        AlltoallvHybrid, AlltoallvPairwise)
-from .dbt import BcastDbt, ReduceDbt
+from .dbt import AllreduceDbt, BcastDbt, ReduceDbt
 from .knomial import (AllreduceKnomial, BarrierKnomial, BcastKnomial,
                       FaninKnomial, FanoutKnomial, GatherLinear,
                       ReduceKnomial, ScatterLinear)
@@ -146,6 +146,8 @@ class HostTlTeam(TlTeamBase):
                      sel=f"0-4k:{S - 5},4k-inf:{S + 5}"),
                 spec(2, "ring", AllreduceRing,
                      sel=f"0-4k:{S - 6},4k-inf:{S + 4}"),
+                spec(3, "dbt", AllreduceDbt,
+                     sel=f"0-4k:{S - 7},4k-inf:{S + 3}"),
             ],
             CollType.ALLGATHER: [
                 # bruck for small msgs, neighbor for medium even teams,
