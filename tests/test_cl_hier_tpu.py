@@ -175,3 +175,49 @@ class TestHierTpuDataMovement:
                                           mem_type=MemoryType.TPU))
                   for _ in range(N)]
         job.run_coll(teams, lambda r: argses[r])
+
+
+class TestHierTpuAllgatherAlltoallv:
+    def test_allgather(self, job, teams):
+        per = 5
+        srcs = [np.arange(per, dtype=np.float32) + 10 * r for r in range(N)]
+        argses = [CollArgs(
+            coll_type=CollType.ALLGATHER,
+            src=dev_buf(job, r, srcs[r], DataType.FLOAT32),
+            dst=BufferInfo(None, per * N, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU)) for r in range(N)]
+        job.run_coll(teams, lambda r: argses[r])
+        expect = np.concatenate(srcs)
+        for r in range(N):
+            np.testing.assert_array_equal(np.asarray(argses[r].dst.buffer),
+                                          expect)
+
+    def test_alltoallv(self, job, teams):
+        rng = np.random.default_rng(5)
+        m = rng.integers(0, 4, size=(N, N))
+        argses = []
+        for r in range(N):
+            scounts = [int(c) for c in m[r]]
+            rcounts = [int(m[p][r]) for p in range(N)]
+            src = np.arange(sum(scounts), dtype=np.float32) + 100 * r
+            argses.append(CollArgs(
+                coll_type=CollType.ALLTOALLV,
+                src=BufferInfoV(
+                    jax.device_put(
+                        jnp.asarray(src),
+                        job.contexts[r].tl_contexts["xla"].obj.device),
+                    scounts, None, DataType.FLOAT32,
+                    mem_type=MemoryType.TPU),
+                dst=BufferInfoV(None, rcounts, None, DataType.FLOAT32,
+                                mem_type=MemoryType.TPU)))
+        job.run_coll(teams, lambda r: argses[r])
+        for r in range(N):
+            out = np.asarray(argses[r].dst.buffer)
+            off = 0
+            for p in range(N):
+                c = int(m[p][r])
+                sd = int(np.sum(m[p][:r]))
+                expect = (np.arange(int(np.sum(m[p])), dtype=np.float32)
+                          + 100 * p)[sd:sd + c]
+                np.testing.assert_array_equal(out[off:off + c], expect)
+                off += c

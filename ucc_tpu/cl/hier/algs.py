@@ -1131,6 +1131,42 @@ def alltoallv_hier_init(init_args, hier_team) -> CollTask:
     return AlltoallvHierNodeAgg(hier_team, init_args)
 
 
+def allgather_hier_init(init_args, hier_team) -> CollTask:
+    """ALLGATHER as the v-variant with uniform counts (the hier
+    gatherv -> leaders allgatherv -> bcast -> unpack pipeline serves both;
+    GET_LOCAL_COUNT duality of allgather_knomial.c)."""
+    import dataclasses
+
+    from ...api.types import BufferInfoV
+    args = init_args.args
+    n = hier_team.core_team.size
+    total = int(args.dst.count)
+    if total % n != 0:
+        raise UccError(Status.ERR_NOT_SUPPORTED,
+                       "hier allgather needs count divisible by team size")
+    blk = total // n
+    dstv = BufferInfoV(args.dst.buffer, [blk] * n, None, args.dst.datatype,
+                       mem_type=args.dst.mem_type)
+    vargs = dataclasses.replace(args, dst=dstv)
+    out = allgatherv_hier_init(
+        dataclasses.replace(init_args, args=vargs), hier_team)
+
+    # the v-pipeline rebinds/fills dstv; mirror into the user's dst
+    class _Mirror(CollTask):
+        def post_fn(self) -> Status:
+            args.dst.buffer = dstv.buffer
+            self.status = Status.OK
+            return Status.OK
+
+    sched = Schedule(team=hier_team, args=args)
+    sched.add_task(out)
+    sched.add_dep_on_schedule_start(out)
+    t_m = _Mirror()
+    sched.add_task(t_m)
+    t_m.subscribe_dep(out, EventType.EVENT_COMPLETED)
+    return sched
+
+
 # ---------------------------------------------------------------------------
 # scores
 # ---------------------------------------------------------------------------
@@ -1198,7 +1234,11 @@ def build_hier_scores(hier_team) -> CollScore:
     add_tpu(CollType.REDUCE, HIER_SCORE, reduce_2step_init, "2step_staged")
     add_tpu(CollType.ALLGATHERV, HIER_SCORE, allgatherv_hier_init,
             "unpack_staged")
+    add_tpu(CollType.ALLGATHER, HIER_SCORE, allgather_hier_init,
+            "unpack_staged")
     add_tpu(CollType.ALLTOALL, HIER_SCORE, alltoall_hier_init,
+            "node_agg_staged")
+    add_tpu(CollType.ALLTOALLV, HIER_SCORE, alltoallv_hier_init,
             "node_agg_staged")
     add_tpu(CollType.BARRIER, HIER_SCORE, barrier_init, "knomial_hier",
             staged=False)
