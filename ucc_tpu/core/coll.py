@@ -90,6 +90,10 @@ class CollRequest:
         self.team = team
         self.args = args
         self._posted = False
+        # hot-path caches: flag tests are enum __and__ calls and the
+        # config read is a table lookup — both fixed after init
+        self._persistent = args.is_persistent
+        self._trace = bool(team.context.lib.config.coll_trace)
 
     @property
     def status(self) -> Status:
@@ -103,13 +107,13 @@ class CollRequest:
                 # COLL_POST_STATUS_CHECK (ucc_coll.c:362)
                 raise UccError(Status.ERR_INVALID_PARAM,
                                "collective re-posted while in progress")
-            if not self.args.is_persistent:
+            if not self._persistent:
                 raise UccError(Status.ERR_INVALID_PARAM,
                                "re-post of non-persistent collective")
             self.task.reset()
         self._posted = True
         self.task.progress_queue = self.team.context.progress_queue
-        if self.team.context.lib.config.coll_trace:
+        if self._trace:
             logger.info("coll post: %s team %s seq %d",
                         coll_type_str(self.args.coll_type), self.team.id,
                         self.task.seq_num)

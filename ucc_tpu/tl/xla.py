@@ -377,6 +377,7 @@ class XlaCollTask(CollTask):
             self.coll not in (CollType.BARRIER, CollType.FANIN,
                               CollType.FANOUT)
             and (dst_bi is None or dst_bi.mem_type == MemoryType.TPU))
+        self._contrib_src = args.src is not None and not args.is_inplace
         if self.coll == CollType.SCATTER and args.src is not None and \
                 args.src.buffer is not None and \
                 int(args.src.count) % team.size != 0:
@@ -394,7 +395,9 @@ class XlaCollTask(CollTask):
     # -- launch plumbing -------------------------------------------------
     def local_src(self):
         args = self.args
-        bi = args.src if args.src is not None and not args.is_inplace else args.dst
+        # which buffer-info contributes is fixed at init; only its
+        # .buffer binding may change between persistent posts
+        bi = args.src if self._contrib_src else args.dst
         if self.coll == CollType.BARRIER or bi is None or bi.buffer is None:
             # contribution-less ranks (scatter non-root, barrier, dst-only)
             # deposit typed zero padding
