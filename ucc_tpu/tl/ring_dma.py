@@ -44,9 +44,14 @@ TL_RING_DMA_CONFIG = register_table(ConfigTable(
                     parse_string),
     ]))
 
-#: VMEM working-set bound: the v1 kernels stage the full vector in VMEM
-#: (~16 MiB/core); larger messages fall back to TL/XLA via selection
-MAX_ELEMS = 1 << 21
+#: per-kernel VMEM working-set bound (~16 MiB/core). Vectors larger than
+#: this are CHUNKED at the program level: the shard_map body slices the
+#: input into VMEM-sized pieces and runs one ring pass per piece (XLA
+#: schedules the independent passes; DMA of pass k overlaps compute of
+#: k+1 where the hardware allows).
+CHUNK_ELEMS = 1 << 18
+#: total bound: chunking covers up to this many elements per rank
+MAX_ELEMS = 1 << 27
 
 
 def _accum(op: ReductionOp):
@@ -129,7 +134,6 @@ def _ring_kernel(local_ref, out_ref, work_ref, comm_ref, send_sem,
     if mode == "reduce_scatter":
         out_ref[:] = work[pl.ds(me * blk, blk)]
         return
-    my_block = jax.lax.rem(me + 1, n)
 
     # allgather phase: circulate the reduced blocks
     for step in range(n - 1):
@@ -154,30 +158,32 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
     interpret = jax.devices()[0].platform == "cpu"
 
     if coll == CollType.ALLGATHER:
-        blk = max(count, 1)
-        padded = blk
+        blk0 = max(count, 1)
+        padded = blk0
         mode = "allgather"
-        out_elems = n * blk
         out_specs = P(None)
     else:
         padded = max(count, 1)
         if padded % n:
             padded += n - padded % n
-        blk = padded // n
-        if coll == CollType.ALLREDUCE:
-            mode, out_elems, out_specs = "allreduce", padded, P("r")
+        blk0 = padded // n
+        mode = "allreduce" if coll == CollType.ALLREDUCE else \
+            "reduce_scatter"
+        out_specs = P("r")
+
+    def one_pass(x, blk):
+        """One VMEM-resident ring pass over x (per-rank size n*blk for
+        reduce modes, blk for allgather)."""
+        kernel = functools.partial(_ring_kernel, n=n, blk=blk, op=op,
+                                   mode=mode)
+        if mode == "allgather":
+            out_elems = n * blk
+        elif mode == "allreduce":
+            out_elems = n * blk
         else:
-            mode, out_elems, out_specs = "reduce_scatter", blk, P("r")
-
-    kernel = functools.partial(_ring_kernel, n=n, blk=blk, op=op, mode=mode)
-
-    def body(x):
-        if x.size != padded and mode != "allgather":
-            x = jnp.pad(x, (0, padded - x.size))
-        # reduce_scatter needs a full-vector work scratch (input refs are
-        # read-only); the other modes get a minimal placeholder
-        work_elems = padded if mode == "reduce_scatter" else 1
-        out = pl.pallas_call(
+            out_elems = blk
+        work_elems = n * blk if mode == "reduce_scatter" else 1
+        return pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct((out_elems,), x.dtype),
             scratch_shapes=[
@@ -188,6 +194,51 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
             ],
             interpret=interpret,
         )(x)
+
+    # chunk plan (mode-dependent slicing, VMEM-sized pieces):
+    # - allreduce: the vector is not rank-blocked — flat contiguous
+    #   pieces, each a multiple of n (ring granularity); out = concat.
+    # - reduce_scatter: slice the SAME sub-range of every rank-block so
+    #   each piece is a valid smaller reduce_scatter; out = concat of my
+    #   sub-blocks.
+    # - allgather: flat pieces of my block; gathered sub-blocks re-
+    #   interleave per source rank.
+    def _split(total, max_c):
+        out = []
+        off = 0
+        while off < total:
+            c = min(max_c, total - off)
+            out.append((off, c))
+            off += c
+        return out
+
+    if mode == "allreduce":
+        max_c = max(n, (CHUNK_ELEMS // n) * n)
+        chunks = _split(padded, max_c)
+    elif mode == "reduce_scatter":
+        chunks = _split(blk0, max(1, CHUNK_ELEMS // n))
+    else:
+        chunks = _split(blk0, CHUNK_ELEMS)
+
+    def body(x):
+        if mode != "allgather" and x.size != padded:
+            x = jnp.pad(x, (0, padded - x.size))
+        if len(chunks) == 1:
+            out = one_pass(x, blk0)
+        elif mode == "allreduce":
+            out = jnp.concatenate(
+                [one_pass(x[o:o + c], c // n) for o, c in chunks])
+        elif mode == "reduce_scatter":
+            xb = x.reshape(n, blk0)
+            out = jnp.concatenate(
+                [one_pass(xb[:, o:o + c].reshape(n * c), c)
+                 for o, c in chunks])
+        else:
+            parts = [one_pass(x[o:o + c], c) for o, c in chunks]
+            # part p holds n gathered sub-blocks; re-interleave by source
+            out = jnp.concatenate(
+                [jnp.concatenate([p.reshape(n, -1)[i] for p in parts])
+                 for i in range(n)])
         if op == ReductionOp.AVG and mode in ("allreduce",
                                               "reduce_scatter"):
             out = (out / n).astype(out.dtype)
@@ -217,8 +268,8 @@ class RingDmaCollTask(XlaCollTask):
         total = int((args.dst or args.src).count)
         if total > MAX_ELEMS:
             raise UccError(Status.ERR_NOT_SUPPORTED,
-                           "tl/ring_dma v1 stages the vector in VMEM; "
-                           f"count {total} exceeds {MAX_ELEMS}")
+                           f"tl/ring_dma count {total} exceeds the "
+                           f"chunked bound {MAX_ELEMS}")
         if self.coll == CollType.REDUCE_SCATTER:
             # the ring delivers per-rank shards; a non-divisible total
             # would need the near-equal remainder convention — defer to
