@@ -336,3 +336,70 @@ def test_socket_tl_sweep(size):
                 np.testing.assert_allclose(got, expect, rtol=1e-6), (i, r)
             else:
                 assert got == expect, (i, case, r)
+
+
+def _death_worker(rank, size, port, outdir):
+    import traceback
+    res_path = os.path.join(outdir, f"r{rank}.txt")
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["UCC_TLS"] = "socket,self"
+        import ucc_tpu
+        from ucc_tpu import (BufferInfo, CollArgs, CollArgsFlags, CollType,
+                             ContextParams, DataType, ReductionOp,
+                             TcpStoreOob, TeamParams)
+        oob = TcpStoreOob(rank, size, port=port)
+        lib = ucc_tpu.init()
+        ctx = ucc_tpu.Context(lib, ContextParams(oob=oob))
+        team = ctx.create_team(TeamParams(
+            oob=TcpStoreOob(rank, size, port=port + 1)))
+        if rank == 1:
+            with open(res_path, "w") as f:
+                f.write("died")
+            os._exit(1)     # abrupt death: no finalize, sockets reset
+        src = np.full(16, 1.0, np.float32)
+        dst = np.zeros(16, np.float32)
+        req = team.collective_init(CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(src, 16, DataType.FLOAT32),
+            dst=BufferInfo(dst, 16, DataType.FLOAT32),
+            op=ReductionOp.SUM,
+            flags=CollArgsFlags.TIMEOUT, timeout=3.0))
+        req.post()
+        try:
+            st = req.wait(timeout=30)
+            out = st.name
+        except Exception as e:  # noqa: BLE001 - wait's own deadline
+            out = f"WAIT_RAISED:{e}"
+        with open(res_path, "w") as f:
+            f.write(out)
+    except Exception:  # noqa: BLE001
+        with open(res_path, "w") as f:
+            f.write("error:" + traceback.format_exc())
+
+
+def test_peer_death_surfaces_as_error(tmp_path):
+    """Failure detection over DCN: a peer process dying mid-collective
+    must surface as ERR_TIMED_OUT (per-coll timeout backstop) or a
+    transport error on the survivor — never a hang."""
+    size = 2
+    port = _free_port_pair()
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=_death_worker,
+                         args=(r, size, port, str(tmp_path)))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    import time as _time
+    deadline = _time.monotonic() + 120
+    while any(p.is_alive() for p in procs):
+        if _time.monotonic() > deadline:
+            for p in procs:
+                p.terminate()
+            raise AssertionError("peer-death test hung")
+        _time.sleep(0.2)
+    r1 = (tmp_path / "r1.txt").read_text()
+    assert r1 == "died"
+    r0 = (tmp_path / "r0.txt").read_text()
+    assert r0 in ("ERR_TIMED_OUT", "ERR_NO_MESSAGE",
+                  "ERR_NO_RESOURCE"), r0
