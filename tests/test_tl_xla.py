@@ -494,3 +494,18 @@ class TestXlaRootedPlacement:
         out = argses[root].dst.buffer
         np.testing.assert_array_equal(np.asarray(out), np.concatenate(srcs))
         assert len(set(out.devices())) == 1
+
+
+class TestXlaActiveSet:
+    def test_active_set_rejected_at_init(self, job, teams):
+        """Active-set colls post on a subset only; the full-team
+        rendezvous would hang waiting for the rest — TL/XLA must refuse
+        at init so selection falls through to subset-capable TLs."""
+        from ucc_tpu import ActiveSet, UccError
+        args = CollArgs(
+            coll_type=CollType.BCAST, root=0,
+            src=tpu_buf(job, 0, np.zeros(8, np.float32), DataType.FLOAT32),
+            active_set=ActiveSet(start=0, stride=1, size=2))
+        # TPU memtype has no subset-capable TL -> clean error, not a hang
+        with pytest.raises(UccError):
+            teams[0].collective_init(args)

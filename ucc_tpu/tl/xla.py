@@ -356,6 +356,13 @@ class XlaCollTask(CollTask):
         self._out = None
         self._out_by_dev = None
         args = init_args.args
+        if args.active_set is not None:
+            # only the subset posts an active-set coll; the full-team
+            # rendezvous would wait for deposits that never come. Host
+            # TLs run active sets over Subsets — fall through to them.
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "tl/xla does not run active-set collectives "
+                           "(subset posting vs full-team rendezvous)")
         self.np_dtype = dt_numpy((args.src or args.dst).datatype)
         self.coll = args.coll_type
         if self.coll == CollType.ALLTOALLV and (
