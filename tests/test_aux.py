@@ -321,3 +321,55 @@ class TestOneSidedRejected:
                 teams[0].collective_init(args)
         finally:
             job.cleanup()
+
+
+class TestTpuStreamEe:
+    """EeType.TPU_STREAM: stream-ordered triggers — the collective
+    dispatches when a jax array FUTURE resolves (the CUDA-stream analog:
+    post after the producing kernel), driven by the normal context
+    progress loop, no host signal or EE thread."""
+
+    def test_data_readiness_trigger(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        from ucc_tpu import MemoryType
+        from ucc_tpu.core.ee import Ee, UccEvent
+        from ucc_tpu.constants import EeType
+        n = 2
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            count = 16
+            # the producing compute: a jitted op whose RESULT triggers
+            # the collective (data dependence, not host signalling)
+            produced = [jax.jit(lambda x: x * 2)(
+                jax.device_put(jnp.full((count,), r + 1.0, jnp.float32),
+                               job.contexts[r].tl_contexts["xla"].obj.device))
+                for r in range(n)]
+            argses = [CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(produced[r], count, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
+                dst=BufferInfo(None, count, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
+                op=ReductionOp.SUM) for r in range(n)]
+            reqs = [teams[r].collective_init(argses[r]) for r in range(n)]
+            ees = [Ee(teams[r], EeType.TPU_STREAM) for r in range(n)]
+            try:
+                for r in range(n):
+                    ees[r].triggered_post(
+                        UccEvent(payload=produced[r]), reqs[r])
+                job.progress_until(lambda: all(
+                    rq.test() == Status.OK for rq in reqs), timeout=20)
+                expect = (1 + 2) * 2.0
+                for r in range(n):
+                    np.testing.assert_allclose(
+                        np.asarray(argses[r].dst.buffer), expect)
+                # completion events observable on the out queue
+                assert any(ees[r].get_event() is not None
+                           for r in range(n))
+            finally:
+                for ee in ees:
+                    ee.destroy()
+        finally:
+            job.cleanup()

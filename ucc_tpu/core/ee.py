@@ -31,7 +31,13 @@ logger = get_logger("ee")
 
 
 class UccEvent:
-    """ucc_ev_t: a signalable event with an optional payload."""
+    """ucc_ev_t: a signalable event with an optional payload.
+
+    STREAM-ORDERED TRIGGERS: when the payload is a jax array (an async
+    future), the event fires automatically once the array's computation
+    completes — `triggered_post(UccEvent(payload=some_jitted_result), req)`
+    is the TPU analog of posting onto a CUDA stream after a kernel: the
+    collective dispatches on data readiness, no host signal needed."""
 
     def __init__(self, ev_type: str = "compute_complete", payload=None):
         self.ev_type = ev_type
@@ -42,7 +48,18 @@ class UccEvent:
         self._set.set()
 
     def is_set(self) -> bool:
-        return self._set.is_set()
+        if self._set.is_set():
+            return True
+        p = self.payload
+        if p is not None and hasattr(p, "is_ready"):
+            try:
+                if p.is_ready():
+                    self._set.set()
+                    return True
+            except Exception:  # noqa: BLE001 - deleted/donated array
+                self._set.set()
+                return True
+        return False
 
 
 class Ee:
@@ -60,6 +77,16 @@ class Ee:
         if ee_type == EeType.CPU_THREAD:
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
+        else:
+            # TPU_STREAM: threadless — pending triggers (typically
+            # data-readiness events on jax futures) are polled by the
+            # context's normal progress loop
+            self._ctx_progress_hook = self.progress
+            try:
+                self.team.context.progress_queue.register_progress_fn(
+                    self._ctx_progress_hook)
+            except Exception:  # noqa: BLE001 - facade teams in tests
+                self._ctx_progress_hook = None
 
     # ------------------------------------------------------------------
     def triggered_post(self, event: UccEvent, req) -> Status:
@@ -118,6 +145,13 @@ class Ee:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if getattr(self, "_ctx_progress_hook", None) is not None:
+            try:
+                self.team.context.progress_queue.deregister_progress_fn(
+                    self._ctx_progress_hook)
+            except Exception:  # noqa: BLE001
+                pass
+            self._ctx_progress_hook = None
         return Status.OK
 
 
