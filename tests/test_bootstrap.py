@@ -1,0 +1,74 @@
+"""World bootstrap helper: one call brings up contexts + the world team
+across processes (the launcher-integration layer; reference users do this
+via MPI / torch.distributed stores)."""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+
+def _worker(rank, nprocs, port, outdir):
+    import traceback
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["UCC_BOOTSTRAP"] = f"127.0.0.1:{port}"
+        os.environ["UCC_RANK"] = str(rank)
+        os.environ["UCC_NPROCS"] = str(nprocs)
+        os.environ["UCC_RANKS_PER_PROC"] = "2"
+        from ucc_tpu.bootstrap import World
+        from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType,
+                             ReductionOp, Status)
+        world = World.from_env()
+        assert world.world_size == nprocs * 2
+        outs = []
+        for i, team in enumerate(world.teams):
+            r = rank * 2 + i
+            src = np.full(8, r + 1.0, np.float64)
+            dst = np.zeros(8, np.float64)
+            req = team.collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(src, 8, DataType.FLOAT64),
+                dst=BufferInfo(dst, 8, DataType.FLOAT64),
+                op=ReductionOp.SUM))
+            req.post()
+            outs.append((req, dst))
+        import time
+        deadline = time.monotonic() + 60
+        while any(rq.test() == Status.IN_PROGRESS for rq, _ in outs):
+            world.progress()
+            assert time.monotonic() < deadline
+        n = world.world_size
+        expect = n * (n + 1) / 2
+        for rq, dst in outs:
+            assert rq.test() == Status.OK
+            np.testing.assert_allclose(dst, expect)
+        world.finalize()
+        with open(os.path.join(outdir, f"r{rank}.txt"), "w") as f:
+            f.write("ok")
+    except Exception:  # noqa: BLE001
+        with open(os.path.join(outdir, f"r{rank}.txt"), "w") as f:
+            f.write("error:" + traceback.format_exc())
+
+
+def test_world_bootstrap_two_processes(tmp_path):
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    nprocs = 2
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=_worker,
+                         args=(r, nprocs, port, str(tmp_path)))
+             for r in range(nprocs)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=150)
+        if p.is_alive():
+            p.terminate()
+            pytest.fail("bootstrap worker hung")
+    for r in range(nprocs):
+        out = (tmp_path / f"r{r}.txt").read_text()
+        assert out == "ok", out

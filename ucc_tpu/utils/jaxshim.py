@@ -54,6 +54,27 @@ def ensure_live_backend(virtual_cpu_devices: int = 0,
     if "result" in _backend_probe_result:
         return _backend_probe_result["result"]
 
+    # cpu already requested (env or runtime config): nothing to probe —
+    # and the subprocess probe is NOT safe here anyway (a wedged
+    # accelerator plugin can hang its discovery regardless of
+    # JAX_PLATFORMS, burning the full probe timeout)
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        if virtual_cpu_devices:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count="
+                            f"{virtual_cpu_devices}").strip()
+        # jax may be PRELOADED (sitecustomize) with the env var read
+        # already past — the runtime config route is the reliable one
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 - backend already initialized
+            pass
+        _backend_probe_result["result"] = "cpu"
+        return "cpu"
+
     if virtual_cpu_devices:
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
