@@ -78,7 +78,7 @@ class World:
             except Exception as e:  # noqa: BLE001
                 errs.append(e)
 
-        ths = [threading.Thread(target=mk, args=(i, r))
+        ths = [threading.Thread(target=mk, args=(i, r), daemon=True)
                for i, r in enumerate(my_ranks)]
         for t in ths:
             t.start()
@@ -100,7 +100,7 @@ class World:
             except Exception as e:  # noqa: BLE001
                 errs.append(e)
 
-        ths = [threading.Thread(target=mkteam, args=(i, r))
+        ths = [threading.Thread(target=mkteam, args=(i, r), daemon=True)
                for i, r in enumerate(my_ranks)]
         for t in ths:
             t.start()
@@ -108,6 +108,9 @@ class World:
             t.join(timeout=timeout)
         if errs:
             raise errs[0]
+        if any(t is None for t in self.teams):
+            raise UccError(Status.ERR_TIMED_OUT,
+                           "bootstrap: team create timed out")
         import time as _time
         deadline = _time.monotonic() + timeout
         while True:

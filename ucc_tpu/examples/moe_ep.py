@@ -19,13 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import ops
-
-
-def _shard_map():
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map
-    return shard_map
+from ..utils.jaxshim import shard_map_compat
 
 
 def make_moe_layer(mesh: Mesh, d_model: int, capacity: int,
@@ -37,13 +31,10 @@ def make_moe_layer(mesh: Mesh, d_model: int, capacity: int,
     assign: per-token expert id.
     """
     n = len(mesh.devices.reshape(-1))
-    sm = _shard_map()
 
     def layer(x, w_up, w_dn, assign):
         # x: (tokens_local, d); assign: (tokens_local,) int32
         # 1. pack tokens into per-expert capacity slots (static shapes)
-        tokens_local = x.shape[0]
-        slot_of = jnp.full((n, capacity), -1, jnp.int32)
         # position of each token within its expert's block
         onehot = jax.nn.one_hot(assign, n, dtype=jnp.int32)
         pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # (tokens, n)
@@ -67,15 +58,8 @@ def make_moe_layer(mesh: Mesh, d_model: int, capacity: int,
         y = combined[assign, pos] * keep[:, None].astype(x.dtype)
         return y
 
-    try:
-        fn = sm(layer, mesh=mesh,
-                in_specs=(P(axis), P(axis), P(axis), P(axis)),
-                out_specs=P(axis), check_vma=False)
-    except TypeError:
-        fn = sm(layer, mesh=mesh,
-                in_specs=(P(axis), P(axis), P(axis), P(axis)),
-                out_specs=P(axis), check_rep=False)
-    return jax.jit(fn)
+    return jax.jit(shard_map_compat(
+        layer, mesh, (P(axis), P(axis), P(axis), P(axis)), P(axis)))
 
 
 def reference_moe(x, w_up, w_dn, assign, capacity: int):

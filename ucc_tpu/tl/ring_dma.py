@@ -218,7 +218,8 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
     elif mode == "reduce_scatter":
         chunks = _split(blk0, max(1, CHUNK_ELEMS // n))
     else:
-        chunks = _split(blk0, CHUNK_ELEMS)
+        # allgather's per-pass VMEM out is n*blk — bound blk accordingly
+        chunks = _split(blk0, max(1, CHUNK_ELEMS // n))
 
     def body(x):
         if mode != "allgather" and x.size != padded:
