@@ -221,3 +221,35 @@ class TestHierTpuAllgatherAlltoallv:
                           + 100 * p)[sd:sd + c]
                 np.testing.assert_array_equal(out[off:off + c], expect)
                 off += c
+
+
+class TestHierTpuPersistent:
+    def test_rab_tpu_repost(self, job, teams):
+        """Persistent HBM allreduce through the hier schedule: init once,
+        post three times with rebound sources."""
+        count = 24
+        argses, reqs = [], []
+        for r in range(N):
+            argses.append(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=dev_buf(job, r, np.full(count, 1.0, np.float32),
+                            DataType.FLOAT32),
+                dst=BufferInfo(None, count, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
+                op=ReductionOp.SUM,
+                flags=CollArgsFlags.PERSISTENT))
+            reqs.append(teams[r].collective_init(argses[r]))
+        for it in range(3):
+            if it:
+                for r in range(N):
+                    argses[r].src.buffer = dev_buf(
+                        job, r, np.full(count, float(it + 1), np.float32),
+                        DataType.FLOAT32).buffer
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs))
+            for r in range(N):
+                assert reqs[r].test() == Status.OK
+                np.testing.assert_allclose(
+                    np.asarray(argses[r].dst.buffer), N * (it + 1))

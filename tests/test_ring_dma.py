@@ -226,3 +226,29 @@ class TestRingDmaChunked:
             for r in range(N):
                 np.testing.assert_allclose(
                     np.asarray(argses[r].dst.buffer), expect)
+
+
+class TestRingDmaPersistent:
+    def test_persistent_repost(self, job, teams):
+        from ucc_tpu import CollArgsFlags
+        count = 32
+        srcs = [np.full(count, r + 1.0, np.float32) for r in range(N)]
+        argses = [CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=dev_buf(job, r, srcs[r], DataType.FLOAT32),
+            dst=BufferInfo(None, count, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU),
+            op=ReductionOp.SUM,
+            flags=CollArgsFlags.PERSISTENT) for r in range(N)]
+        reqs = [teams[r].collective_init(argses[r]) for r in range(N)]
+        for _ in range(3):
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs))
+            for r in range(N):
+                assert reqs[r].test() == Status.OK
+                np.testing.assert_allclose(
+                    np.asarray(argses[r].dst.buffer), N * (N + 1) / 2)
+        for rq in reqs:
+            rq.finalize()
