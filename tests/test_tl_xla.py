@@ -509,3 +509,34 @@ class TestXlaActiveSet:
         # TPU memtype has no subset-capable TL -> clean error, not a hang
         with pytest.raises(UccError):
             teams[0].collective_init(args)
+
+
+class TestXlaLaunchFailure:
+    def test_inconsistent_counts_fail_cleanly(self, job, teams):
+        """A user error (per-rank counts disagree) must fail every local
+        task with an error status — never wedge the rendezvous or raise
+        out of the progress loop."""
+        n = 4
+        counts = [16, 16, 16, 32]        # rank 3 lies
+        argses = [CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=tpu_buf(job, r, np.ones(counts[r], np.float32),
+                        DataType.FLOAT32),
+            dst=BufferInfo(None, counts[r], DataType.FLOAT32,
+                           mem_type=MemoryType.TPU),
+            op=ReductionOp.SUM) for r in range(n)]
+        reqs = [teams[r].collective_init(argses[r]) for r in range(n)]
+        for rq in reqs:
+            rq.post()
+        job.progress_until(lambda: all(
+            rq.test() != Status.IN_PROGRESS for rq in reqs), timeout=20)
+        assert any(rq.test().is_error for rq in reqs)
+        # the team must still be usable afterwards
+        good = [CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=tpu_buf(job, r, np.ones(8, np.float32), DataType.FLOAT32),
+            dst=BufferInfo(None, 8, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU),
+            op=ReductionOp.SUM) for r in range(n)]
+        run_xla(job, teams, lambda r: good[r])
+        np.testing.assert_allclose(np.asarray(good[0].dst.buffer), 4.0)
