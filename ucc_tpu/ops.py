@@ -166,6 +166,57 @@ def alltoall(x, axis_name: str = "r"):
     return y.reshape(x.shape)
 
 
+def alltoallv(x, counts, axis_name: str = "r"):
+    """In-jit alltoallv with a STATIC per-pair counts matrix
+    (``counts[i][j]`` = elements rank i sends rank j) — the uneven-routing
+    primitive MoE-style workloads need without capacity padding.
+
+    Layout contract (packed): rank i's send buffer ``x`` holds its blocks
+    for ranks 0..n-1 back to back (cumsum displacements), padded to
+    ``max_i sum_j counts[i][j]`` elements; the return value is the recv
+    buffer in the same packed layout (blocks from ranks 0..n-1), padded
+    to ``max_j sum_i counts[i][j]``. XLA sees only static shapes: the
+    per-rank pack/unpack index maps are computed at trace time and
+    selected by ``axis_index`` inside the program (the same static
+    index-map technique the TL/XLA alltoallv program uses)."""
+    import numpy as np
+    m = np.asarray(counts, dtype=np.int64)
+    n = m.shape[0]
+    maxblk = max(1, int(m.max()))
+    max_src = max(1, int(m.sum(axis=1).max()))
+    max_dst = max(1, int(m.sum(axis=0).max()))
+    sdispl = np.zeros((n, n), dtype=np.int64)
+    sdispl[:, 1:] = np.cumsum(m, axis=1)[:, :-1]
+    rdispl = np.zeros((n, n), dtype=np.int64)
+    rdispl[1:, :] = np.cumsum(m, axis=0)[:-1, :]
+    # pack: PIDX[i][p*maxblk+j] = sdispl[i][p]+j  (pad -1)
+    pidx = np.full((n, n * maxblk), -1, dtype=np.int32)
+    # unpack over exchanged rows (row p = data from rank p):
+    # UIDX[i][rdispl[p][i]+j] = p*maxblk+j
+    uidx = np.full((n, max_dst), -1, dtype=np.int32)
+    for i in range(n):
+        for p in range(n):
+            c = int(m[i, p])
+            pidx[i, p * maxblk:p * maxblk + c] = np.arange(
+                sdispl[i, p], sdispl[i, p] + c)
+            c = int(m[p, i])
+            uidx[i, rdispl[p, i]:rdispl[p, i] + c] = np.arange(
+                p * maxblk, p * maxblk + c)
+    pidx_c = jnp.asarray(pidx)
+    uidx_c = jnp.asarray(uidx)
+    me = lax.axis_index(axis_name)
+    flat = jnp.ravel(x)
+    if flat.size < max_src:
+        flat = jnp.pad(flat, (0, max_src - flat.size))
+    pi = pidx_c[me]
+    packed = jnp.where(pi >= 0, flat[jnp.clip(pi, 0, max_src - 1)], 0)
+    y = lax.all_to_all(packed.reshape(n, maxblk), axis_name,
+                       split_axis=0, concat_axis=0, tiled=False)
+    rows = y.reshape(n * maxblk)
+    ui = uidx_c[me]
+    return jnp.where(ui >= 0, rows[jnp.clip(ui, 0, n * maxblk - 1)], 0)
+
+
 def bcast(x, root: int, axis_name: str = "r"):
     """Root's shard to everyone (masked psum — the ICI-friendly form)."""
     me = lax.axis_index(axis_name)
