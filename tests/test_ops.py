@@ -159,3 +159,32 @@ class TestOpsAlltoallv:
                 np.testing.assert_array_equal(got[off:off + c], expect)
                 off += c
             np.testing.assert_array_equal(got[off:max_dst], 0)
+
+    def test_allgatherv_matches_numpy(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ucc_tpu.utils.jaxshim import shard_map_compat
+        n = min(8, len(jax.devices()))
+        if n < 2:
+            pytest.skip("needs >= 2 devices")
+        counts = [(i % 4) for i in range(n)]      # includes zeros
+        maxc = max(1, max(counts))
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:n]), ("r",))
+        srcs = []
+        for i in range(n):
+            s = np.zeros(maxc, np.int32)
+            s[:counts[i]] = np.arange(counts[i]) + 10 * i
+            srcs.append(s)
+        garr = jax.make_array_from_single_device_arrays(
+            (n * maxc,), NamedSharding(mesh, P("r")),
+            [jax.device_put(jnp.asarray(srcs[i]),
+                            mesh.devices.reshape(-1)[i])
+             for i in range(n)])
+        prog = jax.jit(shard_map_compat(
+            lambda x: ops.allgatherv(x, counts), mesh, P("r"), P(None)))
+        out = np.asarray(prog(garr))
+        expect = np.concatenate(
+            [np.arange(counts[i], dtype=np.int32) + 10 * i
+             for i in range(n)])
+        np.testing.assert_array_equal(out, expect)

@@ -166,6 +166,25 @@ def alltoall(x, axis_name: str = "r"):
     return y.reshape(x.shape)
 
 
+def allgatherv(x, counts, axis_name: str = "r"):
+    """In-jit allgatherv with STATIC per-rank counts: each rank
+    contributes ``counts[i]`` elements (x padded to max(counts)); returns
+    the packed concatenation (sum(counts) elements, same on every rank).
+    Implemented as a padded all_gather + a static gather-index unpack."""
+    import numpy as np
+    c = [int(v) for v in counts]
+    n = len(c)
+    maxc = max(1, max(c) if c else 1)
+    flat = jnp.ravel(x)
+    if flat.size < maxc:
+        flat = jnp.pad(flat, (0, maxc - flat.size))
+    g = lax.all_gather(flat[:maxc], axis_name, axis=0, tiled=False)  # (n, maxc)
+    rows = g.reshape(n * maxc)
+    idx = np.concatenate([i * maxc + np.arange(c[i]) for i in range(n)]) \
+        if sum(c) else np.zeros(1, np.int64)
+    return rows[jnp.asarray(idx, dtype=jnp.int32)]
+
+
 def alltoallv(x, counts, axis_name: str = "r"):
     """In-jit alltoallv with a STATIC per-pair counts matrix
     (``counts[i][j]`` = elements rank i sends rank j) — the uneven-routing
