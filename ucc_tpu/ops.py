@@ -181,8 +181,53 @@ def allgatherv(x, counts, axis_name: str = "r"):
     g = lax.all_gather(flat[:maxc], axis_name, axis=0, tiled=False)  # (n, maxc)
     rows = g.reshape(n * maxc)
     idx = np.concatenate([i * maxc + np.arange(c[i]) for i in range(n)]) \
-        if sum(c) else np.zeros(1, np.int64)
-    return rows[jnp.asarray(idx, dtype=jnp.int32)]
+        if sum(c) else np.empty(0, np.int64)
+    return rows[jnp.asarray(idx, dtype=jnp.int32)]   # (sum(counts),)
+
+
+def a2av_index_maps(srows, drows):
+    """Static pack/unpack index maps for alltoallv — ONE home for the
+    subtle part, shared by ``ops.alltoallv`` and the TL/XLA program
+    builder. ``srows[i] = (scounts, sdispls)`` describes rank i's send
+    layout; ``drows[i]`` its recv layout (displacements may have gaps).
+    Returns (pidx, uidx, maxblk, max_src, max_span) where
+    PIDX[i][p*maxblk+j] = sdispl_i[p]+j and, over the exchanged rows
+    (row p = data from rank p), UIDX[i][ddispl_i[p]+j] = p*maxblk+j
+    (-1 = padding)."""
+    import numpy as np
+    n = len(srows)
+    maxblk = max((c for sc, _ in srows for c in sc), default=1) or 1
+    max_src = max((sum(sc) for sc, _ in srows), default=1) or 1
+    max_span = max((max((dd[p] + dc[p] for p in range(n)), default=0)
+                    for dc, dd in drows), default=1) or 1
+    pidx = np.full((n, n * maxblk), -1, dtype=np.int32)
+    for r, (sc, sd) in enumerate(srows):
+        for p in range(n):
+            pidx[r, p * maxblk:p * maxblk + sc[p]] = \
+                np.arange(sd[p], sd[p] + sc[p])
+    uidx = np.full((n, max_span), -1, dtype=np.int32)
+    for r, (dc, dd) in enumerate(drows):
+        for p in range(n):
+            uidx[r, dd[p]:dd[p] + dc[p]] = \
+                np.arange(p * maxblk, p * maxblk + dc[p])
+    return pidx, uidx, maxblk, max_src, max_span
+
+
+def a2av_exchange(x, pidx_c, uidx_c, n: int, maxblk: int, max_src: int,
+                  axis_name: str = "r"):
+    """The in-jit alltoallv body over prebuilt index maps: mask-pack,
+    all_to_all, mask-unpack (shared with the TL/XLA program)."""
+    me = lax.axis_index(axis_name)
+    flat = jnp.ravel(x)
+    if flat.size < max_src:
+        flat = jnp.pad(flat, (0, max_src - flat.size))
+    pi = pidx_c[me]
+    packed = jnp.where(pi >= 0, flat[jnp.clip(pi, 0, max_src - 1)], 0)
+    y = lax.all_to_all(packed.reshape(n, maxblk), axis_name,
+                       split_axis=0, concat_axis=0, tiled=False)
+    rows = y.reshape(n * maxblk)
+    ui = uidx_c[me]
+    return jnp.where(ui >= 0, rows[jnp.clip(ui, 0, n * maxblk - 1)], 0)
 
 
 def alltoallv(x, counts, axis_name: str = "r"):
@@ -196,44 +241,21 @@ def alltoallv(x, counts, axis_name: str = "r"):
     buffer in the same packed layout (blocks from ranks 0..n-1), padded
     to ``max_j sum_i counts[i][j]``. XLA sees only static shapes: the
     per-rank pack/unpack index maps are computed at trace time and
-    selected by ``axis_index`` inside the program (the same static
-    index-map technique the TL/XLA alltoallv program uses)."""
+    selected by ``axis_index`` inside the program."""
     import numpy as np
     m = np.asarray(counts, dtype=np.int64)
     n = m.shape[0]
-    maxblk = max(1, int(m.max()))
-    max_src = max(1, int(m.sum(axis=1).max()))
-    max_dst = max(1, int(m.sum(axis=0).max()))
     sdispl = np.zeros((n, n), dtype=np.int64)
     sdispl[:, 1:] = np.cumsum(m, axis=1)[:, :-1]
     rdispl = np.zeros((n, n), dtype=np.int64)
     rdispl[1:, :] = np.cumsum(m, axis=0)[:-1, :]
-    # pack: PIDX[i][p*maxblk+j] = sdispl[i][p]+j  (pad -1)
-    pidx = np.full((n, n * maxblk), -1, dtype=np.int32)
-    # unpack over exchanged rows (row p = data from rank p):
-    # UIDX[i][rdispl[p][i]+j] = p*maxblk+j
-    uidx = np.full((n, max_dst), -1, dtype=np.int32)
-    for i in range(n):
-        for p in range(n):
-            c = int(m[i, p])
-            pidx[i, p * maxblk:p * maxblk + c] = np.arange(
-                sdispl[i, p], sdispl[i, p] + c)
-            c = int(m[p, i])
-            uidx[i, rdispl[p, i]:rdispl[p, i] + c] = np.arange(
-                p * maxblk, p * maxblk + c)
-    pidx_c = jnp.asarray(pidx)
-    uidx_c = jnp.asarray(uidx)
-    me = lax.axis_index(axis_name)
-    flat = jnp.ravel(x)
-    if flat.size < max_src:
-        flat = jnp.pad(flat, (0, max_src - flat.size))
-    pi = pidx_c[me]
-    packed = jnp.where(pi >= 0, flat[jnp.clip(pi, 0, max_src - 1)], 0)
-    y = lax.all_to_all(packed.reshape(n, maxblk), axis_name,
-                       split_axis=0, concat_axis=0, tiled=False)
-    rows = y.reshape(n * maxblk)
-    ui = uidx_c[me]
-    return jnp.where(ui >= 0, rows[jnp.clip(ui, 0, n * maxblk - 1)], 0)
+    srows = [([int(c) for c in m[i]], [int(d) for d in sdispl[i]])
+             for i in range(n)]
+    drows = [([int(m[p, i]) for p in range(n)],
+              [int(rdispl[p, i]) for p in range(n)]) for i in range(n)]
+    pidx, uidx, maxblk, max_src, _ = a2av_index_maps(srows, drows)
+    return a2av_exchange(x, jnp.asarray(pidx), jnp.asarray(uidx), n,
+                         maxblk, max_src, axis_name)
 
 
 def bcast(x, root: int, axis_name: str = "r"):

@@ -525,38 +525,15 @@ class XlaCollTask(CollTask):
         if cached is not None:
             return cached
 
-        maxblk = max((c for sc, _ in rows for c in sc), default=1) or 1
-        max_src = max((sum(sc) for sc, _ in rows), default=1) or 1
-        max_span = max((max((dd[p] + dc[p] for p in range(n)), default=0)
-                        for dc, dd in dsts), default=1) or 1
-
-        # pack index: PIDX[r][p*maxblk+j] = sdispl[r][p]+j (or -1 pad)
-        pidx = np.full((n, n * maxblk), -1, dtype=np.int32)
-        for r, (sc, sd) in enumerate(rows):
-            for p in range(n):
-                pidx[r, p * maxblk:p * maxblk + sc[p]] = \
-                    np.arange(sd[p], sd[p] + sc[p])
-        # unpack index over exchanged rows (row p = data from rank p):
-        # UIDX[r][ddispl[r][p]+j] = p*maxblk + j
-        uidx = np.full((n, max_span), -1, dtype=np.int32)
-        for r, (dc, dd) in enumerate(dsts):
-            for p in range(n):
-                uidx[r, dd[p]:dd[p] + dc[p]] = \
-                    np.arange(p * maxblk, p * maxblk + dc[p])
-
+        # the index-map construction + exchange body live in ops (shared
+        # with the public in-jit ops.alltoallv)
+        from ..ops import a2av_exchange, a2av_index_maps
+        pidx, uidx, maxblk, max_src, _ = a2av_index_maps(rows, dsts)
         pidx_c = jnp.asarray(pidx)
         uidx_c = jnp.asarray(uidx)
 
         def body(x):                 # (max_src,) raw flat send buffer
-            me = jax.lax.axis_index("r")
-            pi = pidx_c[me]
-            packed = jnp.where(pi >= 0, x[jnp.clip(pi, 0, max_src - 1)], 0)
-            y = jax.lax.all_to_all(packed.reshape(n, maxblk), "r",
-                                   split_axis=0, concat_axis=0, tiled=False)
-            flat_rows = y.reshape(n * maxblk)
-            ui = uidx_c[me]
-            return jnp.where(ui >= 0,
-                             flat_rows[jnp.clip(ui, 0, n * maxblk - 1)], 0)
+            return a2av_exchange(x, pidx_c, uidx_c, n, maxblk, max_src)
 
         program = jax.jit(shard_map_compat(body, shared.mesh, P("r"),
                                            P("r")))
