@@ -540,3 +540,19 @@ class TestXlaLaunchFailure:
             op=ReductionOp.SUM) for r in range(n)]
         run_xla(job, teams, lambda r: good[r])
         np.testing.assert_allclose(np.asarray(good[0].dst.buffer), 4.0)
+
+
+class TestXlaGenericDt:
+    def test_generic_dtype_rejected_cleanly(self, job, teams):
+        """User-defined datatypes have no numeric compute type for a
+        compiled program: clean NOT_SUPPORTED, not a raw ValueError
+        (reference device TLs reject the same way)."""
+        from ucc_tpu import UccError
+        from ucc_tpu.constants import GenericDataType
+        gdt = GenericDataType(8, name="opaque")
+        arr = dev_array(job, 0, np.zeros(8, np.uint8))
+        with pytest.raises(UccError):
+            teams[0].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(arr, 8, gdt, mem_type=MemoryType.TPU),
+                dst=BufferInfo(None, 8, gdt, mem_type=MemoryType.TPU)))

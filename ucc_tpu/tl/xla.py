@@ -363,6 +363,14 @@ class XlaCollTask(CollTask):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            "tl/xla does not run active-set collectives "
                            "(subset posting vs full-team rendezvous)")
+        from ..constants import GenericDataType
+        if isinstance((args.src or args.dst).datatype, GenericDataType):
+            # compiled programs need a numeric compute type; the host TLs
+            # move generic dts as raw bytes (reference device TLs reject
+            # user-defined dts the same way, allgather_sparbit.c:25-29)
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "tl/xla does not support user-defined "
+                           "datatypes")
         self.np_dtype = dt_numpy((args.src or args.dst).datatype)
         self.coll = args.coll_type
         if self.coll == CollType.ALLTOALLV and (
