@@ -106,6 +106,12 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if not any(vars(args).values()):
         args.version = True
+    if args.scores or args.caps:
+        # these create contexts (device TLs probe the backend): make sure
+        # the backend is reachable first — one probe with CPU fallback
+        # instead of a per-TL discovery timeout on a wedged accelerator
+        from ..utils.jaxshim import ensure_live_backend
+        ensure_live_backend(virtual_cpu_devices=4)
     if args.version:
         print_version()
     if args.caps:
