@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Periodic real-TPU liveness probe + artifact auto-capture (round 3).
+
+The axon TPU tunnel has been wedged since round 2 (device discovery
+hangs inside PJRT plugin init, so any in-process ``jax.devices()`` call
+never returns).  This daemon makes the recovery attempt *evidence*:
+
+- every ``--interval`` seconds it spawns a throwaway subprocess that
+  tries to enumerate devices and run one tiny matmul on the default
+  (non-forced) platform, with a hard timeout + process-group kill;
+- every attempt is appended to ``TPU_PROBE_r03.log`` with a timestamp
+  and outcome (``hang``/``error``/``ok platform=...``);
+- on the FIRST success it runs the real-chip capture suite:
+    * ``bench.py`` single-chip latency mode -> ``BENCH_TPU_r03.json``
+    * the ring_dma real-chip compile test (the one standing skip)
+    * the Pallas EC kernel smoke
+  and records each result in the log, then keeps probing at a lower
+  cadence so a later wedge is also visible in the history.
+
+Run detached:  nohup python tools/tpu_probe.py >/dev/null 2>&1 &
+
+Mirrors the intent of the reference's perf capture flow
+(/root/reference/tools/perf/ucc_pt_benchmark.cc) being run on real
+hardware: numbers without a platform record are not evidence.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_PROBE_r03.log")
+
+PROBE_SRC = r"""
+import jax
+ds = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+(x @ x).block_until_ready()
+print("PROBE_OK platform=%s kind=%s n=%d" % (
+    ds[0].platform, getattr(ds[0], "device_kind", "?"), len(ds)))
+"""
+
+
+def log(line: str) -> None:
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    with open(LOG, "a") as f:
+        f.write(f"{stamp} {line}\n")
+
+
+def run_sub(argv, timeout, env=None):
+    """Run argv in its own process group; kill the whole group on timeout."""
+    full_env = dict(os.environ)
+    # The probe wants the REAL platform: drop any cpu-forcing leftovers.
+    full_env.pop("JAX_PLATFORMS", None)
+    full_env.pop("XLA_FLAGS", None)
+    if env:
+        full_env.update(env)
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=full_env, start_new_session=True, cwd=REPO)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        return proc.returncode, out
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        return None, ""
+
+
+def probe_once(timeout: float):
+    rc, out = run_sub([sys.executable, "-c", PROBE_SRC], timeout)
+    if rc is None:
+        return "hang", ""
+    tail = out.strip().splitlines()[-1] if out.strip() else ""
+    if rc == 0 and "PROBE_OK" in out:
+        return "ok", tail
+    return "error", tail[-200:]
+
+
+def capture_artifacts():
+    """Chip is alive: grab bench + ring_dma compile + EC kernel evidence."""
+    log("CAPTURE: starting real-chip artifact capture")
+
+    rc, out = run_sub([sys.executable, "bench.py"], timeout=1200)
+    if rc == 0 and out.strip():
+        line = out.strip().splitlines()[-1]
+        try:
+            rec = json.loads(line)
+            rec["captured_by"] = "tools/tpu_probe.py"
+            rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+            with open(os.path.join(REPO, "BENCH_TPU_r03.json"), "w") as f:
+                json.dump(rec, f, indent=1)
+            log(f"CAPTURE: bench ok -> BENCH_TPU_r03.json {line}")
+        except ValueError:
+            log(f"CAPTURE: bench output unparseable: {line[:200]}")
+    else:
+        log(f"CAPTURE: bench failed rc={rc} tail={out.strip()[-200:]!r}")
+
+    rc, out = run_sub(
+        [sys.executable, "-m", "pytest", "tests/test_ring_dma.py",
+         "-q", "--no-header", "-k", "real", "--override-ini",
+         "addopts="],
+        timeout=900, env={"UCC_TPU_REAL_CHIP": "1"})
+    log(f"CAPTURE: ring_dma real-chip test rc={rc} "
+        f"tail={out.strip().splitlines()[-1] if out.strip() else ''!r}")
+
+    rc, out = run_sub(
+        [sys.executable, "-c",
+         "from ucc_tpu.ec.tpu import EcTpu; import jax, numpy as np;"
+         "import jax.numpy as jnp;"
+         "ec=EcTpu(); a=jnp.arange(4096,dtype=jnp.float32);"
+         "print('EC_OK', np.asarray(ec.reduce([a,a],op='sum'))[:2])"],
+        timeout=600)
+    log(f"CAPTURE: EC pallas smoke rc={rc} "
+        f"tail={out.strip().splitlines()[-1] if out.strip() else ''!r}")
+    log("CAPTURE: done")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=900.0)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args()
+
+    log(f"probe daemon start pid={os.getpid()} interval={args.interval}s "
+        f"timeout={args.timeout}s")
+    captured = os.path.exists(os.path.join(REPO, "BENCH_TPU_r03.json"))
+    while True:
+        outcome, detail = probe_once(args.timeout)
+        log(f"probe outcome={outcome} {detail}")
+        if outcome == "ok" and not captured:
+            capture_artifacts()
+            captured = True
+        if args.once:
+            break
+        time.sleep(args.interval if not captured else args.interval * 4)
+
+
+if __name__ == "__main__":
+    main()

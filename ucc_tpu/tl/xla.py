@@ -200,7 +200,14 @@ class XlaTeamShared:
             proto = slot[min(slot)][1]
             if proto.coll in (CollType.GATHER, CollType.GATHERV,
                               CollType.SCATTER, CollType.REDUCE) and \
-                    len(self.devices) > 1:
+                    len(self.devices) > 1 and \
+                    self.n_local == len(self.devices):
+                # Explicit-placement fast path needs every rank's shard in
+                # THIS process's slot (and every device addressable for
+                # device_put).  Teams spanning processes (n_local < size)
+                # fall through to the replicated shard_map program, which
+                # is multi-controller safe — same gate as ALLTOALLV's
+                # alg_table entry.
                 self._launch_rooted(slot, proto)
                 return
             bufs = tuple(buf for _, (buf, _t) in sorted(slot.items()))

@@ -310,6 +310,13 @@ def main(argv=None) -> int:
     bmax = parse_memunits(args.end)
     esz = dt_size(dt)
 
+    # Guard every jax touch (device enumeration AND the TL/XLA context
+    # probe during Context create) against a wedged accelerator tunnel:
+    # probe in a subprocess, fall back to the CPU platform (with enough
+    # virtual devices for the requested rank count) if it hangs.
+    from ..utils.jaxshim import ensure_live_backend
+    ensure_live_backend(virtual_cpu_devices=max(args.nprocs, 8))
+
     devices = None
     if mem == MemoryType.TPU:
         import jax
