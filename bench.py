@@ -148,8 +148,13 @@ def _measure_point(coll: str, count: int, ctxs, teams, devices, mesh,
             for c in ctxs:
                 c.progress()
         # device-mem collectives complete at dispatch (stream-ordered);
-        # hard completion = output readiness, same as the raw loop's block
-        jax.block_until_ready([a.dst.buffer for a in argses])
+        # hard completion = readiness of the launch's global output — the
+        # SAME object the raw loop blocks on (one block per process, which
+        # is also the real per-process cost: the in-process 8-rank job
+        # would otherwise pay 8x the block overhead no real deployment has)
+        glob = getattr(reqs[0].task, "_out", None)
+        jax.block_until_ready(
+            glob if glob is not None else [a.dst.buffer for a in argses])
 
     for _ in range(warmup):
         raw_round()

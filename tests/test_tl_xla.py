@@ -359,8 +359,11 @@ class TestXlaPersistent:
     unchanged; rebinding src changes the buffers and must recompute."""
 
     def test_repost_unchanged_buffers(self, job, teams):
+        # count above SHORT_MSG_MAX: the launch cache belongs to the
+        # compiled-program path (short messages go host-staged eager and
+        # have nothing to cache — TestXlaShortMsg covers them)
         from ucc_tpu import CollArgsFlags
-        n, count = 4, 32
+        n, count = 4, 64 << 10
         srcs = [dev_array(job, r, np.full(count, r + 1.0, np.float32))
                 for r in range(n)]
         argses = [CollArgs(
@@ -556,3 +559,131 @@ class TestXlaGenericDt:
                 coll_type=CollType.ALLREDUCE,
                 src=BufferInfo(arr, 8, gdt, mem_type=MemoryType.TPU),
                 dst=BufferInfo(None, 8, gdt, mem_type=MemoryType.TPU)))
+
+
+class TestXlaShortMsg:
+    """The latency-optimized short-message algorithm (tl/xla 'short'):
+    host-staged eager reduce + ONE replicated jax.device_put instead of a
+    compiled collective program — the tl_ucp short-protocol analog
+    (reference: tl_ucp short vs long protocol split). Selected by score
+    range below UCC_TL_XLA_SHORT_MSG_MAX on fully process-local teams."""
+
+    def test_selected_below_threshold(self, teams):
+        cands = teams[0].score_map.lookup(CollType.ALLREDUCE,
+                                          MemoryType.TPU, 64)
+        assert cands[0].alg_name == "short"
+        big = teams[0].score_map.lookup(CollType.ALLREDUCE,
+                                        MemoryType.TPU, 1 << 20)
+        assert big[0].alg_name != "short"
+
+    @pytest.mark.parametrize("op,expect", [
+        (ReductionOp.SUM, 10.0), (ReductionOp.MAX, 4.0),
+        (ReductionOp.MIN, 1.0), (ReductionOp.AVG, 2.5),
+    ])
+    def test_allreduce_ops(self, job, teams, op, expect):
+        n, count = 4, 16
+        argses = [CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=tpu_buf(job, r, np.full(count, r + 1.0, np.float32),
+                        DataType.FLOAT32),
+            dst=BufferInfo(None, count, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU),
+            op=op) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        for r in range(n):
+            np.testing.assert_allclose(np.asarray(argses[r].dst.buffer),
+                                       expect)
+
+    def test_persistent_repost_no_program(self, job, teams):
+        """Persistent short re-posts go through the eager path every round
+        (nothing to launch-cache) and the fast re-post lane keeps them
+        correct across rounds."""
+        n, count = 4, 8
+        xla_team = next(t for t in teams[0].cl_teams[0].tl_teams
+                        if t.name == "xla")
+        cache_before = len(xla_team.shared.launch_cache)
+        argses = [CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=tpu_buf(job, r, np.full(count, r + 1.0, np.float32),
+                        DataType.FLOAT32),
+            dst=BufferInfo(None, count, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU),
+            op=ReductionOp.SUM,
+            flags=CollArgsFlags.PERSISTENT) for r in range(n)]
+        reqs = [teams[r].collective_init(argses[r]) for r in range(n)]
+        for _ in range(3):
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs))
+            for r in range(n):
+                assert reqs[r].test() == Status.OK
+                np.testing.assert_allclose(
+                    np.asarray(argses[r].dst.buffer), 10.0)
+        assert len(xla_team.shared.launch_cache) == cache_before
+        for rq in reqs:
+            rq.finalize()
+
+    def test_bcast_reduce_allgather(self, job, teams):
+        n, count = 4, 12
+        data = np.arange(count, dtype=np.float32) * 3
+        argses = []
+        for r in range(n):
+            src = data if r == 1 else np.zeros(count, np.float32)
+            argses.append(CollArgs(coll_type=CollType.BCAST, root=1,
+                                   src=tpu_buf(job, r, src,
+                                               DataType.FLOAT32)))
+        run_xla(job, teams, lambda r: argses[r])
+        for r in range(n):
+            np.testing.assert_allclose(np.asarray(argses[r].src.buffer),
+                                       data)
+        argses = [CollArgs(
+            coll_type=CollType.REDUCE, root=2, op=ReductionOp.SUM,
+            src=tpu_buf(job, r, np.full(count, r + 1.0, np.float32),
+                        DataType.FLOAT32),
+            dst=BufferInfo(None, count, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU)) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        np.testing.assert_allclose(np.asarray(argses[2].dst.buffer), 10.0)
+        argses = [CollArgs(
+            coll_type=CollType.ALLGATHER,
+            src=tpu_buf(job, r, np.full(count, float(r), np.float32),
+                        DataType.FLOAT32),
+            dst=BufferInfo(None, n * count, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU)) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        full = np.concatenate([np.full(count, float(g), np.float32)
+                               for g in range(n)])
+        for r in range(n):
+            np.testing.assert_allclose(np.asarray(argses[r].dst.buffer),
+                                       full)
+
+    def test_barrier_rendezvous(self, job, teams):
+        argses = [CollArgs(coll_type=CollType.BARRIER) for _ in range(4)]
+        run_xla(job, teams, lambda r: argses[r])
+
+    def test_unmapped_op_falls_through_to_program(self, job, teams):
+        """Ops without a host ufunc (LAND) at short sizes must fall back
+        to the compiled-program path inside the same launch, not fail."""
+        n, count = 4, 8
+        argses = [CollArgs(
+            coll_type=CollType.ALLREDUCE, op=ReductionOp.LAND,
+            src=tpu_buf(job, r, np.full(count, float(r % 2), np.float32),
+                        DataType.FLOAT32),
+            dst=BufferInfo(None, count, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU)) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        for r in range(n):
+            np.testing.assert_allclose(np.asarray(argses[r].dst.buffer),
+                                       0.0)
+
+    def test_threshold_disable(self, monkeypatch):
+        monkeypatch.setenv("UCC_TL_XLA_SHORT_MSG_MAX", "0")
+        j = UccJob(2)
+        try:
+            teams = j.create_team()
+            cands = teams[0].score_map.lookup(CollType.ALLREDUCE,
+                                              MemoryType.TPU, 64)
+            assert all(c.alg_name != "short" for c in cands)
+        finally:
+            j.cleanup()

@@ -72,7 +72,13 @@ def run_sub(argv, timeout, env=None):
             os.killpg(proc.pid, signal.SIGKILL)
         except OSError:
             pass
-        proc.wait()
+        try:
+            # BOUNDED reap: a child stuck in an uninterruptible ioctl
+            # (the wedged-tunnel D-state, see jaxshim.ensure_live_backend)
+            # ignores SIGKILL — abandon it rather than wedging the daemon
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
         return None, ""
 
 
@@ -86,43 +92,75 @@ def probe_once(timeout: float):
     return "error", tail[-200:]
 
 
+STATE = os.path.join(REPO, "TPU_PROBE_STATE.json")
+
+
+def _load_state():
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def _save_state(state):
+    with open(STATE, "w") as f:
+        json.dump(state, f, indent=1)
+
+
 def capture_artifacts():
-    """Chip is alive: grab bench + ring_dma compile + EC kernel evidence."""
-    log("CAPTURE: starting real-chip artifact capture")
+    """Chip is alive: grab bench + ring_dma compile + EC kernel evidence.
+    Per-artifact success is persisted in TPU_PROBE_STATE.json so a daemon
+    restart after a partial capture retries only what is missing."""
+    state = _load_state()
+    log("CAPTURE: starting real-chip artifact capture "
+        f"(already done: {[k for k, v in state.items() if v]})")
 
-    rc, out = run_sub([sys.executable, "bench.py"], timeout=1200)
-    if rc == 0 and out.strip():
-        line = out.strip().splitlines()[-1]
-        try:
-            rec = json.loads(line)
-            rec["captured_by"] = "tools/tpu_probe.py"
-            rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
-            with open(os.path.join(REPO, "BENCH_TPU_r03.json"), "w") as f:
-                json.dump(rec, f, indent=1)
-            log(f"CAPTURE: bench ok -> BENCH_TPU_r03.json {line}")
-        except ValueError:
-            log(f"CAPTURE: bench output unparseable: {line[:200]}")
-    else:
-        log(f"CAPTURE: bench failed rc={rc} tail={out.strip()[-200:]!r}")
+    if not state.get("bench"):
+        rc, out = run_sub([sys.executable, "bench.py"], timeout=1200)
+        if rc == 0 and out.strip():
+            line = out.strip().splitlines()[-1]
+            try:
+                rec = json.loads(line)
+                rec["captured_by"] = "tools/tpu_probe.py"
+                rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+                with open(os.path.join(REPO, "BENCH_TPU_r03.json"),
+                          "w") as f:
+                    json.dump(rec, f, indent=1)
+                log(f"CAPTURE: bench ok -> BENCH_TPU_r03.json {line}")
+                state["bench"] = True
+            except ValueError:
+                log(f"CAPTURE: bench output unparseable: {line[:200]}")
+        else:
+            log(f"CAPTURE: bench failed rc={rc} "
+                f"tail={out.strip()[-200:]!r}")
+        _save_state(state)
 
-    rc, out = run_sub(
-        [sys.executable, "-m", "pytest", "tests/test_ring_dma.py",
-         "-q", "--no-header", "-k", "real", "--override-ini",
-         "addopts="],
-        timeout=900, env={"UCC_TPU_REAL_CHIP": "1"})
-    log(f"CAPTURE: ring_dma real-chip test rc={rc} "
-        f"tail={out.strip().splitlines()[-1] if out.strip() else ''!r}")
+    if not state.get("ring_dma"):
+        rc, out = run_sub(
+            [sys.executable, "-m", "pytest", "tests/test_ring_dma.py",
+             "-q", "--no-header", "-k", "real", "--override-ini",
+             "addopts="],
+            timeout=900, env={"UCC_TPU_REAL_CHIP": "1"})
+        log(f"CAPTURE: ring_dma real-chip test rc={rc} "
+            f"tail={out.strip().splitlines()[-1] if out.strip() else ''!r}")
+        state["ring_dma"] = rc == 0
+        _save_state(state)
 
-    rc, out = run_sub(
-        [sys.executable, "-c",
-         "from ucc_tpu.ec.tpu import EcTpu; import jax, numpy as np;"
-         "import jax.numpy as jnp;"
-         "ec=EcTpu(); a=jnp.arange(4096,dtype=jnp.float32);"
-         "print('EC_OK', np.asarray(ec.reduce([a,a],op='sum'))[:2])"],
-        timeout=600)
-    log(f"CAPTURE: EC pallas smoke rc={rc} "
-        f"tail={out.strip().splitlines()[-1] if out.strip() else ''!r}")
+    if not state.get("ec"):
+        rc, out = run_sub(
+            [sys.executable, "-c",
+             "from ucc_tpu.ec.tpu import EcTpu; import jax, numpy as np;"
+             "import jax.numpy as jnp;"
+             "ec=EcTpu(); a=jnp.arange(4096,dtype=jnp.float32);"
+             "print('EC_OK', np.asarray(ec.reduce([a,a],op='sum'))[:2])"],
+            timeout=600)
+        log(f"CAPTURE: EC pallas smoke rc={rc} "
+            f"tail={out.strip().splitlines()[-1] if out.strip() else ''!r}")
+        state["ec"] = rc == 0
+        _save_state(state)
     log("CAPTURE: done")
+    return all(state.get(k) for k in ("bench", "ring_dma", "ec"))
 
 
 def main():
@@ -134,13 +172,13 @@ def main():
 
     log(f"probe daemon start pid={os.getpid()} interval={args.interval}s "
         f"timeout={args.timeout}s")
-    captured = os.path.exists(os.path.join(REPO, "BENCH_TPU_r03.json"))
+    st = _load_state()
+    captured = all(st.get(k) for k in ("bench", "ring_dma", "ec"))
     while True:
         outcome, detail = probe_once(args.timeout)
         log(f"probe outcome={outcome} {detail}")
         if outcome == "ok" and not captured:
-            capture_artifacts()
-            captured = True
+            captured = capture_artifacts()
         if args.once:
             break
         time.sleep(args.interval if not captured else args.interval * 4)

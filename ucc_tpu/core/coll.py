@@ -94,6 +94,11 @@ class CollRequest:
         # config read is a table lookup — both fixed after init
         self._persistent = args.is_persistent
         self._trace = bool(team.context.lib.config.coll_trace)
+        # persistent fast re-post lane (TL opt-in, e.g. XlaCollTask):
+        # eligibility probed once on the first re-post, after the first
+        # full post has warmed the TL's launch/program caches
+        self._fast = None if (self._persistent and not self._trace and
+                              hasattr(task, "fast_repost")) else False
 
     @property
     def status(self) -> Status:
@@ -110,6 +115,9 @@ class CollRequest:
             if not self._persistent:
                 raise UccError(Status.ERR_INVALID_PARAM,
                                "re-post of non-persistent collective")
+            if self._fast or (self._fast is None and st == Status.OK and
+                              self._probe_fast()):
+                return self.task.fast_repost()
             self.task.reset()
         self._posted = True
         self.task.progress_queue = self.team.context.progress_queue
@@ -118,6 +126,13 @@ class CollRequest:
                         coll_type_str(self.args.coll_type), self.team.id,
                         self.task.seq_num)
         return self.task.post()
+
+    def _probe_fast(self) -> bool:
+        try:
+            self._fast = bool(self.task.fast_repost_ok())
+        except Exception:  # noqa: BLE001 - opt-in probe must never break post
+            self._fast = False
+        return self._fast
 
     def test(self) -> Status:
         st = self.task.super_status

@@ -55,6 +55,12 @@ TL_XLA_CONFIG = register_table(ConfigTable(
                     "device discovery before disabling tl/xla (a wedged "
                     "accelerator tunnel must not hang host-side teams)",
                     parse_string),
+        ConfigField("SHORT_MSG_MAX", "auto", "max message bytes served by "
+                    "the latency-optimized 'short' algorithm (host-staged "
+                    "eager reduce + one replicated placement, the tl_ucp "
+                    "short-protocol analog). 'auto' = 128K on the CPU "
+                    "platform, 4K on accelerators; 0 disables",
+                    parse_string),
     ]))
 
 
@@ -157,14 +163,19 @@ class XlaTeamShared:
         self.programs: Dict[Any, Any] = {}
         #: tag -> {team_rank: (shard_np_or_jax, task)}
         self.pending: Dict[int, Dict[int, Tuple[Any, "XlaCollTask"]]] = {}
-        #: persistent-collective launch cache: tag -> (bufs, garr, program)
+        #: persistent-collective launch cache:
+        #: tag -> (bufs, garr, program, perm) where perm maps team-rank
+        #: position -> output shard index (None if the mapping failed)
         #: (strong refs to bufs keep ids stable for the identity check)
-        self.launch_cache: Dict[int, Tuple[tuple, Any, Any]] = {}
+        self.launch_cache: Dict[int, Tuple[tuple, Any, Any, Any]] = {}
         #: AOT-compiled executables keyed by id(jit program) — valid
         #: because shared.programs pins the jit objects for the team's
         #: lifetime, and a program key fixes the global shape
         self.aot_programs: Dict[int, Any] = {}
         self.refcount = 0
+        #: device -> shard position for replicated outputs (stable per
+        #: sharding; computed on the first short launch)
+        self._rep_perm: Optional[Dict[int, int]] = None
 
     @classmethod
     def get_or_create(cls, key, mesh_fn) -> "XlaTeamShared":
@@ -198,6 +209,8 @@ class XlaTeamShared:
             # deterministic proto: the lowest team rank's task (the program
             # must not depend on deposit order)
             proto = slot[min(slot)][1]
+            if proto.alg == "short" and self._launch_short(slot, proto):
+                return
             if proto.coll in (CollType.GATHER, CollType.GATHERV,
                               CollType.SCATTER, CollType.REDUCE) and \
                     len(self.devices) > 1 and \
@@ -218,12 +231,21 @@ class XlaTeamShared:
                 # global array and compiled program are reusable as-is
                 # (jax arrays are immutable) — skip per-shard device_put
                 # and array assembly entirely (ucc_perftest's init-once/
-                # post-many contract, ucc.h:1674)
-                _, garr, program = cached
+                # post-many contract, ucc.h:1674). perm maps team-rank
+                # position -> output shard index (computed once at cache
+                # install), so the round pays one addressable_shards walk
+                # and no device->shard dict
+                _, garr, program, perm = cached
                 out = program(garr)
-                by_dev = {s.device: s.data for s in out.addressable_shards}
-                for rank, (_, task) in slot.items():
-                    task.set_result(out, by_dev)
+                if perm is None:
+                    by_dev = {s.device: s.data
+                              for s in out.addressable_shards}
+                    for rank, (_, task) in slot.items():
+                        task.set_result(out, by_dev)
+                    return
+                shards = out.addressable_shards
+                for i, (rank, (_, task)) in enumerate(sorted(slot.items())):
+                    task.set_result(out, shard=shards[perm[i]].data)
                 return
             program, count_padded = proto.build_program(self, slot)
             n = len(self.devices)
@@ -253,7 +275,17 @@ class XlaTeamShared:
                     except Exception:  # noqa: BLE001 - keep jit dispatch
                         launch_prog = program
                     self.aot_programs[id(program)] = launch_prog
-                self.launch_cache[proto.tag] = (bufs, garr, launch_prog)
+                # rank-position -> output-shard-index permutation for the
+                # cached re-post path (shard order is a property of the
+                # output sharding, stable across launches)
+                shard_devs = [s.device for s in out.addressable_shards]
+                try:
+                    perm = [shard_devs.index(self.devices[rank])
+                            for rank in sorted(slot)]
+                except ValueError:   # replicated/odd out sharding
+                    perm = None
+                self.launch_cache[proto.tag] = (bufs, garr, launch_prog,
+                                                perm)
             by_dev = {s.device: s.data for s in out.addressable_shards}
             for rank, (_, task) in slot.items():
                 task.set_result(out, by_dev)
@@ -261,6 +293,11 @@ class XlaTeamShared:
             logger.exception("xla collective launch failed")
             for rank, (_, task) in slot.items():
                 task.status = Status.ERR_NO_MESSAGE
+                if getattr(task, "_fast_round", False):
+                    # fast-posted tasks have no progress pass to surface
+                    # the error — finish them here or test() spins forever
+                    task._fast_round = False
+                    task.super_status = Status.ERR_NO_MESSAGE
 
     # ------------------------------------------------------------------
     def _launch_rooted(self, slot, proto) -> None:
@@ -346,6 +383,102 @@ class XlaTeamShared:
         for rank, (_, task) in slot.items():
             task.set_result(out, by_dev)
 
+    # ------------------------------------------------------------------
+    _SHORT_UFUNC = {
+        ReductionOp.SUM: np.add, ReductionOp.PROD: np.multiply,
+        ReductionOp.MAX: np.maximum, ReductionOp.MIN: np.minimum,
+        ReductionOp.BAND: np.bitwise_and, ReductionOp.BOR: np.bitwise_or,
+        ReductionOp.BXOR: np.bitwise_xor,
+    }
+
+    def _launch_short(self, slot, proto) -> bool:
+        """Latency-optimized short-message algorithm: stage the (tiny)
+        shards through host memory and place the result with ONE
+        replicated/rooted jax.device_put instead of dispatching a compiled
+        collective program. Below the short threshold the fixed program
+        dispatch+rendezvous cost (~190us on the 8-dev CPU mesh, and the
+        launch latency on a real chip) dwarfs the data movement, so the
+        eager protocol wins — the same split tl_ucp makes between its
+        short (eager) and long (rendezvous) protocols
+        (/root/reference/src/components/tl/ucp/tl_ucp_sendrecv.h) and the
+        reason perftest small-message latency targets exist. BARRIER
+        completes on the rendezvous itself (the in-process analog of
+        tl/shm's flag barrier — no device work to wait for).
+
+        Returns False (fall through to the compiled-program path) for
+        shapes/ops the host staging does not cover. Only registered on
+        fully process-local teams (alg_table gate), mirroring a2av.
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        coll = proto.coll
+        n = len(self.devices)
+        if coll in (CollType.BARRIER, CollType.FANIN, CollType.FANOUT):
+            # the deposit rendezvous IS the barrier: no rank reaches here
+            # before every local rank has posted
+            sentinel = np.empty(0)
+            for _, (_, task) in slot.items():
+                task.set_result(sentinel)
+            return True
+
+        hosts = None
+
+        def pull():
+            # D2H staging; np.asarray on a materialized user buffer is a
+            # copy, not a compute sync
+            return {r: np.asarray(buf).reshape(-1)
+                    for r, (buf, _t) in slot.items()}
+
+        if coll == CollType.ALLREDUCE or coll == CollType.REDUCE:
+            args = proto.args
+            op = args.op if args.op is not None else ReductionOp.SUM
+            ufunc = self._SHORT_UFUNC.get(op)
+            avg = op == ReductionOp.AVG
+            if ufunc is None and not avg:
+                return False
+            hosts = pull()
+            ranks = sorted(hosts)
+            acc = hosts[ranks[0]].copy()
+            if avg:
+                if acc.dtype.kind not in "fc":
+                    return False
+                for r in ranks[1:]:
+                    np.add(acc, hosts[r], out=acc)
+                acc *= 1.0 / n
+            else:
+                for r in ranks[1:]:
+                    ufunc(acc, hosts[r], out=acc)
+            if coll == CollType.REDUCE:
+                root_dev = self.devices[int(args.root)]
+                out = jax.device_put(acc, root_dev)
+                by_dev = {root_dev: out}
+                for _, (_, task) in slot.items():
+                    task.set_result(out, by_dev)
+                return True
+            result = acc
+        elif coll == CollType.BCAST:
+            root = int(proto.args.root)
+            result = np.asarray(slot[root][0]).reshape(-1)
+        elif coll == CollType.ALLGATHER:
+            hosts = pull()
+            result = np.concatenate([hosts[r] for r in sorted(hosts)])
+        else:
+            return False
+
+        out = jax.device_put(
+            result, NamedSharding(self.mesh, P()))   # replicated, one call
+        if self._rep_perm is None:
+            shard_devs = [s.device for s in out.addressable_shards]
+            self._rep_perm = {self.devices[r].id: shard_devs.index(
+                self.devices[r]) for r in range(n)}
+        shards = out.addressable_shards
+        perm = self._rep_perm
+        for rank, (_, task) in slot.items():
+            task.set_result(out, shard=shards[perm[
+                self.devices[rank].id]].data)
+        return True
+
 
 # ---------------------------------------------------------------------------
 # tasks
@@ -362,6 +495,7 @@ class XlaCollTask(CollTask):
         self.result_array = None
         self._out = None
         self._out_by_dev = None
+        self._my_shard = None
         args = init_args.args
         if args.active_set is not None:
             # only the subset posts an active-set coll; the full-team
@@ -400,6 +534,8 @@ class XlaCollTask(CollTask):
                               CollType.FANOUT)
             and (dst_bi is None or dst_bi.mem_type == MemoryType.TPU))
         self._contrib_src = args.src is not None and not args.is_inplace
+        self._fast_round = False   # set per-round by fast_repost
+        self._fast_bind = None     # dst BufferInfo for slim re-binds
         if self.coll == CollType.SCATTER and args.src is not None and \
                 args.src.buffer is not None and \
                 int(args.src.count) % team.size != 0:
@@ -564,6 +700,48 @@ class XlaCollTask(CollTask):
         shared.deposit(self.tag, self.tl_team.rank, shard, self)
         return Status.OK
 
+    # -- persistent fast re-post lane -------------------------------------
+    # The generic post path costs ~12 python frames per rank per round
+    # (request.post -> task.post -> post_fn -> deposit, then complete ->
+    # notify -> queue pop) — at 8 ranks that is the bulk of the ~100us
+    # small-message gap vs one raw jitted call (BASELINE.md north star;
+    # reference equivalent: ucc_pt_benchmark's init-once/post-many loop,
+    # ucc_pt_benchmark.cc:139-171). A persistent device-memory collective
+    # with no observers needs none of that machinery: re-post is exactly
+    # "deposit my (unchanged) device buffer again", and completion is
+    # stream-ordered readiness of the rebound dst. fast_repost collapses
+    # the lane to one frame + the rendezvous; the launcher thread then
+    # finishes peers directly in set_result (safe: fast-posted tasks are
+    # never enqueued on a progress queue and have no cb/subscribers, so
+    # there is no owner-side completion to race).
+    def fast_repost_ok(self) -> bool:
+        """Team-uniform eligibility (decided by symmetric collective args)
+        plus rank-local observer checks. Rank-asymmetric observers (a cb
+        on one rank only) are safe: ineligible ranks take the generic
+        deposit, eligible ranks the fast one — both land in the same
+        rendezvous slot."""
+        args = self.args
+        bi = args.src if self._contrib_src else args.dst
+        return (self._eager_complete
+                and self.cb is None and self.schedule is None
+                and self.triggered_task is None
+                and not self.timeout
+                and not any(self.em.listeners)
+                and bi is not None and bi.mem_type == MemoryType.TPU
+                and not isinstance(bi.buffer, np.ndarray))
+
+    def fast_repost(self) -> Status:
+        self._out = None
+        self._out_by_dev = None
+        self._my_shard = None
+        self.result_array = None
+        self._fast_round = True
+        self.status = Status.IN_PROGRESS
+        self.super_status = Status.IN_PROGRESS
+        self.tl_team.shared.deposit(
+            self.tag, self.tl_team.rank, self.local_src(), self)
+        return Status.OK
+
     def reset(self) -> None:
         """Persistent re-post: clear the previous launch's result (the
         launch cache in XlaTeamShared keeps the device-resident input
@@ -571,13 +749,31 @@ class XlaCollTask(CollTask):
         super().reset()
         self._out = None
         self._out_by_dev = None
+        self._my_shard = None
         self.result_array = None
 
-    def set_result(self, out, by_dev=None) -> None:
+    def set_result(self, out, by_dev=None, shard=None) -> None:
         self._out = out
         # per-launch device->shard map, computed once for all local tasks
-        # (addressable_shards builds Shard objects per call — O(n) each)
+        # (addressable_shards builds Shard objects per call — O(n) each);
+        # the cached re-post path passes this rank's shard positionally
+        # instead (no dict at all)
         self._out_by_dev = by_dev
+        self._my_shard = shard
+        if shard is not None and self._fast_bind is not None:
+            # slim re-bind: the first full _copy_out proved this rank's
+            # result IS the whole shard (no slice/pad) — every later
+            # cached launch just swaps the buffer binding (3 attribute
+            # writes instead of the generic branch ladder, which is ~6us
+            # x 8 local ranks of pure python per round)
+            dst = self._fast_bind
+            dst.buffer = shard
+            self.result_array = shard
+            self.status = Status.OK
+            if self._fast_round:
+                self._fast_round = False
+                self.super_status = Status.OK
+            return
         if self._eager_complete:
             # rebind dst to the (async) result and mark OK. complete()
             # itself is NOT called here: set_result may run on the
@@ -588,6 +784,12 @@ class XlaCollTask(CollTask):
             # task exactly once and pops it from the queue.
             self._copy_out()
             self.status = Status.OK
+            if self._fast_round:
+                # fast-posted tasks bypass task.post/progress entirely, so
+                # the launcher finishes them here (no owner-side completion
+                # exists to race — see fast_repost_ok's observer gate)
+                self._fast_round = False
+                self.super_status = Status.OK
 
     def progress_fn(self) -> None:
         if self.status != Status.IN_PROGRESS:
@@ -616,6 +818,8 @@ class XlaCollTask(CollTask):
         return np.asarray(self._my_out_jax())
 
     def _my_out_jax(self):
+        if self._my_shard is not None:
+            return self._my_shard
         dev = self.tl_team.shared.devices[self.tl_team.rank]
         if self._out_by_dev is not None:
             mine = self._out_by_dev.get(dev)
@@ -668,6 +872,10 @@ class XlaCollTask(CollTask):
                 dst.buffer = out[off:off + rsv_want]
             else:
                 dst.buffer = self._unpad_jax(out, dst)
+                if dst.buffer is out and self.args.is_persistent:
+                    # result IS the unsliced shard: later cached launches
+                    # can re-bind without this branch ladder (set_result)
+                    self._fast_bind = dst
             self.result_array = dst.buffer
             return
         row = self._my_out_np()
@@ -840,10 +1048,10 @@ class TlXlaTeam(TlTeamBase):
 
     # ------------------------------------------------------------------
     def alg_table(self) -> Dict[CollType, List[AlgSpec]]:
-        def spec(i, name, **kw):
+        def spec(i, name, select=None, **kw):
             def init(ia, team, _kw=kw):
                 return XlaCollTask(ia, self, **_kw)
-            return AlgSpec(i, name, init)
+            return AlgSpec(i, name, init, default_select=select)
 
         table = {ct: [spec(0, "xla")] for ct in (
             CollType.ALLREDUCE, CollType.REDUCE, CollType.BCAST,
@@ -853,12 +1061,43 @@ class TlXlaTeam(TlTeamBase):
             CollType.REDUCE_SCATTERV, CollType.SCATTER)}
         table[CollType.ALLREDUCE].append(spec(1, "ring", alg="ring"))
         shared = getattr(self, "shared", None)
-        if shared is None or shared.n_local == getattr(self, "size", 0):
+        all_local = shared is None or \
+            shared.n_local == getattr(self, "size", 0)
+        if all_local:
             # the a2av counts matrix is assembled from the rendezvous slot,
             # which only covers the full team when all ranks are local
             # (shared is None only for the ucc_info -A listing stub)
             table[CollType.ALLTOALLV] = [spec(0, "xla")]
+        thr = self._short_msg_max()
+        if thr > 0 and all_local and shared is not None:
+            # latency algorithm for short messages: host-staged eager
+            # reduce + one replicated placement (see _launch_short); wins
+            # the range below thr, the compiled program keeps the rest
+            sel = f"0-{thr}:{TlXla.DEFAULT_SCORE + 5}"
+            for ct in (CollType.ALLREDUCE, CollType.REDUCE, CollType.BCAST,
+                       CollType.ALLGATHER, CollType.BARRIER, CollType.FANIN,
+                       CollType.FANOUT):
+                table[ct].append(spec(2, "short", select=sel, alg="short"))
         return table
+
+    def _short_msg_max(self) -> int:
+        """'auto' resolves by platform: the fixed compiled-dispatch cost
+        the short path avoids is ~190us on the CPU mesh but smaller on a
+        real chip where D2H round-trips also cost more — so the default
+        crossover sits much lower there."""
+        from ..utils.config import parse_memunits
+        cfg = getattr(self.comp_context, "config", None)
+        raw = (getattr(cfg, "short_msg_max", "auto") or "auto").strip()
+        if raw.lower() == "auto":
+            try:
+                plat = self.shared.mesh.devices.flat[0].platform
+            except Exception:  # noqa: BLE001 - listing stub has no mesh
+                plat = "cpu"
+            return 131072 if plat == "cpu" else 4096
+        try:
+            return int(parse_memunits(raw))
+        except Exception:  # noqa: BLE001 - bad value disables the path
+            return 0
 
     def get_scores(self) -> CollScore:
         return build_scores(self, TlXla.DEFAULT_SCORE, self.alg_table(),
