@@ -373,3 +373,70 @@ class TestTpuStreamEe:
                     ee.destroy()
         finally:
             job.cleanup()
+
+
+class TestTriggeredAfterFastLane:
+    """Regression: a persistent device collective whose fast re-post lane
+    has been warmed (two plain posts) must still run the EE callback when
+    a later post is TRIGGERED — the fast lane never runs observers, so
+    the request must divert that round to the generic path (the cb is
+    attached between posts; core/coll.py re-checks observers per post)."""
+
+    def test_triggered_post_after_warm_reposts(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        from ucc_tpu import CollArgsFlags, MemoryType
+        from ucc_tpu.core.ee import Ee, UccEvent
+        from ucc_tpu.constants import EeType
+        import time as _time
+        n = 2
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            count = 8
+            argses, reqs = [], []
+            for r in range(n):
+                dev = job.contexts[r].tl_contexts["xla"].obj.device
+                src = jax.device_put(
+                    jnp.full((count,), r + 1.0, jnp.float32), dev)
+                argses.append(CollArgs(
+                    coll_type=CollType.ALLREDUCE,
+                    src=BufferInfo(src, count, DataType.FLOAT32,
+                                   mem_type=MemoryType.TPU),
+                    dst=BufferInfo(None, count, DataType.FLOAT32,
+                                   mem_type=MemoryType.TPU),
+                    op=ReductionOp.SUM,
+                    flags=CollArgsFlags.PERSISTENT))
+                reqs.append(teams[r].collective_init(argses[r]))
+            # two plain rounds: the second probes + arms the fast lane
+            for _ in range(2):
+                for rq in reqs:
+                    rq.post()
+                job.progress_until(lambda: all(
+                    rq.test() == Status.OK for rq in reqs))
+            ees = [Ee(teams[r], EeType.CPU_THREAD) for r in range(n)]
+            try:
+                evs = [UccEvent() for _ in range(n)]
+                for r in range(n):
+                    ees[r].triggered_post(evs[r], reqs[r])
+                for ev in evs:
+                    ev.set()
+                deadline = _time.monotonic() + 20
+                # the EE completion event must arrive (cb ran) — the bug
+                # was a silent fast_repost that skipped the cb forever
+                got = [False] * n
+                while not all(got):
+                    for r in range(n):
+                        if not got[r] and ees[r].get_event() is not None:
+                            got[r] = True
+                    for c in job.contexts:
+                        c.progress()
+                    assert _time.monotonic() < deadline, got
+                for r in range(n):
+                    np.testing.assert_allclose(
+                        np.asarray(argses[r].dst.buffer), 3.0)
+            finally:
+                for ee in ees:
+                    ee.destroy()
+        finally:
+            job.cleanup()

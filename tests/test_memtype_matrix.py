@@ -1,0 +1,49 @@
+"""Serving-path audit: every collective x {host, tpu} memtype on a
+multi-rank team must have SOME serving path beyond tl/self, or be a
+documented rejection (VERDICT r2 missing #2: scatterv/tpu had nowhere to
+fall). The reference bar: tl_ucp serves every coll on host memory and
+tl_cuda/tl_nccl cover device memory (ucc_info -s score map rows)."""
+import numpy as np
+import pytest
+
+from ucc_tpu import CollType, MemoryType
+
+from harness import UccJob
+
+jax = pytest.importorskip("jax")
+
+ALL_COLLS = [
+    CollType.ALLGATHER, CollType.ALLGATHERV, CollType.ALLREDUCE,
+    CollType.ALLTOALL, CollType.ALLTOALLV, CollType.BARRIER,
+    CollType.BCAST, CollType.FANIN, CollType.FANOUT, CollType.GATHER,
+    CollType.GATHERV, CollType.REDUCE, CollType.REDUCE_SCATTER,
+    CollType.REDUCE_SCATTERV, CollType.SCATTER, CollType.SCATTERV,
+]
+
+# colls where a self-only (or empty) row is an accepted, documented gap.
+# Empty on purpose: any hole that appears is a regression, not a skip.
+DOCUMENTED_REJECTIONS: set = set()
+
+
+@pytest.fixture(scope="module")
+def job():
+    j = UccJob(4)
+    yield j
+    j.cleanup()
+
+
+@pytest.fixture(scope="module")
+def teams(job):
+    return job.create_team()
+
+
+@pytest.mark.parametrize("mem", [MemoryType.HOST, MemoryType.TPU])
+@pytest.mark.parametrize("coll", ALL_COLLS, ids=lambda c: c.name.lower())
+def test_multi_rank_serving_path(teams, coll, mem):
+    cands = teams[0].score_map.lookup(coll, mem, 1 << 10)
+    names = {getattr(c.team, "NAME", getattr(c.team, "name", "?"))
+             for c in cands}
+    if (coll, mem) in DOCUMENTED_REJECTIONS:
+        pytest.skip("documented rejection")
+    assert names - {"self"}, \
+        f"{coll.name}/{mem.name}: no non-self serving path ({names})"

@@ -117,7 +117,16 @@ class CollRequest:
                                "re-post of non-persistent collective")
             if self._fast or (self._fast is None and st == Status.OK and
                               self._probe_fast()):
-                return self.task.fast_repost()
+                # the probe caches STRUCTURAL eligibility (coll shape,
+                # memtype, eager completion); observers can be attached
+                # between posts (EE triggered_post installs task.cb,
+                # schedules subscribe events) and must divert this round
+                # to the generic path, which runs them
+                task = self.task
+                if task.cb is None and task.triggered_task is None and \
+                        task.schedule is None and not task.timeout and \
+                        not any(task.em.listeners):
+                    return task.fast_repost()
             self.task.reset()
         self._posted = True
         self.task.progress_queue = self.team.context.progress_queue
@@ -210,13 +219,18 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
                        "one-sided (global_work_buffer / mem-mapped) "
                        "collectives are not supported on the TPU DCN "
                        "path; see PARITY.md")
-    if _is_zero_size(args):
+    mem_type = _resolve_mem_type(args)
+    if _is_zero_size(args) and mem_type != MemoryType.TPU:
+        # zero-size fast path (ucc_coll.c:191-208) — HOST memory only.
+        # Device-memory colls are served by the rendezvous TL (tl/xla),
+        # where a rank that stubs out desyncs the team's deposit count
+        # (e.g. the zero-count rank of an uneven scatterv); the device
+        # path runs them for real, with typed zero padding.
         task: CollTask = _StubTask()
         req = CollRequest(task, team, args)
         _attach_user_opts(task, args)
         return req
 
-    mem_type = _resolve_mem_type(args)
     msgsize = coll_args_msgsize(args, team.size, team.rank)
     init_args = InitArgs(args=args, team=team, mem_type=mem_type,
                          msgsize=msgsize)

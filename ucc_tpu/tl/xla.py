@@ -212,7 +212,8 @@ class XlaTeamShared:
             if proto.alg == "short" and self._launch_short(slot, proto):
                 return
             if proto.coll in (CollType.GATHER, CollType.GATHERV,
-                              CollType.SCATTER, CollType.REDUCE) and \
+                              CollType.SCATTER, CollType.SCATTERV,
+                              CollType.REDUCE) and \
                     len(self.devices) > 1 and \
                     self.n_local == len(self.devices):
                 # Explicit-placement fast path needs every rank's shard in
@@ -352,6 +353,24 @@ class XlaTeamShared:
             out = jax.make_array_from_single_device_arrays(
                 (n * blk,), NamedSharding(self.mesh, P("r")), shards)
             by_dev = {d: s for d, s in zip(self.devices, shards)}
+        elif coll == CollType.SCATTERV:
+            # root's BufferInfoV gives per-rank counts/displacements; each
+            # v-block lands on its rank's device only — O(total) traffic,
+            # the tl_ucp scatterv-linear shape (scatterv.c) as explicit
+            # placement. Uneven blocks mean no single global array: every
+            # rank's result rides by_dev.
+            from ..utils.mathutils import default_displs
+            src_bi = slot[root][1].args.src
+            counts = [int(c) for c in src_bi.counts]
+            displs = [int(d) for d in src_bi.displacements] \
+                if src_bi.displacements is not None else \
+                default_displs(counts)
+            rbuf = _flat(slot[root][0])
+            by_dev = {
+                self.devices[i]: jax.device_put(
+                    rbuf[displs[i]:displs[i] + counts[i]], self.devices[i])
+                for i in range(n)}
+            out = by_dev[root_dev]
         else:   # REDUCE: psum_scatter program + root-only block gather
             from .. import ops
             count = proto.src_count()
@@ -536,6 +555,13 @@ class XlaCollTask(CollTask):
         self._contrib_src = args.src is not None and not args.is_inplace
         self._fast_round = False   # set per-round by fast_repost
         self._fast_bind = None     # dst BufferInfo for slim re-binds
+        if self.coll == CollType.SCATTERV and \
+                team.rank == int(args.root) and (
+                not isinstance(args.src, BufferInfoV) or
+                args.src.counts is None):
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "tl/xla scatterv requires the counts vector on "
+                           "the root's src BufferInfoV")
         if self.coll == CollType.SCATTER and args.src is not None and \
                 args.src.buffer is not None and \
                 int(args.src.count) % team.size != 0:
@@ -657,12 +683,14 @@ class XlaCollTask(CollTask):
 
         n = len(shared.devices)
 
+        from ..utils.mathutils import default_displs
+
         def _vec(bi):
             counts = [int(c) for c in bi.counts]
             if bi.displacements is not None:
                 displs = [int(d) for d in bi.displacements]
             else:
-                displs = list(np.cumsum([0] + counts[:-1]))
+                displs = default_displs(counts)
             return counts, displs
 
         rows = []      # per src rank: (scounts, sdispls)
@@ -715,18 +743,18 @@ class XlaCollTask(CollTask):
     # never enqueued on a progress queue and have no cb/subscribers, so
     # there is no owner-side completion to race).
     def fast_repost_ok(self) -> bool:
-        """Team-uniform eligibility (decided by symmetric collective args)
-        plus rank-local observer checks. Rank-asymmetric observers (a cb
-        on one rank only) are safe: ineligible ranks take the generic
-        deposit, eligible ranks the fast one — both land in the same
-        rendezvous slot."""
+        """STRUCTURAL eligibility only (coll shape, memtype, eager
+        completion) — fixed for the task's lifetime, so the request
+        caches it. Dynamic observers (cb, triggered_task, schedule, em
+        subscribers, timeout) are re-checked by CollRequest.post on
+        every fast post: an EE triggered_post can attach a cb between
+        posts, and the fast lane never runs callbacks. Rank-asymmetric
+        observers are safe: ineligible ranks take the generic deposit,
+        eligible ranks the fast one — both land in the same rendezvous
+        slot."""
         args = self.args
         bi = args.src if self._contrib_src else args.dst
         return (self._eager_complete
-                and self.cb is None and self.schedule is None
-                and self.triggered_task is None
-                and not self.timeout
-                and not any(self.em.listeners)
                 and bi is not None and bi.mem_type == MemoryType.TPU
                 and not isinstance(bi.buffer, np.ndarray))
 
@@ -886,10 +914,11 @@ class XlaCollTask(CollTask):
     def _a2av_copy_out(self) -> None:
         n = self.tl_team.size
         dstv = self.args.dst
+        from ..utils.mathutils import default_displs
         rcounts = [int(c) for c in dstv.counts]
         rdispls = [int(d) for d in dstv.displacements] \
             if dstv.displacements is not None else \
-            list(np.cumsum([0] + rcounts[:-1]))
+            default_displs(rcounts)
         dst_span = max((rdispls[p] + rcounts[p] for p in range(n)),
                        default=0)
         if dstv.mem_type == MemoryType.TPU:
@@ -1068,6 +1097,11 @@ class TlXlaTeam(TlTeamBase):
             # which only covers the full team when all ranks are local
             # (shared is None only for the ucc_info -A listing stub)
             table[CollType.ALLTOALLV] = [spec(0, "xla")]
+        if all_local and shared is not None:
+            # scatterv is served by the explicit-placement rooted path,
+            # which needs every rank's device addressable (same locality
+            # requirement as a2av's counts-matrix assembly)
+            table[CollType.SCATTERV] = [spec(0, "xla")]
         thr = self._short_msg_max()
         if thr > 0 and all_local and shared is not None:
             # latency algorithm for short messages: host-staged eager
@@ -1136,7 +1170,8 @@ class TlXla(TransportLayer):
                        | CollType.GATHER | CollType.GATHERV
                        | CollType.ALLTOALL | CollType.ALLTOALLV
                        | CollType.REDUCE_SCATTER
-                       | CollType.REDUCE_SCATTERV | CollType.SCATTER)
+                       | CollType.REDUCE_SCATTERV | CollType.SCATTER
+                       | CollType.SCATTERV)
     SUPPORTED_MEM_TYPES = (MemoryType.TPU,)
     SERVICE_CAPABLE = False
     CONTEXT_CONFIG = TL_XLA_CONFIG

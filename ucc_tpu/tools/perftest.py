@@ -95,12 +95,32 @@ def make_args(coll: CollType, rank: int, n: int, count: int, dt: DataType,
         return BufferInfo(np.zeros(shape_count, dtype=nd), shape_count, dt,
                           mem_type=MemoryType.HOST)
 
+    from ucc_tpu import BufferInfoV
+
+    def bufv(counts, with_buffer=True, displs=None):
+        total = sum(counts) or 1
+        if mem == MemoryType.TPU:
+            arr = None
+            if with_buffer:
+                import jax
+                arr = jax.device_put(host(total),
+                                     devices[rank] if devices else None)
+            return BufferInfoV(arr, list(counts), displs, dt,
+                               mem_type=MemoryType.TPU)
+        b = host(total) if with_buffer else np.zeros(total, dtype=nd)
+        return BufferInfoV(b, list(counts), displs, dt,
+                           mem_type=MemoryType.HOST)
+
+    def outv(counts, displs=None):
+        total = sum(counts) or 1
+        if mem == MemoryType.TPU:
+            return BufferInfoV(None, list(counts), displs, dt,
+                               mem_type=MemoryType.TPU)
+        return BufferInfoV(np.zeros(total, dtype=nd), list(counts), displs,
+                           dt, mem_type=MemoryType.HOST)
+
     if coll == CollType.ALLTOALLV:
         # per-pair counts from the traffic matrix (row = what I send)
-        from ucc_tpu import BufferInfoV
-        if mem == MemoryType.TPU:
-            raise SystemExit("perftest: alltoallv over tpu memory is not "
-                             "wired (TL/XLA gap; use -m host)")
         if inplace:
             raise SystemExit("perftest: -i is not supported for alltoallv")
         m = _TRAFFIC_MATRIX
@@ -110,10 +130,9 @@ def make_args(coll: CollType, rank: int, n: int, count: int, dt: DataType,
         rdispl = list(np.cumsum([0] + rcounts[:-1]))
         return CollArgs(
             coll_type=coll, flags=flags,
-            src=BufferInfoV(host(sum(scounts) or 1), scounts, sdispl, dt),
-            dst=BufferInfoV(np.zeros(sum(rcounts) or 1, dtype=nd), rcounts,
-                            rdispl, dt))
-    if coll == CollType.BARRIER:
+            src=bufv(scounts, displs=sdispl),
+            dst=outv(rcounts, displs=rdispl))
+    if coll in (CollType.BARRIER, CollType.FANIN, CollType.FANOUT):
         return CollArgs(coll_type=coll, flags=flags)
     if coll == CollType.ALLREDUCE:
         a = CollArgs(coll_type=coll, op=op, flags=flags)
@@ -147,6 +166,23 @@ def make_args(coll: CollType, rank: int, n: int, count: int, dt: DataType,
         return CollArgs(coll_type=coll, root=root,
                         src=buf(count * n) if rank == root else None,
                         dst=out(count), flags=flags)
+    # v-colls: equal per-rank blocks of `count` (the counts vector is
+    # what exercises the v machinery; ucc_perftest does the same)
+    if coll == CollType.ALLGATHERV:
+        return CollArgs(coll_type=coll, src=buf(count),
+                        dst=outv([count] * n), flags=flags)
+    if coll == CollType.GATHERV:
+        # counts vector on every rank (the device TL derives the launch
+        # shape from it); dst buffer lands at root only
+        return CollArgs(coll_type=coll, root=root, src=buf(count),
+                        dst=outv([count] * n), flags=flags)
+    if coll == CollType.SCATTERV:
+        return CollArgs(coll_type=coll, root=root,
+                        src=bufv([count] * n) if rank == root else None,
+                        dst=out(count), flags=flags)
+    if coll == CollType.REDUCE_SCATTERV:
+        return CollArgs(coll_type=coll, op=op, src=buf(count * n),
+                        dst=outv([count] * n), flags=flags)
     raise SystemExit(f"perftest: coll {coll_type_str(coll)} not wired")
 
 

@@ -65,3 +65,14 @@ def block_offset_aligned(total: int, n_blocks: int, block: int, align: int) -> i
     off = block_offset(total, n_blocks, block)
     off = (off + align - 1) // align * align
     return min(off, total)
+
+
+def default_displs(counts):
+    """Dense default displacements for a v-collective counts vector
+    (MPI convention: block k starts where block k-1 ended)."""
+    out = [0] * len(counts)
+    acc = 0
+    for i, c in enumerate(counts):
+        out[i] = acc
+        acc += int(c)
+    return out
