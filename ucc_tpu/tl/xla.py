@@ -721,6 +721,13 @@ class XlaCollTask(CollTask):
 
     # -- lifecycle --------------------------------------------------------
     def post_fn(self) -> Status:
+        # clear stale launch state BEFORE depositing: pipelined fragment
+        # schedules re-post this task directly (no CollRequest.reset), and
+        # a leftover _out from the previous fragment round would complete
+        # progress_fn immediately with the old result
+        self._out = None
+        self._out_by_dev = None
+        self._my_shard = None
         shared = self.tl_team.shared
         shard = self.local_src()
         if isinstance(shard, np.ndarray):

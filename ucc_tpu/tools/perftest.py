@@ -97,18 +97,15 @@ def make_args(coll: CollType, rank: int, n: int, count: int, dt: DataType,
 
     from ucc_tpu import BufferInfoV
 
-    def bufv(counts, with_buffer=True, displs=None):
+    def bufv(counts, displs=None):
         total = sum(counts) or 1
         if mem == MemoryType.TPU:
-            arr = None
-            if with_buffer:
-                import jax
-                arr = jax.device_put(host(total),
-                                     devices[rank] if devices else None)
+            import jax
+            arr = jax.device_put(host(total),
+                                 devices[rank] if devices else None)
             return BufferInfoV(arr, list(counts), displs, dt,
                                mem_type=MemoryType.TPU)
-        b = host(total) if with_buffer else np.zeros(total, dtype=nd)
-        return BufferInfoV(b, list(counts), displs, dt,
+        return BufferInfoV(host(total), list(counts), displs, dt,
                            mem_type=MemoryType.HOST)
 
     def outv(counts, displs=None):
