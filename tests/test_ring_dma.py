@@ -180,6 +180,32 @@ class TestRingDmaRealChip:
         lowered = program.lower(garr)
         assert lowered.compile() is not None
 
+    def test_bcast_and_hbm_compile_on_tpu(self):
+        """The round-3 kernels (pipelined bcast, HBM-resident chunked
+        allreduce incl. the entry barrier semaphore) must also compile
+        on real hardware."""
+        tpus = [d for d in jax.devices() if d.platform not in ("cpu",)]
+        if not tpus:
+            pytest.skip("no TPU devices reachable")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ucc_tpu.tl.ring_dma import (build_bcast_program,
+                                         build_hbm_allreduce_program,
+                                         CHUNK_ELEMS)
+        n = len(tpus)
+        mesh = jax.sharding.Mesh(np.array(tpus), ("r",))
+        for builder in (
+                lambda: build_bcast_program(mesh, n, 0,
+                                            np.dtype(np.float32), 4096),
+                lambda: build_hbm_allreduce_program(
+                    mesh, n, ReductionOp.SUM, np.dtype(np.float32),
+                    CHUNK_ELEMS * 2)):
+            program, padded = builder()
+            garr = jax.make_array_from_single_device_arrays(
+                (n * padded,), NamedSharding(mesh, P("r")),
+                [jax.device_put(jnp.ones((padded,), jnp.float32), d)
+                 for d in tpus])
+            assert program.lower(garr).compile() is not None
+
 
 class TestRingDmaChunked:
     """Vectors beyond one VMEM working set split into independent ring
@@ -252,3 +278,102 @@ class TestRingDmaPersistent:
                     np.asarray(argses[r].dst.buffer), N * (N + 1) / 2)
         for rq in reqs:
             rq.finalize()
+
+
+class TestRingDmaBcast:
+    """Pipelined ring bcast — the tl/mlx5 mcast role (VERDICT r2 next #6).
+    Symmetric step schedule (wrap-around into the root carries ignored
+    data) so semaphores pair exactly."""
+
+    @pytest.mark.parametrize("root", [0, 2])
+    def test_bcast(self, job, teams, root, monkeypatch):
+        monkeypatch.setenv("UCC_TL_RING_DMA_TUNE", "bcast:@ring_dma:inf")
+        j = UccJob(N)
+        try:
+            tms = j.create_team()
+            count = 40
+            data = np.arange(count, dtype=np.float32) * 2 + 1
+            argses = []
+            for r in range(N):
+                src = data if r == root else np.zeros(count, np.float32)
+                dev = j.contexts[r].tl_contexts["ring_dma"].obj.device
+                arr = jax.device_put(jnp.asarray(src), dev)
+                argses.append(CollArgs(
+                    coll_type=CollType.BCAST, root=root,
+                    src=BufferInfo(arr, count, DataType.FLOAT32,
+                                   mem_type=MemoryType.TPU)))
+            j.run_coll(tms, lambda r: argses[r])
+            for r in range(N):
+                np.testing.assert_allclose(np.asarray(argses[r].src.buffer),
+                                           data)
+        finally:
+            j.cleanup()
+
+    def test_bcast_pipelined_subblocks(self, monkeypatch):
+        """nsub > 1: the sub-block pipeline (root streams pieces, hops
+        forward while receiving)."""
+        import ucc_tpu.tl.ring_dma as rd
+        from ucc_tpu.tl.ring_dma import build_bcast_program
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        monkeypatch.setattr(rd, "CHUNK_ELEMS", 64)
+        n = 4
+        mesh = jax.make_mesh((n,), ("r",))
+        prog, padded = build_bcast_program(mesh, n, 1,
+                                           np.dtype(np.float32), 500)
+        assert padded // min(padded, 32) > 1   # really pipelined
+        data = np.arange(padded, dtype=np.float32) + 7
+        shards = [jax.device_put(
+            jnp.asarray(data if r == 1 else np.zeros(padded, np.float32)),
+            jax.devices()[r]) for r in range(n)]
+        garr = jax.make_array_from_single_device_arrays(
+            (n * padded,), NamedSharding(mesh, P("r")), shards)
+        out = np.asarray(jax.block_until_ready(prog(garr)))
+        np.testing.assert_allclose(out[:500], data[:500])
+
+
+class TestRingDmaHbmChunked:
+    """HBM-resident grid allreduce: the full vector stays in HBM, chunks
+    stage through double-buffered VMEM inside the kernel schedule (lifts
+    the old 2^27 cap; sliding-window role)."""
+
+    def test_hbm_allreduce_multi_chunk(self, monkeypatch):
+        import ucc_tpu.tl.ring_dma as rd
+        from ucc_tpu.tl.ring_dma import build_hbm_allreduce_program
+        from ucc_tpu.constants import ReductionOp as R
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        monkeypatch.setattr(rd, "CHUNK_ELEMS", 64)
+        n = 4
+        mesh = jax.make_mesh((n,), ("r",))
+        prog, padded = build_hbm_allreduce_program(
+            mesh, n, R.SUM, np.dtype(np.float32), 500)
+        csize = max(n, (64 // n) * n)
+        assert padded // csize >= 8            # genuinely multi-chunk
+        shards = [jax.device_put(
+            jnp.arange(padded, dtype=jnp.float32) * (r + 1),
+            jax.devices()[r]) for r in range(n)]
+        garr = jax.make_array_from_single_device_arrays(
+            (n * padded,), NamedSharding(mesh, P("r")), shards)
+        out = np.asarray(jax.block_until_ready(prog(garr)))
+        expect = np.arange(padded, dtype=np.float32) * sum(
+            range(1, n + 1))
+        np.testing.assert_allclose(out.reshape(n, padded),
+                                   np.tile(expect, (n, 1)))
+
+    def test_large_count_selects_hbm_path(self, job, teams):
+        """Counts beyond one VMEM pass route through the HBM builder via
+        the task (no NOT_SUPPORTED above the old cap)."""
+        from ucc_tpu.tl.ring_dma import CHUNK_ELEMS
+        count = CHUNK_ELEMS + 1024      # > one pass, modest memory
+        argses = []
+        for r in range(N):
+            argses.append(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=dev_buf(job, r, np.full(count, 1.0, np.float32),
+                            DataType.FLOAT32),
+                dst=BufferInfo(None, count, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
+                op=ReductionOp.SUM))
+        job.run_coll(teams, lambda r: argses[r], timeout=120)
+        for r in range(N):
+            np.testing.assert_allclose(np.asarray(argses[r].dst.buffer),
+                                       N)

@@ -12,9 +12,18 @@ written in the kernel, and semaphores are the completion protocol (the
 QP/doorbell analog).
 
 Algorithms: ring allreduce (reduce-scatter phase + allgather phase,
-2*(n-1) block steps), ring allgather, ring reduce_scatter. Selectable via
-``UCC_TL_RING_DMA_TUNE`` or by boosting the TL score; default score sits
-below TL/XLA so compiler-scheduled collectives stay the default.
+2*(n-1) block steps; large vectors run the HBM-resident grid kernel with
+double-buffered HBM<->VMEM staging — the sliding-window role, no element
+cap beyond HBM), ring allgather, ring reduce_scatter, and pipelined ring
+bcast (the tl/mlx5 mcast role). Selectable via ``UCC_TL_RING_DMA_TUNE``
+or by boosting the TL score; default score sits below TL/XLA so
+compiler-scheduled collectives stay the default.
+
+Compiled kernels open with a ring-neighbor barrier-semaphore handshake
+(collective_id'd) so a remote DMA cannot land before the peer kernel owns
+its comm slots; interpret mode skips it (no barrier model). The compiled
+ICI path still needs real-chip validation (the standing hardware gate,
+tests/test_ring_dma.py real-chip test).
 
 Kernels run compiled on real TPU meshes and in Pallas interpret mode on
 the virtual CPU mesh (tests); the rendezvous/dispatch machinery is shared
@@ -45,13 +54,13 @@ TL_RING_DMA_CONFIG = register_table(ConfigTable(
     ]))
 
 #: per-kernel VMEM working-set bound (~16 MiB/core). Vectors larger than
-#: this are CHUNKED at the program level: the shard_map body slices the
-#: input into VMEM-sized pieces and runs one ring pass per piece (XLA
-#: schedules the independent passes; DMA of pass k overlaps compute of
-#: k+1 where the hardware allows).
+#: this are CHUNKED: small overflows slice at the program level (XLA
+#: schedules the passes); large allreduces run the HBM-RESIDENT grid
+#: kernel, which keeps the full vector in HBM and double-buffers
+#: HBM<->VMEM staging against the ring DMAs inside the kernel schedule
+#: (the sliding-window role, allreduce_sliding_window.h:30-50 — no
+#: whole-vector working set, no element cap beyond HBM capacity).
 CHUNK_ELEMS = 1 << 18
-#: total bound: chunking covers up to this many elements per rank
-MAX_ELEMS = 1 << 27
 
 
 def _accum(op: ReductionOp):
@@ -61,30 +70,53 @@ def _accum(op: ReductionOp):
             ReductionOp.PROD: jnp.multiply}[op]
 
 
-def _ring_kernel(local_ref, out_ref, work_ref, comm_ref, send_sem,
-                 recv_sem, *, n: int, blk: int, op, mode: str,
-                 axis: str = "r"):
-    """One kernel body for all three ring collectives.
+def _compiler_params(collective_id: int):
+    """CompilerParams across pallas versions (CompilerParams vs
+    TPUCompilerParams); collective_id keys the global barrier semaphore
+    for kernels that participate in cross-chip collectives."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams", None)
+    if cls is None:
+        return None
+    try:
+        return cls(collective_id=collective_id, has_side_effects=True)
+    except TypeError:
+        try:
+            return cls(collective_id=collective_id)
+        except TypeError:
+            return None
 
-    mode:
-      - "allreduce":      out (n*blk,) = reduced full vector
-      - "reduce_scatter": out (blk,)   = my reduced block
-      - "allgather":      out (n*blk,) = concatenated blocks
 
-    Ring protocol per step: copy the outgoing block into the send slot,
-    start the remote DMA into the right neighbor's recv slot, wait both
-    semaphores (send drained + left neighbor's block arrived), consume.
-    Slots alternate by global step parity, so the slot being overwritten
-    at step t is exactly the one whose send completed at t-1.
-    """
+def _neighbor_barrier(n: int, axis: str):
+    """Initial ring-neighbor handshake (the standard Pallas distributed
+    entry barrier): a remote DMA must not land in a peer's comm slots
+    before that peer's kernel instance owns them, and the one-step-skew
+    argument that makes 2-slot double buffering safe assumes neighbors
+    start within one step of each other. Skipped in interpret mode
+    (no barrier-semaphore model there; the compiled path is what needs
+    it — hardware validation pending, see module docstring)."""
     import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     me = jax.lax.axis_index(axis)
+    left = jax.lax.rem(me - 1 + n, n)
     right = jax.lax.rem(me + 1, n)
-    acc = _accum(op) if op is not None else None
+    barrier = pltpu.get_barrier_semaphore()
+    for nb in (left, right):
+        pltpu.semaphore_signal(barrier, inc=1, device_id=nb,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+
+def _make_step_dma(comm_ref, send_sem, recv_sem, right):
+    """The correctness-critical slot protocol, shared by every ring
+    kernel: copy the outgoing block into the send slot, start the remote
+    DMA into the right neighbor's recv slot, wait both semaphores (send
+    drained + left neighbor's block arrived). Slots alternate by global
+    step parity, so the slot being overwritten at step t is exactly the
+    one whose send completed at t-1."""
+    from jax.experimental.pallas import tpu as pltpu
 
     def step_dma(t: int, send_block_getter=None):
         send_slot = t % 2
@@ -103,6 +135,62 @@ def _ring_kernel(local_ref, out_ref, work_ref, comm_ref, send_sem,
         rdma.wait()
         return recv_slot
 
+    return step_dma
+
+
+def _ring_reduce_steps(work, comm_ref, step_dma, *, n, blk, me, acc,
+                       mode, t0=0):
+    """The 2(n-1)-step reduce ring, shared by the VMEM and HBM kernels.
+
+    reduce-scatter phase: with ring shift c, after n-1 steps rank me
+    owns the fully-reduced block (me + 1 - c) % n. allreduce uses c=0
+    (its allgather phase redistributes everything); reduce_scatter uses
+    c=1 so each rank ends up owning ITS OWN block. Returns the next
+    global step counter (slot parity continues across calls)."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    shift = 1 if mode == "reduce_scatter" else 0
+    t = t0
+    for step in range(n - 1):
+        send_i = jax.lax.rem(me - step - shift + n + n, n)
+        recv_i = jax.lax.rem(me - step - 1 - shift + n + n, n)
+        rs = step_dma(t, lambda i=send_i: work[pl.ds(i * blk, blk)])
+        work[pl.ds(recv_i * blk, blk)] = acc(
+            work[pl.ds(recv_i * blk, blk)], comm_ref[rs])
+        t += 1
+    if mode == "reduce_scatter":
+        return t
+    # allgather phase: circulate the reduced blocks
+    for step in range(n - 1):
+        send_i = jax.lax.rem(me + 1 - step + n + n, n)
+        recv_i = jax.lax.rem(me - step + n + n, n)
+        rs = step_dma(t, lambda i=send_i: work[pl.ds(i * blk, blk)])
+        work[pl.ds(recv_i * blk, blk)] = comm_ref[rs]
+        t += 1
+    return t
+
+
+def _ring_kernel(local_ref, out_ref, work_ref, comm_ref, send_sem,
+                 recv_sem, *, n: int, blk: int, op, mode: str,
+                 axis: str = "r", barrier: bool = False):
+    """One kernel body for the three VMEM-resident ring collectives.
+
+    mode:
+      - "allreduce":      out (n*blk,) = reduced full vector
+      - "reduce_scatter": out (blk,)   = my reduced block
+      - "allgather":      out (n*blk,) = concatenated blocks
+    """
+    import jax
+    from jax.experimental import pallas as pl
+
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, n)
+    acc = _accum(op) if op is not None else None
+    if barrier:
+        _neighbor_barrier(n, axis)
+    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right)
+
     if mode == "allgather":
         out_ref[pl.ds(me * blk, blk)] = local_ref[:]
         comm_ref[0] = local_ref[:]
@@ -114,34 +202,266 @@ def _ring_kernel(local_ref, out_ref, work_ref, comm_ref, send_sem,
             out_ref[pl.ds(src_dev * blk, blk)] = comm_ref[rs]
         return
 
-    # reduce-scatter phase: with ring shift c, after n-1 steps rank me
-    # owns the fully-reduced block (me + 1 - c) % n. allreduce uses c=0
-    # (its allgather phase redistributes everything); reduce_scatter uses
-    # c=1 so each rank ends up owning ITS OWN block. Input refs are
-    # read-only: allreduce reduces in out_ref; reduce_scatter in scratch.
+    # input refs are read-only: allreduce reduces in out_ref;
+    # reduce_scatter in scratch
     work = out_ref if mode == "allreduce" else work_ref
     work[:] = local_ref[:]
-    shift = 1 if mode == "reduce_scatter" else 0
-    t = 0
-    for step in range(n - 1):
-        send_i = jax.lax.rem(me - step - shift + n + n, n)
-        recv_i = jax.lax.rem(me - step - 1 - shift + n + n, n)
-        rs = step_dma(t, lambda i=send_i: work[pl.ds(i * blk, blk)])
-        work[pl.ds(recv_i * blk, blk)] = acc(
-            work[pl.ds(recv_i * blk, blk)], comm_ref[rs])
-        t += 1
-
+    _ring_reduce_steps(work, comm_ref, step_dma, n=n, blk=blk, me=me,
+                       acc=acc, mode=mode)
     if mode == "reduce_scatter":
         out_ref[:] = work[pl.ds(me * blk, blk)]
-        return
 
-    # allgather phase: circulate the reduced blocks
-    for step in range(n - 1):
-        send_i = jax.lax.rem(me + 1 - step + n + n, n)
-        recv_i = jax.lax.rem(me - step + n + n, n)
-        rs = step_dma(t, lambda i=send_i: work[pl.ds(i * blk, blk)])
-        work[pl.ds(recv_i * blk, blk)] = comm_ref[rs]
-        t += 1
+
+def _bcast_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem, *,
+                  n: int, blk: int, nsub: int, root: int,
+                  axis: str = "r", barrier: bool = False):
+    """Ring-pipelined bcast — the tl/mlx5 mcast role
+    (/root/reference/src/components/tl/mlx5/mcast/): the root streams
+    ``nsub`` sub-blocks around the ring; every hop forwards sub-block s
+    while receiving s+1, so the pipe is full after ``dist`` steps and the
+    whole bcast takes nsub + n - 2 block-steps instead of nsub * (n-1).
+
+    The step schedule is fully SYMMETRIC (every rank DMAs to its right
+    neighbor every step, the wrap-around into the root carries ignored
+    data) so each rdma.start/wait pairs exactly with the neighbors' —
+    no asymmetric semaphore accounting. Rank at ring distance d from the
+    root consumes sub-block s = t - (d - 1) at step t.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, n)
+    dist = jax.lax.rem(me - root + n, n)
+    is_root = dist == 0
+    if barrier:
+        _neighbor_barrier(n, axis)
+
+    @pl.when(is_root)
+    def _():
+        out_ref[:] = local_ref[:]
+
+    for t in range(nsub + n - 2):
+        send_slot = t % 2
+        recv_slot = (t + 1) % 2
+
+        @pl.when(is_root)
+        def _(t=t, s=send_slot):
+            sub = min(t, nsub - 1)     # static: clamp past-end sends
+            comm_ref[s] = local_ref[pl.ds(sub * blk, blk)]
+
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_ref.at[send_slot],
+            dst_ref=comm_ref.at[recv_slot],
+            send_sem=send_sem.at[send_slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+
+        s_idx = t - (dist - 1)         # traced: per-rank arrival index
+        valid = jnp.logical_and(dist > 0,
+                                jnp.logical_and(s_idx >= 0,
+                                                s_idx < nsub))
+        s_clamped = jnp.clip(s_idx, 0, nsub - 1)
+
+        @pl.when(valid)
+        def _(rs=recv_slot, s=s_clamped):
+            out_ref[pl.ds(s * blk, blk)] = comm_ref[rs]
+
+
+def _hbm_allreduce_kernel(local_ref, out_ref, work_ref, comm_ref,
+                          fetch_sem, flush_sem, send_sem, recv_sem, *,
+                          n: int, blk: int, n_chunks: int,
+                          op, axis: str = "r", barrier: bool = False):
+    """HBM-resident ring allreduce, one grid step per chunk (the
+    sliding-window role, allreduce_sliding_window.h:30-50): the full
+    vector never leaves HBM; each grid step stages chunk g into a VMEM
+    work buffer, runs the 2(n-1)-step ring pass, and flushes the result
+    back — with chunk g+1's HBM->VMEM fetch started BEFORE g's ring pass
+    so the local DMA overlaps the remote ones (double buffering written
+    into the kernel schedule, not left to XLA).
+
+    Slot safety across chunks: each chunk runs exactly 2(n-1) ring steps
+    (even), so the 2-slot parity restarts aligned at every chunk boundary
+    and the one-step-skew argument holds across the whole grid.
+    """
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    g = pl.program_id(0)
+    csize = n * blk                    # chunk elements (rank-blocked)
+    buf = jax.lax.rem(g, 2)
+    nxt = jax.lax.rem(g + 1, 2)
+
+    if barrier:
+        @pl.when(g == 0)
+        def _():
+            _neighbor_barrier(n, axis)
+
+    @pl.when(g == 0)
+    def _():
+        # prologue: blocking fetch of chunk 0
+        dma = pltpu.make_async_copy(
+            local_ref.at[pl.ds(0, csize)], work_ref.at[0],
+            fetch_sem.at[0])
+        dma.start()
+        dma.wait()
+
+    @pl.when(jax.numpy.logical_and(g > 0, g + 1 < n_chunks))
+    def _():
+        # work_ref[nxt] is about to be prefetch-overwritten, but chunk
+        # g-1's FLUSH still reads from it — drain that flush first (the
+        # race is invisible in interpret mode, where DMAs are synchronous)
+        pltpu.make_async_copy(
+            work_ref.at[nxt],
+            out_ref.at[pl.ds((g - 1) * csize, csize)],
+            flush_sem.at[nxt]).wait()
+
+    @pl.when(g + 1 < n_chunks)
+    def _():
+        # prefetch chunk g+1 while this chunk's ring runs
+        pltpu.make_async_copy(
+            local_ref.at[pl.ds((g + 1) * csize, csize)],
+            work_ref.at[nxt], fetch_sem.at[nxt]).start()
+
+    work = work_ref.at[buf]
+    acc = _accum(op)
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, n)
+    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right)
+    _ring_reduce_steps(work, comm_ref, step_dma, n=n, blk=blk, me=me,
+                       acc=acc, mode="allreduce")
+
+    # drain the previous flush when no prefetch did it (final chunk) so
+    # the two flush slots never alias (one outstanding write-back max)
+    @pl.when(jax.numpy.logical_and(g > 0, g + 1 >= n_chunks))
+    def _():
+        pltpu.make_async_copy(
+            work_ref.at[nxt],
+            out_ref.at[pl.ds((g - 1) * csize, csize)],
+            flush_sem.at[nxt]).wait()
+
+    flush = pltpu.make_async_copy(
+        work_ref.at[buf], out_ref.at[pl.ds(g * csize, csize)],
+        flush_sem.at[buf])
+    flush.start()
+
+    @pl.when(g + 1 >= n_chunks)
+    def _():
+        flush.wait()                   # epilogue: drain the last flush
+
+    @pl.when(g + 1 < n_chunks)
+    def _():
+        # the next grid step reuses work_ref[nxt]: its fetch must land
+        pltpu.make_async_copy(
+            local_ref.at[pl.ds((g + 1) * csize, csize)],
+            work_ref.at[nxt], fetch_sem.at[nxt]).wait()
+
+
+def build_hbm_allreduce_program(mesh, n: int, op, nd, count: int):
+    """shard_map-wrapped HBM-resident chunked ring allreduce.
+    Returns (jitted program, padded per-rank count)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.jaxshim import shard_map_compat
+
+    interpret = jax.devices()[0].platform == "cpu"
+
+    csize = max(n, (CHUNK_ELEMS // n) * n)     # chunk elems, n-divisible
+    padded = max(count, 1)
+    if padded % csize:
+        padded += csize - padded % csize
+    n_chunks = padded // csize
+    blk = csize // n
+
+    kernel = functools.partial(
+        _hbm_allreduce_kernel, n=n, blk=blk, n_chunks=n_chunks, op=op,
+        barrier=not interpret)
+    cp = _compiler_params(collective_id=1)
+
+    def body(x):
+        if x.size != padded:
+            x = jnp.pad(x, (0, padded - x.size))
+        kw = {"compiler_params": cp} if cp is not None and not interpret \
+            else {}
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_chunks,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_shape=jax.ShapeDtypeStruct((padded,), x.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((2, csize), x.dtype),      # work (dbl-buffered)
+                pltpu.VMEM((2, blk), x.dtype),        # ring comm slots
+                pltpu.SemaphoreType.DMA((2,)),        # fetch
+                pltpu.SemaphoreType.DMA((2,)),        # flush
+                pltpu.SemaphoreType.DMA((2,)),        # ring send
+                pltpu.SemaphoreType.DMA((2,)),        # ring recv
+            ],
+            interpret=interpret,
+            **kw,
+        )(x)
+        if op == ReductionOp.AVG:
+            out = (out / n).astype(out.dtype)
+        return out
+
+    program = jax.jit(shard_map_compat(body, mesh, P("r"), P("r")))
+    return program, padded
+
+
+def build_bcast_program(mesh, n: int, root: int, nd, count: int):
+    """shard_map-wrapped pipelined ring bcast. Returns (program, padded)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.jaxshim import shard_map_compat
+
+    interpret = jax.devices()[0].platform == "cpu"
+
+    padded = max(count, 1)
+    # sub-block size: small messages go whole (1 sub-block); large ones
+    # pipeline in VMEM-bounded pieces
+    blk = min(padded, max(1, CHUNK_ELEMS // 2))
+    if padded % blk:
+        padded += blk - padded % blk
+    nsub = padded // blk
+
+    kernel = functools.partial(_bcast_kernel, n=n, blk=blk, nsub=nsub,
+                               root=root, barrier=not interpret)
+    cp = _compiler_params(collective_id=2)
+
+    def body(x):
+        if x.size != padded:
+            x = jnp.pad(x, (0, padded - x.size))
+        kw = {"compiler_params": cp} if cp is not None and not interpret \
+            else {}
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((padded,), x.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((2, blk), x.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    program = jax.jit(shard_map_compat(body, mesh, P("r"), P(None)))
+    return program, padded
 
 
 def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
@@ -175,7 +495,7 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
         """One VMEM-resident ring pass over x (per-rank size n*blk for
         reduce modes, blk for allgather)."""
         kernel = functools.partial(_ring_kernel, n=n, blk=blk, op=op,
-                                   mode=mode)
+                                   mode=mode, barrier=not interpret)
         if mode == "allgather":
             out_elems = n * blk
         elif mode == "allreduce":
@@ -183,6 +503,9 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
         else:
             out_elems = blk
         work_elems = n * blk if mode == "reduce_scatter" else 1
+        cp = _compiler_params(collective_id=0)
+        kw = {"compiler_params": cp} if cp is not None and not interpret \
+            else {}
         return pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct((out_elems,), x.dtype),
@@ -193,6 +516,7 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
                 pltpu.SemaphoreType.DMA((2,)),
             ],
             interpret=interpret,
+            **kw,
         )(x)
 
     # chunk plan (mode-dependent slicing, VMEM-sized pieces):
@@ -213,6 +537,9 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
         return out
 
     if mode == "allreduce":
+        # large allreduces use the HBM-resident grid kernel instead
+        # (build_hbm_allreduce_program); this path only sees counts that
+        # fit one VMEM pass
         max_c = max(n, (CHUNK_ELEMS // n) * n)
         chunks = _split(padded, max_c)
     elif mode == "reduce_scatter":
@@ -257,20 +584,33 @@ class RingDmaCollTask(XlaCollTask):
         super().__init__(init_args, team, alg=alg)
         args = init_args.args
         if self.coll not in (CollType.ALLREDUCE, CollType.ALLGATHER,
-                             CollType.REDUCE_SCATTER):
+                             CollType.REDUCE_SCATTER, CollType.BCAST):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            f"tl/ring_dma does not implement {self.coll}")
         op = args.op if args.op is not None else ReductionOp.SUM
-        if self.coll != CollType.ALLGATHER and op not in (
+        if self.coll not in (CollType.ALLGATHER, CollType.BCAST) and \
+                op not in (
                 ReductionOp.SUM, ReductionOp.AVG, ReductionOp.MAX,
                 ReductionOp.MIN, ReductionOp.PROD):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            f"tl/ring_dma does not implement op {op}")
         total = int((args.dst or args.src).count)
-        if total > MAX_ELEMS:
+        if self.coll == CollType.BCAST and total > CHUNK_ELEMS:
+            # bcast's local/out refs are whole-vector VMEM operands (the
+            # comm pipeline is blocked, the endpoints are not); beyond
+            # the VMEM budget selection must fall back to TL/XLA rather
+            # than fail at Mosaic allocation
             raise UccError(Status.ERR_NOT_SUPPORTED,
-                           f"tl/ring_dma count {total} exceeds the "
-                           f"chunked bound {MAX_ELEMS}")
+                           f"tl/ring_dma bcast count {total} exceeds the "
+                           f"VMEM bound {CHUNK_ELEMS}")
+        if self.coll in (CollType.ALLGATHER, CollType.REDUCE_SCATTER) \
+                and total > (1 << 27):
+            # program-level chunking unrolls one pallas_call per chunk;
+            # beyond this the unrolled program is pathological — only
+            # ALLREDUCE has the HBM-resident grid kernel so far
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           f"tl/ring_dma {self.coll} count {total} "
+                           f"exceeds the chunked bound {1 << 27}")
         if self.coll == CollType.REDUCE_SCATTER:
             # the ring delivers per-rank shards; a non-divisible total
             # would need the near-equal remainder convention — defer to
@@ -287,12 +627,22 @@ class RingDmaCollTask(XlaCollTask):
         n = len(shared.devices)
         count = self.src_count()
         op = args.op if args.op is not None else ReductionOp.SUM
-        key = ("ring_dma", self.coll, op, self.np_dtype.str, count)
+        root = int(args.root) if self.coll == CollType.BCAST else 0
+        key = ("ring_dma", self.coll, op, self.np_dtype.str, count, root)
         cached = shared.programs.get(key)
         if cached is not None:
             return cached
-        program, padded = build_ring_program(
-            shared.mesh, n, self.coll, op, self.np_dtype, count)
+        if self.coll == CollType.BCAST:
+            program, padded = build_bcast_program(
+                shared.mesh, n, root, self.np_dtype, count)
+        elif self.coll == CollType.ALLREDUCE and \
+                count > max(n, (CHUNK_ELEMS // n) * n):
+            # larger than one VMEM pass: HBM-resident grid kernel
+            program, padded = build_hbm_allreduce_program(
+                shared.mesh, n, op, self.np_dtype, count)
+        else:
+            program, padded = build_ring_program(
+                shared.mesh, n, self.coll, op, self.np_dtype, count)
         shared.programs[key] = (program, padded)
         return program, padded
 
@@ -309,7 +659,7 @@ class TlRingDmaTeam(TlXlaTeam):
 
         return {ct: [spec(0, "ring_dma")] for ct in (
             CollType.ALLREDUCE, CollType.ALLGATHER,
-            CollType.REDUCE_SCATTER)}
+            CollType.REDUCE_SCATTER, CollType.BCAST)}
 
     def get_scores(self) -> CollScore:
         return build_scores(self, TlRingDma.DEFAULT_SCORE, self.alg_table(),
@@ -325,7 +675,7 @@ class TlRingDma(TransportLayer):
     NAME = "ring_dma"
     DEFAULT_SCORE = 20        # below TL/XLA: opt-in via TUNE/score boost
     SUPPORTED_COLLS = (CollType.ALLREDUCE | CollType.ALLGATHER
-                       | CollType.REDUCE_SCATTER)
+                       | CollType.REDUCE_SCATTER | CollType.BCAST)
     SUPPORTED_MEM_TYPES = (MemoryType.TPU,)
     SERVICE_CAPABLE = False
     CONTEXT_CONFIG = TL_RING_DMA_CONFIG
