@@ -70,6 +70,13 @@ def _accum(op: ReductionOp):
             ReductionOp.PROD: jnp.multiply}[op]
 
 
+def _vmem_pass_elems(n: int) -> int:
+    """Per-rank elements one VMEM-resident ring pass covers (n-divisible).
+    Single source of truth: the HBM-routing predicate and both builders
+    must agree or counts in the gap mis-route."""
+    return max(n, (CHUNK_ELEMS // n) * n)
+
+
 def _compiler_params(collective_id: int):
     """CompilerParams across pallas versions (CompilerParams vs
     TPUCompilerParams); collective_id keys the global barrier semaphore
@@ -377,17 +384,20 @@ def build_hbm_allreduce_program(mesh, n: int, op, nd, count: int):
 
     interpret = jax.devices()[0].platform == "cpu"
 
-    csize = max(n, (CHUNK_ELEMS // n) * n)     # chunk elems, n-divisible
+    csize = _vmem_pass_elems(n)                # chunk elems, n-divisible
     padded = max(count, 1)
     if padded % csize:
         padded += csize - padded % csize
     n_chunks = padded // csize
     blk = csize // n
 
+    cp = _compiler_params(collective_id=1)
+    # the barrier semaphore needs a collective_id in the compiler params;
+    # on pallas versions without that knob, skip the barrier rather than
+    # fail every launch at lowering
     kernel = functools.partial(
         _hbm_allreduce_kernel, n=n, blk=blk, n_chunks=n_chunks, op=op,
-        barrier=not interpret)
-    cp = _compiler_params(collective_id=1)
+        barrier=not interpret and cp is not None)
 
     def body(x):
         if x.size != padded:
@@ -439,9 +449,10 @@ def build_bcast_program(mesh, n: int, root: int, nd, count: int):
         padded += blk - padded % blk
     nsub = padded // blk
 
-    kernel = functools.partial(_bcast_kernel, n=n, blk=blk, nsub=nsub,
-                               root=root, barrier=not interpret)
     cp = _compiler_params(collective_id=2)
+    kernel = functools.partial(_bcast_kernel, n=n, blk=blk, nsub=nsub,
+                               root=root,
+                               barrier=not interpret and cp is not None)
 
     def body(x):
         if x.size != padded:
@@ -494,8 +505,10 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
     def one_pass(x, blk):
         """One VMEM-resident ring pass over x (per-rank size n*blk for
         reduce modes, blk for allgather)."""
+        cp = _compiler_params(collective_id=0)
         kernel = functools.partial(_ring_kernel, n=n, blk=blk, op=op,
-                                   mode=mode, barrier=not interpret)
+                                   mode=mode,
+                                   barrier=not interpret and cp is not None)
         if mode == "allgather":
             out_elems = n * blk
         elif mode == "allreduce":
@@ -503,7 +516,6 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
         else:
             out_elems = blk
         work_elems = n * blk if mode == "reduce_scatter" else 1
-        cp = _compiler_params(collective_id=0)
         kw = {"compiler_params": cp} if cp is not None and not interpret \
             else {}
         return pl.pallas_call(
@@ -540,7 +552,7 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
         # large allreduces use the HBM-resident grid kernel instead
         # (build_hbm_allreduce_program); this path only sees counts that
         # fit one VMEM pass
-        max_c = max(n, (CHUNK_ELEMS // n) * n)
+        max_c = _vmem_pass_elems(n)
         chunks = _split(padded, max_c)
     elif mode == "reduce_scatter":
         chunks = _split(blk0, max(1, CHUNK_ELEMS // n))
@@ -636,7 +648,7 @@ class RingDmaCollTask(XlaCollTask):
             program, padded = build_bcast_program(
                 shared.mesh, n, root, self.np_dtype, count)
         elif self.coll == CollType.ALLREDUCE and \
-                count > max(n, (CHUNK_ELEMS // n) * n):
+                count > _vmem_pass_elems(n):
             # larger than one VMEM pass: HBM-resident grid kernel
             program, padded = build_hbm_allreduce_program(
                 shared.mesh, n, op, self.np_dtype, count)

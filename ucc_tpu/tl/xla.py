@@ -547,6 +547,15 @@ class XlaCollTask(CollTask):
         # jax.block_until_ready(dst.buffer) is the hard-completion point.
         # Host-staged dsts and barriers keep hard completion (polled
         # readiness) — a barrier's only meaning IS program completion.
+        #
+        # FAILURE CONTRACT (ucc_schedule.h:258 analog): a failure DURING
+        # launch fails the task (test() returns the error). A failure
+        # AFTER dispatch — the device program faulting asynchronously —
+        # can NOT be reported by test(): completion was already signaled
+        # at dispatch. It surfaces at the consumption point instead
+        # (block_until_ready / np.asarray on dst.buffer raises), exactly
+        # like work queued behind a faulted CUDA stream. Pinned by
+        # tests/test_tl_xla.py::TestXlaAsyncFailure.
         dst_bi = args.dst if args.dst is not None else args.src
         self._eager_complete = (
             self.coll not in (CollType.BARRIER, CollType.FANIN,
