@@ -1,0 +1,21 @@
+"""Out-of-tree TL coll plugin used by tests/test_coll_plugin.py — the
+ucc_tl.h:64-69 / tl/ucp/coll_plugins analog: injects an extra allreduce
+algorithm ("dummy") into tl/shm via UCC_TL_SHM_COLL_PLUGINS, selectable
+through the normal TUNE string. Delegates the actual work to the
+knomial task (plugins compose framework algorithms freely) and counts
+invocations so the test can prove the plugin path ran."""
+
+from ucc_tpu.constants import CollType
+from ucc_tpu.tl.base import AlgSpec
+from ucc_tpu.tl.host.knomial import AllreduceKnomial
+
+INIT_CALLS = 0
+
+
+def ucc_coll_plugin(tl_team):
+    def init(ia, team):
+        global INIT_CALLS
+        INIT_CALLS += 1
+        return AllreduceKnomial(ia, team)
+
+    return {CollType.ALLREDUCE: [AlgSpec(100, "dummy", init)]}

@@ -1,0 +1,65 @@
+"""TL coll-plugin sub-framework (VERDICT r2 missing #4 / next #9;
+reference: ucc_tl.h:64-69 tlcp iface, tl/ucp/coll_plugins/): an
+out-of-tree module injects AlgSpecs into an existing TL's algorithm
+table via UCC_TL_<NAME>_COLL_PLUGINS, gets default score ranges, and is
+selectable by name through the TL's TUNE string like any built-in."""
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType, MemoryType,
+                     ReductionOp, UccError)
+
+from harness import UccJob
+
+
+class TestCollPlugin:
+    def test_plugin_alg_selectable_via_tune(self, monkeypatch):
+        import dummy_coll_plugin
+        monkeypatch.setenv("UCC_TL_SHM_COLL_PLUGINS", "dummy_coll_plugin")
+        monkeypatch.setenv("UCC_TL_SHM_TUNE", "allreduce:@dummy:inf")
+        before = dummy_coll_plugin.INIT_CALLS
+        job = UccJob(4)
+        try:
+            teams = job.create_team()
+            cands = teams[0].score_map.lookup(CollType.ALLREDUCE,
+                                              MemoryType.HOST, 1 << 10)
+            assert cands[0].alg_name == "dummy"
+            count = 32
+            dsts = [np.zeros(count, np.float32) for _ in range(4)]
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(np.full(count, r + 1.0, np.float32),
+                               count, DataType.FLOAT32),
+                dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+                op=ReductionOp.SUM))
+            for r in range(4):
+                np.testing.assert_allclose(dsts[r], 10.0)
+            assert dummy_coll_plugin.INIT_CALLS > before, \
+                "plugin init never ran"
+        finally:
+            job.cleanup()
+
+    def test_plugin_registered_without_tune_keeps_defaults(self,
+                                                           monkeypatch):
+        """Without a TUNE boost the plugin alg is present in the table
+        but the built-in default ranges still win selection."""
+        monkeypatch.setenv("UCC_TL_SHM_COLL_PLUGINS", "dummy_coll_plugin")
+        job = UccJob(2)
+        try:
+            teams = job.create_team()
+            cands = teams[0].score_map.lookup(CollType.ALLREDUCE,
+                                              MemoryType.HOST, 64)
+            assert cands[0].alg_name != "dummy"
+        finally:
+            job.cleanup()
+
+    def test_broken_plugin_is_a_hard_config_error(self, monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_COLL_PLUGINS",
+                           "no_such_module_xyz")
+        with pytest.raises(UccError, match="coll plugin"):
+            job = UccJob(2)
+            try:
+                job.create_team()
+            finally:
+                job.cleanup()

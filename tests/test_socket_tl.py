@@ -465,8 +465,9 @@ def _sweep_expect(case, size, rank):
 
 @pytest.mark.parametrize("size", [2, 4])
 def test_socket_tl_sweep(size):
-    """13 cases x {2,4}-process teams over real TCP: coll x dtype x size
-    matrix in the reference test/mpi style."""
+    """27 cases x {2,4}-process teams over real TCP: coll x dtype x size
+    x mode (v-colls, inplace, persistent, active-set, fanin/fanout)
+    matrix in the reference test/mpi style (main.cc:19-66)."""
     port = _free_port_pair()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()

@@ -68,7 +68,12 @@ class World:
         self.libs = [ucc_tpu.init(lib_params) if lib_params is not None
                      else ucc_tpu.init() for _ in my_ranks]
         self.contexts: List = [None] * ranks_per_proc
-        errs: List = []
+        self.teams: List = [None] * ranks_per_proc
+        # per-phase error lists: a context thread that outlives its join
+        # timeout must not have its late exception misattributed to the
+        # team phase — and a still-alive thread after join IS the error
+        # (it keeps running as a daemon against half-torn-down state)
+        ctx_errs: List = []
 
         def mk(i, r):
             try:
@@ -76,7 +81,7 @@ class World:
                     self.libs[i], ContextParams(oob=TcpStoreOob(
                         r, n, host=host, port=base_port)))
             except Exception as e:  # noqa: BLE001
-                errs.append(e)
+                ctx_errs.append(e)
 
         ths = [threading.Thread(target=mk, args=(i, r), daemon=True)
                for i, r in enumerate(my_ranks)]
@@ -84,13 +89,20 @@ class World:
             t.start()
         for t in ths:
             t.join(timeout=timeout)
-        if errs:
-            raise errs[0]
+        if any(t.is_alive() for t in ths):
+            self._teardown_partial()
+            raise UccError(Status.ERR_TIMED_OUT,
+                           "bootstrap: context create timed out (thread "
+                           "still running)")
+        if ctx_errs:
+            self._teardown_partial()
+            raise ctx_errs[0]
         if any(c is None for c in self.contexts):
+            self._teardown_partial()
             raise UccError(Status.ERR_TIMED_OUT,
                            "bootstrap: context create timed out")
 
-        self.teams: List = [None] * ranks_per_proc
+        team_errs: List = []
 
         def mkteam(i, r):
             try:
@@ -98,7 +110,7 @@ class World:
                     TeamParams(oob=TcpStoreOob(r, n, host=host,
                                                port=base_port + 1)))
             except Exception as e:  # noqa: BLE001
-                errs.append(e)
+                team_errs.append(e)
 
         ths = [threading.Thread(target=mkteam, args=(i, r), daemon=True)
                for i, r in enumerate(my_ranks)]
@@ -106,25 +118,51 @@ class World:
             t.start()
         for t in ths:
             t.join(timeout=timeout)
-        if errs:
-            raise errs[0]
-        if any(t is None for t in self.teams):
-            raise UccError(Status.ERR_TIMED_OUT,
-                           "bootstrap: team create timed out")
-        import time as _time
-        deadline = _time.monotonic() + timeout
-        while True:
-            sts = [t.create_test() for t in self.teams]
-            for c in self.contexts:
-                c.progress()
-            if all(s == Status.OK for s in sts):
-                break
-            bad = [s for s in sts if s.is_error]
-            if bad:
-                raise UccError(bad[0], "bootstrap: team create failed")
-            if _time.monotonic() > deadline:
+        try:
+            if any(t.is_alive() for t in ths):
+                raise UccError(Status.ERR_TIMED_OUT,
+                               "bootstrap: team create timed out (thread "
+                               "still running)")
+            if team_errs:
+                raise team_errs[0]
+            if any(t is None for t in self.teams):
                 raise UccError(Status.ERR_TIMED_OUT,
                                "bootstrap: team create timed out")
+            import time as _time
+            deadline = _time.monotonic() + timeout
+            while True:
+                sts = [t.create_test() for t in self.teams]
+                for c in self.contexts:
+                    c.progress()
+                if all(s == Status.OK for s in sts):
+                    break
+                bad = [s for s in sts if s.is_error]
+                if bad:
+                    raise UccError(bad[0], "bootstrap: team create failed")
+                if _time.monotonic() > deadline:
+                    raise UccError(Status.ERR_TIMED_OUT,
+                                   "bootstrap: team create timed out")
+        except BaseException:
+            self._teardown_partial()
+            raise
+
+    def _teardown_partial(self) -> None:
+        """Best-effort destruction of whatever the failed bootstrap
+        created, so the caller does not leak listeners/threads."""
+        for t in getattr(self, "teams", []) or []:
+            if t is not None:
+                try:
+                    t.destroy()
+                except Exception:  # noqa: BLE001
+                    pass
+        self.teams = []
+        for c in getattr(self, "contexts", []) or []:
+            if c is not None:
+                try:
+                    c.destroy()
+                except Exception:  # noqa: BLE001
+                    pass
+        self.contexts = []
 
     # ------------------------------------------------------------------
     @property

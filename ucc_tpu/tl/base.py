@@ -111,11 +111,57 @@ class AlgSpec:
     default_select: Optional[str] = None
 
 
+def load_coll_plugins(tl_name: str):
+    """TL coll-plugin sub-framework (the ucc_tl.h:64-69 /
+    tl/ucp/coll_plugins role): OUT-OF-TREE modules inject algorithms and
+    score ranges into an existing TL without living in this repo.
+
+    ``UCC_TL_<NAME>_COLL_PLUGINS`` is a comma-separated list of importable
+    module paths; each module exposes
+
+        def ucc_coll_plugin(tl_team) -> Dict[CollType, List[AlgSpec]]
+
+    whose AlgSpecs are merged into the TL's algorithm table before score
+    construction — so a plugin alg gets default ranges from its
+    ``default_select`` and is addressable by name in the user TUNE string
+    exactly like a built-in (the reference's tlcp modules contribute
+    score-map entries the same way). A plugin that fails to import or
+    register is a hard config error, matching the reference's behavior
+    for a requested-but-broken tlcp."""
+    import importlib
+
+    raw = os.environ.get(f"UCC_TL_{tl_name.upper()}_COLL_PLUGINS", "")
+    plugins = []
+    for path in filter(None, (m.strip() for m in raw.split(","))):
+        try:
+            mod = importlib.import_module(path)
+            plugins.append((path, getattr(mod, "ucc_coll_plugin")))
+        except Exception as e:  # noqa: BLE001 - surface the broken plugin
+            raise UccError(
+                Status.ERR_INVALID_PARAM,
+                f"coll plugin '{path}' for tl/{tl_name} failed to "
+                f"load: {e}")
+    return plugins
+
+
 def build_scores(team: BaseTeam, default_score: int,
                  alg_table: Dict[CollType, List[AlgSpec]],
                  mem_types: Sequence[MemoryType],
                  tune_env: str = "") -> CollScore:
-    """Default ranges + built-in per-alg selection + user TUNE overlay."""
+    """Default ranges + built-in per-alg selection + coll plugins + user
+    TUNE overlay."""
+    plugins = load_coll_plugins(getattr(team, "NAME", ""))
+    if plugins:
+        alg_table = {k: list(v) for k, v in alg_table.items()}
+        for path, fn in plugins:
+            try:
+                extra = fn(team)
+            except Exception as e:  # noqa: BLE001
+                raise UccError(Status.ERR_INVALID_PARAM,
+                               f"coll plugin '{path}' registration "
+                               f"failed: {e}")
+            for coll, specs in (extra or {}).items():
+                alg_table.setdefault(coll, []).extend(specs)
     score = CollScore()
     for coll, specs in alg_table.items():
         for mt in mem_types:

@@ -243,8 +243,7 @@ class AllreduceDbt(_DbtBase):
                     tr = self.rank_of(rootv)
                     buf = np.empty(hi - lo, dtype=nd)
                     rreq = self.recv_nb(tr, buf, slot=slot_up)
-                    while not rreq.test():
-                        yield
+                    yield from self.wait(rreq)
                     half[:] = reduce_arrays([half, buf], red_op, self.dt)
                 if op == ReductionOp.AVG:
                     half[:] = reduce_arrays([half], ReductionOp.SUM,
@@ -252,8 +251,7 @@ class AllreduceDbt(_DbtBase):
                 if rootv is not None:
                     sreq = self.send_nb(self.rank_of(rootv), half,
                                         slot=slot_dn)
-                    while not sreq.test():
-                        yield
+                    yield from self.wait(sreq)
                 return
             v = self.v_of(me)
             # up: accumulate children's halves, forward to parent/root
@@ -261,27 +259,19 @@ class AllreduceDbt(_DbtBase):
             bufs = [np.empty(hi - lo, dtype=nd) for _ in kids]
             rreqs = [self.recv_nb(self.rank_of(c), b, slot=slot_up)
                      for c, b in zip(kids, bufs)]
-            while not all(r.test() for r in rreqs):
-                yield
-            for r in rreqs:
-                if getattr(r, "error", None):
-                    from ...status import UccError, Status
-                    raise UccError(Status.ERR_NO_MESSAGE, r.error)
+            yield from self.wait(*rreqs)
             if bufs:
                 half[:] = reduce_arrays([half] + bufs, red_op, self.dt)
             up_to = 0 if v == rootv else self.rank_of(parent[v])
             sreq = self.send_nb(up_to, half, slot=slot_up)
-            while not sreq.test():
-                yield
+            yield from self.wait(sreq)
             # down: receive the reduced half, forward to children
             dn_from = 0 if v == rootv else self.rank_of(parent[v])
             rreq = self.recv_nb(dn_from, half, slot=slot_dn)
-            while not rreq.test():
-                yield
+            yield from self.wait(rreq)
             sreqs = [self.send_nb(self.rank_of(c), half, slot=slot_dn)
                      for c in kids]
-            while not all(r.test() for r in sreqs):
-                yield
+            yield from self.wait(*sreqs)
 
         gens = [tree_flow(0), tree_flow(1)]
         done = [False, False]

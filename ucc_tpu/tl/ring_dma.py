@@ -77,6 +77,26 @@ def _vmem_pass_elems(n: int) -> int:
     return max(n, (CHUNK_ELEMS // n) * n)
 
 
+_warned_no_barrier = False
+
+
+def _warn_no_barrier():
+    """A pallas without collective_id compiler params cannot emit the
+    entry barrier the DMA slot protocol relies on — say so LOUDLY once
+    (silent skipping would trade a lowering failure for a possible
+    data race on multi-chip runs)."""
+    global _warned_no_barrier
+    if not _warned_no_barrier:
+        _warned_no_barrier = True
+        from ..utils.log import get_logger
+        get_logger("tl_ring_dma").warning(
+            "pallas version exposes no collective_id compiler param: "
+            "ring_dma kernels compile WITHOUT the neighbor entry "
+            "barrier; multi-chip correctness is not guaranteed on this "
+            "jax version (upgrade jax, or disable tl/ring_dma via "
+            "UCC_TLS)")
+
+
 def _compiler_params(collective_id: int):
     """CompilerParams across pallas versions (CompilerParams vs
     TPUCompilerParams); collective_id keys the global barrier semaphore
@@ -392,6 +412,8 @@ def build_hbm_allreduce_program(mesh, n: int, op, nd, count: int):
     blk = csize // n
 
     cp = _compiler_params(collective_id=1)
+    if cp is None:
+        _warn_no_barrier()
     # the barrier semaphore needs a collective_id in the compiler params;
     # on pallas versions without that knob, skip the barrier rather than
     # fail every launch at lowering
@@ -450,6 +472,8 @@ def build_bcast_program(mesh, n: int, root: int, nd, count: int):
     nsub = padded // blk
 
     cp = _compiler_params(collective_id=2)
+    if cp is None:
+        _warn_no_barrier()
     kernel = functools.partial(_bcast_kernel, n=n, blk=blk, nsub=nsub,
                                root=root,
                                barrier=not interpret and cp is not None)
@@ -506,6 +530,8 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
         """One VMEM-resident ring pass over x (per-rank size n*blk for
         reduce modes, blk for allgather)."""
         cp = _compiler_params(collective_id=0)
+    if cp is None:
+        _warn_no_barrier()
         kernel = functools.partial(_ring_kernel, n=n, blk=blk, op=op,
                                    mode=mode,
                                    barrier=not interpret and cp is not None)
