@@ -55,11 +55,19 @@ class TestCollPlugin:
             job.cleanup()
 
     def test_broken_plugin_is_a_hard_config_error(self, monkeypatch):
+        from ucc_tpu import Status
+        from ucc_tpu.tl.base import load_coll_plugins
         monkeypatch.setenv("UCC_TL_SHM_COLL_PLUGINS",
                            "no_such_module_xyz")
+        # the loader itself names the broken plugin...
         with pytest.raises(UccError, match="coll plugin"):
+            load_coll_plugins("shm")
+        # ...and through the full stack team create fails INVALID_PARAM
+        # (the state machine wraps the message; the status carries)
+        with pytest.raises(UccError) as ei:
             job = UccJob(2)
             try:
                 job.create_team()
             finally:
                 job.cleanup()
+        assert ei.value.status == Status.ERR_INVALID_PARAM

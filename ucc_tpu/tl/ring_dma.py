@@ -530,8 +530,8 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
         """One VMEM-resident ring pass over x (per-rank size n*blk for
         reduce modes, blk for allgather)."""
         cp = _compiler_params(collective_id=0)
-    if cp is None:
-        _warn_no_barrier()
+        if cp is None:
+            _warn_no_barrier()
         kernel = functools.partial(_ring_kernel, n=n, blk=blk, op=op,
                                    mode=mode,
                                    barrier=not interpret and cp is not None)
