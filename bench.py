@@ -195,7 +195,8 @@ def main(sweep: bool = False) -> None:
         points = [("allreduce", c) for c in
                   (2, 256, 16 << 10, 256 << 10, 1 << 20, 16 << 20)
                   if c * 4 * n < (2 << 30)]
-        points += [("alltoall", c) for c in (256 << 10, 1 << 20, 16 << 20)
+        points += [("alltoall", c) for c in
+                   (16 << 10, 256 << 10, 1 << 20, 16 << 20)
                    if c * 4 * n < (2 << 30)]
         for coll, cnt in points:
             if coll == "alltoall" and cnt % n:

@@ -805,3 +805,50 @@ class TestXlaAsyncFailure:
                     np.asarray(argses[r].dst.buffer)
         finally:
             shared.programs.pop(key, None)
+
+
+class TestXlaShortAlltoall:
+    """ALLTOALL through the short path: host transpose + one row-sharded
+    device_put (each rank's receive layout is its row of the global)."""
+
+    def test_alltoall_short(self, job, teams):
+        n, blk = 4, 8
+        total = n * blk
+        cands = teams[0].score_map.lookup(CollType.ALLTOALL,
+                                          MemoryType.TPU, total * 4)
+        assert cands[0].alg_name == "short"
+        srcs = [np.arange(total, dtype=np.float32) + 1000 * r
+                for r in range(n)]
+        argses = [CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=tpu_buf(job, r, srcs[r], DataType.FLOAT32),
+            dst=BufferInfo(None, total, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU)) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        for r in range(n):
+            expect = np.concatenate(
+                [srcs[p][r * blk:(r + 1) * blk] for p in range(n)])
+            np.testing.assert_allclose(np.asarray(argses[r].dst.buffer),
+                                       expect)
+
+    def test_alltoall_non_divisible_falls_through(self, job, teams):
+        """count % n != 0: the short path defers to the padded program,
+        whose ceil-block exchange semantics must hold (content-checked,
+        not just shape — the fallback itself is what's under test)."""
+        n, total = 4, 10
+        padded = 12                      # ceil to n-divisible, blk=3
+        blk = padded // n
+        srcs = [np.arange(total, dtype=np.float32) + 100 * r
+                for r in range(n)]
+        argses = [CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=tpu_buf(job, r, srcs[r], DataType.FLOAT32),
+            dst=BufferInfo(None, total, DataType.FLOAT32,
+                           mem_type=MemoryType.TPU)) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        srcs_p = [np.pad(s, (0, padded - total)) for s in srcs]
+        for r in range(n):
+            expect = np.concatenate(
+                [srcs_p[p][r * blk:(r + 1) * blk] for p in range(n)])
+            got = np.asarray(argses[r].dst.buffer)
+            np.testing.assert_allclose(got[:total], expect[:total])

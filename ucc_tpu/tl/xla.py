@@ -534,6 +534,27 @@ class XlaTeamShared:
         elif coll == CollType.ALLGATHER:
             hosts = pull()
             result = np.concatenate([hosts[r] for r in sorted(hosts)])
+        elif coll == CollType.ALLTOALL:
+            # host transpose + ONE row-sharded placement: rank r's row of
+            # the global vector is its receive layout, so a single P("r")
+            # device_put lands every block where it belongs
+            hosts = pull()
+            cnt = hosts[min(hosts)].size
+            if cnt % n or any(h.size != cnt for h in hosts.values()):
+                # padded blocks / inconsistent counts belong to the
+                # program path, whose shard_for_launch raises the
+                # explicit per-rank-counts diagnostic
+                return False
+            blk = cnt // n
+            rows = [np.concatenate([hosts[p][r * blk:(r + 1) * blk]
+                                    for p in sorted(hosts)])
+                    for r in range(n)]
+            out = jax.device_put(np.concatenate(rows),
+                                 NamedSharding(self.mesh, P("r")))
+            by_dev = {s.device: s.data for s in out.addressable_shards}
+            for _, (_, task) in slot.items():
+                task.set_result(out, by_dev)
+            return True
         else:
             return False
 
@@ -1177,8 +1198,8 @@ class TlXlaTeam(TlTeamBase):
             # the range below thr, the compiled program keeps the rest
             sel = f"0-{thr}:{TlXla.DEFAULT_SCORE + 5}"
             for ct in (CollType.ALLREDUCE, CollType.REDUCE, CollType.BCAST,
-                       CollType.ALLGATHER, CollType.BARRIER, CollType.FANIN,
-                       CollType.FANOUT):
+                       CollType.ALLGATHER, CollType.ALLTOALL,
+                       CollType.BARRIER, CollType.FANIN, CollType.FANOUT):
                 table[ct].append(spec(2, "short", select=sel, alg="short"))
         return table
 
