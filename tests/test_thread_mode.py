@@ -145,3 +145,73 @@ class TestThreadModeStress:
             for r in range(n):
                 assert sums[r][it] == expect_sum, (r, it)
                 np.testing.assert_array_equal(gathers[r][it], expect_g)
+
+
+class TestThreadModeFastLane:
+    """MULTIPLE-mode stress of the round-3 persistent FAST RE-POST lane
+    on device buffers: every rank re-posts from its own OS thread, the
+    last depositor's thread launches and finishes peers in set_result
+    (cross-thread super_status writes) — the exact interleaving the
+    lane's no-owner-completion argument must survive."""
+
+    def test_concurrent_persistent_device_reposts(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        from ucc_tpu import CollArgsFlags, MemoryType
+
+        n, iters, count = 4, 12, 64
+        world = ThreadOobWorld(n)
+        libs = [ucc_tpu.init(LibParams(thread_mode=ThreadMode.MULTIPLE))
+                for _ in range(n)]
+        ctxs = [None] * n
+
+        def mk(r):
+            ctxs[r] = Context(libs[r], ContextParams(oob=world.endpoint(r)))
+
+        ths = [threading.Thread(target=mk, args=(r,)) for r in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+
+        tw = ThreadOobWorld(n)
+        errors = []
+        results = [[None] * iters for _ in range(n)]
+        barrier = threading.Barrier(n)
+
+        def rank_main(r):
+            try:
+                team = ctxs[r].create_team(TeamParams(oob=tw.endpoint(r)))
+                dev = ctxs[r].tl_contexts["xla"].obj.device
+                src = jax.device_put(
+                    jnp.full((count,), r + 1.0, jnp.float32), dev)
+                args = CollArgs(
+                    coll_type=CollType.ALLREDUCE,
+                    src=BufferInfo(src, count, DataType.FLOAT32,
+                                   mem_type=MemoryType.TPU),
+                    dst=BufferInfo(None, count, DataType.FLOAT32,
+                                   mem_type=MemoryType.TPU),
+                    op=ReductionOp.SUM,
+                    flags=CollArgsFlags.PERSISTENT)
+                req = team.collective_init(args)
+                for it in range(iters):
+                    barrier.wait(timeout=60)   # maximize re-post overlap
+                    req.post()
+                    req.wait(timeout=60)
+                    results[r][it] = float(
+                        np.asarray(args.dst.buffer)[0])
+                req.finalize()
+            except Exception as e:  # noqa: BLE001
+                errors.append((r, e))
+
+        ths = [threading.Thread(target=rank_main, args=(r,))
+               for r in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=180)
+        assert not errors, errors
+        expect = n * (n + 1) / 2
+        for r in range(n):
+            for it in range(iters):
+                assert results[r][it] == expect, (r, it, results[r][it])
