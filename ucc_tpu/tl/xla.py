@@ -546,10 +546,11 @@ class XlaTeamShared:
                 # explicit per-rank-counts diagnostic
                 return False
             blk = cnt // n
-            rows = [np.concatenate([hosts[p][r * blk:(r + 1) * blk]
-                                    for p in sorted(hosts)])
-                    for r in range(n)]
-            out = jax.device_put(np.concatenate(rows),
+            # one vectorized (src, dst, blk) -> (dst, src, blk) permute
+            # instead of n^2 python slices
+            cube = np.stack([hosts[p] for p in sorted(hosts)])
+            rows = cube.reshape(n, n, blk).transpose(1, 0, 2).reshape(-1)
+            out = jax.device_put(rows,
                                  NamedSharding(self.mesh, P("r")))
             by_dev = {s.device: s.data for s in out.addressable_shards}
             for _, (_, task) in slot.items():
