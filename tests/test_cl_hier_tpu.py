@@ -355,3 +355,52 @@ class TestHierTpuPipelined:
                 rq.finalize()
         finally:
             job.cleanup()
+
+
+class TestStagedPipelined:
+    """The generic staged fallback (no node XLA team) also honors the
+    RAB pipeline knob: D2H slice -> host hierarchy -> H2D slice per
+    fragment (VERDICT r2 next #3, staged_init half)."""
+
+    @pytest.mark.parametrize("inplace", [False, True])
+    def test_staged_allreduce_pipelined(self, monkeypatch, inplace):
+        monkeypatch.setenv("UCC_TOPO_FAKE_PPN", "2")
+        monkeypatch.setenv("UCC_TLS", "shm,self")    # no xla: staged path
+        monkeypatch.setenv(
+            "UCC_CL_HIER_ALLREDUCE_RAB_PIPELINE",
+            "thresh=64:fragsize=256:nfrags=4:pdepth=2:sequential")
+        from harness import UccJob
+        from ucc_tpu import CollArgsFlags
+        count = 500
+        n = 4
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            cands = teams[0].score_map.lookup(CollType.ALLREDUCE,
+                                              MemoryType.TPU, count * 4)
+            assert cands[0].alg_name == "rab_tpu"    # staged fallback
+            argses = []
+            for r in range(n):
+                arr = jax.device_put(
+                    jnp.arange(count, dtype=jnp.float32) + r + 1.0)
+                bi = BufferInfo(arr, count, DataType.FLOAT32,
+                                mem_type=MemoryType.TPU)
+                if inplace:
+                    argses.append(CollArgs(
+                        coll_type=CollType.ALLREDUCE, dst=bi,
+                        op=ReductionOp.SUM,
+                        flags=CollArgsFlags.IN_PLACE))
+                else:
+                    argses.append(CollArgs(
+                        coll_type=CollType.ALLREDUCE, src=bi,
+                        dst=BufferInfo(None, count, DataType.FLOAT32,
+                                       mem_type=MemoryType.TPU),
+                        op=ReductionOp.SUM))
+            job.run_coll(teams, lambda r: argses[r])
+            expect = np.arange(count, dtype=np.float32) * n + \
+                n * (n + 1) / 2
+            for r in range(n):
+                np.testing.assert_allclose(np.asarray(argses[r].dst.buffer),
+                                           expect)
+        finally:
+            job.cleanup()
