@@ -852,3 +852,49 @@ class TestXlaShortAlltoall:
                 [srcs_p[p][r * blk:(r + 1) * blk] for p in range(n)])
             got = np.asarray(argses[r].dst.buffer)
             np.testing.assert_allclose(got[:total], expect[:total])
+
+
+class TestXlaShortDtypes:
+    """Short-path dtype breadth: the host staging must honor the same
+    dtype matrix the compiled programs serve (bf16 rides ml_dtypes in
+    numpy; AVG on non-float kinds falls back to the program)."""
+
+    @pytest.mark.parametrize("dt,np_dt", [
+        (DataType.BFLOAT16, "bfloat16"), (DataType.FLOAT16, np.float16),
+        (DataType.INT8, np.int8), (DataType.UINT64, np.uint64),
+        (DataType.FLOAT64, np.float64),
+    ])
+    def test_short_allreduce_dtypes(self, job, teams, dt, np_dt):
+        n, count = 4, 16
+        if np_dt == "bfloat16":
+            import ml_dtypes
+            np_dt = ml_dtypes.bfloat16
+        srcs = [(np.arange(count) % 3 + r + 1).astype(np_dt)
+                for r in range(n)]
+        argses = [CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=tpu_buf(job, r, srcs[r], dt),
+            dst=BufferInfo(None, count, dt, mem_type=MemoryType.TPU),
+            op=ReductionOp.SUM) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        expect = np.sum([s.astype(np.float64) for s in srcs], axis=0)
+        for r in range(n):
+            got = np.asarray(argses[r].dst.buffer).astype(np.float64)
+            np.testing.assert_allclose(got, expect, rtol=1e-2)
+
+    def test_short_avg_int_falls_back_to_program(self, job, teams):
+        """AVG on an integer dtype has no exact host ufunc ladder; the
+        short path defers to the compiled program, which must still
+        produce the (truncated) integer mean."""
+        n, count = 4, 8
+        argses = [CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=tpu_buf(job, r, np.full(count, (r + 1) * 2, np.int32),
+                        DataType.INT32),
+            dst=BufferInfo(None, count, DataType.INT32,
+                           mem_type=MemoryType.TPU),
+            op=ReductionOp.AVG) for r in range(n)]
+        run_xla(job, teams, lambda r: argses[r])
+        for r in range(n):
+            got = np.asarray(argses[r].dst.buffer)
+            assert got[0] in (5, 5.0), got[0]   # (2+4+6+8)/4
