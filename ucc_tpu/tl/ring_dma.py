@@ -239,6 +239,130 @@ def _ring_kernel(local_ref, out_ref, work_ref, comm_ref, send_sem,
         out_ref[:] = work[pl.ds(me * blk, blk)]
 
 
+def _all_rank_barrier(n: int, axis: str):
+    """Entry barrier against EVERY rank (not just ring neighbors): the
+    pairwise-exchange kernel DMAs to arbitrary partners, so any rank's
+    remote write must not land before the target kernel instance owns
+    its comm slots."""
+    import jax
+    from jax.experimental.pallas import tpu as pltpu
+
+    me = jax.lax.axis_index(axis)
+    barrier = pltpu.get_barrier_semaphore()
+    for d in range(1, n):
+        peer = jax.lax.rem(me + d, n)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=peer,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, n - 1)
+
+
+def _alltoall_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem, *,
+                     n: int, blk: int, axis: str = "r",
+                     barrier: bool = False):
+    """Pairwise-exchange alltoall — the tl_mlx5 hardware-alltoall role
+    (/root/reference/src/components/tl/mlx5/alltoall/): at step s every
+    rank DMAs its block for rank (me+s) DIRECTLY to that rank (remote
+    DMA takes any device_id, not just a ring neighbor) and receives the
+    matching block from (me-s).
+
+    Unlike the ring kernels, partners are arbitrary, so NO slot-parity
+    skew argument applies. Safety comes from single-use resources
+    instead: comm slot s and recv_sem s are written/signaled by exactly
+    ONE sender (the step-s partner) and consumed exactly once — a peer
+    running arbitrarily ahead writes its own unique slot, never one
+    still in use. The entry barrier is against ALL ranks for the same
+    reason."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    me = jax.lax.axis_index(axis)
+    if barrier:
+        _all_rank_barrier(n, axis)
+
+    # my own block moves locally
+    out_ref[pl.ds(me * blk, blk)] = local_ref[pl.ds(me * blk, blk)]
+    for s in range(1, n):
+        to = jax.lax.rem(me + s, n)
+        frm = jax.lax.rem(me - s + n + n, n)
+        comm_ref[pl.ds((s - 1) * blk, blk)] = local_ref[pl.ds(to * blk,
+                                                              blk)]
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_ref.at[pl.ds((s - 1) * blk, blk)],
+            dst_ref=comm_ref.at[pl.ds((n - 1 + s - 1) * blk, blk)],
+            send_sem=send_sem.at[s - 1],
+            recv_sem=recv_sem.at[s - 1],
+            device_id=to,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        out_ref[pl.ds(frm * blk, blk)] = \
+            comm_ref[pl.ds((n - 1 + s - 1) * blk, blk)]
+
+
+def _build_vmem_kernel_program(mesh, kernel_fn, padded: int,
+                               scratch_fn, collective_id: int, out_spec):
+    """Shared scaffold for the whole-vector VMEM kernels (bcast,
+    alltoall): interpret probe, pad-to-padded, compiler params with the
+    barrier gate, pallas_call, shard_map wrap. kernel_fn(barrier=...)
+    returns the kernel partial; scratch_fn(dtype) the scratch list."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.jaxshim import shard_map_compat
+
+    interpret = jax.devices()[0].platform == "cpu"
+    cp = _compiler_params(collective_id=collective_id)
+    if cp is None:
+        _warn_no_barrier()
+    kernel = kernel_fn(barrier=not interpret and cp is not None)
+
+    def body(x):
+        if x.size != padded:
+            x = jnp.pad(x, (0, padded - x.size))
+        kw = {"compiler_params": cp} if cp is not None and not interpret \
+            else {}
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((padded,), x.dtype),
+            scratch_shapes=scratch_fn(x.dtype),
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    program = jax.jit(shard_map_compat(body, mesh, P("r"), out_spec))
+    return program, padded
+
+
+def build_alltoall_program(mesh, n: int, nd, count: int):
+    """shard_map-wrapped pairwise alltoall. count = per-rank total
+    (n blocks). Returns (program, padded)."""
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import PartitionSpec as P
+
+    padded = max(count, n)
+    if padded % n:
+        padded += n - padded % n
+    blk = padded // n
+
+    def scratch(dtype):
+        return [
+            # single-use slots: n-1 send + n-1 recv blocks, flat
+            pltpu.VMEM((2 * (n - 1) * blk,), dtype),
+            pltpu.SemaphoreType.DMA((n - 1,)),
+            pltpu.SemaphoreType.DMA((n - 1,)),
+        ]
+
+    return _build_vmem_kernel_program(
+        mesh,
+        lambda barrier: functools.partial(_alltoall_kernel, n=n, blk=blk,
+                                          barrier=barrier),
+        padded, scratch, collective_id=3, out_spec=P("r"))
+
+
 def _bcast_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem, *,
                   n: int, blk: int, nsub: int, root: int,
                   axis: str = "r", barrier: bool = False):
@@ -453,15 +577,8 @@ def build_hbm_allreduce_program(mesh, n: int, op, nd, count: int):
 
 def build_bcast_program(mesh, n: int, root: int, nd, count: int):
     """shard_map-wrapped pipelined ring bcast. Returns (program, padded)."""
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     from jax.sharding import PartitionSpec as P
-
-    from ..utils.jaxshim import shard_map_compat
-
-    interpret = jax.devices()[0].platform == "cpu"
 
     padded = max(count, 1)
     # sub-block size: small messages go whole (1 sub-block); large ones
@@ -471,32 +588,19 @@ def build_bcast_program(mesh, n: int, root: int, nd, count: int):
         padded += blk - padded % blk
     nsub = padded // blk
 
-    cp = _compiler_params(collective_id=2)
-    if cp is None:
-        _warn_no_barrier()
-    kernel = functools.partial(_bcast_kernel, n=n, blk=blk, nsub=nsub,
-                               root=root,
-                               barrier=not interpret and cp is not None)
+    def scratch(dtype):
+        return [
+            pltpu.VMEM((2, blk), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
 
-    def body(x):
-        if x.size != padded:
-            x = jnp.pad(x, (0, padded - x.size))
-        kw = {"compiler_params": cp} if cp is not None and not interpret \
-            else {}
-        return pl.pallas_call(
-            kernel,
-            out_shape=jax.ShapeDtypeStruct((padded,), x.dtype),
-            scratch_shapes=[
-                pltpu.VMEM((2, blk), x.dtype),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-            ],
-            interpret=interpret,
-            **kw,
-        )(x)
-
-    program = jax.jit(shard_map_compat(body, mesh, P("r"), P(None)))
-    return program, padded
+    return _build_vmem_kernel_program(
+        mesh,
+        lambda barrier: functools.partial(_bcast_kernel, n=n, blk=blk,
+                                          nsub=nsub, root=root,
+                                          barrier=barrier),
+        padded, scratch, collective_id=2, out_spec=P(None))
 
 
 def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
@@ -622,7 +726,8 @@ class RingDmaCollTask(XlaCollTask):
         super().__init__(init_args, team, alg=alg)
         args = init_args.args
         if self.coll not in (CollType.ALLREDUCE, CollType.ALLGATHER,
-                             CollType.REDUCE_SCATTER, CollType.BCAST):
+                             CollType.REDUCE_SCATTER, CollType.BCAST,
+                             CollType.ALLTOALL):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            f"tl/ring_dma does not implement {self.coll}")
         op = args.op if args.op is not None else ReductionOp.SUM
@@ -633,14 +738,15 @@ class RingDmaCollTask(XlaCollTask):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            f"tl/ring_dma does not implement op {op}")
         total = int((args.dst or args.src).count)
-        if self.coll == CollType.BCAST and total > CHUNK_ELEMS:
-            # bcast's local/out refs are whole-vector VMEM operands (the
-            # comm pipeline is blocked, the endpoints are not); beyond
-            # the VMEM budget selection must fall back to TL/XLA rather
-            # than fail at Mosaic allocation
+        if self.coll in (CollType.BCAST, CollType.ALLTOALL) and \
+                total > CHUNK_ELEMS:
+            # these kernels keep local/out as whole-vector VMEM operands
+            # (only the comm traffic is blocked); beyond the VMEM budget
+            # selection must fall back to TL/XLA rather than fail at
+            # Mosaic allocation
             raise UccError(Status.ERR_NOT_SUPPORTED,
-                           f"tl/ring_dma bcast count {total} exceeds the "
-                           f"VMEM bound {CHUNK_ELEMS}")
+                           f"tl/ring_dma {self.coll} count {total} "
+                           f"exceeds the VMEM bound {CHUNK_ELEMS}")
         if self.coll in (CollType.ALLGATHER, CollType.REDUCE_SCATTER) \
                 and total > (1 << 27):
             # program-level chunking unrolls one pallas_call per chunk;
@@ -673,6 +779,9 @@ class RingDmaCollTask(XlaCollTask):
         if self.coll == CollType.BCAST:
             program, padded = build_bcast_program(
                 shared.mesh, n, root, self.np_dtype, count)
+        elif self.coll == CollType.ALLTOALL:
+            program, padded = build_alltoall_program(
+                shared.mesh, n, self.np_dtype, count)
         elif self.coll == CollType.ALLREDUCE and \
                 count > _vmem_pass_elems(n):
             # larger than one VMEM pass: HBM-resident grid kernel
@@ -697,7 +806,8 @@ class TlRingDmaTeam(TlXlaTeam):
 
         return {ct: [spec(0, "ring_dma")] for ct in (
             CollType.ALLREDUCE, CollType.ALLGATHER,
-            CollType.REDUCE_SCATTER, CollType.BCAST)}
+            CollType.REDUCE_SCATTER, CollType.BCAST,
+            CollType.ALLTOALL)}
 
     def get_scores(self) -> CollScore:
         return build_scores(self, TlRingDma.DEFAULT_SCORE, self.alg_table(),
@@ -713,7 +823,8 @@ class TlRingDma(TransportLayer):
     NAME = "ring_dma"
     DEFAULT_SCORE = 20        # below TL/XLA: opt-in via TUNE/score boost
     SUPPORTED_COLLS = (CollType.ALLREDUCE | CollType.ALLGATHER
-                       | CollType.REDUCE_SCATTER | CollType.BCAST)
+                       | CollType.REDUCE_SCATTER | CollType.BCAST
+                       | CollType.ALLTOALL)
     SUPPORTED_MEM_TYPES = (MemoryType.TPU,)
     SERVICE_CAPABLE = False
     CONTEXT_CONFIG = TL_RING_DMA_CONFIG
