@@ -281,39 +281,46 @@ class TestEeDeviceCollective:
             job.cleanup()
 
 
-class TestOneSidedRejected:
-    """One-sided args (global work buffer / mem-mapped peer buffers) are
-    honestly rejected at init — no DCN RDMA analog on TPU pods (see
-    PARITY.md one-sided justification)."""
+class TestOneSidedGating:
+    """One-sided args gating (round 3): HOST-memory one-sided args are
+    SERVED by the socket/shm RDMA-emulation path (full coverage in
+    test_onesided.py); device-memory one-sided args remain honestly
+    rejected — no HBM RDMA window over the TPU DCN (PARITY.md)."""
 
-    def test_global_work_buffer_rejected(self):
+    def test_host_global_work_buffer_accepted(self):
         job = UccJob(2)
         try:
             teams = job.create_team()
-            args = CollArgs(
+            src = np.arange(4, dtype=np.float32)
+            reqs = [teams[r].collective_init(CollArgs(
                 coll_type=CollType.ALLTOALL,
-                src=BufferInfo(np.zeros(4, np.float32), 4,
-                               DataType.FLOAT32),
+                src=BufferInfo(src.copy(), 4, DataType.FLOAT32),
                 dst=BufferInfo(np.zeros(4, np.float32), 4,
-                               DataType.FLOAT32))
-            args.global_work_buffer = np.zeros(16, np.uint8)
-            from ucc_tpu import UccError
-            with pytest.raises(UccError):
-                teams[0].collective_init(args)
+                               DataType.FLOAT32),
+                global_work_buffer=np.zeros(16, np.uint8)))
+                for r in range(2)]
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs))
+            assert all(rq.test() == Status.OK for rq in reqs)
         finally:
             job.cleanup()
 
-    def test_mem_mapped_flag_rejected(self):
-        from ucc_tpu import CollArgsFlags
+    def test_tpu_mem_mapped_flag_rejected(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        from ucc_tpu import CollArgsFlags, MemoryType
         job = UccJob(2)
         try:
             teams = job.create_team()
+            x = jnp.zeros(4, dtype=jnp.float32)
             args = CollArgs(
                 coll_type=CollType.ALLREDUCE,
-                src=BufferInfo(np.zeros(4, np.float32), 4,
-                               DataType.FLOAT32),
-                dst=BufferInfo(np.zeros(4, np.float32), 4,
-                               DataType.FLOAT32),
+                src=BufferInfo(x, 4, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
+                dst=BufferInfo(x, 4, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
                 op=ReductionOp.SUM,
                 flags=CollArgsFlags.MEM_MAPPED_BUFFERS)
             from ucc_tpu import UccError

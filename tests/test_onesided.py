@@ -1,0 +1,389 @@
+"""One-sided (mem_map remote access + global_work_buffer collectives).
+
+Mirrors the reference's one-sided coverage: gtest core/test_mem_map.cc
+(export/import/unmap), test/mpi onesided alltoall sweeps (main.cc -o flag),
+and the sliding-window allreduce path (allreduce_sliding_window.c) — here
+over the host RDMA-emulation transports (tl/host/onesided.py)."""
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, CollArgs, CollArgsFlags, CollType, DataType,
+                     ReductionOp, Status)
+from ucc_tpu.constants import dt_numpy
+from ucc_tpu.tl.host.onesided import REGISTRY
+
+from harness import UccJob
+
+
+def _mkdata(rank, count, nd, seed=11):
+    rng = np.random.default_rng(seed + rank)
+    if np.issubdtype(nd, np.floating):
+        return (rng.random(count) * 4 - 2).astype(nd)
+    return rng.integers(1, 50, size=count).astype(nd)
+
+
+@pytest.fixture()
+def job4(monkeypatch, request):
+    """Fresh 4-rank job; tests parametrize the TUNE env via markers."""
+    tune = getattr(request, "param", "")
+    if tune:
+        monkeypatch.setenv("UCC_TL_SHM_TUNE", tune)
+    j = UccJob(4)
+    try:
+        yield j
+    finally:
+        j.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# mem_map export/import/unmap (ucc.h:2265-2320)
+# ---------------------------------------------------------------------------
+
+class TestMemMap:
+    def test_export_registers_segment(self, job4):
+        ctx = job4.contexts[0]
+        buf = np.arange(64, dtype=np.float64)
+        h = ctx.mem_map(buf)
+        desc = ctx.mem_import(h)
+        assert desc["onesided"] is True
+        assert desc["nbytes"] == buf.nbytes
+        assert desc["buffer"] is buf           # same-process resolution
+        key = (desc["ctx_uid"], desc["seg_id"])
+        assert key in REGISTRY.segments
+        ctx.mem_unmap(h)
+        assert key not in REGISTRY.segments
+
+    def test_import_remote_handle_is_metadata_only(self, job4):
+        h = job4.contexts[1].mem_map(np.zeros(8, dtype=np.int32))
+        desc = job4.contexts[0].mem_import(h)
+        assert desc["buffer"] is None
+        assert desc["seg_id"] >= 1
+
+    def test_context_destroy_unregisters(self):
+        job = UccJob(2)
+        uid = job.contexts[0]._ctx_uid
+        job.contexts[0].mem_map(np.zeros(16, dtype=np.uint8))
+        assert any(k[0] == uid for k in REGISTRY.segments)
+        job.cleanup()
+        assert not any(k[0] == uid for k in REGISTRY.segments)
+
+    def test_readonly_buffer_is_get_only(self, job4):
+        ctx = job4.contexts[0]
+        h = ctx.mem_map(b"\x01\x02\x03\x04")
+        desc = ctx.mem_import(h)
+        got = REGISTRY.read_get(desc["ctx_uid"], desc["seg_id"], 1, 2)
+        assert got is not None and bytes(got) == b"\x02\x03"
+        err = REGISTRY.apply_put(desc["ctx_uid"], desc["seg_id"], 0,
+                                 np.zeros(2, dtype=np.uint8))
+        assert err is not None and "read-only" in err
+
+    def test_tpu_buffer_exports_metadata_only(self, job4):
+        jax = pytest.importorskip("jax")
+        ctx = job4.contexts[0]
+        import jax.numpy as jnp
+        h = ctx.mem_map(jnp.zeros(8, dtype=jnp.float32))
+        desc = ctx.mem_import(h)
+        assert desc["onesided"] is False
+
+
+# ---------------------------------------------------------------------------
+# onesided alltoall (tl_ucp alltoall_onesided.c)
+# ---------------------------------------------------------------------------
+
+def _a2a_expect(srcs, n, bsz):
+    return [np.concatenate([srcs[p][r * bsz:(r + 1) * bsz]
+                            for p in range(n)]) for r in range(n)]
+
+
+class TestAlltoallOnesided:
+    @pytest.mark.parametrize("job4", ["alltoall:@onesided"], indirect=True)
+    @pytest.mark.parametrize("count_per", [1, 7, 1024])
+    def test_put_variant(self, job4, count_per):
+        n = 4
+        count = count_per * n
+        teams = job4.create_team()
+        srcs = [_mkdata(r, count, np.float32) for r in range(n)]
+        dsts = [np.zeros(count, dtype=np.float32) for _ in range(n)]
+        handles = [job4.contexts[r].mem_map(dsts[r]) for r in range(n)]
+        job4.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+            dst_memh=list(handles),
+            flags=CollArgsFlags.MEM_MAP_DST_MEMH))
+        for r, e in enumerate(_a2a_expect(srcs, n, count_per)):
+            np.testing.assert_array_equal(dsts[r], e)
+        # completion counters are deleted once consumed
+        assert not any(isinstance(k, tuple) and k and k[0] == "__os_ctr__"
+                       for k in REGISTRY.counters)
+
+    @pytest.mark.parametrize("job4", ["alltoall:@onesided"], indirect=True)
+    def test_get_variant(self, job4, monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_ALLTOALL_ONESIDED_ALG", "get")
+        n = 4
+        count = 8 * n
+        teams = job4.create_team()
+        srcs = [_mkdata(r, count, np.int64) for r in range(n)]
+        dsts = [np.zeros(count, dtype=np.int64) for _ in range(n)]
+        handles = [job4.contexts[r].mem_map(srcs[r]) for r in range(n)]
+        job4.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=BufferInfo(srcs[r], count, DataType.INT64),
+            dst=BufferInfo(dsts[r], count, DataType.INT64),
+            src_memh=list(handles),
+            flags=CollArgsFlags.MEM_MAP_SRC_MEMH))
+        for r, e in enumerate(_a2a_expect(srcs, n, 8)):
+            np.testing.assert_array_equal(dsts[r], e)
+
+    @pytest.mark.parametrize("job4", ["alltoall:@onesided"], indirect=True)
+    def test_missing_memh_falls_back_to_twosided(self, job4):
+        """TUNE selects onesided but no memh args: init raises
+        NOT_SUPPORTED and the score-map fallback walk must serve the
+        collective with a two-sided algorithm (ucc_coll_score_map.c:136)."""
+        n = 4
+        count = 4 * n
+        teams = job4.create_team()
+        srcs = [_mkdata(r, count, np.float32) for r in range(n)]
+        dsts = [np.zeros(count, dtype=np.float32) for _ in range(n)]
+        job4.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32)))
+        for r, e in enumerate(_a2a_expect(srcs, n, 4)):
+            np.testing.assert_array_equal(dsts[r], e)
+
+    def test_memh_args_with_default_tune_run_twosided(self, job4):
+        """Passing global memh without TUNE-selecting onesided keeps the
+        default algorithm (reference parity: memh args enable, never
+        force, the onesided path)."""
+        n = 4
+        count = 4 * n
+        teams = job4.create_team()
+        srcs = [_mkdata(r, count, np.float32) for r in range(n)]
+        dsts = [np.zeros(count, dtype=np.float32) for _ in range(n)]
+        handles = [job4.contexts[r].mem_map(dsts[r]) for r in range(n)]
+        job4.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+            dst_memh=list(handles),
+            flags=CollArgsFlags.MEM_MAP_DST_MEMH))
+        for r, e in enumerate(_a2a_expect(srcs, n, 4)):
+            np.testing.assert_array_equal(dsts[r], e)
+
+
+# ---------------------------------------------------------------------------
+# sliding-window one-sided allreduce (allreduce_sliding_window.{c,h})
+# ---------------------------------------------------------------------------
+
+def _sw_args(srcs, dsts, sh, dh, op, dt, count, inplace=False):
+    flags = (CollArgsFlags.MEM_MAP_SRC_MEMH
+             | CollArgsFlags.MEM_MAP_DST_MEMH)
+    if inplace:
+        flags |= CollArgsFlags.IN_PLACE
+
+    def make(r):
+        return CollArgs(coll_type=CollType.ALLREDUCE,
+                        src=BufferInfo(srcs[r], count, dt),
+                        dst=BufferInfo(dsts[r], count, dt),
+                        op=op, src_memh=list(sh), dst_memh=list(dh),
+                        flags=flags)
+    return make
+
+
+class TestSlidingWindowAllreduce:
+    @pytest.mark.parametrize("job4", ["allreduce:@sliding_window"],
+                             indirect=True)
+    @pytest.mark.parametrize("count", [3, 64, 4097])
+    def test_sum_multiwindow(self, job4, count, monkeypatch):
+        # tiny window forces the multi-window pipeline incl. remainders
+        monkeypatch.setenv("UCC_TL_SHM_ALLREDUCE_SW_WINDOW", "256")
+        n = 4
+        teams = job4.create_team()
+        srcs = [_mkdata(r, count, np.float32) for r in range(n)]
+        dsts = [np.zeros(count, dtype=np.float32) for _ in range(n)]
+        sh = [job4.contexts[r].mem_map(srcs[r]) for r in range(n)]
+        dh = [job4.contexts[r].mem_map(dsts[r]) for r in range(n)]
+        job4.run_coll(teams, _sw_args(srcs, dsts, sh, dh, ReductionOp.SUM,
+                                      DataType.FLOAT32, count))
+        expect = np.sum(srcs, axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], expect, rtol=1e-4,
+                                       atol=1e-5)
+
+    @pytest.mark.parametrize("job4", ["allreduce:@sliding_window"],
+                             indirect=True)
+    def test_avg_inplace(self, job4):
+        n = 4
+        count = 1000
+        teams = job4.create_team()
+        bufs = [_mkdata(r, count, np.float64) for r in range(n)]
+        ref = [b.copy() for b in bufs]
+        # in-place: src and dst memh map the same buffer
+        h = [job4.contexts[r].mem_map(bufs[r]) for r in range(n)]
+        job4.run_coll(teams, _sw_args(bufs, bufs, h, h, ReductionOp.AVG,
+                                      DataType.FLOAT64, count, inplace=True))
+        expect = np.mean(ref, axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(bufs[r], expect, rtol=1e-9)
+
+    @pytest.mark.parametrize("job4", ["allreduce:@sliding_window"],
+                             indirect=True)
+    @pytest.mark.parametrize("op,nd,dt", [
+        (ReductionOp.MAX, np.int32, DataType.INT32),
+        (ReductionOp.PROD, np.float32, DataType.FLOAT32),
+    ])
+    def test_ops_dtypes(self, job4, op, nd, dt):
+        n = 4
+        count = 37          # not divisible by team size: uneven partitions
+        teams = job4.create_team()
+        srcs = [_mkdata(r, count, nd) for r in range(n)]
+        if op == ReductionOp.PROD:
+            srcs = [np.clip(s, 0.5, 1.5).astype(nd) for s in srcs]
+        dsts = [np.zeros(count, dtype=nd) for _ in range(n)]
+        sh = [job4.contexts[r].mem_map(srcs[r]) for r in range(n)]
+        dh = [job4.contexts[r].mem_map(dsts[r]) for r in range(n)]
+        job4.run_coll(teams, _sw_args(srcs, dsts, sh, dh, op, dt, count))
+        if op == ReductionOp.MAX:
+            expect = np.max(srcs, axis=0)
+            for r in range(n):
+                np.testing.assert_array_equal(dsts[r], expect)
+        else:
+            expect = np.prod(srcs, axis=0)
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r], expect, rtol=1e-4)
+
+    @pytest.mark.parametrize("job4", ["allreduce:@sliding_window"],
+                             indirect=True)
+    def test_persistent_repost(self, job4):
+        n = 4
+        count = 512
+        teams = job4.create_team()
+        srcs = [_mkdata(r, count, np.float32) for r in range(n)]
+        dsts = [np.zeros(count, dtype=np.float32) for _ in range(n)]
+        sh = [job4.contexts[r].mem_map(srcs[r]) for r in range(n)]
+        dh = [job4.contexts[r].mem_map(dsts[r]) for r in range(n)]
+        make = _sw_args(srcs, dsts, sh, dh, ReductionOp.SUM,
+                        DataType.FLOAT32, count)
+
+        def persistent(r):
+            a = make(r)
+            a.flags |= CollArgsFlags.PERSISTENT
+            return a
+        reqs = job4.run_coll(teams, persistent)
+        expect = np.sum(srcs, axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], expect, rtol=1e-4,
+                                       atol=1e-5)
+        # mutate sources and re-post the same requests
+        for r in range(n):
+            srcs[r] += r + 1
+            dsts[r][:] = 0
+        for rq in reqs:
+            rq.post()
+        job4.progress_until(lambda: all(
+            rq.test() != Status.IN_PROGRESS for rq in reqs))
+        for rq in reqs:
+            assert rq.test() == Status.OK
+        expect = np.sum(srcs, axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], expect, rtol=1e-4,
+                                       atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# failure semantics + device-memory gating
+# ---------------------------------------------------------------------------
+
+class TestOneSidedFailure:
+    @pytest.mark.parametrize("job4", ["alltoall:@onesided"], indirect=True)
+    def test_unmapped_segment_fails_not_hangs(self, job4):
+        """A put against an unmapped segment must fail the task (the
+        initiator raises at apply; the target's notify counter is bumped
+        AND poisoned so its wait completes with an error), never hang or
+        complete with silent corruption."""
+        n = 4
+        count = 4 * n
+        teams = job4.create_team()
+        srcs = [_mkdata(r, count, np.float32) for r in range(n)]
+        dsts = [np.zeros(count, dtype=np.float32) for _ in range(n)]
+        handles = [job4.contexts[r].mem_map(dsts[r]) for r in range(n)]
+        # rank 2 unmaps before the collective
+        job4.contexts[2].mem_unmap(handles[2])
+        reqs = [t.collective_init(CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+            dst_memh=list(handles),
+            flags=CollArgsFlags.MEM_MAP_DST_MEMH))
+            for r, t in enumerate(teams)]
+        for rq in reqs:
+            rq.post()
+        import time
+        deadline = time.monotonic() + 20
+        sts = [Status.IN_PROGRESS] * n
+        while time.monotonic() < deadline:
+            for r in range(n):
+                job4.contexts[r].progress()
+            sts = [rq.test() for rq in reqs]
+            if all(s != Status.IN_PROGRESS for s in sts):
+                break
+        assert any(s.is_error for s in sts if s != Status.IN_PROGRESS) or \
+            any(s == Status.IN_PROGRESS for s in sts) is False
+        # at least the ranks whose put hit the dead segment must error
+        assert any(s.is_error for s in sts)
+
+    def test_rejected_put_poisons_notify_counter(self):
+        """Protocol invariant: a rejected put with a notify key bumps the
+        counter (so the target's count completes) and records the error
+        (so the target fails instead of consuming garbage)."""
+        key = ("__os_ctr__", "test-uid", "tk", 1)
+        err = REGISTRY.apply_put("no-such-ctx", 99, 0,
+                                 np.zeros(4, np.uint8), notify=key)
+        assert err is not None
+        assert REGISTRY.counter_read(key) == 1
+        assert REGISTRY.counter_errs(key) == [err]
+        REGISTRY.counter_del(key)
+        assert REGISTRY.counter_read(key) == 0
+        assert REGISTRY.counter_errs(key) == []
+
+    def test_socket_flush_fence_reports_rejections(self, job4):
+        """os_flush over a real socket connection: the ack fences all
+        prior puts on that path and reports rejections since the last
+        flush (ucp_ep_flush error semantics), then resets."""
+        # force the socket TL path between two in-process contexts
+        ctx0 = job4.contexts[0].tl_contexts["socket"].obj
+        ctx1_core = job4.contexts[1]
+        buf = np.zeros(16, np.uint8)
+        h = ctx1_core.mem_map(buf)
+        desc = ctx1_core.mem_import(h)
+        peer = 1
+        # good put -> flush ack must be clean
+        ctx0.os_put(peer, desc, 0, np.arange(4, dtype=np.uint8))
+        fr = ctx0.os_flush(peer)
+        job4.progress_until(lambda: fr.test(), timeout=10)
+        assert fr.error is None
+        assert buf[:4].tolist() == [0, 1, 2, 3]
+        # out-of-bounds put -> flush reports it, next flush is clean again
+        ctx0.os_put(peer, desc, 1000, np.zeros(64, np.uint8))
+        fr2 = ctx0.os_flush(peer)
+        job4.progress_until(lambda: fr2.test(), timeout=10)
+        assert fr2.error is not None and "rejected" in fr2.error
+        fr3 = ctx0.os_flush(peer)
+        job4.progress_until(lambda: fr3.test(), timeout=10)
+        assert fr3.error is None
+
+    def test_tpu_memory_onesided_rejected(self, job4):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        teams = job4.create_team()
+        x = jnp.zeros(8, dtype=jnp.float32)
+        with pytest.raises(ucc_tpu.UccError) as ei:
+            teams[0].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(x, 8, DataType.FLOAT32),
+                dst=BufferInfo(x, 8, DataType.FLOAT32),
+                op=ReductionOp.SUM,
+                global_work_buffer=np.zeros(8)))
+        assert ei.value.status == Status.ERR_NOT_SUPPORTED

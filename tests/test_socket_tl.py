@@ -537,6 +537,131 @@ def _death_worker(rank, size, port, outdir):
             f.write("error:" + traceback.format_exc())
 
 
+def _onesided_worker(rank: int, size: int, port: int, q):
+    """One-sided collectives over real TCP frames: PUT/GET/flush applied
+    by the passive peer's reader thread (the emulated-RDMA DCN path,
+    tl/host/onesided.py; reference: test/mpi -o onesided sweeps)."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["UCC_TLS"] = "socket,self"
+        os.environ["UCC_TL_SOCKET_TUNE"] = \
+            "alltoall:@onesided#allreduce:@sliding_window"
+        # tiny window: force multi-window gets/puts across the wire
+        os.environ["UCC_TL_SOCKET_ALLREDUCE_SW_WINDOW"] = "64"
+        import ucc_tpu
+        from ucc_tpu import (BufferInfo, CollArgs, CollArgsFlags, CollType,
+                             ContextParams, DataType, ReductionOp,
+                             TcpStoreOob, TeamParams)
+
+        oob = TcpStoreOob(rank, size, port=port)
+        lib = ucc_tpu.init()
+        ctx = ucc_tpu.Context(lib, ContextParams(oob=oob))
+        team = ctx.create_team(TeamParams(
+            oob=TcpStoreOob(rank, size, port=port + 1)))
+        results = {}
+
+        def exchange_handle(handle: bytes) -> list:
+            """Allgather the (variable-size) handle via a fixed-size
+            padded UINT8 allgather — the public-API way a runtime
+            distributes rkeys."""
+            pad = 1024
+            assert len(handle) <= pad - 8
+            blob = np.zeros(pad, np.uint8)
+            blob[:8] = np.frombuffer(
+                np.int64(len(handle)).tobytes(), np.uint8)
+            blob[8:8 + len(handle)] = np.frombuffer(handle, np.uint8)
+            out = np.zeros(pad * size, np.uint8)
+            req = team.collective_init(CollArgs(
+                coll_type=CollType.ALLGATHER,
+                src=BufferInfo(blob, pad, DataType.UINT8),
+                dst=BufferInfo(out, pad * size, DataType.UINT8)))
+            req.post()
+            req.wait(timeout=60)
+            hs = []
+            for p in range(size):
+                seg = out[p * pad:(p + 1) * pad]
+                ln = int(np.frombuffer(seg[:8].tobytes(), np.int64)[0])
+                hs.append(seg[8:8 + ln].tobytes())
+            return hs
+
+        # --- onesided alltoall (put variant over TCP) ---
+        per = 4
+        total = per * size
+        src = np.arange(total, dtype=np.int32) + 1000 * rank
+        dst = np.zeros(total, np.int32)
+        handles = exchange_handle(ctx.mem_map(dst))
+        req = team.collective_init(CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=BufferInfo(src, total, DataType.INT32),
+            dst=BufferInfo(dst, total, DataType.INT32),
+            dst_memh=handles,
+            flags=CollArgsFlags.MEM_MAP_DST_MEMH))
+        req.post()
+        req.wait(timeout=90)
+        results["a2a"] = dst.tolist()
+
+        # --- sliding-window allreduce (windowed gets + puts over TCP) ---
+        count = 257        # odd: uneven partitions + window remainders
+        asrc = (np.arange(count, dtype=np.float32) + rank) * 0.5
+        adst = np.zeros(count, np.float32)
+        sh = exchange_handle(ctx.mem_map(asrc))
+        dh = exchange_handle(ctx.mem_map(adst))
+        req = team.collective_init(CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(asrc, count, DataType.FLOAT32),
+            dst=BufferInfo(adst, count, DataType.FLOAT32),
+            op=ReductionOp.SUM, src_memh=sh, dst_memh=dh,
+            flags=(CollArgsFlags.MEM_MAP_SRC_MEMH
+                   | CollArgsFlags.MEM_MAP_DST_MEMH)))
+        req.post()
+        req.wait(timeout=90)
+        results["sw_allreduce"] = adst.tolist()
+
+        q.put((rank, results))
+        ctx.destroy()
+        if rank == 0:
+            oob.close()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        q.put((rank, {"error": f"{e}\n{traceback.format_exc()}"}))
+
+
+def test_socket_onesided_three_processes():
+    # 4 processes = 3 remote peers per rank: sliding-window gets complete
+    # out of order across peers, exercising the bounded-slot free-list
+    size = 4
+    port = _free_port_pair()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_onesided_worker, args=(r, size, port, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(size):
+        rank, res = q.get(timeout=180)
+        results[rank] = res
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    for r in range(size):
+        assert "error" not in results[r], results[r].get("error")
+    per = 4
+    for r in range(size):
+        expect = []
+        for p in range(size):
+            base = 1000 * p
+            expect += [base + r * per + i for i in range(per)]
+        assert results[r]["a2a"] == expect
+    count = 257
+    expect_ar = np.sum([(np.arange(count, dtype=np.float32) + p) * 0.5
+                        for p in range(size)], axis=0)
+    for r in range(size):
+        np.testing.assert_allclose(results[r]["sw_allreduce"], expect_ar,
+                                   rtol=1e-6)
+
+
 def test_peer_death_surfaces_as_error(tmp_path):
     """Failure detection over DCN: a peer process dying mid-collective
     must surface as ERR_TIMED_OUT (per-coll timeout backstop) or a

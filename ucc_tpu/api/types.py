@@ -170,7 +170,12 @@ class CollArgs:
     timeout: float = 0.0                     # seconds, used with FLAG TIMEOUT
     active_set: Optional[ActiveSet] = None
     cb: Optional[Callable[[Any, Status], None]] = None
-    global_work_buffer: Any = None           # one-sided support hook
+    global_work_buffer: Any = None           # one-sided scratchpad (ucc.h:1878)
+    #: mem_map handles for one-sided collectives (ucc.h:1900-1930 union):
+    #: a single exported handle (local) or a list of one handle per team
+    #: rank (global — set flags MEM_MAP_SRC_MEMH / MEM_MAP_DST_MEMH)
+    src_memh: Any = None
+    dst_memh: Any = None
 
     # -- convenience predicates ------------------------------------------
     @property

@@ -208,18 +208,22 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
         # reference restriction (ucc_coll.c:210-214)
         raise UccError(Status.ERR_NOT_SUPPORTED,
                        "active sets supported for bcast only")
-    if args.global_work_buffer is not None or \
-            (args.flags & CollArgsFlags.MEM_MAPPED_BUFFERS):
-        # one-sided DCN collectives (global work buffer / mem-mapped
-        # peer buffers, ucc.h:1878-1887) are honestly rejected rather
-        # than silently ignored: TPU pods have no UCX-style host RDMA
-        # window over DCN; the device-initiated role is served on ICI by
+    mem_type = _resolve_mem_type(args)
+    onesided_args = (args.global_work_buffer is not None
+                     or args.src_memh is not None
+                     or args.dst_memh is not None
+                     or bool(args.flags & CollArgsFlags.MEM_MAPPED_BUFFERS))
+    if onesided_args and mem_type == MemoryType.TPU:
+        # one-sided args on HOST memory are served by the socket/shm
+        # RDMA-emulation path (tl/host/onesided.py, TUNE-selected like the
+        # reference's onesided algorithms); on DEVICE memory they are
+        # honestly rejected: TPU DCN NICs expose no user RDMA window over
+        # HBM, and the device-initiated role is served on ICI by
         # tl/ring_dma (see PARITY.md "one-sided capabilities")
         raise UccError(Status.ERR_NOT_SUPPORTED,
                        "one-sided (global_work_buffer / mem-mapped) "
-                       "collectives are not supported on the TPU DCN "
+                       "collectives are host-memory only on the TPU DCN "
                        "path; see PARITY.md")
-    mem_type = _resolve_mem_type(args)
     if _is_zero_size(args) and mem_type != MemoryType.TPU:
         # zero-size fast path (ucc_coll.c:191-208) — HOST memory only.
         # Device-memory colls are served by the rendezvous TL (tl/xla),

@@ -119,6 +119,7 @@ class Context:
         import uuid as _uuid
         self._ctx_uid = _uuid.uuid4().hex
         self._mem_maps = {}
+        self._seg_id_counter = 1
         self._destroyed = False
 
     # ------------------------------------------------------------------
@@ -141,39 +142,56 @@ class Context:
 
     # ------------------------------------------------------------------
     # memory map export/import (ucc_mem_map, ucc.h:2265-2320 /
-    # ucc_context.c:1250-1559). On TPU hosts there is no RDMA rkey to
-    # exchange; the handle carries enough metadata for a future one-sided
-    # DCN path and already supports local validation + re-import.
+    # ucc_context.c:1250-1559). HOST buffers are registered for genuine
+    # remote access: the handle's (ctx_uid, seg_id) addresses the segment
+    # through the one-sided transport emulation (tl/host/onesided.py —
+    # puts/gets/atomics serviced passively, the UCX-over-TCP emulated-RDMA
+    # role). Device (TPU) buffers export metadata only: TPU DCN NICs have
+    # no user RDMA window, and the device-side one-sided role is served on
+    # ICI by tl/ring_dma.
     def mem_map(self, buffer, mode: str = "export") -> bytes:
         """Returns an opaque exported memory handle (pickled descriptor)."""
         import pickle as _pickle
 
         from ..mc.base import detect_mem_type
+        from ..constants import MemoryType
         mt = detect_mem_type(buffer)
         nbytes = getattr(buffer, "nbytes", len(buffer))
+        seg_id = self._seg_id_counter
+        self._seg_id_counter += 1
         desc = {"ctx_rank": self.rank, "ctx_uid": self._ctx_uid,
                 "mem_type": int(mt), "nbytes": int(nbytes), "mode": mode,
+                "seg_id": seg_id, "onesided": False,
                 "addr_id": id(buffer)}
-        self._mem_maps[desc["addr_id"]] = buffer
+        if mt == MemoryType.HOST:
+            from ..tl.host.onesided import REGISTRY
+            desc["nbytes"] = REGISTRY.register(self._ctx_uid, seg_id, buffer)
+            desc["onesided"] = True
+        self._mem_maps[seg_id] = buffer
         return _pickle.dumps(desc)
 
     def mem_unmap(self, handle: bytes) -> Status:
         import pickle as _pickle
         desc = _pickle.loads(handle)
-        self._mem_maps.pop(desc.get("addr_id"), None)
+        seg_id = desc.get("seg_id")
+        if self._mem_maps.pop(seg_id, None) is not None and \
+                desc.get("onesided"):
+            from ..tl.host.onesided import REGISTRY
+            REGISTRY.unregister(self._ctx_uid, seg_id)
         return Status.OK
 
     def mem_import(self, handle: bytes):
         """Import a peer's exported handle -> descriptor dict. Same-process
         handles resolve to the live buffer (the shm fast path); remote
-        handles carry metadata only (one-sided DCN transport: future)."""
+        handles carry the (ctx_uid, seg_id) remote-access address used by
+        the one-sided put/get path."""
         import pickle as _pickle
         desc = _pickle.loads(handle)
         # only resolve to a live buffer when the handle was exported by
         # THIS context (id() reuse across contexts/processes would
         # otherwise alias unrelated buffers)
         if desc.get("ctx_uid") == self._ctx_uid:
-            desc["buffer"] = self._mem_maps.get(desc.get("addr_id"))
+            desc["buffer"] = self._mem_maps.get(desc.get("seg_id"))
         else:
             desc["buffer"] = None
         return desc
@@ -183,5 +201,9 @@ class Context:
             return Status.OK
         for h in self.tl_contexts.values():
             h.obj.destroy()
+        if self._mem_maps:
+            from ..tl.host.onesided import REGISTRY
+            REGISTRY.unregister_ctx(self._ctx_uid)
+            self._mem_maps.clear()
         self._destroyed = True
         return Status.OK

@@ -1,0 +1,516 @@
+"""One-sided (put/get/atomic) semantics over host transports — the DCN
+RDMA-emulation role.
+
+Reference surface being served (previously deferred, PARITY §one-sided):
+  - ``ucc_mem_map`` export/import with *remote-access* capability
+    (/root/reference/src/ucc/api/ucc.h:2265-2320,
+     /root/reference/src/core/ucc_context.c:1250-1559);
+  - ``global_work_buffer`` / global memh collective args
+    (/root/reference/src/ucc/api/ucc.h:1878-1887, :1900-1930);
+  - TL/UCP's one-sided p2p (put/get/atomic_inc + ep_flush,
+    /root/reference/src/components/tl/ucp/tl_ucp_sendrecv.h:112-), and its
+    users ``alltoall_onesided.c`` and ``allreduce_sliding_window.{c,h}``.
+
+TPU hosts have no UCX and their DCN NICs expose no user RDMA window — but
+the same is true of UCX's own ``tcp`` transport, which *emulates* RDMA
+(put/get/atomics) with active messages serviced by the progress engine.
+This module is that emulation for the framework's transports:
+
+  - a process-global SEGMENT registry maps (ctx_uid, seg_id) -> registered
+    host buffer (``Context.mem_map`` registers — the memh/rkey analog);
+  - PUT/GET/ATOMIC arrive as transport frames; the socket reader thread
+    applies them passively — the target's *user* thread never participates,
+    which is the defining one-sided property (UCX am-emulated RDMA has the
+    same progress model);
+  - in-process peers (TL/SHM, socket loopback) apply them directly under
+    the registry lock;
+  - remote completion: per-connection TCP ordering + FLUSH frames acked by
+    the passive side (the ``ucp_ep_flush`` analog); delivery notification
+    rides atomic counters (tl_ucp ``atomic_inc`` onesided completion
+    counters, tl_ucp_task ``onesided.put_completed``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...constants import ReductionOp, dt_numpy, dt_size
+from ...ec.cpu import reduce_arrays
+from ...status import Status, UccError
+from ...utils.mathutils import block_count, block_offset, div_round_up
+from ..base import binfo_typed
+from .task import HostCollTask
+from .transport import RecvReq
+
+# frame-op sentinels (first element of a socket frame key; TagKeys always
+# start with a team_key tuple, so plain strings cannot collide)
+OS_PUT = "__os_put__"
+OS_GET = "__os_get__"
+OS_CTR = "__os_ctr__"
+OS_FLUSH = "__os_flush__"
+OS_OPS = frozenset((OS_PUT, OS_GET, OS_CTR, OS_FLUSH))
+
+
+class _Registry:
+    """Process-global exported-segment + atomic-counter store.
+
+    One per process (like the reference's per-context memh storage,
+    ucc_context.c:1250-1559 — process-global here because in-process
+    "ranks" are contexts inside one process and must reach each other's
+    segments without a copy)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.segments: Dict[Tuple[str, int], np.ndarray] = {}
+        self.counters: Dict[Any, int] = {}
+        #: notify-key -> error strings: a REJECTED put with a notify still
+        #: bumps the counter but poisons it, so the waiting target
+        #: completes with an error instead of hanging on a count that can
+        #: never arrive (the error-propagation role of the reference's
+        #: schedule ERROR events, ucc_schedule.h:258)
+        self.counter_errors: Dict[Any, List[str]] = {}
+
+    # -- segments ------------------------------------------------------
+    def register(self, ctx_uid: str, seg_id: int, buffer) -> int:
+        """Register a host buffer for remote access; returns nbytes.
+        Read-only buffers (bytes) register GET-only — a PUT into them
+        fails at apply time, like an rkey without remote-write access."""
+        if isinstance(buffer, np.ndarray):
+            if not buffer.flags["C_CONTIGUOUS"]:
+                raise UccError(Status.ERR_INVALID_PARAM,
+                               "mem_map buffer must be C-contiguous")
+            flat = buffer.reshape(-1).view(np.uint8)
+        else:
+            # bytes / bytearray / memoryview
+            flat = np.frombuffer(buffer, dtype=np.uint8)
+        with self.lock:
+            self.segments[(ctx_uid, seg_id)] = flat
+        return int(flat.nbytes)
+
+    def unregister(self, ctx_uid: str, seg_id: int) -> None:
+        with self.lock:
+            self.segments.pop((ctx_uid, seg_id), None)
+
+    def unregister_ctx(self, ctx_uid: str) -> None:
+        with self.lock:
+            for k in [k for k in self.segments if k[0] == ctx_uid]:
+                del self.segments[k]
+
+    # -- data ops (applied by the passive side) ------------------------
+    def apply_put(self, ctx_uid: str, seg_id: int, offset: int,
+                  data: np.ndarray, notify: Any = None) -> Optional[str]:
+        """Write ``data`` into the segment at byte ``offset``; bump the
+        ``notify`` counter on success, bump-and-POISON it on rejection
+        (see counter_errors). Returns an error string instead of raising
+        (the socket reader must not die on a bad frame)."""
+        with self.lock:
+            seg = self.segments.get((ctx_uid, seg_id))
+            err = None
+            if seg is None:
+                err = f"put to unknown segment ({ctx_uid[:8]}…,{seg_id})"
+            elif not seg.flags["WRITEABLE"]:
+                err = f"put to read-only segment {seg_id}"
+            elif offset < 0 or offset + data.nbytes > seg.nbytes:
+                err = (f"put out of bounds: [{offset},{offset + data.nbytes})"
+                       f" into {seg.nbytes}-byte segment {seg_id}")
+            else:
+                seg[offset:offset + data.nbytes] = \
+                    data.reshape(-1).view(np.uint8)
+            if notify is not None:
+                self.counters[notify] = self.counters.get(notify, 0) + 1
+                if err is not None:
+                    self.counter_errors.setdefault(notify, []).append(err)
+        return err
+
+    def read_get(self, ctx_uid: str, seg_id: int, offset: int,
+                 nbytes: int) -> Optional[np.ndarray]:
+        """Copy ``nbytes`` out of the segment (None on bad address)."""
+        with self.lock:
+            seg = self.segments.get((ctx_uid, seg_id))
+            if seg is None or offset < 0 or offset + nbytes > seg.nbytes:
+                return None
+            return seg[offset:offset + nbytes].copy()
+
+    # -- atomic counters ----------------------------------------------
+    def counter_add(self, key: Any, delta: int = 1) -> None:
+        with self.lock:
+            self.counters[key] = self.counters.get(key, 0) + delta
+
+    def counter_read(self, key: Any) -> int:
+        with self.lock:
+            return self.counters.get(key, 0)
+
+    def counter_errs(self, key: Any) -> List[str]:
+        with self.lock:
+            return list(self.counter_errors.get(key, ()))
+
+    def counter_del(self, key: Any) -> None:
+        with self.lock:
+            self.counters.pop(key, None)
+            self.counter_errors.pop(key, None)
+
+
+#: the process singleton (module import is the "global constructor")
+REGISTRY = _Registry()
+
+
+def local_os_put(desc: dict, offset: int, data: np.ndarray,
+                 notify: Any = None) -> None:
+    """In-process put (shm peers / socket loopback): apply directly under
+    the registry lock. A rejection poisons the notify counter (unblocking
+    the waiting target with an error) AND raises at the initiator."""
+    err = REGISTRY.apply_put(desc["ctx_uid"], desc["seg_id"], offset, data,
+                             notify)
+    if err:
+        raise UccError(Status.ERR_INVALID_PARAM, f"one-sided put: {err}")
+
+
+def local_os_get(desc: dict, offset: int, dst: np.ndarray) -> RecvReq:
+    """In-process get: synchronous copy-out. A short read (nbytes=0)
+    marks a bad handle/bounds — callers validate via _check_get, the same
+    convention the socket reply path uses."""
+    req = RecvReq(dst.reshape(-1).view(np.uint8))
+    data = REGISTRY.read_get(desc["ctx_uid"], desc["seg_id"], offset,
+                             req.dst.nbytes)
+    if data is not None:
+        req.dst[:] = data
+        req.nbytes = data.nbytes
+    req.done = True
+    return req
+
+
+def import_memh(handle: bytes) -> dict:
+    """Decode an exported handle into its descriptor (remote form of
+    Context.mem_import: no live-buffer resolution)."""
+    import pickle
+    desc = pickle.loads(handle)
+    if not isinstance(desc, dict) or "seg_id" not in desc:
+        raise UccError(Status.ERR_INVALID_PARAM,
+                       "not a mem_map handle (no seg_id)")
+    return desc
+
+
+# ---------------------------------------------------------------------------
+# task-side helpers
+# ---------------------------------------------------------------------------
+
+class OneSidedMixin:
+    """One-sided p2p for HostCollTask algorithms (group-rank addressed).
+
+    The comp_context must expose ``os_put/os_get/os_flush`` (TL/SHM:
+    direct registry; TL/SOCKET: frames with loopback fast path)."""
+
+    def _os_resolve(self, peer_grank: int) -> int:
+        return self.tl_team._peer_ctx_rank(self.subset, peer_grank)
+
+    def os_put(self, peer_grank: int, desc: dict, offset: int,
+               data: np.ndarray, notify: Any = None) -> None:
+        """Local-completion put (sender buffer reusable on return)."""
+        self.tl_team.comp_context.os_put(
+            self._os_resolve(peer_grank), desc, int(offset), data, notify)
+
+    def os_get(self, peer_grank: int, desc: dict, offset: int,
+               dst: np.ndarray) -> RecvReq:
+        return self.tl_team.comp_context.os_get(
+            self._os_resolve(peer_grank), desc, int(offset), dst)
+
+    def os_flush(self, peer_grank: int):
+        """Remote-completion fence for prior puts to this peer
+        (ucp_ep_flush analog). Returns a waitable request."""
+        return self.tl_team.comp_context.os_flush(self._os_resolve(peer_grank))
+
+    def os_wait_counter(self, key: Any, target: int):
+        """Yield until the local atomic counter reaches ``target``; a
+        poisoned counter (some put was rejected) fails the task."""
+        while REGISTRY.counter_read(key) < target:
+            yield
+        errs = REGISTRY.counter_errs(key)
+        if errs:
+            REGISTRY.counter_del(key)
+            raise UccError(Status.ERR_NO_MESSAGE,
+                           f"one-sided delivery failed: {errs[0]} "
+                           f"({len(errs)} rejected)")
+
+    def ctr_key(self, target_uid: str) -> Any:
+        """Per-collective arrival-counter key on the rank owning
+        ``target_uid`` (team-sequenced tags are symmetric across ranks,
+        so every rank derives the same key for a given target)."""
+        return (OS_CTR, target_uid, self.tl_team.team_key, self.tag)
+
+    def _check_get(self, req: RecvReq, nbytes: int) -> None:
+        """Socket get errors surface as short replies (see sockets.py)."""
+        if req.nbytes != nbytes:
+            raise UccError(Status.ERR_NO_MESSAGE,
+                           f"one-sided get failed: expected {nbytes} bytes, "
+                           f"got {req.nbytes} (bad handle/bounds at target)")
+
+
+def _memh_descs(task: HostCollTask, memh, which: str) -> List[dict]:
+    """Validate + decode a global memh array (one handle per team rank,
+    ucc.h global_memh). Accepts raw exported handles (bytes) or
+    already-imported descriptor dicts."""
+    size = task.gsize
+    if memh is None:
+        raise UccError(Status.ERR_NOT_SUPPORTED,
+                       f"onesided algorithm requires {which}_memh global "
+                       "handles (flags MEM_MAP_{SRC,DST}_MEMH)")
+    if not isinstance(memh, (list, tuple)) or len(memh) != size:
+        raise UccError(Status.ERR_INVALID_PARAM,
+                       f"{which}_memh must be a list of {size} handles "
+                       "(one per team rank)")
+    descs = []
+    for h in memh:
+        descs.append(import_memh(h) if isinstance(h, (bytes, bytearray))
+                     else dict(h))
+    for d in descs:
+        if "seg_id" not in d or "ctx_uid" not in d:
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           f"bad {which}_memh handle: {d}")
+    return descs
+
+
+def _dissemination_barrier(task: HostCollTask, slot_base: int = 7000):
+    """Inline barrier rounds (the schedule-level barrier the reference
+    appends to the get-based onesided alltoall,
+    alltoall_onesided.c:183-240)."""
+    size, me = task.gsize, task.grank
+    tok = np.zeros(1, dtype=np.uint8)
+    sink = np.empty(1, dtype=np.uint8)
+    dist = 1
+    rnd = 0
+    while dist < size:
+        to = (me + dist) % size
+        frm = (me - dist) % size
+        sreq = task.send_nb(to, tok, slot=slot_base + rnd)
+        rreq = task.recv_nb(frm, sink, slot=slot_base + rnd)
+        yield from task.wait(sreq, rreq)
+        dist *= 2
+        rnd += 1
+
+
+# ---------------------------------------------------------------------------
+# onesided alltoall (tl_ucp alltoall_onesided.c)
+# ---------------------------------------------------------------------------
+
+class AlltoallOnesided(OneSidedMixin, HostCollTask):
+    """One-sided alltoall over globally mem-mapped buffers.
+
+    Two variants, selected by ``UCC_TL_<X>_ALLTOALL_ONESIDED_ALG``
+    (reference knob ``alltoall_onesided_alg``):
+
+    - ``put`` (default): rank r puts src block p into peer p's *dst
+      segment* at offset r*block, each put carrying an arrival-counter
+      notify; completion = own counter reaching team size (the
+      ``onesided.put_completed`` / atomic-counter protocol,
+      alltoall_onesided.c:128-170). Requires ``dst_memh`` global handles.
+    - ``get``: rank r gets peer p's src block r from p's *src segment*
+      into its own dst (alltoall_onesided.c:84-126), then a closing
+      barrier keeps every src segment valid until all readers are done
+      (the reference schedules a barrier task after the a2a task for the
+      same reason). Requires ``src_memh`` global handles.
+
+    Like the reference, this algorithm is never the default: it is
+    selected via the TUNE DSL (``UCC_TL_SOCKET_TUNE=alltoall:@onesided``)
+    and errors cleanly when the memh args are absent, which lets the
+    score-map fallback walk pick a two-sided algorithm instead.
+    """
+
+    def __init__(self, init_args, team, variant: Optional[str] = None):
+        super().__init__(init_args, team)
+        args = init_args.args
+        if args.is_inplace:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "onesided alltoall does not support in-place")
+        if variant is None:
+            cfg = team.comp_context.config
+            try:
+                variant = cfg.get("alltoall_onesided_alg") if cfg else "put"
+            except KeyError:
+                variant = "put"
+        self.variant = variant or "put"
+        if self.variant not in ("put", "get"):
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           f"unknown onesided alltoall variant "
+                           f"'{self.variant}' (put|get)")
+        which = "dst" if self.variant == "put" else "src"
+        self.descs = _memh_descs(
+            self, getattr(args, f"{which}_memh", None), which)
+        self.count = int(args.src.count)
+        if self.count % self.gsize:
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           "alltoall count must divide by team size")
+
+    def run(self):
+        if self.variant == "put":
+            yield from self._run_put()
+        else:
+            yield from self._run_get()
+
+    def _run_put(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        nb = (self.count // size) * dt_size(args.src.datatype)
+        src_u8 = binfo_typed(args.src, self.count).view(np.uint8)
+        my_uid = self.descs[me]["ctx_uid"]
+        my_ctr = self.ctr_key(my_uid)
+        # put loop starting at grank+1 (the reference's peer rotation,
+        # alltoall_onesided.c:143 — spreads target load across ranks)
+        for i in range(1, size + 1):
+            peer = (me + i) % size
+            self.os_put(peer, self.descs[peer], me * nb,
+                        src_u8[peer * nb:(peer + 1) * nb],
+                        notify=self.ctr_key(self.descs[peer]["ctx_uid"]))
+        # completion: everyone has landed in MY dst segment
+        yield from self.os_wait_counter(my_ctr, size)
+        REGISTRY.counter_del(my_ctr)
+
+    def _run_get(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        nb = (self.count // size) * dt_size(args.src.datatype)
+        dst_u8 = binfo_typed(args.dst, self.count).view(np.uint8)
+        reqs = []
+        for i in range(1, size + 1):
+            peer = (me + i) % size
+            reqs.append((self.os_get(peer, self.descs[peer], me * nb,
+                                     dst_u8[peer * nb:(peer + 1) * nb]), nb))
+        yield from self.wait(*[r for r, _ in reqs])
+        for r, n in reqs:
+            self._check_get(r, n)
+        # src segments must outlive every reader (reference appends a
+        # barrier task to the schedule for the get path)
+        yield from _dissemination_barrier(self)
+
+
+# ---------------------------------------------------------------------------
+# sliding-window one-sided allreduce (tl_ucp allreduce_sliding_window.{c,h})
+# ---------------------------------------------------------------------------
+
+class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
+    """One-sided windowed allreduce over globally mem-mapped src/dst.
+
+    The reference's sliding-window allreduce
+    (allreduce_sliding_window.h:30-50) exists for messages larger than
+    the working set: each rank owns partition r of the vector, GETs the
+    other ranks' fragments of that partition window-by-window (bounded
+    in-flight get buffers), reduces them, and PUTs the reduced window
+    into every peer's dst — a reduce_scatter + allgather expressed
+    entirely as one-sided ops against the global work buffers.
+
+    Completion protocol: every put carries an arrival-counter notify;
+    rank r's dst is complete when its counter reaches
+    sum(windows(owner) for owner != r) + its own local windows. That
+    counter full also proves every owner has *read* r's src (an owner
+    only puts a window after getting all contributions for it), so no
+    closing barrier is needed — the same property the reference's
+    count_serviced tracking provides.
+
+    In-place is safe: the only writer of partition q (owner q's put) is
+    also the only remote reader of partition q (owner q's gets), and the
+    owner sequences its gets before its puts per window.
+    """
+
+    def __init__(self, init_args, team, window_bytes: Optional[int] = None,
+                 inflight: int = 2):
+        super().__init__(init_args, team)
+        args = init_args.args
+        self.src_descs = _memh_descs(self, getattr(args, "src_memh", None),
+                                     "src")
+        self.dst_descs = _memh_descs(self, getattr(args, "dst_memh", None),
+                                     "dst")
+        self.count = int(args.dst.count)
+        self.dt = args.dst.datatype
+        self.op = args.op if args.op is not None else ReductionOp.SUM
+        if window_bytes is None:
+            cfg = team.comp_context.config
+            try:
+                window_bytes = int(cfg.get("allreduce_sw_window")) if cfg \
+                    else 1 << 20
+            except KeyError:
+                window_bytes = 1 << 20
+        esz = dt_size(self.dt)
+        self.window = max(1, int(window_bytes) // esz)
+        #: bounded get buffers (reference num_buffers / avail_buffs,
+        #: allreduce_sliding_window.h:36-38)
+        self.inflight = max(1, inflight)
+
+    def _nwin(self, owner: int) -> int:
+        return div_round_up(block_count(self.count, self.gsize, owner),
+                            self.window)
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        esz = dt_size(self.dt)
+        nd = dt_numpy(self.dt)
+        src = binfo_typed(args.dst if args.is_inplace else args.src,
+                          self.count)
+        dst = binfo_typed(args.dst, self.count)
+        my_uid = self.dst_descs[me]["ctx_uid"]
+        my_ctr = self.ctr_key(my_uid)
+        my_count = block_count(self.count, size, me)
+        my_off = block_offset(self.count, size, me)
+        op = ReductionOp.SUM if self.op == ReductionOp.AVG else self.op
+        alpha = 1.0 / size if self.op == ReductionOp.AVG else None
+
+        if size == 1:
+            out = reduce_arrays([src], ReductionOp.SUM, self.dt, alpha=alpha) \
+                if alpha is not None else src
+            dst[:] = out
+            return
+
+        # expected arrivals into MY dst: one put per (owner, window) pair
+        # from every other owner, plus my own local window writes
+        expect = sum(self._nwin(r) for r in range(size) if r != me)
+
+        peers = [(me + i) % size for i in range(1, size)]
+        getbuf = np.empty((self.inflight, min(self.window, max(my_count, 1))),
+                          dtype=nd)
+        for w0 in range(0, my_count, self.window):
+            wn = min(self.window, my_count - w0)
+            goff = (my_off + w0) * esz
+            acc = src[my_off + w0:my_off + w0 + wn].copy()
+            # windowed gets from every peer's src segment, bounded
+            # in-flight; slots come from a free-list — a slot is only
+            # reissued after ITS request completed (gets finish out of
+            # order across peers, so `issued % inflight` would alias a
+            # buffer that a pending reply still targets)
+            pending: List[Tuple[RecvReq, int]] = []
+            free_slots = list(range(self.inflight))
+            issued = 0
+            while issued < len(peers) or pending:
+                while issued < len(peers) and free_slots:
+                    slot = free_slots.pop()
+                    req = self.os_get(peers[issued],
+                                      self.src_descs[peers[issued]], goff,
+                                      getbuf[slot, :wn].view(np.uint8))
+                    pending.append((req, slot))
+                    issued += 1
+                # reduce whichever get has landed (reference REDUCING state)
+                done_i = None
+                for i, (req, slot) in enumerate(pending):
+                    if req.test():
+                        done_i = i
+                        break
+                if done_i is None:
+                    yield
+                    continue
+                req, slot = pending.pop(done_i)
+                self._check_get(req, wn * esz)
+                acc = reduce_arrays([acc, getbuf[slot, :wn]], op, self.dt)
+                free_slots.append(slot)
+            if alpha is not None:
+                acc = reduce_arrays([acc], ReductionOp.SUM, self.dt,
+                                    alpha=alpha)
+            # distribute the reduced window into every dst segment
+            for p in peers:
+                self.os_put(p, self.dst_descs[p], goff,
+                            np.ascontiguousarray(acc).view(np.uint8),
+                            notify=self.ctr_key(self.dst_descs[p]["ctx_uid"]))
+            dst[my_off + w0:my_off + w0 + wn] = acc
+        # completion: all owners' windows have landed in my dst — which
+        # also proves every owner has read my src (see class docstring)
+        yield from self.os_wait_counter(my_ctr, expect)
+        REGISTRY.counter_del(my_ctr)

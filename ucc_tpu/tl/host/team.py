@@ -30,6 +30,7 @@ from .knomial import (AllreduceKnomial, BarrierKnomial, BcastKnomial,
                       ReduceKnomial, ScatterLinear)
 from .knomial2 import (BcastSagKnomial, GatherKnomial, ReduceScatterKnomial,
                        ScatterKnomial)
+from .onesided import AllreduceSlidingWindow, AlltoallOnesided
 from .ring import (AllgatherRing, AllgathervRing, AllreduceRing,
                    ReduceScatterRing, ReduceScatterRingBidirectional,
                    ReduceScattervRing)
@@ -148,6 +149,11 @@ class HostTlTeam(TlTeamBase):
                      sel=f"0-4k:{S - 6},4k-inf:{S + 4}"),
                 spec(3, "dbt", AllreduceDbt,
                      sel=f"0-4k:{S - 7},4k-inf:{S + 3}"),
+                # one-sided sliding window: never default (matches the
+                # reference, where it is TUNE-selected); init validates the
+                # global memh args and NOT_SUPPORTED-falls-back without them
+                spec(4, "sliding_window", AllreduceSlidingWindow,
+                     sel="0-inf:1"),
             ],
             CollType.ALLGATHER: [
                 # bruck for small msgs, neighbor for medium even teams,
@@ -175,6 +181,8 @@ class HostTlTeam(TlTeamBase):
                 spec(1, "bruck", AlltoallBruck,
                      sel=f"0-256:{S + 5},256-inf:{S - 5}"),
                 spec(2, "linear", AlltoallLinear),
+                # TUNE-selected one-sided variant (tl_ucp onesided role)
+                spec(3, "onesided", AlltoallOnesided, sel="0-inf:1"),
             ],
             CollType.ALLTOALLV: [
                 spec(0, "pairwise", AlltoallvPairwise),

@@ -19,7 +19,7 @@ from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
 from ..ec.cpu import EcCpu
 from ..status import Status, UccError
 from ..utils.config import (ConfigField, ConfigTable, parse_memunits,
-                            parse_mrange_uint, register_table)
+                            parse_mrange_uint, parse_string, register_table)
 from .host.team import HostTlTeam
 from .host.transport import InProcTransport
 
@@ -35,6 +35,11 @@ TL_SHM_CONFIG = register_table(ConfigTable(
                     "radix", parse_mrange_uint),
         ConfigField("EAGER_THRESH", "8k", "eager copy threshold; larger "
                     "sends are zero-copy rendezvous", parse_memunits),
+        ConfigField("ALLTOALL_ONESIDED_ALG", "put", "one-sided alltoall "
+                    "variant: put (counter completion) | get (barrier)",
+                    parse_string),
+        ConfigField("ALLREDUCE_SW_WINDOW", "1M", "sliding-window allreduce "
+                    "window bytes", parse_memunits),
     ]))
 
 
@@ -80,6 +85,23 @@ class TlShmContext(BaseContext):
 
     def send_to(self, peer_ctx_rank: int, key, data: np.ndarray):
         return self.transport.send_nb(self._peer(peer_ctx_rank), key, data)
+
+    # -- one-sided (tl/host/onesided.py): every peer is in-process, so
+    # put/get/atomic apply directly under the registry lock; flush is a
+    # no-op fence (in-order, synchronous application)
+    def os_put(self, peer_ctx_rank: int, desc: dict, offset: int,
+               data: np.ndarray, notify=None) -> None:
+        from .host.onesided import local_os_put
+        local_os_put(desc, offset, data, notify)
+
+    def os_get(self, peer_ctx_rank: int, desc: dict, offset: int,
+               dst: np.ndarray):
+        from .host.onesided import local_os_get
+        return local_os_get(desc, offset, dst)
+
+    def os_flush(self, peer_ctx_rank: int):
+        from .host.transport import SendReq
+        return SendReq(done=True)
 
     def destroy(self) -> None:
         self.transport.close()

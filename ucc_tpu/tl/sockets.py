@@ -17,6 +17,7 @@ import pickle
 import socket
 import struct
 import threading
+from queue import SimpleQueue
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -25,15 +26,45 @@ from ..constants import COLL_TYPE_ALL, MemoryType
 from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
 from ..ec.cpu import EcCpu
 from ..status import Status, UccError
-from ..utils.config import (ConfigField, ConfigTable, parse_mrange_uint,
-                            parse_string, register_table)
+from ..utils.config import (ConfigField, ConfigTable, parse_memunits,
+                            parse_mrange_uint, parse_string, register_table)
 from ..utils.log import get_logger
+from .host.onesided import (OS_FLUSH, OS_GET, OS_OPS, OS_PUT, REGISTRY,
+                            local_os_get, local_os_put)
 from .host.team import HostTlTeam
 from .host.transport import Mailbox, RecvReq, SendReq, _PendingSend
 
 logger = get_logger("tl_socket")
 
 _HDR = struct.Struct("!IQ")
+
+
+class FlushReq:
+    """Waitable remote-completion fence (ucp_ep_flush analog): completes
+    when the passive side acks; a nonzero error count in the ack fails
+    the fence (an earlier put/get frame on this path was rejected)."""
+
+    __slots__ = ("_inner", "error", "done")
+
+    def __init__(self, inner: RecvReq):
+        self._inner = inner
+        self.error = None
+        self.done = False
+
+    def test(self) -> bool:
+        if self.done:
+            return True
+        if not self._inner.test():
+            return False
+        self.done = True
+        if self._inner.nbytes != 8:
+            self.error = "one-sided flush ack malformed"
+        else:
+            nerr = int(self._inner.dst.view(np.uint64)[0])
+            if nerr:
+                self.error = (f"one-sided flush: target rejected {nerr} "
+                              "prior operation(s) (bad handle/bounds)")
+        return True
 
 TL_SOCKET_CONFIG = register_table(ConfigTable(
     prefix="TL_SOCKET_", name="tl/socket", fields=[
@@ -47,6 +78,11 @@ TL_SOCKET_CONFIG = register_table(ConfigTable(
                     parse_mrange_uint),
         ConfigField("BIND_HOST", "", "address to bind/advertise (default: "
                     "auto-detect, 127.0.0.1 fallback)", parse_string),
+        ConfigField("ALLTOALL_ONESIDED_ALG", "put", "one-sided alltoall "
+                    "variant: put (counter completion) | get (barrier)",
+                    parse_string),
+        ConfigField("ALLREDUCE_SW_WINDOW", "1M", "sliding-window allreduce "
+                    "window bytes", parse_memunits),
     ]))
 
 
@@ -76,7 +112,16 @@ class SocketTransport:
         self._conns: Dict[Tuple[str, int], socket.socket] = {}
         self._send_locks: Dict[Tuple[str, int], threading.Lock] = {}
         self._lock = threading.Lock()
+        self._os_reply_seq = 0
+        # one-sided replies (GET data / FLUSH acks) leave via a dedicated
+        # sender thread: a reader that called a blocking sendall itself
+        # would stop draining its socket, and two hosts replying to each
+        # other over full TCP buffers would deadlock
+        self._reply_q: "SimpleQueue" = SimpleQueue()
         self._closing = False
+        self._reply_thread = threading.Thread(target=self._reply_loop,
+                                              daemon=True)
+        self._reply_thread.start()
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
@@ -93,6 +138,11 @@ class SocketTransport:
                              daemon=True).start()
 
     def _reader(self, conn: socket.socket) -> None:
+        # per-connection one-sided error count: a FLUSH ack reports (and
+        # resets) the rejections among the frames THIS connection carried
+        # since the last flush — per-connection TCP ordering makes the
+        # ack a correct fence for exactly the initiator's prior ops
+        errbox = [0]
         try:
             while True:
                 hdr = _recv_exact(conn, _HDR.size)
@@ -100,10 +150,53 @@ class SocketTransport:
                 key = pickle.loads(_recv_exact(conn, klen))
                 payload = _recv_exact(conn, plen)
                 data = np.frombuffer(payload, dtype=np.uint8)
+                if isinstance(key, tuple) and key and key[0] in OS_OPS:
+                    # one-sided frames are applied HERE, by the passive
+                    # side's reader thread — the target's user thread never
+                    # participates (the UCX am-emulated-RDMA progress model)
+                    self._handle_onesided(key, data, errbox)
+                    continue
                 ps = _PendingSend(data, SendReq(done=True), copied=True)
                 self.mailbox.push(key, ps)
         except (ConnectionError, OSError):
             return
+
+    def _handle_onesided(self, key, data: np.ndarray, errbox) -> None:
+        op = key[0]
+        if op == OS_PUT:
+            _, ctx_uid, seg_id, offset, notify = key
+            err = REGISTRY.apply_put(ctx_uid, seg_id, offset, data, notify)
+            if err:
+                logger.warning("one-sided put rejected: %s", err)
+                errbox[0] += 1
+        elif op == OS_GET:
+            _, ctx_uid, seg_id, offset, nbytes, reply_key, rhost, rport = key
+            out = REGISTRY.read_get(ctx_uid, seg_id, offset, nbytes)
+            if out is None:
+                logger.warning("one-sided get rejected: segment (%s…,%s) "
+                               "[%s,+%s)", str(ctx_uid)[:8], seg_id, offset,
+                               nbytes)
+                errbox[0] += 1
+                out = np.empty(0, dtype=np.uint8)  # short reply = error
+            self._reply_q.put(((rhost, rport), reply_key, out))
+        elif op == OS_FLUSH:
+            _, reply_key, rhost, rport = key
+            ack = np.array([errbox[0]], dtype=np.uint64).view(np.uint8)
+            errbox[0] = 0
+            self._reply_q.put(((rhost, rport), reply_key, ack))
+
+    def _reply_loop(self) -> None:
+        while True:
+            item = self._reply_q.get()
+            if item is None:
+                return
+            addr, key, data = item
+            try:
+                self.send_to_addr(addr, key, data)
+            except (ConnectionError, OSError) as e:
+                if not self._closing:
+                    logger.warning("one-sided reply to %s failed: %s",
+                                   addr, e)
 
     # ------------------------------------------------------------------
     def _addr_lock(self, addr: Tuple[str, int]) -> threading.Lock:
@@ -148,11 +241,39 @@ class SocketTransport:
         self.mailbox.post_recv(key, req)
         return req
 
+    # -- one-sided initiator side --------------------------------------
+    def _reply_key(self) -> tuple:
+        with self._lock:
+            self._os_reply_seq += 1
+            return ("__os_reply__", self.host, self.port, self._os_reply_seq)
+
+    def os_put_to_addr(self, addr, desc: dict, offset: int,
+                       data: np.ndarray, notify) -> None:
+        self.send_to_addr(addr, (OS_PUT, desc["ctx_uid"], desc["seg_id"],
+                                 int(offset), notify), data)
+
+    def os_get_from_addr(self, addr, desc: dict, offset: int,
+                         dst: np.ndarray) -> RecvReq:
+        rk = self._reply_key()
+        req = self.recv_nb(rk, dst)        # post BEFORE the request frame
+        nbytes = dst.reshape(-1).view(np.uint8).nbytes
+        self.send_to_addr(addr, (OS_GET, desc["ctx_uid"], desc["seg_id"],
+                                 int(offset), int(nbytes), rk, self.host,
+                                 self.port), _EMPTY)
+        return req
+
+    def os_flush_addr(self, addr) -> FlushReq:
+        rk = self._reply_key()
+        inner = self.recv_nb(rk, np.empty(8, dtype=np.uint8))
+        self.send_to_addr(addr, (OS_FLUSH, rk, self.host, self.port), _EMPTY)
+        return FlushReq(inner)
+
     def progress(self) -> None:
         pass  # reader threads drive delivery
 
     def close(self) -> None:
         self._closing = True
+        self._reply_q.put(None)
         try:
             self.lsock.close()
         except OSError:
@@ -163,6 +284,9 @@ class SocketTransport:
                     c.close()
                 except OSError:
                     pass
+
+
+_EMPTY = np.empty(0, dtype=np.uint8)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -205,6 +329,33 @@ class TlSocketContext(BaseContext):
                 key, _PendingSend(data.copy(), SendReq(done=True), True))
             return SendReq(done=True)
         return self.transport.send_to_addr(addr, key, data)
+
+    # -- one-sided (tl/host/onesided.py) -------------------------------
+    def _os_addr(self, peer_ctx_rank: int):
+        addr = self.peer_addrs.get(peer_ctx_rank)
+        if addr is None:
+            raise UccError(Status.ERR_NOT_FOUND,
+                           f"no socket address for ctx rank {peer_ctx_rank}")
+        return addr
+
+    def os_put(self, peer_ctx_rank: int, desc: dict, offset: int,
+               data: np.ndarray, notify=None) -> None:
+        if peer_ctx_rank == self.core_context.rank:
+            return local_os_put(desc, offset, data, notify)
+        self.transport.os_put_to_addr(self._os_addr(peer_ctx_rank), desc,
+                                      offset, data, notify)
+
+    def os_get(self, peer_ctx_rank: int, desc: dict, offset: int,
+               dst: np.ndarray):
+        if peer_ctx_rank == self.core_context.rank:
+            return local_os_get(desc, offset, dst)
+        return self.transport.os_get_from_addr(self._os_addr(peer_ctx_rank),
+                                               desc, offset, dst)
+
+    def os_flush(self, peer_ctx_rank: int):
+        if peer_ctx_rank == self.core_context.rank:
+            return SendReq(done=True)
+        return self.transport.os_flush_addr(self._os_addr(peer_ctx_rank))
 
     def destroy(self) -> None:
         self.transport.close()
