@@ -173,6 +173,75 @@ class TestAlltoallOnesided:
             np.testing.assert_array_equal(dsts[r], e)
 
 
+class TestAlltoallvOnesided:
+    """alltoallv_onesided.c semantics: initiator-side dst displacements
+    are TARGET-relative (the transpose of the usual receive table)."""
+
+    @pytest.mark.parametrize("job4", ["alltoallv:@onesided"], indirect=True)
+    def test_uneven_blocks(self, job4):
+        n = 4
+        teams = job4.create_team()
+        # m[r][p] = elements rank r sends to rank p
+        m = [[(r + p) % 3 + 1 for p in range(n)] for r in range(n)]
+        recv_counts = [[m[q][p] for q in range(n)] for p in range(n)]
+        srcs, dsts, s_displ, d_displ_target = [], [], [], []
+        for r in range(n):
+            total = sum(m[r])
+            srcs.append(np.arange(total, dtype=np.int32) + 1000 * r)
+            dsts.append(np.full(sum(recv_counts[r]), -1, np.int32))
+            s_displ.append(list(np.cumsum([0] + m[r][:-1])))
+            # target-relative: my offset inside peer p's dst buffer
+            d_displ_target.append(
+                [sum(m[q][p] for q in range(r)) for p in range(n)])
+        handles = [job4.contexts[r].mem_map(dsts[r]) for r in range(n)]
+        from ucc_tpu import BufferInfoV
+        job4.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALLV,
+            src=BufferInfoV(srcs[r], m[r], s_displ[r], DataType.INT32),
+            dst=BufferInfoV(dsts[r], recv_counts[r], d_displ_target[r],
+                            DataType.INT32),
+            dst_memh=list(handles),
+            flags=CollArgsFlags.MEM_MAP_DST_MEMH))
+        for p in range(n):
+            expect = np.concatenate([
+                srcs[q][s_displ[q][p]:s_displ[q][p] + m[q][p]]
+                for q in range(n)])
+            np.testing.assert_array_equal(dsts[p], expect)
+
+    @pytest.mark.parametrize("job4", ["alltoallv:@onesided"], indirect=True)
+    def test_zero_count_rank_still_notifies(self, job4):
+        """An all-zero-count rank must not take the zero-size stub: its
+        zero-byte puts carry the notifies peers' counters wait on."""
+        n = 4
+        teams = job4.create_team()
+        # rank 0 sends nothing and receives nothing
+        m = [[0] * n] + [[0 if p == 0 else 2 for p in range(n)]
+                         for _ in range(1, n)]
+        recv_counts = [[m[q][p] for q in range(n)] for p in range(n)]
+        srcs, dsts, s_displ, d_displ_target = [], [], [], []
+        for r in range(n):
+            total = max(1, sum(m[r]))
+            srcs.append(np.arange(total, dtype=np.float32) + 100 * r)
+            dsts.append(np.zeros(max(1, sum(recv_counts[r])), np.float32))
+            s_displ.append(list(np.cumsum([0] + m[r][:-1])))
+            d_displ_target.append(
+                [sum(m[q][p] for q in range(r)) for p in range(n)])
+        handles = [job4.contexts[r].mem_map(dsts[r]) for r in range(n)]
+        from ucc_tpu import BufferInfoV
+        job4.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALLV,
+            src=BufferInfoV(srcs[r], m[r], s_displ[r], DataType.FLOAT32),
+            dst=BufferInfoV(dsts[r], recv_counts[r], d_displ_target[r],
+                            DataType.FLOAT32),
+            dst_memh=list(handles),
+            flags=CollArgsFlags.MEM_MAP_DST_MEMH))
+        for p in range(1, n):
+            expect = np.concatenate([
+                srcs[q][s_displ[q][p]:s_displ[q][p] + m[q][p]]
+                for q in range(n) if m[q][p]])
+            np.testing.assert_array_equal(dsts[p][:expect.size], expect)
+
+
 # ---------------------------------------------------------------------------
 # sliding-window one-sided allreduce (allreduce_sliding_window.{c,h})
 # ---------------------------------------------------------------------------

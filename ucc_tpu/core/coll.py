@@ -224,7 +224,11 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
                        "one-sided (global_work_buffer / mem-mapped) "
                        "collectives are host-memory only on the TPU DCN "
                        "path; see PARITY.md")
-    if _is_zero_size(args) and mem_type != MemoryType.TPU:
+    if _is_zero_size(args) and mem_type != MemoryType.TPU and \
+            not onesided_args:
+        # (one-sided colls are excluded from the stub: peers count THIS
+        # rank's put notifies, so an all-zero-count rank must still post
+        # its zero-byte puts or the team's arrival counters never fill)
         # zero-size fast path (ucc_coll.c:191-208) — HOST memory only.
         # Device-memory colls are served by the rendezvous TL (tl/xla),
         # where a rank that stubs out desyncs the team's deposit count

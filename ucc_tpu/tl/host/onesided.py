@@ -384,6 +384,65 @@ class AlltoallOnesided(OneSidedMixin, HostCollTask):
         yield from _dissemination_barrier(self)
 
 
+class AlltoallvOnesided(OneSidedMixin, HostCollTask):
+    """One-sided alltoallv — a port of alltoallv_onesided.c's semantics.
+
+    IMPORTANT layout convention (inherited from the reference,
+    alltoallv_onesided.c:36-48 "perform a put to each member peer using
+    the peer's index in the destination displacement"): the initiator's
+    ``dst.displacements[peer]`` is TARGET-RELATIVE — the offset inside
+    *peer's* destination buffer where THIS rank's block lands (the
+    SHMEM symmetric-layout convention), not the local receive offset the
+    two-sided algorithms use. Callers build it as the transpose of the
+    usual receive-displacement table. Counts follow the usual meaning
+    (``src.counts[peer]`` elements go to ``peer``).
+
+    Completion: per-put notify counters (the reference's pSync
+    atomic_inc protocol, :55-57) — rank r completes when all team
+    members' blocks have landed in its destination segment.
+    """
+
+    def __init__(self, init_args, team):
+        super().__init__(init_args, team)
+        args = init_args.args
+        if args.is_inplace:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "onesided alltoallv does not support in-place")
+        self.descs = _memh_descs(self, getattr(args, "dst_memh", None),
+                                 "dst")
+        for bi, name in ((args.src, "src"), (args.dst, "dst")):
+            if bi is None or bi.counts is None:
+                raise UccError(Status.ERR_INVALID_PARAM,
+                               f"alltoallv requires {name} counts")
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        s_esz = dt_size(args.src.datatype)
+        d_esz = dt_size(args.dst.datatype)
+        s_counts = [int(c) for c in args.src.counts]
+        s_displ = args.src.displacements
+        if s_displ is None:
+            s_displ = np.cumsum([0] + s_counts[:-1])
+        d_displ = args.dst.displacements
+        if d_displ is None:
+            d_displ = np.cumsum([0] + [int(c) for c in args.dst.counts[:-1]])
+        total_src = max(int(s_displ[p]) + s_counts[p] for p in range(size))
+        src_u8 = binfo_typed(args.src, total_src).view(np.uint8) \
+            if total_src else np.empty(0, dtype=np.uint8)
+        my_uid = self.descs[me]["ctx_uid"]
+        my_ctr = self.ctr_key(my_uid)
+        for i in range(1, size + 1):
+            peer = (me + i) % size
+            sd = int(s_displ[peer]) * s_esz
+            nb = s_counts[peer] * s_esz
+            dd = int(d_displ[peer]) * d_esz       # TARGET-relative (see doc)
+            self.os_put(peer, self.descs[peer], dd, src_u8[sd:sd + nb],
+                        notify=self.ctr_key(self.descs[peer]["ctx_uid"]))
+        yield from self.os_wait_counter(my_ctr, size)
+        REGISTRY.counter_del(my_ctr)
+
+
 # ---------------------------------------------------------------------------
 # sliding-window one-sided allreduce (tl_ucp allreduce_sliding_window.{c,h})
 # ---------------------------------------------------------------------------

@@ -30,7 +30,8 @@ from .knomial import (AllreduceKnomial, BarrierKnomial, BcastKnomial,
                       ReduceKnomial, ScatterLinear)
 from .knomial2 import (BcastSagKnomial, GatherKnomial, ReduceScatterKnomial,
                        ScatterKnomial)
-from .onesided import AllreduceSlidingWindow, AlltoallOnesided
+from .onesided import (AllreduceSlidingWindow, AlltoallOnesided,
+                       AlltoallvOnesided)
 from .ring import (AllgatherRing, AllgathervRing, AllreduceRing,
                    ReduceScatterRing, ReduceScatterRingBidirectional,
                    ReduceScattervRing)
@@ -187,6 +188,9 @@ class HostTlTeam(TlTeamBase):
             CollType.ALLTOALLV: [
                 spec(0, "pairwise", AlltoallvPairwise),
                 spec(1, "hybrid", AlltoallvHybrid),
+                # TUNE-selected; SHMEM-style target-relative dst
+                # displacements (alltoallv_onesided.c convention)
+                spec(2, "onesided", AlltoallvOnesided, sel="0-inf:1"),
             ],
             CollType.BARRIER: [
                 spec(0, "knomial", BarrierKnomial),
