@@ -35,8 +35,8 @@ from .constants import (CollArgsFlags, CollArgsHints, CollSyncType, CollType,  #
                         ReductionOp, ThreadMode, coll_type_str, dt_size)
 from .status import Status, UccError, check  # noqa: F401
 from .api.types import (ActiveSet, BufferInfo, BufferInfoV, CollArgs,  # noqa: F401
-                        ContextParams, ContextType, LibAttr, LibParams,
-                        OobColl, OobRequest, TeamAttr, TeamParams)
+                        ContextAttr, ContextParams, ContextType, LibAttr,
+                        LibParams, OobColl, OobRequest, TeamAttr, TeamParams)
 from .core.lib import Lib, init  # noqa: F401
 from .core.context import Context  # noqa: F401
 from .core.team import Team, TeamState  # noqa: F401

@@ -99,6 +99,18 @@ class ContextParams:
 
 
 @dataclass
+class ContextAttr:
+    """ucc_context_attr_t (ucc.h:968-975): context type, packed context
+    address, and the scratchpad size one-sided collectives require of a
+    user-provided global_work_buffer (ucc.h:1878-1887)."""
+
+    type: ContextType = ContextType.EXCLUSIVE
+    ctx_addr: bytes = b""
+    ctx_addr_len: int = 0
+    global_work_buffer_size: int = 0
+
+
+@dataclass
 class TeamParams:
     """ucc_team_params_t (ucc.h:1337+): ep_map kinds FULL/STRIDED/ARRAY/CB,
     per-team OOB, ordering/sync requirements."""

@@ -99,6 +99,7 @@ class Context:
                 "tl": {name: h.obj.pack_address()
                        for name, h in self.tl_contexts.items()},
             }
+            self._packed_addr = pickle.dumps(payload)
             req = oob.allgather(pickle.dumps(payload))
             peers = req.wait()
             req.free()
@@ -111,6 +112,7 @@ class Context:
         else:
             self.addr_storage = [{"proc": self.proc_info, "tl": {}}]
             self.topo = ContextTopo([self.proc_info])
+            self._packed_addr = pickle.dumps(self.addr_storage[0])
 
         for h in self.tl_contexts.values():
             h.obj.create_epilog()
@@ -123,6 +125,23 @@ class Context:
         self._destroyed = False
 
     # ------------------------------------------------------------------
+    def get_attr(self):
+        """ucc_context_get_attr (ucc.h:1177-1185): packed context address
+        (the per-component worker-address payload, ucc_context.h:155-171)
+        and global_work_buffer_size = max over component contexts
+        (ucc_context.c:1230-1244) — the minimum scratchpad a user must
+        provide via CollArgs.global_work_buffer for one-sided colls."""
+        from ..api.types import ContextAttr
+        wbs = 0
+        for h in self.tl_contexts.values():
+            fn = getattr(h.obj, "global_work_buffer_size", None)
+            if fn is not None:
+                wbs = max(wbs, int(fn()))
+        return ContextAttr(type=self.params.type,
+                           ctx_addr=self._packed_addr,
+                           ctx_addr_len=len(self._packed_addr),
+                           global_work_buffer_size=wbs)
+
     def progress(self) -> int:
         """ucc_context_progress (ucc_context.c:1062)."""
         return self.progress_queue.progress()

@@ -52,6 +52,11 @@ OS_CTR = "__os_ctr__"
 OS_FLUSH = "__os_flush__"
 OS_OPS = frozenset((OS_PUT, OS_GET, OS_CTR, OS_FLUSH))
 
+#: bounded in-flight get buffers for the sliding window (reference
+#: num_buffers, allreduce_sliding_window.h:36); also sizes the
+#: context-attr global_work_buffer_size contract
+SW_INFLIGHT = 2
+
 
 class _Registry:
     """Process-global exported-segment + atomic-counter store.
@@ -472,7 +477,7 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
     """
 
     def __init__(self, init_args, team, window_bytes: Optional[int] = None,
-                 inflight: int = 2):
+                 inflight: int = SW_INFLIGHT):
         super().__init__(init_args, team)
         args = init_args.args
         self.src_descs = _memh_descs(self, getattr(args, "src_memh", None),
@@ -498,6 +503,21 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
     def _nwin(self, owner: int) -> int:
         return div_round_up(block_count(self.count, self.gsize, owner),
                             self.window)
+
+    def _scratch(self, gwb, wlen: int, nd, esz: int) -> np.ndarray:
+        """In-flight get buffers, backed by the user's global_work_buffer
+        when one of sufficient size is provided (ucc.h:1878-1887: "at
+        least the size returned by ucc_context_get_attr with
+        WORK_BUFFER_SIZE"); internal allocation otherwise."""
+        need = self.inflight * wlen * esz
+        if isinstance(gwb, np.ndarray) and gwb.nbytes >= need and \
+                gwb.flags["C_CONTIGUOUS"] and gwb.flags["WRITEABLE"]:
+            try:
+                return gwb.reshape(-1).view(np.uint8)[:need].view(nd) \
+                    .reshape(self.inflight, wlen)
+            except ValueError:
+                pass      # misaligned user buffer: fall back
+        return np.empty((self.inflight, wlen), dtype=nd)
 
     def run(self):
         args = self.args
@@ -525,8 +545,8 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
         expect = sum(self._nwin(r) for r in range(size) if r != me)
 
         peers = [(me + i) % size for i in range(1, size)]
-        getbuf = np.empty((self.inflight, min(self.window, max(my_count, 1))),
-                          dtype=nd)
+        wlen = min(self.window, max(my_count, 1))
+        getbuf = self._scratch(args.global_work_buffer, wlen, nd, esz)
         for w0 in range(0, my_count, self.window):
             wn = min(self.window, my_count - w0)
             goff = (my_off + w0) * esz

@@ -103,6 +103,11 @@ class TlShmContext(BaseContext):
         from .host.transport import SendReq
         return SendReq(done=True)
 
+    def global_work_buffer_size(self) -> int:
+        from .host.onesided import SW_INFLIGHT
+        window = self.config.allreduce_sw_window if self.config else 1 << 20
+        return SW_INFLIGHT * int(window)
+
     def destroy(self) -> None:
         self.transport.close()
 

@@ -357,6 +357,14 @@ class TlSocketContext(BaseContext):
             return SendReq(done=True)
         return self.transport.os_flush_addr(self._os_addr(peer_ctx_rank))
 
+    def global_work_buffer_size(self) -> int:
+        """Scratch a one-sided collective may ask of the user's
+        global_work_buffer (ucc_context_get_attr WORK_BUFFER_SIZE):
+        the sliding-window in-flight get buffers."""
+        from .host.onesided import SW_INFLIGHT
+        window = self.config.allreduce_sw_window if self.config else 1 << 20
+        return SW_INFLIGHT * int(window)
+
     def destroy(self) -> None:
         self.transport.close()
 
