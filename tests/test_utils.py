@@ -238,6 +238,41 @@ class TestPerftestModes:
                      "-n", "2", "-w", "1", "--matrix", "moe", "-F"]) == 0
         assert "ucc_perftest" in capsys.readouterr().out
 
+    def test_onesided_modes(self, capsys):
+        """-O: mem_map + handle exchange + TUNE-selected onesided algs
+        (sliding_window allreduce; put alltoall(v)), incl. persistent
+        in-place."""
+        import os
+        from ucc_tpu.tools.perftest import main
+
+        def clean():
+            # main() env-setdefaults the TUNE strings; they must not leak
+            # into later tests (or their spawned child processes)
+            for tl in ("SHM", "SOCKET"):
+                os.environ.pop(f"UCC_TL_{tl}_TUNE", None)
+        clean()
+        try:
+            assert main(["-c", "allreduce", "-p", "2", "-b", "8", "-e", "8",
+                         "-n", "2", "-w", "1", "-O"]) == 0
+            clean()
+            assert main(["-c", "alltoall", "-p", "2", "-b", "64", "-e",
+                         "64", "-n", "2", "-w", "1", "-O"]) == 0
+            clean()
+            assert main(["-c", "alltoallv", "-p", "2", "-b", "64", "-e",
+                         "64", "-n", "2", "-w", "1", "-O", "--matrix",
+                         "moe"]) == 0
+            clean()
+            assert main(["-c", "allreduce", "-p", "2", "-b", "8", "-e", "8",
+                         "-n", "2", "-w", "1", "-O", "--persistent",
+                         "-i"]) == 0
+            assert "ucc_perftest" in capsys.readouterr().out
+            with pytest.raises(SystemExit):
+                main(["-c", "bcast", "-p", "2", "-O"])
+            with pytest.raises(SystemExit):
+                main(["-c", "allreduce", "-p", "2", "-O", "-m", "tpu"])
+        finally:
+            clean()
+
 
 class TestInfoScoreMapRows:
     """Pin the live `ucc_info -s` rows the judge verifies: every round-3

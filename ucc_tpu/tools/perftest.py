@@ -13,6 +13,7 @@ Examples::
     python -m ucc_tpu.tools.perftest -c allreduce -b 8 -e 1M -p 4
     python -m ucc_tpu.tools.perftest -c alltoall -m tpu -F
     python -m ucc_tpu.tools.perftest -c allreduce --store h:29500 --rank 0 --np 8
+    python -m ucc_tpu.tools.perftest -c allreduce -O          # one-sided
 """
 from __future__ import annotations
 
@@ -193,6 +194,78 @@ def _wait_reqs(job, reqs) -> None:
             raise SystemExit(f"collective failed: {rq.test()}")
 
 
+# ---------------------------------------------------------------------------
+# one-sided mode (-O): mem_map + handle exchange (the test/mpi -o role)
+# ---------------------------------------------------------------------------
+
+ONESIDED_TUNE = {
+    CollType.ALLREDUCE: "allreduce:@sliding_window",
+    CollType.ALLTOALL: "alltoall:@onesided",
+    CollType.ALLTOALLV: "alltoallv:@onesided",
+}
+
+
+def _allgather_handles(team, handle: bytes, n: int, pad: int = 2048):
+    """Distribute exported memh handles across a multi-process team via a
+    fixed-size padded allgather (the public-API rkey-exchange shape)."""
+    assert len(handle) <= pad - 8
+    blob = np.zeros(pad, np.uint8)
+    blob[:8] = np.frombuffer(np.int64(len(handle)).tobytes(), np.uint8)
+    blob[8:8 + len(handle)] = np.frombuffer(handle, np.uint8)
+    out = np.zeros(pad * n, np.uint8)
+    req = team.collective_init(CollArgs(
+        coll_type=CollType.ALLGATHER,
+        src=BufferInfo(blob, pad, DataType.UINT8),
+        dst=BufferInfo(out, pad * n, DataType.UINT8)))
+    req.post()
+    req.wait(timeout=120)
+    hs = []
+    for p in range(n):
+        seg = out[p * pad:(p + 1) * pad]
+        ln = int(np.frombuffer(seg[:8].tobytes(), np.int64)[0])
+        hs.append(seg[8:8 + ln].tobytes())
+    return hs
+
+
+def attach_onesided(job, argses, coll, ranks, n):
+    """mem_map each rank's buffers, exchange handles, and fill the
+    global-memh coll args. Returns (ctx, handle) pairs to unmap."""
+    to_unmap = []
+
+    def map_exchange(get_bi):
+        local = []
+        for i, _ in enumerate(ranks):
+            ctx = job.contexts[i] if len(job.contexts) > 1 \
+                else job.contexts[0]
+            h = ctx.mem_map(get_bi(argses[i]).buffer)
+            local.append(h)
+            to_unmap.append((ctx, h))
+        if len(ranks) == n:
+            return local                       # in-process: global view
+        return _allgather_handles(job.team, local[0], n)
+
+    dst_handles = map_exchange(lambda a: a.dst)
+    for a in argses:
+        a.dst_memh = list(dst_handles)
+        a.flags |= CollArgsFlags.MEM_MAP_DST_MEMH
+    if coll == CollType.ALLREDUCE:
+        if argses[0].src is argses[0].dst:     # inplace: one mapping
+            src_handles = dst_handles
+        else:
+            src_handles = map_exchange(lambda a: a.src)
+        for a in argses:
+            a.src_memh = list(src_handles)
+            a.flags |= CollArgsFlags.MEM_MAP_SRC_MEMH
+    if coll == CollType.ALLTOALLV:
+        # onesided a2av displacements are TARGET-relative
+        # (alltoallv_onesided.c convention; see tl/host/onesided.py)
+        m = _TRAFFIC_MATRIX
+        for i, r in enumerate(ranks):
+            argses[i].dst.displacements = [
+                int(sum(m[q][p] for q in range(r))) for p in range(n)]
+    return to_unmap
+
+
 class InProcJob:
     persistent_capable = True
 
@@ -324,6 +397,10 @@ def main(argv=None) -> int:
     p.add_argument("--matrix", default="", choices=["", "uniform", "moe"],
                    help="alltoallv traffic-matrix generator "
                         "(ucc_pt_config.h:98-108 MoE-style skew)")
+    p.add_argument("-O", "--onesided", action="store_true",
+                   help="one-sided algorithms over mem-mapped buffers "
+                        "(host mem; allreduce->sliding_window, "
+                        "alltoall(v)->onesided put — the test/mpi -o role)")
     p.add_argument("-T", "--triggered", action="store_true",
                    help="post through execution engines (triggered-post "
                         "lifecycle, ucc_pt_benchmark.cc:217-246; "
@@ -342,6 +419,24 @@ def main(argv=None) -> int:
     bmin = parse_memunits(args.begin)
     bmax = parse_memunits(args.end)
     esz = dt_size(dt)
+
+    if args.onesided:
+        if mem != MemoryType.HOST:
+            raise SystemExit("perftest: -O/--onesided requires -m host "
+                             "(no HBM RDMA window over DCN)")
+        if coll not in ONESIDED_TUNE:
+            raise SystemExit("perftest: -O supports "
+                             + "/".join(coll_type_str(c)
+                                        for c in ONESIDED_TUNE))
+        if args.inplace and coll != CollType.ALLREDUCE:
+            raise SystemExit("perftest: -O -i only for allreduce")
+        if args.streaming or args.triggered:
+            # concurrent one-sided rounds would overlap puts into the
+            # same mapped segments; triggered rebuilds fresh buffers
+            raise SystemExit("perftest: -O is incompatible with -S/-T")
+        import os as _os
+        for tl in ("SHM", "SOCKET"):
+            _os.environ.setdefault(f"UCC_TL_{tl}_TUNE", ONESIDED_TUNE[coll])
 
     # Guard every jax touch (device enumeration AND the TL/XLA context
     # probe during Context create) against a wedged accelerator tunnel:
@@ -385,13 +480,24 @@ def main(argv=None) -> int:
         lats = []
         rounds = args.warmup + args.iters
         persistent_reqs = None
-        if args.persistent:
+        os_argses = None
+        os_unmap = []
+        if args.persistent or args.onesided:
             # init once, post many (ucc.h:1674 persistent semantics);
-            # measured time then excludes collective_init
+            # measured time then excludes collective_init. One-sided mode
+            # also builds args once per size: buffers are mem_mapped and
+            # handles exchanged before the timed rounds (the rkey-exchange
+            # setup cost is out-of-band, like the reference's onesided
+            # benchmarks)
             argses = [make_args(coll, r, n, count, dt, op, mem,
-                                args.inplace, args.root, True, devices)
+                                args.inplace, args.root, args.persistent,
+                                devices)
                       for r in ranks]
-            persistent_reqs = job.init_reqs(argses)
+            if args.onesided:
+                os_unmap = attach_onesided(job, argses, coll, ranks, n)
+                os_argses = argses
+            if args.persistent:
+                persistent_reqs = job.init_reqs(argses)
         if args.streaming and persistent_reqs is None:
             # streaming: init+post everything, single wait at the end;
             # reported number is per-op amortized time
@@ -429,9 +535,12 @@ def main(argv=None) -> int:
                 elif persistent_reqs is not None:
                     job.post_and_wait(persistent_reqs)
                 else:
-                    argses = [make_args(coll, r, n, count, dt, op, mem,
-                                        args.inplace, args.root, False,
-                                        devices) for r in ranks]
+                    if os_argses is not None:
+                        argses = os_argses
+                    else:
+                        argses = [make_args(coll, r, n, count, dt, op, mem,
+                                            args.inplace, args.root, False,
+                                            devices) for r in ranks]
                     t0 = time.perf_counter()
                     job.run_round(argses)
                 dt_s = time.perf_counter() - t0
@@ -446,6 +555,8 @@ def main(argv=None) -> int:
                 bw = busbw_factor(coll, n) * size / lats.mean() / 1e9
                 line += f" {bw:>14.3f}"
             print(line, flush=True)
+        for ctx, h in os_unmap:
+            ctx.mem_unmap(h)
         size *= 2
     return 0
 
