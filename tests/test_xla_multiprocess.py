@@ -8,9 +8,9 @@ Coverage:
   buffers — the rooted colls pin the n_local gate: a spanning team must
   take the replicated shard_map program, NOT the explicit-placement
   fast path (which would silently truncate at root / KeyError);
-- ALLTOALLV spanning-team gating: the xla TL must NOT advertise a2av on
-  a team whose ranks span processes (tl/xla.py alg_table gate), and the
-  score map must still offer a host fallback;
+- ALLTOALLV on the spanning team (uneven per-pair counts): the counts
+  matrix is exchanged over the service team before launch so every
+  controller compiles the identical program (tl/xla.py post_fn);
 - hier-over-HBM mode (UCC_TOPO_FAKE_PPN=2): each process becomes a
   "node" — node stages run on-device through the NODE unit's XLA team,
   leaders run the DCN stage over the socket TL across processes
@@ -215,28 +215,33 @@ def _worker_main(proc_id: int, base_port: int, mode: str = "flat") -> None:
             np.asarray(a.src.buffer), bdata),
         label="bcast")
 
-    # 6) ALLTOALLV spanning-team gating: the xla TL must not advertise
-    #    a2av when n_local < size, and the score map still has a fallback
-    def xla_tl_team(team):
-        for clt in team.cl_teams:
-            for t in getattr(clt, "tl_teams", []):
-                if t.NAME == "xla":
-                    return t
-        return None
+    # 6) ALLTOALLV on the spanning team: the counts matrix is exchanged
+    #    over the service team before the launch, so every controller
+    #    compiles the identical program (round-3 lift of the old
+    #    n_local gate). Uneven per-pair counts exercise the index maps.
+    m = [[(q + p) % 3 + 1 for p in range(n)] for q in range(n)]
+    rcounts = [[m[q][p] for q in range(n)] for p in range(n)]
+    vsrcs = {q: np.concatenate([np.full(m[q][p], 100.0 * q + p,
+                                        np.float32) for p in range(n)])
+             for q in range(n)}
 
-    for r in my_ranks:
-        xt = xla_tl_team(teams[r])
-        if xt is None:
-            continue
-        assert xt.shared.n_local < len(xt.shared.devices)
-        assert CollType.ALLTOALLV not in xt.alg_table(), \
-            "spanning team must not advertise xla a2av"
-        cands = teams[r].score_map.lookup(CollType.ALLTOALLV,
-                                          MemoryType.TPU, 1 << 10)
-        assert all(
-            getattr(c.team, "NAME", "") != "xla" for c in cands), \
-            [(getattr(c.team, "NAME", "?"), c.alg_name) for c in cands]
-    print(f"COLL-OK a2av-gating {proc_id}", flush=True)
+    def _mk_a2av(r):
+        a = jax.device_put(jnp.asarray(vsrcs[r]), devs[r])
+        return CollArgs(
+            coll_type=CollType.ALLTOALLV,
+            src=BufferInfoV(a, m[r], None, DataType.FLOAT32,
+                            mem_type=MemoryType.TPU),
+            dst=BufferInfoV(None, rcounts[r], None, DataType.FLOAT32,
+                            mem_type=MemoryType.TPU))
+
+    def _check_a2av(r, a):
+        sdispl = {q: np.cumsum([0] + m[q][:-1]) for q in range(n)}
+        expect = np.concatenate([
+            vsrcs[q][sdispl[q][r]:sdispl[q][r] + m[q][r]]
+            for q in range(n)])
+        np.testing.assert_allclose(np.asarray(a.dst.buffer), expect)
+
+    run(_mk_a2av, _check_a2av, timeout=180, label="alltoallv-spanning")
 
     print(f"MULTIPROC-OK {proc_id}", flush=True)
 

@@ -638,6 +638,11 @@ class XlaCollTask(CollTask):
         self._contrib_src = args.src is not None and not args.is_inplace
         self._fast_round = False   # set per-round by fast_repost
         self._fast_bind = None     # dst BufferInfo for slim re-binds
+        #: multi-controller a2av: the per-rank counts/displacement table
+        #: exchanged over the service team (None until exchanged; local
+        #: teams read the rendezvous slot instead and never set it)
+        self._a2av_table = None
+        self._a2av_svc = None
         if self.coll == CollType.SCATTERV and \
                 team.rank == int(args.root) and (
                 not isinstance(args.src, BufferInfoV) or
@@ -722,11 +727,10 @@ class XlaCollTask(CollTask):
         """Compiled shard_map program + padded per-rank count (cached).
 
         For ALLTOALLV the per-pair counts matrix is assembled from the
-        rendezvous slot (every local task's args) — possible because in the
-        rank==context model all team ranks of a process deposit before
-        launch. Teams spanning processes never get an ALLTOALLV entry in
-        alg_table (n_local != size gating), so selection falls through to
-        host TLs for host memory and errors cleanly for device memory.
+        rendezvous slot (every local task's args) when all team ranks are
+        process-local; teams SPANNING processes exchange the vectors over
+        the service team first (post_fn), so every controller compiles
+        the identical program from the identical table.
         """
         args = self.args
         n = len(shared.devices)
@@ -776,10 +780,21 @@ class XlaCollTask(CollTask):
                 displs = default_displs(counts)
             return counts, displs
 
-        rows = []      # per src rank: (scounts, sdispls)
-        for r in sorted(slot):
-            rows.append(_vec(slot[r][1].args.src))
-        dsts = [_vec(slot[r][1].args.dst) for r in sorted(slot)]
+        if self._a2av_table is not None:
+            # spanning team: vectors came from the service-team exchange
+            # (one entry per TEAM rank, identical in every process — the
+            # compiled program must be bit-identical across controllers)
+            rows = [(list(sc), list(sd) if sd is not None
+                     else default_displs(list(sc)))
+                    for sc, sd, _, _ in self._a2av_table]
+            dsts = [(list(dc), list(dd) if dd is not None
+                     else default_displs(list(dc)))
+                    for _, _, dc, dd in self._a2av_table]
+        else:
+            rows = []      # per src rank: (scounts, sdispls)
+            for r in sorted(slot):
+                rows.append(_vec(slot[r][1].args.src))
+            dsts = [_vec(slot[r][1].args.dst) for r in sorted(slot)]
         key = (self.coll, self.np_dtype.str,
                tuple((tuple(c), tuple(d)) for c, d in rows),
                tuple((tuple(c), tuple(d)) for c, d in dsts))
@@ -812,11 +827,41 @@ class XlaCollTask(CollTask):
         self._out_by_dev = None
         self._my_shard = None
         shared = self.tl_team.shared
+        if self.coll == CollType.ALLTOALLV and \
+                shared.n_local < len(shared.devices) and \
+                self._a2av_table is None:
+            # spanning team: the compiled program's static index maps need
+            # EVERY rank's counts/displacements, but the rendezvous slot
+            # only covers local ranks — exchange the vectors over the
+            # service team first (nonblocking; the tl_nccl-style
+            # host-side metadata exchange before a device launch), then
+            # deposit from progress_fn. Persistent re-posts reuse the
+            # table (coll args are fixed, ucc.h:1674).
+            import pickle
+            svc_team = getattr(self.tl_team.core_team, "service_team", None)
+            if svc_team is None or \
+                    not hasattr(svc_team, "service_allgather"):
+                self.status = Status.ERR_NOT_SUPPORTED
+                return Status.OK
+            args = self.args
+            vecs = ([int(c) for c in args.src.counts],
+                    None if args.src.displacements is None else
+                    [int(d) for d in args.src.displacements],
+                    [int(c) for c in args.dst.counts],
+                    None if args.dst.displacements is None else
+                    [int(d) for d in args.dst.displacements])
+            svc = svc_team.service_allgather(pickle.dumps(vecs))
+            svc.post()
+            self._a2av_svc = svc
+            return Status.OK
+        self._deposit()
+        return Status.OK
+
+    def _deposit(self) -> None:
         shard = self.local_src()
         if isinstance(shard, np.ndarray):
             shard = shard.copy()   # snapshot: user may reuse src immediately
-        shared.deposit(self.tag, self.tl_team.rank, shard, self)
-        return Status.OK
+        self.tl_team.shared.deposit(self.tag, self.tl_team.rank, shard, self)
 
     # -- persistent fast re-post lane -------------------------------------
     # The generic post path costs ~12 python frames per rank per round
@@ -911,6 +956,18 @@ class XlaCollTask(CollTask):
 
     def progress_fn(self) -> None:
         if self.status != Status.IN_PROGRESS:
+            return
+        if self._a2av_svc is not None:
+            svc = self._a2av_svc
+            if not svc.is_completed():
+                return
+            self._a2av_svc = None
+            if svc.super_status.is_error:
+                self.status = svc.super_status
+                return
+            import pickle
+            self._a2av_table = [pickle.loads(b) for b in svc.result]
+            self._deposit()
             return
         if self._out is None:
             return  # not launched yet (other local ranks haven't posted)
@@ -1182,11 +1239,10 @@ class TlXlaTeam(TlTeamBase):
         shared = getattr(self, "shared", None)
         all_local = shared is None or \
             shared.n_local == getattr(self, "size", 0)
-        if all_local:
-            # the a2av counts matrix is assembled from the rendezvous slot,
-            # which only covers the full team when all ranks are local
-            # (shared is None only for the ucc_info -A listing stub)
-            table[CollType.ALLTOALLV] = [spec(0, "xla")]
+        # a2av is served for spanning teams too: the counts matrix is
+        # exchanged over the service team before the launch (post_fn);
+        # all-local teams assemble it from the rendezvous slot directly
+        table[CollType.ALLTOALLV] = [spec(0, "xla")]
         if all_local and shared is not None:
             # scatterv is served by the explicit-placement rooted path,
             # which needs every rank's device addressable (same locality
