@@ -215,3 +215,72 @@ class TestThreadModeFastLane:
         for r in range(n):
             for it in range(iters):
                 assert results[r][it] == expect, (r, it, results[r][it])
+
+
+class TestThreadModeOneSided:
+    """MULTIPLE-mode stress of the one-sided path: every rank drives
+    sliding-window allreduce re-posts from its own OS thread — the
+    segment registry and arrival counters take concurrent puts/gets
+    under the registry lock while each owner reduces in its own
+    thread."""
+
+    def test_concurrent_sliding_window_reposts(self, monkeypatch):
+        from ucc_tpu import CollArgsFlags
+        monkeypatch.setenv("UCC_TL_SHM_TUNE", "allreduce:@sliding_window")
+        monkeypatch.setenv("UCC_TL_SHM_ALLREDUCE_SW_WINDOW", "128")
+        n, iters, count = 4, 10, 300
+        world = ThreadOobWorld(n)
+        libs = [ucc_tpu.init(LibParams(thread_mode=ThreadMode.MULTIPLE))
+                for _ in range(n)]
+        ctxs = [None] * n
+
+        def mk(r):
+            ctxs[r] = Context(libs[r], ContextParams(oob=world.endpoint(r)))
+
+        ths = [threading.Thread(target=mk, args=(r,)) for r in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+
+        tw = ThreadOobWorld(n)
+        srcs = [np.arange(count, dtype=np.float64) * (r + 1)
+                for r in range(n)]
+        dsts = [np.zeros(count, dtype=np.float64) for _ in range(n)]
+        sh = [ctxs[r].mem_map(srcs[r]) for r in range(n)]
+        dh = [ctxs[r].mem_map(dsts[r]) for r in range(n)]
+        errors = []
+        barrier = threading.Barrier(n)
+
+        def rank_main(r):
+            try:
+                team = ctxs[r].create_team(TeamParams(oob=tw.endpoint(r)))
+                args = CollArgs(
+                    coll_type=CollType.ALLREDUCE,
+                    src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+                    dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+                    op=ReductionOp.SUM,
+                    src_memh=list(sh), dst_memh=list(dh),
+                    flags=(CollArgsFlags.MEM_MAP_SRC_MEMH
+                           | CollArgsFlags.MEM_MAP_DST_MEMH
+                           | CollArgsFlags.PERSISTENT))
+                req = team.collective_init(args)
+                for _ in range(iters):
+                    barrier.wait(timeout=60)
+                    req.post()
+                    req.wait(timeout=60)
+                req.finalize()
+            except Exception as e:  # noqa: BLE001
+                errors.append((r, e))
+
+        ths = [threading.Thread(target=rank_main, args=(r,))
+               for r in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=180)
+        assert not errors, errors
+        expect = np.arange(count, dtype=np.float64) * sum(
+            range(1, n + 1))
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], expect, rtol=1e-12)

@@ -198,7 +198,7 @@ def _worker_main(proc_id: int, base_port: int, mode: str = "flat") -> None:
     run(lambda r: CollArgs(
             coll_type=CollType.ALLGATHERV,
             src=dev_buf(r, np.full(vcounts[r], float(r), np.float32)),
-            dst=BufferInfoV(None, vcounts, DataType.FLOAT32,
+            dst=BufferInfoV(None, vcounts, None, DataType.FLOAT32,
                             mem_type=MemoryType.TPU)),
         lambda r, a: np.testing.assert_allclose(
             np.asarray(a.dst.buffer), vfull),
