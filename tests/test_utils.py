@@ -294,3 +294,12 @@ class TestInfoScoreMapRows:
         assert "ring_dma" in bc
         a2a = next(ln for ln in out.splitlines() if "alltoall/tpu" in ln)
         assert "ring_dma" in a2a
+
+    def test_onesided_algs_listed(self, capsys):
+        """The one-sided algorithms are addressable by name (-A listing
+        / TUNE ids) on both host transports."""
+        from ucc_tpu.tools.info import print_algorithms
+        print_algorithms()
+        out = capsys.readouterr().out
+        assert "sliding_window" in out
+        assert "onesided" in out
