@@ -7,7 +7,10 @@ execute without TPU hardware). Must run before jax is first imported.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE (not setdefault): this environment presets JAX_PLATFORMS=axon, and
+# an inherited accelerator platform makes ensure_live_backend probe the
+# (possibly wedged) tunnel for its full timeout inside the test run
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -83,6 +83,17 @@ def ensure_live_backend(virtual_cpu_devices: int = 0,
                         f"{virtual_cpu_devices}").strip()
 
     import jax
+    # the runtime config may already pin cpu even though the env var says
+    # otherwise (preloaded jax + a conftest-style jax.config.update): the
+    # probe would then burn its full timeout against an accelerator this
+    # process will never use
+    try:
+        cfgp = getattr(jax.config, "jax_platforms", None)
+    except Exception:  # noqa: BLE001 - config API drift
+        cfgp = None
+    if cfgp and str(cfgp).strip().lower() == "cpu":
+        _backend_probe_result["result"] = "cpu"
+        return "cpu"
     try:
         from jax._src import xla_bridge
         if xla_bridge.backends_are_initialized():
