@@ -145,21 +145,24 @@ class TestAlltoallOnesided:
             np.testing.assert_array_equal(dsts[r], e)
 
     @pytest.mark.parametrize("job4", ["alltoall:@onesided"], indirect=True)
-    def test_missing_memh_falls_back_to_twosided(self, job4):
-        """TUNE selects onesided but no memh args: init raises
-        NOT_SUPPORTED and the score-map fallback walk must serve the
-        collective with a two-sided algorithm (ucc_coll_score_map.c:136)."""
+    def test_missing_memh_self_bootstraps(self, job4):
+        """TUNE selects onesided with NO memh args: the task mem_maps its
+        own buffers and exchanges handles inline (round-3 bootstrap mode),
+        then runs the one-sided protocol — no user rkey plumbing. The
+        bootstrap segments are unmapped at completion."""
         n = 4
         count = 4 * n
         teams = job4.create_team()
         srcs = [_mkdata(r, count, np.float32) for r in range(n)]
         dsts = [np.zeros(count, dtype=np.float32) for _ in range(n)]
+        before = len(REGISTRY.segments)
         job4.run_coll(teams, lambda r: CollArgs(
             coll_type=CollType.ALLTOALL,
             src=BufferInfo(srcs[r], count, DataType.FLOAT32),
             dst=BufferInfo(dsts[r], count, DataType.FLOAT32)))
         for r, e in enumerate(_a2a_expect(srcs, n, 4)):
             np.testing.assert_array_equal(dsts[r], e)
+        assert len(REGISTRY.segments) == before   # bootstrap maps cleaned
 
     def test_memh_args_with_default_tune_run_twosided(self, job4):
         """Passing global memh without TUNE-selecting onesided keeps the
@@ -213,6 +216,31 @@ class TestAlltoallvOnesided:
         for p in range(n):
             expect = np.concatenate([
                 srcs[q][s_displ[q][p]:s_displ[q][p] + m[q][p]]
+                for q in range(n)])
+            np.testing.assert_array_equal(dsts[p], expect)
+
+    @pytest.mark.parametrize("job4", ["alltoallv:@onesided"], indirect=True)
+    def test_bootstrap_mode_standard_semantics(self, job4):
+        """Without memh the a2av bootstrap exchange carries each rank's
+        receive displacements, so STANDARD MPI alltoallv args (usual
+        receive-displacement table, no transpose) just work."""
+        n = 4
+        teams = job4.create_team()
+        m = [[(r * 2 + p) % 3 + 1 for p in range(n)] for r in range(n)]
+        recv_counts = [[m[q][p] for q in range(n)] for p in range(n)]
+        srcs, dsts = [], []
+        for r in range(n):
+            srcs.append(np.arange(sum(m[r]), dtype=np.int32) + 1000 * r)
+            dsts.append(np.full(sum(recv_counts[r]), -1, np.int32))
+        from ucc_tpu import BufferInfoV
+        job4.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALLV,
+            src=BufferInfoV(srcs[r], m[r], None, DataType.INT32),
+            dst=BufferInfoV(dsts[r], recv_counts[r], None, DataType.INT32)))
+        for p in range(n):
+            sdispl = {q: np.cumsum([0] + m[q][:-1]) for q in range(n)}
+            expect = np.concatenate([
+                srcs[q][sdispl[q][p]:sdispl[q][p] + m[q][p]]
                 for q in range(n)])
             np.testing.assert_array_equal(dsts[p], expect)
 
@@ -330,6 +358,53 @@ class TestSlidingWindowAllreduce:
             expect = np.prod(srcs, axis=0)
             for r in range(n):
                 np.testing.assert_allclose(dsts[r], expect, rtol=1e-4)
+
+    @pytest.mark.parametrize("job4", ["allreduce:@sliding_window"],
+                             indirect=True)
+    def test_bootstrap_no_memh(self, job4, monkeypatch):
+        """Plain TUNE selection with standard two-sided args: the task
+        self-bootstraps its memh (mem_map + inline exchange) and the
+        result matches; bootstrap segments unmapped at completion."""
+        monkeypatch.setenv("UCC_TL_SHM_ALLREDUCE_SW_WINDOW", "128")
+        n = 4
+        count = 777
+        teams = job4.create_team()
+        srcs = [_mkdata(r, count, np.float32) for r in range(n)]
+        dsts = [np.zeros(count, dtype=np.float32) for _ in range(n)]
+        before = len(REGISTRY.segments)
+        job4.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+            op=ReductionOp.SUM))
+        expect = np.sum(srcs, axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], expect, rtol=1e-4,
+                                       atol=1e-5)
+        assert len(REGISTRY.segments) == before
+
+    def test_hier_leaders_pick_sliding_window(self, monkeypatch):
+        """The DCN-leader integration the bootstrap mode exists for:
+        CL/HIER's RAB leader allreduce stage selects sliding_window via
+        plain TL TUNE (no memh plumbing anywhere in hier)."""
+        monkeypatch.setenv("UCC_TOPO_FAKE_PPN", "2")
+        monkeypatch.setenv("UCC_TL_SHM_TUNE", "allreduce:@sliding_window")
+        job = UccJob(4)
+        try:
+            teams = job.create_team()
+            n, count = 4, 512
+            srcs = [_mkdata(r, count, np.float64) for r in range(n)]
+            dsts = [np.zeros(count, dtype=np.float64) for _ in range(n)]
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+                dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+                op=ReductionOp.SUM))
+            expect = np.sum(srcs, axis=0)
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r], expect, rtol=1e-12)
+        finally:
+            job.cleanup()
 
     @pytest.mark.parametrize("job4", ["allreduce:@sliding_window"],
                              indirect=True)

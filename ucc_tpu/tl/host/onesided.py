@@ -252,12 +252,18 @@ class OneSidedMixin:
                            f"got {req.nbytes} (bad handle/bounds at target)")
 
 
-def _memh_descs(task: HostCollTask, memh, which: str) -> List[dict]:
+def _memh_descs(task: HostCollTask, memh, which: str,
+                allow_none: bool = False) -> Optional[List[dict]]:
     """Validate + decode a global memh array (one handle per team rank,
     ucc.h global_memh). Accepts raw exported handles (bytes) or
-    already-imported descriptor dicts."""
+    already-imported descriptor dicts. ``allow_none`` returns None for
+    absent memh — the algorithm then SELF-BOOTSTRAPS: it mem_maps its
+    own buffers and exchanges the handles inline (beyond-reference
+    convenience; the explicit-memh path stays bit-for-bit)."""
     size = task.gsize
     if memh is None:
+        if allow_none:
+            return None
         raise UccError(Status.ERR_NOT_SUPPORTED,
                        f"onesided algorithm requires {which}_memh global "
                        "handles (flags MEM_MAP_{SRC,DST}_MEMH)")
@@ -274,6 +280,47 @@ def _memh_descs(task: HostCollTask, memh, which: str) -> List[dict]:
             raise UccError(Status.ERR_INVALID_PARAM,
                            f"bad {which}_memh handle: {d}")
     return descs
+
+
+def _bootstrap_exchange(task: HostCollTask, payload: bytes,
+                        slot: int = 8200, pad: int = 8192):
+    """Inline all-to-all of small fixed-size blobs over the team's tagged
+    p2p — the rkey exchange a runtime would otherwise do out of band
+    before a one-sided collective. Returns the per-rank blobs (own
+    payload included)."""
+    size, me = task.gsize, task.grank
+    if len(payload) > pad - 8:
+        raise UccError(Status.ERR_INVALID_PARAM,
+                       "one-sided bootstrap payload too large")
+    blob = np.zeros(pad, np.uint8)
+    blob[:8] = np.frombuffer(np.int64(len(payload)).tobytes(), np.uint8)
+    blob[8:8 + len(payload)] = np.frombuffer(payload, np.uint8)
+    inbox = np.zeros((size, pad), np.uint8)
+    reqs = []
+    for p in range(size):
+        if p == me:
+            continue
+        reqs.append(task.send_nb(p, blob, slot=slot))
+        reqs.append(task.recv_nb(p, inbox[p], slot=slot))
+    yield from task.wait(*reqs)
+    inbox[me] = blob
+    out = []
+    for p in range(size):
+        ln = int(np.frombuffer(inbox[p, :8].tobytes(), np.int64)[0])
+        out.append(inbox[p, 8:8 + ln].tobytes())
+    return out
+
+
+def _self_map(task: HostCollTask, *buffers):
+    """mem_map this rank's buffers through the core context; returns
+    (handles, unmap_fn)."""
+    ctx = task.tl_team.core_team.context
+    handles = [ctx.mem_map(b) for b in buffers]
+
+    def unmap():
+        for h in handles:
+            ctx.mem_unmap(h)
+    return handles, unmap
 
 
 def _dissemination_barrier(task: HostCollTask, slot_base: int = 7000):
@@ -339,39 +386,54 @@ class AlltoallOnesided(OneSidedMixin, HostCollTask):
             raise UccError(Status.ERR_INVALID_PARAM,
                            f"unknown onesided alltoall variant "
                            f"'{self.variant}' (put|get)")
-        which = "dst" if self.variant == "put" else "src"
+        self.which = "dst" if self.variant == "put" else "src"
         self.descs = _memh_descs(
-            self, getattr(args, f"{which}_memh", None), which)
+            self, getattr(args, f"{self.which}_memh", None), self.which,
+            allow_none=True)
         self.count = int(args.src.count)
         if self.count % self.gsize:
             raise UccError(Status.ERR_INVALID_PARAM,
                            "alltoall count must divide by team size")
 
     def run(self):
+        unmap = None
+        descs = self.descs
+        if descs is None:
+            # self-bootstrap (see _memh_descs): map the variant's remote
+            # side and exchange handles inline
+            buf = (self.args.dst if self.which == "dst"
+                   else self.args.src).buffer
+            handles, unmap = _self_map(self, buf)
+            blobs = yield from _bootstrap_exchange(self, handles[0])
+            descs = [import_memh(b) for b in blobs]
         if self.variant == "put":
-            yield from self._run_put()
+            yield from self._run_put(descs)
         else:
-            yield from self._run_get()
+            yield from self._run_get(descs)
+        if unmap is not None:
+            # put: my counter full = no more writes to my dst segment;
+            # get: the closing barrier = no more reads of my src segment
+            unmap()
 
-    def _run_put(self):
+    def _run_put(self, descs):
         args = self.args
         size, me = self.gsize, self.grank
         nb = (self.count // size) * dt_size(args.src.datatype)
         src_u8 = binfo_typed(args.src, self.count).view(np.uint8)
-        my_uid = self.descs[me]["ctx_uid"]
+        my_uid = descs[me]["ctx_uid"]
         my_ctr = self.ctr_key(my_uid)
         # put loop starting at grank+1 (the reference's peer rotation,
         # alltoall_onesided.c:143 — spreads target load across ranks)
         for i in range(1, size + 1):
             peer = (me + i) % size
-            self.os_put(peer, self.descs[peer], me * nb,
+            self.os_put(peer, descs[peer], me * nb,
                         src_u8[peer * nb:(peer + 1) * nb],
-                        notify=self.ctr_key(self.descs[peer]["ctx_uid"]))
+                        notify=self.ctr_key(descs[peer]["ctx_uid"]))
         # completion: everyone has landed in MY dst segment
         yield from self.os_wait_counter(my_ctr, size)
         REGISTRY.counter_del(my_ctr)
 
-    def _run_get(self):
+    def _run_get(self, descs):
         args = self.args
         size, me = self.gsize, self.grank
         nb = (self.count // size) * dt_size(args.src.datatype)
@@ -379,7 +441,7 @@ class AlltoallOnesided(OneSidedMixin, HostCollTask):
         reqs = []
         for i in range(1, size + 1):
             peer = (me + i) % size
-            reqs.append((self.os_get(peer, self.descs[peer], me * nb,
+            reqs.append((self.os_get(peer, descs[peer], me * nb,
                                      dst_u8[peer * nb:(peer + 1) * nb]), nb))
         yield from self.wait(*[r for r, _ in reqs])
         for r, n in reqs:
@@ -405,6 +467,12 @@ class AlltoallvOnesided(OneSidedMixin, HostCollTask):
     Completion: per-put notify counters (the reference's pSync
     atomic_inc protocol, :55-57) — rank r completes when all team
     members' blocks have landed in its destination segment.
+
+    WITHOUT explicit memh the task self-bootstraps (see _memh_descs) and
+    the exchange carries each rank's OWN receive displacements, so puts
+    target ``peer's d_displs[me]`` — i.e. bootstrap mode keeps standard
+    MPI alltoallv semantics (no transposed table needed), while the
+    explicit-memh path keeps the reference convention bit-for-bit.
     """
 
     def __init__(self, init_args, team):
@@ -414,7 +482,7 @@ class AlltoallvOnesided(OneSidedMixin, HostCollTask):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            "onesided alltoallv does not support in-place")
         self.descs = _memh_descs(self, getattr(args, "dst_memh", None),
-                                 "dst")
+                                 "dst", allow_none=True)
         for bi, name in ((args.src, "src"), (args.dst, "dst")):
             if bi is None or bi.counts is None:
                 raise UccError(Status.ERR_INVALID_PARAM,
@@ -432,20 +500,39 @@ class AlltoallvOnesided(OneSidedMixin, HostCollTask):
         d_displ = args.dst.displacements
         if d_displ is None:
             d_displ = np.cumsum([0] + [int(c) for c in args.dst.counts[:-1]])
+        descs = self.descs
+        unmap = None
+        peer_doffs = None      # bootstrap mode: peer -> my offset there
+        if descs is None:
+            import pickle
+            handles, unmap = _self_map(self, args.dst.buffer)
+            payload = pickle.dumps(
+                (handles[0], [int(d) for d in d_displ]))
+            blobs = yield from _bootstrap_exchange(self, payload)
+            decoded = [pickle.loads(b) for b in blobs]
+            descs = [import_memh(h) for h, _ in decoded]
+            # standard semantics: put to peer p at p's OWN receive
+            # displacement for source rank me
+            peer_doffs = [int(dd[me]) for _, dd in decoded]
         total_src = max(int(s_displ[p]) + s_counts[p] for p in range(size))
         src_u8 = binfo_typed(args.src, total_src).view(np.uint8) \
             if total_src else np.empty(0, dtype=np.uint8)
-        my_uid = self.descs[me]["ctx_uid"]
+        my_uid = descs[me]["ctx_uid"]
         my_ctr = self.ctr_key(my_uid)
         for i in range(1, size + 1):
             peer = (me + i) % size
             sd = int(s_displ[peer]) * s_esz
             nb = s_counts[peer] * s_esz
-            dd = int(d_displ[peer]) * d_esz       # TARGET-relative (see doc)
-            self.os_put(peer, self.descs[peer], dd, src_u8[sd:sd + nb],
-                        notify=self.ctr_key(self.descs[peer]["ctx_uid"]))
+            if peer_doffs is not None:
+                dd = peer_doffs[peer] * d_esz
+            else:
+                dd = int(d_displ[peer]) * d_esz   # TARGET-relative (see doc)
+            self.os_put(peer, descs[peer], dd, src_u8[sd:sd + nb],
+                        notify=self.ctr_key(descs[peer]["ctx_uid"]))
         yield from self.os_wait_counter(my_ctr, size)
         REGISTRY.counter_del(my_ctr)
+        if unmap is not None:
+            unmap()
 
 
 # ---------------------------------------------------------------------------
@@ -480,10 +567,14 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
                  inflight: int = SW_INFLIGHT):
         super().__init__(init_args, team)
         args = init_args.args
+        # absent memh -> self-bootstrap at run time (mem_map own buffers
+        # + inline handle exchange): plain TUNE selection works without
+        # any rkey plumbing, which is what lets CL/HIER's DCN leader
+        # stage pick this algorithm up unchanged
         self.src_descs = _memh_descs(self, getattr(args, "src_memh", None),
-                                     "src")
+                                     "src", allow_none=True)
         self.dst_descs = _memh_descs(self, getattr(args, "dst_memh", None),
-                                     "dst")
+                                     "dst", allow_none=True)
         self.count = int(args.dst.count)
         self.dt = args.dst.datatype
         self.op = args.op if args.op is not None else ReductionOp.SUM
@@ -527,10 +618,6 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
         src = binfo_typed(args.dst if args.is_inplace else args.src,
                           self.count)
         dst = binfo_typed(args.dst, self.count)
-        my_uid = self.dst_descs[me]["ctx_uid"]
-        my_ctr = self.ctr_key(my_uid)
-        my_count = block_count(self.count, size, me)
-        my_off = block_offset(self.count, size, me)
         op = ReductionOp.SUM if self.op == ReductionOp.AVG else self.op
         alpha = 1.0 / size if self.op == ReductionOp.AVG else None
 
@@ -539,6 +626,30 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
                 if alpha is not None else src
             dst[:] = out
             return
+
+        src_descs, dst_descs = self.src_descs, self.dst_descs
+        unmap = None
+        if src_descs is None or dst_descs is None:
+            import pickle
+            same = args.is_inplace or args.src is None or \
+                args.src.buffer is args.dst.buffer
+            if same:
+                handles, unmap = _self_map(self, args.dst.buffer)
+                h_src = h_dst = handles[0]
+            else:
+                handles, unmap = _self_map(self, args.src.buffer,
+                                           args.dst.buffer)
+                h_src, h_dst = handles
+            blobs = yield from _bootstrap_exchange(
+                self, pickle.dumps((h_src, h_dst)))
+            pairs = [pickle.loads(b) for b in blobs]
+            src_descs = [import_memh(h) for h, _ in pairs]
+            dst_descs = [import_memh(h) for _, h in pairs]
+
+        my_uid = dst_descs[me]["ctx_uid"]
+        my_ctr = self.ctr_key(my_uid)
+        my_count = block_count(self.count, size, me)
+        my_off = block_offset(self.count, size, me)
 
         # expected arrivals into MY dst: one put per (owner, window) pair
         # from every other owner, plus my own local window writes
@@ -563,7 +674,7 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
                 while issued < len(peers) and free_slots:
                     slot = free_slots.pop()
                     req = self.os_get(peers[issued],
-                                      self.src_descs[peers[issued]], goff,
+                                      src_descs[peers[issued]], goff,
                                       getbuf[slot, :wn].view(np.uint8))
                     pending.append((req, slot))
                     issued += 1
@@ -585,11 +696,15 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
                                     alpha=alpha)
             # distribute the reduced window into every dst segment
             for p in peers:
-                self.os_put(p, self.dst_descs[p], goff,
+                self.os_put(p, dst_descs[p], goff,
                             np.ascontiguousarray(acc).view(np.uint8),
-                            notify=self.ctr_key(self.dst_descs[p]["ctx_uid"]))
+                            notify=self.ctr_key(dst_descs[p]["ctx_uid"]))
             dst[my_off + w0:my_off + w0 + wn] = acc
         # completion: all owners' windows have landed in my dst — which
-        # also proves every owner has read my src (see class docstring)
+        # also proves every owner has read my src (see class docstring).
+        # Counter full also makes the bootstrap unmap safe: nobody will
+        # touch my segments again (see class docstring invariant).
         yield from self.os_wait_counter(my_ctr, expect)
         REGISTRY.counter_del(my_ctr)
+        if unmap is not None:
+            unmap()
