@@ -153,6 +153,51 @@ def _build(n: int, h: int, s_local: int, d: int, dtype_str: str,
     return shard_fn
 
 
+def _xla_ring_shard(q, k, v, n: int, scale: float, causal: bool,
+                    axis: str):
+    """Differentiable mirror of the fused kernel's math (same streaming
+    softmax, same ring direction, same causal mask) expressed in plain
+    lax ops — this is what the custom_vjp backward differentiates, so
+    gradients flow through an equivalent ring schedule (flash-style
+    recompute; K/V rotation reverses automatically under VJP)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import ops
+
+    me = lax.axis_index(axis)
+    h, s_local, d = q.shape
+    qf = q.astype(jnp.float32) * scale
+    iq = lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0)
+    ik = lax.broadcasted_iota(jnp.int32, (s_local, s_local), 1)
+
+    def step(t, carry):
+        acc, m_run, l_run, kc, vc = carry
+        s = jnp.einsum("hqd,hkd->hqk", qf, kc.astype(jnp.float32))
+        if causal:
+            src = lax.rem(me - t + 2 * n, n)
+            mask = (me * s_local + iq) >= (src * s_local + ik)
+            s = jnp.where(mask[None], s, -jnp.inf)
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1))
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(jnp.where(jnp.isfinite(s), s - safe_m[..., None],
+                              -jnp.inf))
+        corr = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - safe_m), 0.0)
+        l_new = l_run * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "hqk,hkd->hqd", p, vc.astype(jnp.float32))
+        return (acc, m_new, l_new, ops.ring_shift(kc, axis),
+                ops.ring_shift(vc, axis))
+
+    acc0 = jnp.zeros((h, s_local, d), jnp.float32)
+    m0 = jnp.full((h, s_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((h, s_local), jnp.float32)
+    acc, _, l_run, _, _ = lax.fori_loop(0, n, step, (acc0, m0, l0, k, v))
+    out = acc / jnp.where(l_run == 0.0, 1.0, l_run)[..., None]
+    return out.astype(q.dtype)
+
+
 def ring_flash_attention(q, k, v, *, axis_name: str = "r",
                          scale: float = None, causal: bool = False):
     """Shard-level fused ring attention (call inside shard_map).
@@ -160,16 +205,39 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
     q, k, v: (heads, seq_local, head_dim) — this rank's sequence block.
     Returns (heads, seq_local, head_dim): exact attention of the local
     queries against the FULL sequence-sharded context.
+
+    Differentiable: the forward runs the fused Pallas kernel; the
+    backward recomputes through the equivalent lax ring schedule
+    (flash-style rematerialization) via custom_vjp.
     """
+    import jax
+
     from .ops import axis_size
 
     n = int(axis_size(axis_name))
     h, s_local, d = q.shape
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
-    fn = _build(int(n), h, s_local, d, str(q.dtype), float(scale),
-                bool(causal), axis_name)
-    return fn(q, k, v)
+    fused = _build(int(n), h, s_local, d, str(q.dtype), float(scale),
+                   bool(causal), axis_name)
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        return fused(q, k, v)
+
+    def fwd(q, k, v):
+        return fused(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda a, b, c: _xla_ring_shard(a, b, c, int(n), float(scale),
+                                            bool(causal), axis_name),
+            q, k, v)
+        return vjp(g)
+
+    attn.defvjp(fwd, bwd)
+    return attn(q, k, v)
 
 
 def make_ring_flash_attention(mesh, *, causal: bool = False,
