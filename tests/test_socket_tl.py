@@ -617,6 +617,19 @@ def _onesided_worker(rank: int, size: int, port: int, q):
         req.wait(timeout=90)
         results["sw_allreduce"] = adst.tolist()
 
+        # --- bootstrap mode: NO memh args — the task mem_maps its own
+        # buffers and runs the inline handle exchange over real TCP ---
+        bsrc = np.arange(101, dtype=np.float64) * (rank + 1)
+        bdst = np.zeros(101, np.float64)
+        req = team.collective_init(CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(bsrc, 101, DataType.FLOAT64),
+            dst=BufferInfo(bdst, 101, DataType.FLOAT64),
+            op=ReductionOp.SUM))
+        req.post()
+        req.wait(timeout=90)
+        results["sw_bootstrap"] = bdst.tolist()
+
         q.put((rank, results))
         ctx.destroy()
         if rank == 0:
@@ -660,6 +673,11 @@ def test_socket_onesided_three_processes():
     for r in range(size):
         np.testing.assert_allclose(results[r]["sw_allreduce"], expect_ar,
                                    rtol=1e-6)
+    expect_bs = np.arange(101, dtype=np.float64) * sum(
+        range(1, size + 1))
+    for r in range(size):
+        np.testing.assert_allclose(results[r]["sw_bootstrap"], expect_bs,
+                                   rtol=1e-12)
 
 
 def test_peer_death_surfaces_as_error(tmp_path):
