@@ -121,7 +121,10 @@ class Context:
         import uuid as _uuid
         self._ctx_uid = _uuid.uuid4().hex
         self._mem_maps = {}
-        self._seg_id_counter = 1
+        # itertools.count: next() is atomic under the GIL, so concurrent
+        # mem_map calls in ThreadMode.MULTIPLE never mint duplicate ids
+        import itertools as _it
+        self._seg_ids = _it.count(1)
         self._destroyed = False
 
     # ------------------------------------------------------------------
@@ -176,8 +179,7 @@ class Context:
         from ..constants import MemoryType
         mt = detect_mem_type(buffer)
         nbytes = getattr(buffer, "nbytes", len(buffer))
-        seg_id = self._seg_id_counter
-        self._seg_id_counter += 1
+        seg_id = next(self._seg_ids)
         desc = {"ctx_rank": self.rank, "ctx_uid": self._ctx_uid,
                 "mem_type": int(mt), "nbytes": int(nbytes), "mode": mode,
                 "seg_id": seg_id, "onesided": False,

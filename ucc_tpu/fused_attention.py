@@ -47,9 +47,10 @@ def _kernel(n: int, scale: float, causal: bool, s_local: int,
     from .tl.ring_dma import _neighbor_barrier
 
     def kernel(q_ref, k_ref, v_ref, o_ref, comm_ref, send_sem, recv_sem,
-               m_ref, l_ref, acc_ref):
+               ack_sem, m_ref, l_ref, acc_ref):
         me = lax.axis_index(axis)
         right = lax.rem(me + 1, n)
+        left = lax.rem(me - 1 + n, n)
         if barrier:
             _neighbor_barrier(n, axis)
         # resident K/V starts as the local block in slot 0
@@ -67,11 +68,19 @@ def _kernel(n: int, scale: float, causal: bool, s_local: int,
             nxt = (t + 1) % 2
             rdma = None
             if t < n - 1:
+                if barrier and t >= 1:
+                    # consumer-side throttle: my step-t copy overwrites
+                    # the right neighbor's slot it consumed at ITS step
+                    # t-1 — wait for that consumption ack before
+                    # starting, or a rank running 2+ steps ahead would
+                    # clobber an unread K/V block (the 2-slot protocol's
+                    # skew bound is NOT self-enforcing; acks flow left
+                    # while data flows right, so no cycle)
+                    pltpu.semaphore_wait(ack_sem, 1)
                 # kick the rotation FIRST: block t+1 rides the ICI while
                 # the MXU chews block t (the fused overlap this kernel
                 # exists for). Slot parity alternates; rdma.wait() at the
-                # bottom proves send drained + neighbor's block arrived,
-                # the same one-step-skew protocol as tl/ring_dma.
+                # bottom proves send drained + neighbor's block arrived.
                 rdma = pltpu.make_async_remote_copy(
                     src_ref=comm_ref.at[cur],
                     dst_ref=comm_ref.at[nxt],
@@ -107,6 +116,15 @@ def _kernel(n: int, scale: float, causal: bool, s_local: int,
 
             if rdma is not None:
                 rdma.wait()
+            if barrier and t <= n - 3:
+                # ack AFTER rdma.wait: my outgoing copy has drained slot
+                # cur, and my block update consumed it — the left
+                # neighbor may now overwrite it (its step t+1 targets
+                # exactly this slot). n-2 signals balance the n-2 waits,
+                # so the semaphore drains to zero at kernel exit.
+                pltpu.semaphore_signal(
+                    ack_sem, inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
 
         l = l_ref[:]
         out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)[..., None]
@@ -142,6 +160,7 @@ def _build(n: int, h: int, s_local: int, d: int, dtype_str: str,
                 pltpu.VMEM((2, 2, h, s_local, d), nd),    # K/V slots
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,              # consumption acks
                 pltpu.VMEM((h, s_local), jnp.float32),    # running max
                 pltpu.VMEM((h, s_local), jnp.float32),    # normalizer
                 pltpu.VMEM((h, s_local, d), jnp.float32),  # accumulator

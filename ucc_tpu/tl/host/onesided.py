@@ -398,22 +398,29 @@ class AlltoallOnesided(OneSidedMixin, HostCollTask):
     def run(self):
         unmap = None
         descs = self.descs
-        if descs is None:
-            # self-bootstrap (see _memh_descs): map the variant's remote
-            # side and exchange handles inline
-            buf = (self.args.dst if self.which == "dst"
-                   else self.args.src).buffer
-            handles, unmap = _self_map(self, buf)
-            blobs = yield from _bootstrap_exchange(self, handles[0])
-            descs = [import_memh(b) for b in blobs]
-        if self.variant == "put":
-            yield from self._run_put(descs)
-        else:
-            yield from self._run_get(descs)
-        if unmap is not None:
-            # put: my counter full = no more writes to my dst segment;
-            # get: the closing barrier = no more reads of my src segment
-            unmap()
+        try:
+            if descs is None:
+                # self-bootstrap (see _memh_descs): map the variant's
+                # remote side and exchange handles inline
+                buf = (self.args.dst if self.which == "dst"
+                       else self.args.src).buffer
+                handles, unmap = _self_map(self, buf)
+                blobs = yield from _bootstrap_exchange(self, handles[0])
+                descs = [import_memh(b) for b in blobs]
+            if self.variant == "put":
+                yield from self._run_put(descs)
+            else:
+                yield from self._run_get(descs)
+        finally:
+            # success: put = my counter full (no more writes to my dst
+            # segment), get = closing barrier (no more reads of my src).
+            # Failure: the task is dead; unregister rather than leak a
+            # live remote window onto the user's buffer.
+            if unmap is not None:
+                unmap()
+            if descs:
+                REGISTRY.counter_del(
+                    self.ctr_key(descs[self.grank]["ctx_uid"]))
 
     def _run_put(self, descs):
         args = self.args
@@ -503,36 +510,42 @@ class AlltoallvOnesided(OneSidedMixin, HostCollTask):
         descs = self.descs
         unmap = None
         peer_doffs = None      # bootstrap mode: peer -> my offset there
-        if descs is None:
-            import pickle
-            handles, unmap = _self_map(self, args.dst.buffer)
-            payload = pickle.dumps(
-                (handles[0], [int(d) for d in d_displ]))
-            blobs = yield from _bootstrap_exchange(self, payload)
-            decoded = [pickle.loads(b) for b in blobs]
-            descs = [import_memh(h) for h, _ in decoded]
-            # standard semantics: put to peer p at p's OWN receive
-            # displacement for source rank me
-            peer_doffs = [int(dd[me]) for _, dd in decoded]
-        total_src = max(int(s_displ[p]) + s_counts[p] for p in range(size))
-        src_u8 = binfo_typed(args.src, total_src).view(np.uint8) \
-            if total_src else np.empty(0, dtype=np.uint8)
-        my_uid = descs[me]["ctx_uid"]
-        my_ctr = self.ctr_key(my_uid)
-        for i in range(1, size + 1):
-            peer = (me + i) % size
-            sd = int(s_displ[peer]) * s_esz
-            nb = s_counts[peer] * s_esz
-            if peer_doffs is not None:
-                dd = peer_doffs[peer] * d_esz
-            else:
-                dd = int(d_displ[peer]) * d_esz   # TARGET-relative (see doc)
-            self.os_put(peer, descs[peer], dd, src_u8[sd:sd + nb],
-                        notify=self.ctr_key(descs[peer]["ctx_uid"]))
-        yield from self.os_wait_counter(my_ctr, size)
-        REGISTRY.counter_del(my_ctr)
-        if unmap is not None:
-            unmap()
+        try:
+            if descs is None:
+                import pickle
+                handles, unmap = _self_map(self, args.dst.buffer)
+                payload = pickle.dumps(
+                    (handles[0], [int(d) for d in d_displ]))
+                blobs = yield from _bootstrap_exchange(self, payload)
+                decoded = [pickle.loads(b) for b in blobs]
+                descs = [import_memh(h) for h, _ in decoded]
+                # standard semantics: put to peer p at p's OWN receive
+                # displacement for source rank me
+                peer_doffs = [int(dd[me]) for _, dd in decoded]
+            total_src = max(int(s_displ[p]) + s_counts[p]
+                            for p in range(size))
+            src_u8 = binfo_typed(args.src, total_src).view(np.uint8) \
+                if total_src else np.empty(0, dtype=np.uint8)
+            my_ctr = self.ctr_key(descs[me]["ctx_uid"])
+            for i in range(1, size + 1):
+                peer = (me + i) % size
+                sd = int(s_displ[peer]) * s_esz
+                nb = s_counts[peer] * s_esz
+                if peer_doffs is not None:
+                    dd = peer_doffs[peer] * d_esz
+                else:
+                    dd = int(d_displ[peer]) * d_esz  # TARGET-relative (doc)
+                self.os_put(peer, descs[peer], dd, src_u8[sd:sd + nb],
+                            notify=self.ctr_key(descs[peer]["ctx_uid"]))
+            yield from self.os_wait_counter(my_ctr, size)
+        finally:
+            # failure path included: unregister the bootstrap window and
+            # drop the arrival counter rather than leak them
+            if unmap is not None:
+                unmap()
+            if descs:
+                REGISTRY.counter_del(
+                    self.ctr_key(descs[me]["ctx_uid"]))
 
 
 # ---------------------------------------------------------------------------
@@ -646,8 +659,21 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
             src_descs = [import_memh(h) for h, _ in pairs]
             dst_descs = [import_memh(h) for _, h in pairs]
 
-        my_uid = dst_descs[me]["ctx_uid"]
-        my_ctr = self.ctr_key(my_uid)
+        try:
+            yield from self._windows(args, src, dst, src_descs, dst_descs,
+                                     op, alpha, esz, nd)
+        finally:
+            # failure path included: unregister bootstrap windows and the
+            # arrival counter rather than leak a live remote window
+            if unmap is not None:
+                unmap()
+            REGISTRY.counter_del(
+                self.ctr_key(dst_descs[me]["ctx_uid"]))
+
+    def _windows(self, args, src, dst, src_descs, dst_descs, op, alpha,
+                 esz, nd):
+        size, me = self.gsize, self.grank
+        my_ctr = self.ctr_key(dst_descs[me]["ctx_uid"])
         my_count = block_count(self.count, size, me)
         my_off = block_offset(self.count, size, me)
 
@@ -705,6 +731,3 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
         # Counter full also makes the bootstrap unmap safe: nobody will
         # touch my segments again (see class docstring invariant).
         yield from self.os_wait_counter(my_ctr, expect)
-        REGISTRY.counter_del(my_ctr)
-        if unmap is not None:
-            unmap()

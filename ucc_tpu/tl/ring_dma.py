@@ -23,7 +23,13 @@ Compiled kernels open with a ring-neighbor barrier-semaphore handshake
 (collective_id'd) so a remote DMA cannot land before the peer kernel owns
 its comm slots; interpret mode skips it (no barrier model). The compiled
 ICI path still needs real-chip validation (the standing hardware gate,
-tests/test_ring_dma.py real-chip test).
+tests/test_ring_dma.py real-chip test). KNOWN PROTOCOL LIMIT pending that
+validation: the 2-slot schedule bounds neighbor skew only via the entry
+barrier + per-step send/recv waits; a rank stalling 2+ steps (preemption,
+grid skew) could have its unread slot overwritten by an upstream sender.
+``fused_attention.py`` adds the consumer-ack throttle that closes this
+(acks flow left, data flows right); port it here once real-chip runs can
+validate the semaphore traffic.
 
 Kernels run compiled on real TPU meshes and in Pallas interpret mode on
 the virtual CPU mesh (tests); the rendezvous/dispatch machinery is shared
