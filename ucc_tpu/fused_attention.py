@@ -237,6 +237,18 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
     h, s_local, d = q.shape
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
+    # pallas remote DMA with LOGICAL device ids supports single-named-
+    # axis meshes only; under a multi-axis mesh (e.g. ('dp','sp')) run
+    # the equivalent lax ring schedule — same math and gradients,
+    # compiler-scheduled overlap instead of in-kernel DMA
+    try:
+        from jax._src.core import get_axis_env
+        multi_axis = len(get_axis_env().axis_sizes) > 1
+    except Exception:  # noqa: BLE001 - private API drift: assume 1-axis
+        multi_axis = False
+    if multi_axis:
+        return _xla_ring_shard(q, k, v, int(n), float(scale),
+                               bool(causal), axis_name)
     fused = _build(int(n), h, s_local, d, str(q.dtype), float(scale),
                    bool(causal), axis_name)
 
@@ -256,7 +268,15 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
         return vjp(g)
 
     attn.defvjp(fwd, bwd)
-    return attn(q, k, v)
+    try:
+        return attn(q, k, v)
+    except NotImplementedError:
+        # pallas remote DMA with LOGICAL device ids supports single-named-
+        # axis meshes only; on multi-axis meshes (e.g. ('dp','sp')) fall
+        # back to the equivalent lax ring schedule — same math, compiler-
+        # scheduled overlap instead of in-kernel DMA
+        return _xla_ring_shard(q, k, v, int(n), float(scale),
+                               bool(causal), axis_name)
 
 
 def make_ring_flash_attention(mesh, *, causal: bool = False,
