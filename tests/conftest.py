@@ -9,18 +9,23 @@ import os
 
 # FORCE (not setdefault): this environment presets JAX_PLATFORMS=axon, and
 # an inherited accelerator platform makes ensure_live_backend probe the
-# (possibly wedged) tunnel for its full timeout inside the test run
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+# (possibly wedged) tunnel for its full timeout inside the test run.
+# UCC_TPU_REAL_CHIP=1 (set by tools/tpu_probe.py during a live chip
+# window) disables the forcing so the real-chip compile tests actually
+# see the accelerator instead of a virtual CPU mesh.
+_REAL_CHIP = os.environ.get("UCC_TPU_REAL_CHIP") == "1"
+if not _REAL_CHIP:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 # this environment preloads jax at interpreter startup, so the env vars
 # above may arrive too late for jax's import-time config read — force the
 # platform through the runtime config as well (backends init lazily)
 import sys
-if "jax" in sys.modules:
+if not _REAL_CHIP and "jax" in sys.modules:
     import jax
     try:
         jax.config.update("jax_platforms", "cpu")

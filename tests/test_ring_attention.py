@@ -190,6 +190,51 @@ class TestLongContextTraining:
             w = list(out[1:])
         assert losses[-1] < losses[0], losses
 
+    def test_grads_match_dense(self):
+        """The applied update must equal -lr * (gradient of the GLOBAL
+        mean loss), identically on every device — pins the sp-axis
+        weight-gradient reduction (weight grads are per-rank partials;
+        the ring backward only aggregates dK/dV, so without the sp
+        allreduce the 'replicated' params silently diverge)."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        from ucc_tpu.examples.long_context import (init_params,
+                                                   make_train_step)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = jax.make_mesh((2, 4), ("dp", "sp"))
+        heads, d, batch, seq = 2, 4, 4, 32
+        params = init_params(heads, d)
+        kx, ky = jax.random.split(jax.random.PRNGKey(3))
+        x = jax.random.normal(kx, (batch, heads, seq, d), jnp.float32)
+        y = jax.random.normal(ky, (batch, heads, seq, d),
+                              jnp.float32) * 0.1
+
+        def dense_loss(wq, wk, wv, wo):
+            q = jnp.einsum("bhsd,hde->bhse", x, wq)
+            k = jnp.einsum("bhsd,hde->bhse", x, wk)
+            v = jnp.einsum("bhsd,hde->bhse", x, wv)
+            scores = jnp.einsum("bhse,bhte->bhst", q, k) / np.sqrt(d)
+            mask = jnp.tril(jnp.ones((seq, seq), bool))
+            p = jax.nn.softmax(jnp.where(mask, scores, -jnp.inf), -1)
+            attn = jnp.einsum("bhst,bhte->bhse", p, v)
+            out = jnp.einsum("bhse,hed->bhsd", attn, wo)
+            return jnp.mean((out - y) ** 2)
+
+        w = (params["wq"], params["wk"], params["wv"], params["wo"])
+        ref = jax.grad(dense_loss, argnums=(0, 1, 2, 3))(*w)
+        lr = 0.05
+        xs = NamedSharding(mesh, P("dp", None, "sp", None))
+        out = make_train_step(mesh, lr=lr)(
+            *w, jax.device_put(x, xs), jax.device_put(y, xs))
+        for name, new, old, g in zip(("wq", "wk", "wv", "wo"),
+                                     out[1:], w, ref):
+            shards = [np.asarray(s.data) for s in new.addressable_shards]
+            for s in shards[1:]:       # truly replicated after update
+                np.testing.assert_array_equal(s, shards[0], err_msg=name)
+            np.testing.assert_allclose(
+                shards[0], np.asarray(old - lr * g), rtol=1e-4,
+                atol=1e-6, err_msg=name)
+
     def test_multi_axis_fallback_matches_fused(self, mesh):
         """ring_flash_attention under a multi-axis mesh silently takes
         the lax ring schedule; results must match the 1-axis fused path."""

@@ -5,10 +5,13 @@ carry (task brief: ring attention / sequence parallelism first-class):
 a single-head-block attention "model" whose sequence axis is sharded
 over the `sp` mesh axis and whose batch is sharded over `dp` —
 
-  - attention runs as the FUSED Pallas ring flash-attention kernel
-    (``fused_attention.ring_flash_attention``): K/V blocks rotate as
-    in-kernel remote DMAs overlapping the block updates, O(seq/n_sp)
-    activation memory per chip;
+  - attention runs as ``fused_attention.ring_flash_attention`` with
+    ``fused=False``: the multi-axis ('dp','sp') mesh forces the lax
+    ring schedule (the fused Pallas kernel's LOGICAL device ids need a
+    1-axis mesh) — same ring math and gradients, O(seq/n_sp) activation
+    memory per chip, compiler-scheduled overlap instead of in-kernel
+    DMA. 1-axis fused-kernel coverage lives in
+    ``make_ring_flash_attention`` and tests/test_ring_attention.py;
   - gradients flow through the kernel's custom_vjp (lax ring-schedule
     backward, flash-style recompute);
   - DP gradient synchronization is ``ops.allreduce(AVG)`` — the
@@ -56,22 +59,28 @@ def make_train_step(mesh: Mesh, lr: float = 1e-2, causal: bool = True):
             attn = ring_flash_attention(
                 q.reshape(b * h, s_loc, e), k.reshape(b * h, s_loc, e),
                 v.reshape(b * h, s_loc, e), axis_name="sp",
-                causal=causal).reshape(b, h, s_loc, e)
+                causal=causal,
+                # this mesh is ('dp','sp'): the Pallas kernel's LOGICAL
+                # device ids need a 1-axis mesh, so take the lax ring
+                # schedule explicitly rather than via the probe
+                fused=False).reshape(b, h, s_loc, e)
             out = jnp.einsum("bhse,hed->bhsd", attn, wo)
             local = jnp.mean((out - y) ** 2)
-            # mean over both data AND sequence shards: the loss is a
-            # global scalar (every rank holds seq/n_sp of the tokens)
-            local = ops.allreduce(local[None], ReductionOp.AVG,
-                                  axis_name="sp")[0]
+            # mean over data AND sequence shards in ONE collective (the
+            # loss is a global scalar; every rank holds seq/n_sp tokens)
             return ops.allreduce(local[None], ReductionOp.AVG,
-                                 axis_name="dp")[0]
+                                 axis_name=("sp", "dp"))[0]
 
         loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2, 3))(
             wq, wk, wv, wo)
-        # grads of replicated params are already summed over 'sp' by the
-        # backward collectives; DP-sync them explicitly (optimizer-side
-        # allreduce role)
-        grads = [ops.allreduce(g, ReductionOp.AVG, axis_name="dp")
+        # local autodiff yields PER-RANK partials dlocal_r/dw (the ring
+        # backward only aggregates activation grads dK/dV, never weight
+        # grads); the global-mean loss needs the mean of the partials
+        # over BOTH mesh axes — sp (sequence shards of the same batch)
+        # and dp (the optimizer-side allreduce role) — one joint-axis
+        # collective per weight. Verified exact vs a dense single-device
+        # reference in tests/test_ring_attention.py::test_grads_match_dense.
+        grads = [ops.allreduce(g, ReductionOp.AVG, axis_name=("sp", "dp"))
                  for g in grads]
         new = [p - lr * g for p, g in zip((wq, wk, wv, wo), grads)]
         return (loss, *new)

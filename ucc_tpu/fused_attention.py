@@ -217,8 +217,30 @@ def _xla_ring_shard(q, k, v, n: int, scale: float, causal: bool,
     return out.astype(q.dtype)
 
 
+def _mesh_multi_axis() -> bool:
+    """True iff the enclosing shard_map mesh has more than one named
+    axis — the fused kernel's LOGICAL device ids only lower on 1-axis
+    meshes. Probes the abstract mesh first (vmap/pmap axis_names around
+    the shard_map must NOT count — they don't change the device mesh);
+    falls back to the trace-time axis env on API drift."""
+    import jax
+
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and am.axis_names:
+            return len(am.axis_names) > 1
+    except Exception:  # noqa: BLE001 - API drift: try the axis env
+        pass
+    try:
+        from jax._src.core import get_axis_env
+        return len(get_axis_env().axis_sizes) > 1
+    except Exception:  # noqa: BLE001 - assume 1-axis; callers that know
+        return False   # their mesh can force via fused=
+
+
 def ring_flash_attention(q, k, v, *, axis_name: str = "r",
-                         scale: float = None, causal: bool = False):
+                         scale: float = None, causal: bool = False,
+                         fused: bool = None):
     """Shard-level fused ring attention (call inside shard_map).
 
     q, k, v: (heads, seq_local, head_dim) — this rank's sequence block.
@@ -228,6 +250,14 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
     Differentiable: the forward runs the fused Pallas kernel; the
     backward recomputes through the equivalent lax ring schedule
     (flash-style rematerialization) via custom_vjp.
+
+    ``fused``: None (default) auto-detects — the Pallas kernel's LOGICAL
+    device ids only lower on single-axis meshes, so multi-axis meshes
+    (e.g. ('dp','sp')) take the equivalent lax ring schedule (same math
+    and gradients, compiler-scheduled overlap instead of in-kernel DMA).
+    Callers that know their mesh shape should pass it explicitly
+    (``make_ring_flash_attention`` does); forcing ``fused=True`` on a
+    multi-axis mesh fails at Mosaic lowering time.
     """
     import jax
 
@@ -237,16 +267,9 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
     h, s_local, d = q.shape
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
-    # pallas remote DMA with LOGICAL device ids supports single-named-
-    # axis meshes only; under a multi-axis mesh (e.g. ('dp','sp')) run
-    # the equivalent lax ring schedule — same math and gradients,
-    # compiler-scheduled overlap instead of in-kernel DMA
-    try:
-        from jax._src.core import get_axis_env
-        multi_axis = len(get_axis_env().axis_sizes) > 1
-    except Exception:  # noqa: BLE001 - private API drift: assume 1-axis
-        multi_axis = False
-    if multi_axis:
+    if fused is None:
+        fused = not _mesh_multi_axis()
+    if not fused:
         return _xla_ring_shard(q, k, v, int(n), float(scale),
                                bool(causal), axis_name)
     fused = _build(int(n), h, s_local, d, str(q.dtype), float(scale),
@@ -268,15 +291,11 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
         return vjp(g)
 
     attn.defvjp(fwd, bwd)
-    try:
-        return attn(q, k, v)
-    except NotImplementedError:
-        # pallas remote DMA with LOGICAL device ids supports single-named-
-        # axis meshes only; on multi-axis meshes (e.g. ('dp','sp')) fall
-        # back to the equivalent lax ring schedule — same math, compiler-
-        # scheduled overlap instead of in-kernel DMA
-        return _xla_ring_shard(q, k, v, int(n), float(scale),
-                               bool(causal), axis_name)
+    # NOTE: no try/except fallback here — if the multi_axis probe above
+    # ever mis-detects (private-API drift), Mosaic raises its
+    # NotImplementedError at jit LOWERING time, outside this trace-time
+    # frame, so a try around attn() could never catch it anyway
+    return attn(q, k, v)
 
 
 def make_ring_flash_attention(mesh, *, causal: bool = False,
@@ -289,8 +308,11 @@ def make_ring_flash_attention(mesh, *, causal: bool = False,
     from .utils.jaxshim import shard_map_compat
 
     def body(q, k, v):
+        # the mesh is known here: choose the path explicitly instead of
+        # relying on the trace-time probe
         return ring_flash_attention(q, k, v, axis_name=axis, scale=scale,
-                                    causal=causal)
+                                    causal=causal,
+                                    fused=len(mesh.axis_names) == 1)
 
     return jax.jit(shard_map_compat(
         body, mesh, (P(None, axis, None),) * 3, P(None, axis, None)))

@@ -132,6 +132,8 @@ def _neighbor_barrier(n: int, axis: str):
     import jax
     from jax.experimental.pallas import tpu as pltpu
 
+    if n == 1:
+        return                      # no neighbors; self-signal is noise
     me = jax.lax.axis_index(axis)
     left = jax.lax.rem(me - 1 + n, n)
     right = jax.lax.rem(me + 1, n)
@@ -253,6 +255,8 @@ def _all_rank_barrier(n: int, axis: str):
     import jax
     from jax.experimental.pallas import tpu as pltpu
 
+    if n == 1:
+        return                      # no peers; self-signal is noise
     me = jax.lax.axis_index(axis)
     barrier = pltpu.get_barrier_semaphore()
     for d in range(1, n):
@@ -355,11 +359,14 @@ def build_alltoall_program(mesh, n: int, nd, count: int):
     blk = padded // n
 
     def scratch(dtype):
+        # n==1 degenerates to the local block move; zero-sized VMEM /
+        # semaphore arrays do not lower on real hardware, so keep the
+        # (unused) scratch at minimum size 1
         return [
             # single-use slots: n-1 send + n-1 recv blocks, flat
-            pltpu.VMEM((2 * (n - 1) * blk,), dtype),
-            pltpu.SemaphoreType.DMA((n - 1,)),
-            pltpu.SemaphoreType.DMA((n - 1,)),
+            pltpu.VMEM((max(1, 2 * (n - 1) * blk),), dtype),
+            pltpu.SemaphoreType.DMA((max(1, n - 1),)),
+            pltpu.SemaphoreType.DMA((max(1, n - 1),)),
         ]
 
     return _build_vmem_kernel_program(
