@@ -175,7 +175,8 @@ class TestRingDmaRealChip:
 
     @pytest.mark.parametrize("family", [
         "ring_allreduce", "ring_allgather", "ring_reduce_scatter",
-        "bcast", "hbm_allreduce", "alltoall"])
+        "bcast", "hbm_allreduce", "hbm_allgather", "hbm_reduce_scatter",
+        "alltoall"])
     def test_compiles_on_tpu(self, family):
         tpus = self._tpus()
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -196,6 +197,11 @@ class TestRingDmaRealChip:
                                                     4096),
             "hbm_allreduce": lambda: rd.build_hbm_allreduce_program(
                 mesh, n, ReductionOp.SUM, f32, rd.CHUNK_ELEMS * 2),
+            "hbm_allgather": lambda: rd.build_hbm_allgather_program(
+                mesh, n, f32, rd.CHUNK_ELEMS * 2),
+            "hbm_reduce_scatter": lambda:
+                rd.build_hbm_reduce_scatter_program(
+                    mesh, n, ReductionOp.SUM, f32, rd.CHUNK_ELEMS * 2 * n),
             "alltoall": lambda: rd.build_alltoall_program(mesh, n, f32,
                                                           128 * n),
         }[family]
@@ -358,6 +364,57 @@ class TestRingDmaHbmChunked:
             range(1, n + 1))
         np.testing.assert_allclose(out.reshape(n, padded),
                                    np.tile(expect, (n, 1)))
+
+    def test_hbm_allgather_multi_chunk_padding(self, monkeypatch):
+        """HBM allgather with a count that is NOT a chunk multiple: the
+        per-block padding circulates through the ring and is sliced off
+        in the program body (end-padding would interleave garbage)."""
+        import ucc_tpu.tl.ring_dma as rd
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        monkeypatch.setattr(rd, "CHUNK_ELEMS", 64)
+        n, count = 4, 150                      # 3 chunks of 64, pad 42
+        mesh = jax.make_mesh((n,), ("r",))
+        prog, padded = rd.build_hbm_allgather_program(
+            mesh, n, np.dtype(np.float32), count)
+        assert padded == 192 and padded != count
+        srcs = [np.arange(count, dtype=np.float32) * (r + 1)
+                for r in range(n)]
+        shards = [jax.device_put(
+            jnp.pad(jnp.asarray(srcs[r]), (0, padded - count)),
+            jax.devices()[r]) for r in range(n)]
+        garr = jax.make_array_from_single_device_arrays(
+            (n * padded,), NamedSharding(mesh, P("r")), shards)
+        out = np.asarray(jax.block_until_ready(prog(garr)))
+        np.testing.assert_array_equal(out, np.concatenate(srcs))
+
+    def test_hbm_reduce_scatter_multi_chunk_padding(self, monkeypatch):
+        """HBM reduce_scatter with per-rank blocks that are NOT a chunk
+        multiple: the program re-pads PER BLOCK so boundaries align."""
+        import ucc_tpu.tl.ring_dma as rd
+        from ucc_tpu.constants import ReductionOp as R
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        monkeypatch.setattr(rd, "CHUNK_ELEMS", 64)
+        n = 4
+        blk0 = 40                              # cblk=16 -> blk_tot=48
+        count = n * blk0
+        mesh = jax.make_mesh((n,), ("r",))
+        prog, padded = rd.build_hbm_reduce_scatter_program(
+            mesh, n, R.SUM, np.dtype(np.float32), count)
+        assert padded == n * 48 and padded != count
+        srcs = [np.arange(count, dtype=np.float32) * (r + 1)
+                for r in range(n)]
+        shards = [jax.device_put(
+            jnp.pad(jnp.asarray(srcs[r]), (0, padded - count)),
+            jax.devices()[r]) for r in range(n)]
+        garr = jax.make_array_from_single_device_arrays(
+            (n * padded,), NamedSharding(mesh, P("r")), shards)
+        out = np.asarray(jax.block_until_ready(prog(garr)))
+        full = np.sum(srcs, axis=0)
+        blk_tot = padded // n
+        for r in range(n):
+            np.testing.assert_allclose(
+                out[r * blk_tot:r * blk_tot + blk0],
+                full[r * blk0:(r + 1) * blk0])
 
     def test_large_count_selects_hbm_path(self, job, teams):
         """Counts beyond one VMEM pass route through the HBM builder via

@@ -12,10 +12,12 @@ written in the kernel, and semaphores are the completion protocol (the
 QP/doorbell analog).
 
 Algorithms: ring allreduce (reduce-scatter phase + allgather phase,
-2*(n-1) block steps; large vectors run the HBM-resident grid kernel with
-double-buffered HBM<->VMEM staging — the sliding-window role, no element
-cap beyond HBM), ring allgather, ring reduce_scatter, and pipelined ring
-bcast (the tl/mlx5 mcast role). Selectable via ``UCC_TL_RING_DMA_TUNE``
+2*(n-1) block steps), ring allgather, ring reduce_scatter, pairwise
+alltoall, and pipelined ring bcast (the tl/mlx5 mcast role). Allreduce,
+allgather and reduce_scatter have NO element cap beyond HBM: vectors
+larger than one VMEM pass run HBM-resident grid kernels with
+double-buffered HBM<->VMEM staging overlapping the ring DMAs inside the
+kernel schedule (the sliding-window role). Selectable via ``UCC_TL_RING_DMA_TUNE``
 or by boosting the TL score; default score sits below TL/XLA so
 compiler-scheduled collectives stay the default.
 
@@ -438,17 +440,77 @@ def _bcast_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem, *,
             out_ref[pl.ds(s * blk, blk)] = comm_ref[rs]
 
 
+def _hbm_chunk_schedule(g, n_chunks, fetch_copies, flush_copy, ring_pass):
+    """The shared double-buffer schedule of the HBM-resident grid
+    kernels (allreduce, reduce_scatter): stage chunk g into a VMEM work
+    slot, run the ring pass, flush the result back — with chunk g+1's
+    HBM->VMEM fetch started BEFORE g's ring pass so the local DMA
+    overlaps the remote ones (double buffering written into the kernel
+    schedule, not left to XLA).
+
+    ``fetch_copies(chunk, slot)`` / ``flush_copy(chunk, slot)`` return
+    the (lists of) async-copy objects for staging chunk->work[slot] and
+    work[slot]->out; reconstructing the same copy is how a start is
+    waited later. ``ring_pass(slot)`` runs the ring steps in-place on
+    work[slot]. Drain invariants owned here: a work slot is never
+    prefetch-overwritten while its flush is in flight, and at most one
+    write-back is outstanding (the two flush slots never alias)."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    buf = jax.lax.rem(g, 2)
+    nxt = jax.lax.rem(g + 1, 2)
+
+    @pl.when(g == 0)
+    def _():
+        # prologue: blocking fetch of chunk 0
+        for c in fetch_copies(0, 0):
+            c.start()
+        for c in fetch_copies(0, 0):
+            c.wait()
+
+    @pl.when(jax.numpy.logical_and(g > 0, g + 1 < n_chunks))
+    def _():
+        # work[nxt] is about to be prefetch-overwritten, but chunk g-1's
+        # FLUSH still reads from it — drain that flush first (the race
+        # is invisible in interpret mode, where DMAs are synchronous)
+        flush_copy(g - 1, nxt).wait()
+
+    @pl.when(g + 1 < n_chunks)
+    def _():
+        # prefetch chunk g+1 while this chunk's ring runs
+        for c in fetch_copies(g + 1, nxt):
+            c.start()
+
+    ring_pass(buf)
+
+    # drain the previous flush when no prefetch did it (final chunk)
+    @pl.when(jax.numpy.logical_and(g > 0, g + 1 >= n_chunks))
+    def _():
+        flush_copy(g - 1, nxt).wait()
+
+    flush = flush_copy(g, buf)
+    flush.start()
+
+    @pl.when(g + 1 >= n_chunks)
+    def _():
+        flush.wait()                   # epilogue: drain the last flush
+
+    @pl.when(g + 1 < n_chunks)
+    def _():
+        # the next grid step reads work[nxt]: its fetch must land
+        for c in fetch_copies(g + 1, nxt):
+            c.wait()
+
+
 def _hbm_allreduce_kernel(local_ref, out_ref, work_ref, comm_ref,
                           fetch_sem, flush_sem, send_sem, recv_sem, *,
                           n: int, blk: int, n_chunks: int,
                           op, axis: str = "r", barrier: bool = False):
     """HBM-resident ring allreduce, one grid step per chunk (the
     sliding-window role, allreduce_sliding_window.h:30-50): the full
-    vector never leaves HBM; each grid step stages chunk g into a VMEM
-    work buffer, runs the 2(n-1)-step ring pass, and flushes the result
-    back — with chunk g+1's HBM->VMEM fetch started BEFORE g's ring pass
-    so the local DMA overlaps the remote ones (double buffering written
-    into the kernel schedule, not left to XLA).
+    vector never leaves HBM; the _hbm_chunk_schedule double buffering
+    stages each chunk through VMEM around the 2(n-1)-step ring pass.
 
     Slot safety across chunks: each chunk runs exactly 2(n-1) ring steps
     (even), so the 2-slot parity restarts aligned at every chunk boundary
@@ -460,72 +522,32 @@ def _hbm_allreduce_kernel(local_ref, out_ref, work_ref, comm_ref,
 
     g = pl.program_id(0)
     csize = n * blk                    # chunk elements (rank-blocked)
-    buf = jax.lax.rem(g, 2)
-    nxt = jax.lax.rem(g + 1, 2)
 
     if barrier:
         @pl.when(g == 0)
         def _():
             _neighbor_barrier(n, axis)
 
-    @pl.when(g == 0)
-    def _():
-        # prologue: blocking fetch of chunk 0
-        dma = pltpu.make_async_copy(
-            local_ref.at[pl.ds(0, csize)], work_ref.at[0],
-            fetch_sem.at[0])
-        dma.start()
-        dma.wait()
+    def fetch_copies(chunk, slot):
+        return [pltpu.make_async_copy(
+            local_ref.at[pl.ds(chunk * csize, csize)],
+            work_ref.at[slot], fetch_sem.at[slot])]
 
-    @pl.when(jax.numpy.logical_and(g > 0, g + 1 < n_chunks))
-    def _():
-        # work_ref[nxt] is about to be prefetch-overwritten, but chunk
-        # g-1's FLUSH still reads from it — drain that flush first (the
-        # race is invisible in interpret mode, where DMAs are synchronous)
-        pltpu.make_async_copy(
-            work_ref.at[nxt],
-            out_ref.at[pl.ds((g - 1) * csize, csize)],
-            flush_sem.at[nxt]).wait()
+    def flush_copy(chunk, slot):
+        return pltpu.make_async_copy(
+            work_ref.at[slot], out_ref.at[pl.ds(chunk * csize, csize)],
+            flush_sem.at[slot])
 
-    @pl.when(g + 1 < n_chunks)
-    def _():
-        # prefetch chunk g+1 while this chunk's ring runs
-        pltpu.make_async_copy(
-            local_ref.at[pl.ds((g + 1) * csize, csize)],
-            work_ref.at[nxt], fetch_sem.at[nxt]).start()
-
-    work = work_ref.at[buf]
     acc = _accum(op)
     me = jax.lax.axis_index(axis)
     right = jax.lax.rem(me + 1, n)
     step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right)
-    _ring_reduce_steps(work, comm_ref, step_dma, n=n, blk=blk, me=me,
-                       acc=acc, mode="allreduce")
 
-    # drain the previous flush when no prefetch did it (final chunk) so
-    # the two flush slots never alias (one outstanding write-back max)
-    @pl.when(jax.numpy.logical_and(g > 0, g + 1 >= n_chunks))
-    def _():
-        pltpu.make_async_copy(
-            work_ref.at[nxt],
-            out_ref.at[pl.ds((g - 1) * csize, csize)],
-            flush_sem.at[nxt]).wait()
+    def ring_pass(slot):
+        _ring_reduce_steps(work_ref.at[slot], comm_ref, step_dma, n=n,
+                           blk=blk, me=me, acc=acc, mode="allreduce")
 
-    flush = pltpu.make_async_copy(
-        work_ref.at[buf], out_ref.at[pl.ds(g * csize, csize)],
-        flush_sem.at[buf])
-    flush.start()
-
-    @pl.when(g + 1 >= n_chunks)
-    def _():
-        flush.wait()                   # epilogue: drain the last flush
-
-    @pl.when(g + 1 < n_chunks)
-    def _():
-        # the next grid step reuses work_ref[nxt]: its fetch must land
-        pltpu.make_async_copy(
-            local_ref.at[pl.ds((g + 1) * csize, csize)],
-            work_ref.at[nxt], fetch_sem.at[nxt]).wait()
+    _hbm_chunk_schedule(g, n_chunks, fetch_copies, flush_copy, ring_pass)
 
 
 def build_hbm_allreduce_program(mesh, n: int, op, nd, count: int):
@@ -572,6 +594,259 @@ def build_hbm_allreduce_program(mesh, n: int, op, nd, count: int):
             scratch_shapes=[
                 pltpu.VMEM((2, csize), x.dtype),      # work (dbl-buffered)
                 pltpu.VMEM((2, blk), x.dtype),        # ring comm slots
+                pltpu.SemaphoreType.DMA((2,)),        # fetch
+                pltpu.SemaphoreType.DMA((2,)),        # flush
+                pltpu.SemaphoreType.DMA((2,)),        # ring send
+                pltpu.SemaphoreType.DMA((2,)),        # ring recv
+            ],
+            interpret=interpret,
+            **kw,
+        )(x)
+        if op == ReductionOp.AVG:
+            out = (out / n).astype(out.dtype)
+        return out
+
+    program = jax.jit(shard_map_compat(body, mesh, P("r"), P("r")))
+    return program, padded
+
+
+def _hbm_allgather_kernel(local_ref, out_ref, comm_ref, stage_ref,
+                          fetch_sem, myout_sem, flush_sem, send_sem,
+                          recv_sem, *, n: int, csize: int, padded: int,
+                          axis: str = "r", barrier: bool = False):
+    """HBM-resident ring allgather, one grid step per chunk of the LOCAL
+    block (no element cap beyond HBM): chunk g of every rank's block
+    circulates the ring in n-1 remote-DMA steps; each arriving block is
+    consumed with a SYNCHRONOUS copy into a dedicated staging buffer
+    (the same consumption semantics the VMEM ring kernel's out_ref store
+    has — an async read of the comm slot would race the upstream
+    neighbor's next write into it, which no local drain can order), then
+    flushed staging->HBM while the ring keeps moving.
+
+    Slot parity restarts at 0 every chunk on EVERY rank — neighbors only
+    need to AGREE on the slot schedule, so a uniform restart is safe for
+    any n (no even-step requirement like the allreduce kernel). The
+    staging buffer is purely local (no remote writes land in it): its
+    reuse drain below is complete protection for the async flushes.
+    """
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    g = pl.program_id(0)
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, n)
+
+    if barrier:
+        @pl.when(g == 0)
+        def _():
+            _neighbor_barrier(n, axis)
+
+    def src_dev(s):
+        return jax.lax.rem(me - s - 1 + n + n, n)
+
+    def flush_copy(slot, s):
+        return pltpu.make_async_copy(
+            stage_ref.at[slot],
+            out_ref.at[pl.ds(src_dev(s) * padded + g * csize, csize)],
+            flush_sem.at[slot])
+
+    # stage my chunk into this chunk's first send slot, and start my own
+    # block's HBM->HBM copy into the output (overlaps the whole ring)
+    fetch = pltpu.make_async_copy(
+        local_ref.at[pl.ds(g * csize, csize)], comm_ref.at[0], fetch_sem)
+    fetch.start()
+    myout = pltpu.make_async_copy(
+        local_ref.at[pl.ds(g * csize, csize)],
+        out_ref.at[pl.ds(me * padded + g * csize, csize)], myout_sem)
+    myout.start()
+    fetch.wait()
+
+    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right)
+    for s in range(n - 1):
+        # the block to forward already sits in the send slot (it is last
+        # step's recv slot); s == 0 sends the fetched slot 0
+        rs = step_dma(s)
+        f = s % 2
+        if s >= 2:
+            # staging slot f is still the source of the flush issued at
+            # s-2 — drain it before the synchronous overwrite below
+            flush_copy(f, s - 2).wait()
+        stage_ref[f] = comm_ref[rs]        # sync consume of the recv slot
+        flush_copy(f, s).start()
+
+    # chunk boundary: drain every outstanding flush (issued at the last
+    # one or two steps) + my own block's copy, so the next chunk starts
+    # with the staging and output regions quiescent
+    for s in range(max(0, n - 3), n - 1):
+        flush_copy(s % 2, s).wait()
+    myout.wait()
+
+
+def build_hbm_allgather_program(mesh, n: int, nd, count: int):
+    """shard_map-wrapped HBM-resident chunked ring allgather. count =
+    per-rank block elements. Returns (jitted program, padded per-rank
+    count); global out is (n * padded,), replicated."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.jaxshim import shard_map_compat
+
+    interpret = jax.devices()[0].platform == "cpu"
+
+    count0 = max(count, 1)
+    csize = min(CHUNK_ELEMS, count0)
+    padded = count0
+    if padded % csize:
+        padded += csize - padded % csize
+    n_chunks = padded // csize
+
+    cp = _compiler_params(collective_id=4)
+    if cp is None:
+        _warn_no_barrier()
+    kernel = functools.partial(
+        _hbm_allgather_kernel, n=n, csize=csize, padded=padded,
+        barrier=not interpret and cp is not None)
+
+    def body(x):
+        # the launch path END-pads the per-rank shard to `padded`; the
+        # kernel circulates whole padded blocks, so the gathered output
+        # has padding interleaved per block — sliced off below
+        if x.size != padded:
+            x = jnp.pad(x, (0, padded - x.size))
+        kw = {"compiler_params": cp} if cp is not None and not interpret \
+            else {}
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_chunks,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_shape=jax.ShapeDtypeStruct((n * padded,), x.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((2, csize), x.dtype),      # ring comm slots
+                pltpu.VMEM((2, csize), x.dtype),      # flush staging
+                pltpu.SemaphoreType.DMA,              # fetch
+                pltpu.SemaphoreType.DMA,              # my-block copy
+                pltpu.SemaphoreType.DMA((2,)),        # flush (per slot)
+                pltpu.SemaphoreType.DMA((2,)),        # ring send
+                pltpu.SemaphoreType.DMA((2,)),        # ring recv
+            ],
+            interpret=interpret,
+            **kw,
+        )(x)
+        if padded != count0:
+            out = out.reshape(n, padded)[:, :count0].reshape(-1)
+        return out
+
+    program = jax.jit(shard_map_compat(body, mesh, P("r"), P(None)))
+    return program, padded
+
+
+def _hbm_reduce_scatter_kernel(local_ref, out_ref, work_ref, comm_ref,
+                               fetch_sem, flush_sem, send_sem, recv_sem,
+                               *, n: int, cblk: int, n_chunks: int,
+                               blk_tot: int, op, axis: str = "r",
+                               barrier: bool = False):
+    """HBM-resident ring reduce_scatter (no element cap beyond HBM):
+    the per-rank input is n rank-blocks of ``blk_tot``; grid step g
+    covers the SAME ``cblk``-sized sub-range of every rank-block (a
+    valid smaller reduce_scatter), staged into VMEM with n strided
+    fetches, reduced around the ring in n-1 steps, and the owned block
+    flushed back — with chunk g+1's fetches started before g's ring
+    pass (double buffering, mirroring the HBM allreduce kernel).
+
+    Slot parity restarts per chunk uniformly (see the allgather kernel's
+    note: neighbors only need to agree on the schedule)."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    g = pl.program_id(0)
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, n)
+
+    if barrier:
+        @pl.when(g == 0)
+        def _():
+            _neighbor_barrier(n, axis)
+
+    def fetch_copies(chunk, slot):
+        # strided: the same cblk sub-range of each of the n rank-blocks
+        return [pltpu.make_async_copy(
+            local_ref.at[pl.ds(i * blk_tot + chunk * cblk, cblk)],
+            work_ref.at[slot, pl.ds(i * cblk, cblk)],
+            fetch_sem.at[slot]) for i in range(n)]
+
+    def flush_copy(chunk, slot):
+        # only my owned block of the chunk flushes back
+        return pltpu.make_async_copy(
+            work_ref.at[slot, pl.ds(me * cblk, cblk)],
+            out_ref.at[pl.ds(chunk * cblk, cblk)],
+            flush_sem.at[slot])
+
+    acc = _accum(op)
+    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right)
+
+    def ring_pass(slot):
+        _ring_reduce_steps(work_ref.at[slot], comm_ref, step_dma, n=n,
+                           blk=cblk, me=me, acc=acc,
+                           mode="reduce_scatter")
+
+    _hbm_chunk_schedule(g, n_chunks, fetch_copies, flush_copy, ring_pass)
+
+
+def build_hbm_reduce_scatter_program(mesh, n: int, op, nd, count: int):
+    """shard_map-wrapped HBM-resident chunked ring reduce_scatter.
+    count = per-rank TOTAL input elements (n rank-blocks). Returns
+    (jitted program, padded per-rank count)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.jaxshim import shard_map_compat
+
+    interpret = jax.devices()[0].platform == "cpu"
+
+    count0 = max(count, 1)
+    blk0 = count0 // n                 # caller enforces count % n == 0
+    cblk = min(max(1, CHUNK_ELEMS // n), max(blk0, 1))
+    blk_tot = max(blk0, 1)
+    if blk_tot % cblk:
+        blk_tot += cblk - blk_tot % cblk
+    n_chunks = blk_tot // cblk
+    padded = n * blk_tot
+
+    cp = _compiler_params(collective_id=5)
+    if cp is None:
+        _warn_no_barrier()
+    kernel = functools.partial(
+        _hbm_reduce_scatter_kernel, n=n, cblk=cblk, n_chunks=n_chunks,
+        blk_tot=blk_tot, op=op,
+        barrier=not interpret and cp is not None)
+
+    def body(x):
+        # the launch path END-pads the flat (n * blk0) shard; the kernel
+        # wants n rank-blocks of blk_tot — re-pad PER BLOCK so block
+        # boundaries stay aligned
+        if blk_tot != blk0:
+            x = jnp.pad(x[:count0].reshape(n, max(blk0, 1)),
+                        ((0, 0), (0, blk_tot - max(blk0, 1)))).reshape(-1)
+        kw = {"compiler_params": cp} if cp is not None and not interpret \
+            else {}
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_chunks,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_shape=jax.ShapeDtypeStruct((blk_tot,), x.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((2, n * cblk), x.dtype),   # work (dbl-buffered)
+                pltpu.VMEM((2, cblk), x.dtype),       # ring comm slots
                 pltpu.SemaphoreType.DMA((2,)),        # fetch
                 pltpu.SemaphoreType.DMA((2,)),        # flush
                 pltpu.SemaphoreType.DMA((2,)),        # ring send
@@ -674,54 +949,15 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
             **kw,
         )(x)
 
-    # chunk plan (mode-dependent slicing, VMEM-sized pieces):
-    # - allreduce: the vector is not rank-blocked — flat contiguous
-    #   pieces, each a multiple of n (ring granularity); out = concat.
-    # - reduce_scatter: slice the SAME sub-range of every rank-block so
-    #   each piece is a valid smaller reduce_scatter; out = concat of my
-    #   sub-blocks.
-    # - allgather: flat pieces of my block; gathered sub-blocks re-
-    #   interleave per source rank.
-    def _split(total, max_c):
-        out = []
-        off = 0
-        while off < total:
-            c = min(max_c, total - off)
-            out.append((off, c))
-            off += c
-        return out
-
-    if mode == "allreduce":
-        # large allreduces use the HBM-resident grid kernel instead
-        # (build_hbm_allreduce_program); this path only sees counts that
-        # fit one VMEM pass
-        max_c = _vmem_pass_elems(n)
-        chunks = _split(padded, max_c)
-    elif mode == "reduce_scatter":
-        chunks = _split(blk0, max(1, CHUNK_ELEMS // n))
-    else:
-        # allgather's per-pass VMEM out is n*blk — bound blk accordingly
-        chunks = _split(blk0, max(1, CHUNK_ELEMS // n))
-
+    # counts beyond one VMEM pass never reach this builder: the task
+    # routes them to the HBM-resident grid kernels
+    # (build_hbm_{allreduce,allgather,reduce_scatter}_program), which
+    # keep the vector in HBM and double-buffer the staging inside the
+    # kernel schedule instead of unrolling pallas_calls
     def body(x):
         if mode != "allgather" and x.size != padded:
             x = jnp.pad(x, (0, padded - x.size))
-        if len(chunks) == 1:
-            out = one_pass(x, blk0)
-        elif mode == "allreduce":
-            out = jnp.concatenate(
-                [one_pass(x[o:o + c], c // n) for o, c in chunks])
-        elif mode == "reduce_scatter":
-            xb = x.reshape(n, blk0)
-            out = jnp.concatenate(
-                [one_pass(xb[:, o:o + c].reshape(n * c), c)
-                 for o, c in chunks])
-        else:
-            parts = [one_pass(x[o:o + c], c) for o, c in chunks]
-            # part p holds n gathered sub-blocks; re-interleave by source
-            out = jnp.concatenate(
-                [jnp.concatenate([p.reshape(n, -1)[i] for p in parts])
-                 for i in range(n)])
+        out = one_pass(x, blk0)
         if op == ReductionOp.AVG and mode in ("allreduce",
                                               "reduce_scatter"):
             out = (out / n).astype(out.dtype)
@@ -760,14 +996,6 @@ class RingDmaCollTask(XlaCollTask):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            f"tl/ring_dma {self.coll} count {total} "
                            f"exceeds the VMEM bound {CHUNK_ELEMS}")
-        if self.coll in (CollType.ALLGATHER, CollType.REDUCE_SCATTER) \
-                and total > (1 << 27):
-            # program-level chunking unrolls one pallas_call per chunk;
-            # beyond this the unrolled program is pathological — only
-            # ALLREDUCE has the HBM-resident grid kernel so far
-            raise UccError(Status.ERR_NOT_SUPPORTED,
-                           f"tl/ring_dma {self.coll} count {total} "
-                           f"exceeds the chunked bound {1 << 27}")
         if self.coll == CollType.REDUCE_SCATTER:
             # the ring delivers per-rank shards; a non-divisible total
             # would need the near-equal remainder convention — defer to
@@ -799,6 +1027,16 @@ class RingDmaCollTask(XlaCollTask):
                 count > _vmem_pass_elems(n):
             # larger than one VMEM pass: HBM-resident grid kernel
             program, padded = build_hbm_allreduce_program(
+                shared.mesh, n, op, self.np_dtype, count)
+        elif self.coll == CollType.ALLGATHER and \
+                count > max(1, CHUNK_ELEMS // n):
+            # per-pass VMEM out is n*blk: beyond one pass, the HBM-
+            # resident grid kernel (no element cap beyond HBM)
+            program, padded = build_hbm_allgather_program(
+                shared.mesh, n, self.np_dtype, count)
+        elif self.coll == CollType.REDUCE_SCATTER and \
+                count > _vmem_pass_elems(n):
+            program, padded = build_hbm_reduce_scatter_program(
                 shared.mesh, n, op, self.np_dtype, count)
         else:
             program, padded = build_ring_program(
