@@ -133,18 +133,27 @@ def capture_artifacts():
         f"(already done: {[k for k, v in state.items() if v is True]})")
 
     if not _exhausted(state, "bench"):
-        rc, out = run_sub([sys.executable, "bench.py"], timeout=1200)
+        rc, out = run_sub([sys.executable, "bench.py"], timeout=1200,
+                          env={"UCC_BENCH_NO_FALLBACK": "1"})
         if rc == 0 and out.strip():
             line = out.strip().splitlines()[-1]
             try:
                 rec = json.loads(line)
-                rec["captured_by"] = "tools/tpu_probe.py"
-                rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
-                with open(os.path.join(REPO, "BENCH_TPU_r03.json"),
-                          "w") as f:
-                    json.dump(rec, f, indent=1)
-                log(f"CAPTURE: bench ok -> BENCH_TPU_r03.json {line}")
-                state["bench"] = True
+                # bench.py can fall back to the CPU mesh and still exit
+                # 0 — a record without platform=tpu is NOT chip evidence
+                if rec.get("detail", {}).get("platform") != "tpu":
+                    log("CAPTURE: bench record not from tpu "
+                        f"(platform={rec.get('detail', {}).get('platform')})"
+                        " — rejected")
+                else:
+                    rec["captured_by"] = "tools/tpu_probe.py"
+                    rec["captured_at"] = time.strftime(
+                        "%Y-%m-%dT%H:%M:%S%z")
+                    with open(os.path.join(REPO, "BENCH_TPU_r03.json"),
+                              "w") as f:
+                        json.dump(rec, f, indent=1)
+                    log(f"CAPTURE: bench ok -> BENCH_TPU_r03.json {line}")
+                    state["bench"] = True
             except ValueError:
                 log(f"CAPTURE: bench output unparseable: {line[:200]}")
         else:
@@ -199,9 +208,14 @@ def capture_artifacts():
     if not _exhausted(state, "sweep"):
         # full size sweep on the real chip (each size is a fresh program
         # compile, so this is the longest capture — run it LAST; a wedge
-        # mid-sweep still leaves the earlier artifacts)
+        # mid-sweep still leaves the earlier artifacts). NO_FALLBACK +
+        # a matched inner budget: the CPU rerun would be rejected below
+        # anyway, and without the override bench's own 900s child cap
+        # would kill a slow-compiling real-chip sweep early
         rc, out = run_sub([sys.executable, "bench.py", "--sweep"],
-                          timeout=1800)
+                          timeout=1800,
+                          env={"UCC_BENCH_NO_FALLBACK": "1",
+                               "UCC_BENCH_TIMEOUT": "1740"})
         lines = []
         for ln in (out or "").strip().splitlines():
             try:

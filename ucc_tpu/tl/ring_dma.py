@@ -23,15 +23,16 @@ compiler-scheduled collectives stay the default.
 
 Compiled kernels open with a ring-neighbor barrier-semaphore handshake
 (collective_id'd) so a remote DMA cannot land before the peer kernel owns
-its comm slots; interpret mode skips it (no barrier model). The compiled
-ICI path still needs real-chip validation (the standing hardware gate,
-tests/test_ring_dma.py real-chip test). KNOWN PROTOCOL LIMIT pending that
-validation: the 2-slot schedule bounds neighbor skew only via the entry
-barrier + per-step send/recv waits; a rank stalling 2+ steps (preemption,
-grid skew) could have its unread slot overwritten by an upstream sender.
-``fused_attention.py`` adds the consumer-ack throttle that closes this
-(acks flow left, data flows right); port it here once real-chip runs can
-validate the semaphore traffic.
+its comm slots, and every ring-schedule kernel runs the CONSUMER-ACK
+THROTTLE (ported from ``fused_attention.py``): before each step's DMA the
+sender waits one consumption ack from its right neighbor, closing the
+2-slot protocol's skew hole (a rank running 2+ steps ahead can no longer
+overwrite an unread slot; acks flow left while data flows right, so no
+wait cycle). The pairwise alltoall needs neither (single-use slots).
+Interpret mode skips both (no semaphore model there). The compiled ICI
+path still needs real-chip validation (the standing hardware gate,
+tests/test_ring_dma.py::TestRingDmaRealChip, parametrized per kernel
+family).
 
 Kernels run compiled on real TPU meshes and in Pallas interpret mode on
 the virtual CPU mesh (tests); the rendezvous/dispatch machinery is shared
@@ -146,18 +147,44 @@ def _neighbor_barrier(n: int, axis: str):
     pltpu.semaphore_wait(barrier, 2)
 
 
-def _make_step_dma(comm_ref, send_sem, recv_sem, right):
+def _make_step_dma(comm_ref, send_sem, recv_sem, right, *, ack=None):
     """The correctness-critical slot protocol, shared by every ring
     kernel: copy the outgoing block into the send slot, start the remote
     DMA into the right neighbor's recv slot, wait both semaphores (send
     drained + left neighbor's block arrived). Slots alternate by global
     step parity, so the slot being overwritten at step t is exactly the
-    one whose send completed at t-1."""
+    one whose send completed at t-1.
+
+    ``ack`` (compiled path only) closes the protocol's skew hole: the
+    2-slot parity argument tolerates ONE step of neighbor skew but is
+    not self-enforcing — a rank running 2+ steps ahead (preemption, grid
+    skew) would overwrite a slot its right neighbor has not consumed.
+    ack = (ack_sem, left, wait_pred, signal_pred): before step t's DMA
+    the sender waits one consumption ack from its RIGHT neighbor
+    (certifying right finished step t-1: send drained + recv consumed),
+    and after step t's rdma.wait it acks its LEFT neighbor. Acks flow
+    left while data flows right, so there is no wait cycle within a
+    step; wait_pred/signal_pred(t) -> bool | traced bool make the first
+    step wait-free and the last step signal-free so the REGULAR
+    semaphore drains to zero at kernel exit (grid kernels pass traced
+    predicates spanning chunk boundaries). Ported from the fused ring
+    attention kernel's consumer-ack throttle (fused_attention.py)."""
+    from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    def _guarded(pred, fn):
+        if pred is True:
+            fn()
+        elif pred is not False:
+            pl.when(pred)(fn)
 
     def step_dma(t: int, send_block_getter=None):
         send_slot = t % 2
         recv_slot = (t + 1) % 2
+        if ack is not None:
+            ack_sem, _left, wait_pred, _sig = ack
+            _guarded(wait_pred(t),
+                     lambda: pltpu.semaphore_wait(ack_sem, 1))
         if send_block_getter is not None:
             comm_ref[send_slot] = send_block_getter()
         rdma = pltpu.make_async_remote_copy(
@@ -170,9 +197,30 @@ def _make_step_dma(comm_ref, send_sem, recv_sem, right):
         )
         rdma.start()
         rdma.wait()
+        if ack is not None:
+            ack_sem, left, _wait, sig_pred = ack
+            _guarded(sig_pred(t), lambda: pltpu.semaphore_signal(
+                ack_sem, inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL))
         return recv_slot
 
     return step_dma
+
+
+def _ack_boundary_signal(ack_sem, left, pred):
+    """Cross-chunk consumer ack for the HBM grid kernels: emitted AFTER
+    the chunk's final recv slot is consumed (the in-step signal fires
+    inside step_dma before the caller's consumption, which would let the
+    left neighbor's next-chunk step-0 DMA race the final staging copy —
+    for odd steps-per-chunk the boundary write targets exactly that
+    slot). sig_pred therefore statically suppresses the last in-step
+    signal and this helper supplies the balancing one."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    pl.when(pred)(lambda: pltpu.semaphore_signal(
+        ack_sem, inc=1, device_id=left,
+        device_id_type=pltpu.DeviceIdType.LOGICAL))
 
 
 def _ring_reduce_steps(work, comm_ref, step_dma, *, n, blk, me, acc,
@@ -209,7 +257,7 @@ def _ring_reduce_steps(work, comm_ref, step_dma, *, n, blk, me, acc,
 
 
 def _ring_kernel(local_ref, out_ref, work_ref, comm_ref, send_sem,
-                 recv_sem, *, n: int, blk: int, op, mode: str,
+                 recv_sem, ack_sem, *, n: int, blk: int, op, mode: str,
                  axis: str = "r", barrier: bool = False):
     """One kernel body for the three VMEM-resident ring collectives.
 
@@ -223,10 +271,15 @@ def _ring_kernel(local_ref, out_ref, work_ref, comm_ref, send_sem,
 
     me = jax.lax.axis_index(axis)
     right = jax.lax.rem(me + 1, n)
+    left = jax.lax.rem(me - 1 + n, n)
     acc = _accum(op) if op is not None else None
     if barrier:
         _neighbor_barrier(n, axis)
-    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right)
+    n_steps = 2 * (n - 1) if mode == "allreduce" else n - 1
+    ack = (ack_sem, left, lambda t: t >= 1,
+           lambda t: t <= n_steps - 2) if barrier else None
+    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right,
+                              ack=ack)
 
     if mode == "allgather":
         out_ref[pl.ds(me * blk, blk)] = local_ref[:]
@@ -378,8 +431,8 @@ def build_alltoall_program(mesh, n: int, nd, count: int):
         padded, scratch, collective_id=3, out_spec=P("r"))
 
 
-def _bcast_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem, *,
-                  n: int, blk: int, nsub: int, root: int,
+def _bcast_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem,
+                  ack_sem, *, n: int, blk: int, nsub: int, root: int,
                   axis: str = "r", barrier: bool = False):
     """Ring-pipelined bcast — the tl/mlx5 mcast role
     (/root/reference/src/components/tl/mlx5/mcast/): the root streams
@@ -400,6 +453,7 @@ def _bcast_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem, *,
 
     me = jax.lax.axis_index(axis)
     right = jax.lax.rem(me + 1, n)
+    left = jax.lax.rem(me - 1 + n, n)
     dist = jax.lax.rem(me - root + n, n)
     is_root = dist == 0
     if barrier:
@@ -409,9 +463,14 @@ def _bcast_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem, *,
     def _():
         out_ref[:] = local_ref[:]
 
-    for t in range(nsub + n - 2):
+    n_steps = nsub + n - 2
+    for t in range(n_steps):
         send_slot = t % 2
         recv_slot = (t + 1) % 2
+        if barrier and t >= 1:
+            # consumer-ack throttle (see _make_step_dma): my step-t DMA
+            # overwrites the slot my right neighbor consumed at t-1
+            pltpu.semaphore_wait(ack_sem, 1)
 
         @pl.when(is_root)
         def _(t=t, s=send_slot):
@@ -428,6 +487,11 @@ def _bcast_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem, *,
         )
         rdma.start()
         rdma.wait()
+        if barrier and t <= n_steps - 2:
+            # signals balance the waits; drains to zero at kernel exit
+            pltpu.semaphore_signal(
+                ack_sem, inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
 
         s_idx = t - (dist - 1)         # traced: per-rank arrival index
         valid = jnp.logical_and(dist > 0,
@@ -504,8 +568,8 @@ def _hbm_chunk_schedule(g, n_chunks, fetch_copies, flush_copy, ring_pass):
 
 
 def _hbm_allreduce_kernel(local_ref, out_ref, work_ref, comm_ref,
-                          fetch_sem, flush_sem, send_sem, recv_sem, *,
-                          n: int, blk: int, n_chunks: int,
+                          fetch_sem, flush_sem, send_sem, recv_sem,
+                          ack_sem, *, n: int, blk: int, n_chunks: int,
                           op, axis: str = "r", barrier: bool = False):
     """HBM-resident ring allreduce, one grid step per chunk (the
     sliding-window role, allreduce_sliding_window.h:30-50): the full
@@ -541,11 +605,25 @@ def _hbm_allreduce_kernel(local_ref, out_ref, work_ref, comm_ref,
     acc = _accum(op)
     me = jax.lax.axis_index(axis)
     right = jax.lax.rem(me + 1, n)
-    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right)
+    left = jax.lax.rem(me - 1 + n, n)
+    # the ack throttle spans CHUNK boundaries (a rank racing into chunk
+    # g+1 step 0 overwrites a slot its right neighbor is still on in
+    # chunk g): chunk step 0 waits only past the first chunk, the last
+    # in-step signal is statically suppressed, and the balancing
+    # cross-chunk signal is emitted after the final recv consumption
+    # (_ack_boundary_signal) — counts balance, semaphore drains to zero
+    n_steps = 2 * (n - 1)
+    ack = (ack_sem, left,
+           lambda t: True if t >= 1 else (g > 0),
+           lambda t: t <= n_steps - 2) if barrier else None
+    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right,
+                              ack=ack)
 
     def ring_pass(slot):
         _ring_reduce_steps(work_ref.at[slot], comm_ref, step_dma, n=n,
                            blk=blk, me=me, acc=acc, mode="allreduce")
+        if ack is not None and n > 1:
+            _ack_boundary_signal(ack_sem, left, g + 1 < n_chunks)
 
     _hbm_chunk_schedule(g, n_chunks, fetch_copies, flush_copy, ring_pass)
 
@@ -598,6 +676,7 @@ def build_hbm_allreduce_program(mesh, n: int, op, nd, count: int):
                 pltpu.SemaphoreType.DMA((2,)),        # flush
                 pltpu.SemaphoreType.DMA((2,)),        # ring send
                 pltpu.SemaphoreType.DMA((2,)),        # ring recv
+                pltpu.SemaphoreType.REGULAR,          # consumption acks
             ],
             interpret=interpret,
             **kw,
@@ -612,7 +691,8 @@ def build_hbm_allreduce_program(mesh, n: int, op, nd, count: int):
 
 def _hbm_allgather_kernel(local_ref, out_ref, comm_ref, stage_ref,
                           fetch_sem, myout_sem, flush_sem, send_sem,
-                          recv_sem, *, n: int, csize: int, padded: int,
+                          recv_sem, ack_sem, *, n: int, csize: int,
+                          padded: int, n_chunks: int,
                           axis: str = "r", barrier: bool = False):
     """HBM-resident ring allgather, one grid step per chunk of the LOCAL
     block (no element cap beyond HBM): chunk g of every rank's block
@@ -662,7 +742,12 @@ def _hbm_allgather_kernel(local_ref, out_ref, comm_ref, stage_ref,
     myout.start()
     fetch.wait()
 
-    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right)
+    left = jax.lax.rem(me - 1 + n, n)
+    ack = (ack_sem, left,
+           lambda t: True if t >= 1 else (g > 0),
+           lambda t: t <= n - 3) if barrier else None
+    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right,
+                              ack=ack)
     for s in range(n - 1):
         # the block to forward already sits in the send slot (it is last
         # step's recv slot); s == 0 sends the fetched slot 0
@@ -673,6 +758,11 @@ def _hbm_allgather_kernel(local_ref, out_ref, comm_ref, stage_ref,
             # s-2 — drain it before the synchronous overwrite below
             flush_copy(f, s - 2).wait()
         stage_ref[f] = comm_ref[rs]        # sync consume of the recv slot
+        if ack is not None and s == n - 2:
+            # cross-chunk ack only AFTER the final recv is staged (see
+            # _ack_boundary_signal: the in-step signal would race the
+            # left neighbor's next-chunk step-0 write into this slot)
+            _ack_boundary_signal(ack_sem, left, g + 1 < n_chunks)
         flush_copy(f, s).start()
 
     # chunk boundary: drain every outstanding flush (issued at the last
@@ -709,7 +799,7 @@ def build_hbm_allgather_program(mesh, n: int, nd, count: int):
         _warn_no_barrier()
     kernel = functools.partial(
         _hbm_allgather_kernel, n=n, csize=csize, padded=padded,
-        barrier=not interpret and cp is not None)
+        n_chunks=n_chunks, barrier=not interpret and cp is not None)
 
     def body(x):
         # the launch path END-pads the per-rank shard to `padded`; the
@@ -733,6 +823,7 @@ def build_hbm_allgather_program(mesh, n: int, nd, count: int):
                 pltpu.SemaphoreType.DMA((2,)),        # flush (per slot)
                 pltpu.SemaphoreType.DMA((2,)),        # ring send
                 pltpu.SemaphoreType.DMA((2,)),        # ring recv
+                pltpu.SemaphoreType.REGULAR,          # consumption acks
             ],
             interpret=interpret,
             **kw,
@@ -747,9 +838,9 @@ def build_hbm_allgather_program(mesh, n: int, nd, count: int):
 
 def _hbm_reduce_scatter_kernel(local_ref, out_ref, work_ref, comm_ref,
                                fetch_sem, flush_sem, send_sem, recv_sem,
-                               *, n: int, cblk: int, n_chunks: int,
-                               blk_tot: int, op, axis: str = "r",
-                               barrier: bool = False):
+                               ack_sem, *, n: int, cblk: int,
+                               n_chunks: int, blk_tot: int, op,
+                               axis: str = "r", barrier: bool = False):
     """HBM-resident ring reduce_scatter (no element cap beyond HBM):
     the per-rank input is n rank-blocks of ``blk_tot``; grid step g
     covers the SAME ``cblk``-sized sub-range of every rank-block (a
@@ -788,12 +879,21 @@ def _hbm_reduce_scatter_kernel(local_ref, out_ref, work_ref, comm_ref,
             flush_sem.at[slot])
 
     acc = _accum(op)
-    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right)
+    left = jax.lax.rem(me - 1 + n, n)
+    ack = (ack_sem, left,
+           lambda t: True if t >= 1 else (g > 0),
+           lambda t: t <= n - 3) if barrier else None
+    step_dma = _make_step_dma(comm_ref, send_sem, recv_sem, right,
+                              ack=ack)
 
     def ring_pass(slot):
         _ring_reduce_steps(work_ref.at[slot], comm_ref, step_dma, n=n,
                            blk=cblk, me=me, acc=acc,
                            mode="reduce_scatter")
+        if ack is not None and n > 1:
+            # cross-chunk ack AFTER the final recv's accumulate inside
+            # _ring_reduce_steps (see _ack_boundary_signal)
+            _ack_boundary_signal(ack_sem, left, g + 1 < n_chunks)
 
     _hbm_chunk_schedule(g, n_chunks, fetch_copies, flush_copy, ring_pass)
 
@@ -851,6 +951,7 @@ def build_hbm_reduce_scatter_program(mesh, n: int, op, nd, count: int):
                 pltpu.SemaphoreType.DMA((2,)),        # flush
                 pltpu.SemaphoreType.DMA((2,)),        # ring send
                 pltpu.SemaphoreType.DMA((2,)),        # ring recv
+                pltpu.SemaphoreType.REGULAR,          # consumption acks
             ],
             interpret=interpret,
             **kw,
@@ -881,6 +982,7 @@ def build_bcast_program(mesh, n: int, root: int, nd, count: int):
             pltpu.VMEM((2, blk), dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,       # consumption acks
         ]
 
     return _build_vmem_kernel_program(
@@ -944,6 +1046,7 @@ def build_ring_program(mesh, n: int, coll: CollType, op, nd, count: int):
                 pltpu.VMEM((2, blk), x.dtype),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,       # consumption acks
             ],
             interpret=interpret,
             **kw,
