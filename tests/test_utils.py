@@ -273,6 +273,31 @@ class TestPerftestModes:
         finally:
             clean()
 
+    def test_executor_op_benches(self, capsys):
+        """-c memcpy/reducedt/reducedt_strided: the EC executor-op
+        benchmarks (ucc_pt_op_{memcpy,reduce,reduce_strided}.cc) on both
+        memory types, incl. the nbufs cap."""
+        from ucc_tpu.tools.perftest import main
+        assert main(["-c", "memcpy", "-b", "8", "-e", "16", "-n", "2",
+                     "-w", "1", "-F"]) == 0
+        assert main(["-c", "memcpy", "-b", "8", "-e", "8", "-n", "2",
+                     "-w", "1", "--nbufs", "3"]) == 0
+        assert main(["-c", "reducedt", "-b", "8", "-e", "8", "-n", "2",
+                     "-w", "1", "--nbufs", "4", "-o", "max"]) == 0
+        assert main(["-c", "reducedt_strided", "-b", "8", "-e", "8",
+                     "-n", "2", "-w", "1"]) == 0
+        assert main(["-c", "reducedt", "-b", "8", "-e", "8", "-n", "1",
+                     "-w", "0", "-m", "tpu"]) == 0
+        out = capsys.readouterr().out
+        assert "memcpy" in out and "reducedt" in out
+        for bad in (["-c", "reducedt", "--nbufs", "10"],
+                    ["-c", "reducedt", "--nbufs", "1"],
+                    ["-c", "memcpy", "--nbufs", "8"],
+                    ["-c", "memcpy", "--nbufs", "-1"],
+                    ["-c", "memcpy", "-n", "0"]):
+            with pytest.raises(SystemExit):
+                main(bad)
+
 
 class TestInfoScoreMapRows:
     """Pin the live `ucc_info -s` rows the judge verifies: every round-3

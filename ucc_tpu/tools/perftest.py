@@ -33,6 +33,9 @@ from ucc_tpu.constants import coll_type_str, dt_numpy, dt_size
 from ucc_tpu.utils.config import memunits_str, parse_memunits
 
 COLLS = {coll_type_str(c): c for c in CollType}
+#: executor-op benchmarks (ucc_pt_config.h:55-57 MEMCPY/REDUCEDT/
+#: REDUCEDT_STRIDED): time the EC component directly, no team involved
+OP_BENCHES = ("memcpy", "reducedt", "reducedt_strided")
 _TRAFFIC_MATRIX = None
 
 
@@ -182,6 +185,114 @@ def make_args(coll: CollType, rank: int, n: int, count: int, dt: DataType,
         return CollArgs(coll_type=coll, op=op, src=buf(count * n),
                         dst=outv([count] * n), flags=flags)
     raise SystemExit(f"perftest: coll {coll_type_str(coll)} not wired")
+
+
+def run_op_bench(args) -> int:
+    """Executor-op benchmark path (ucc_pt_op_{memcpy,reduce,
+    reduce_strided}.cc): times the EC component's copy/reduce tasks
+    directly — no team, no transport. BW formulas match the reference:
+    memcpy 2*S/t (read+write); reduce (nbufs+1)*S/t (nbufs reads + one
+    write)."""
+    from ..ec.base import EXECUTOR_NUM_BUFS, create_executor
+
+    # ucc_ec_base.h:83 UCC_EE_EXECUTOR_MULTI_OP_NUM_BUFS
+    MULTI_OP_NUM_BUFS = 7
+
+    dt = DTS[args.dtype]
+    op = OPS[args.op]
+    mem = MemoryType.parse(args.mem)
+    esz = dt_size(dt)
+    nd = dt_numpy(dt)
+    if args.iters < 1:
+        raise SystemExit("perftest: -n must be >= 1")
+    nbufs = args.nbufs or (1 if args.coll == "memcpy" else 2)
+    if args.coll == "memcpy":
+        # copy_multi's vector cap (ucc_ec_base.h:83) is 7, tighter than
+        # the 9-source reduce cap
+        if not 1 <= nbufs <= MULTI_OP_NUM_BUFS:
+            raise SystemExit("perftest: memcpy needs 1 <= nbufs <= "
+                             f"{MULTI_OP_NUM_BUFS}")
+    elif not 2 <= nbufs <= EXECUTOR_NUM_BUFS:
+        raise SystemExit("perftest: reducedt needs 2 <= nbufs <= "
+                         f"{EXECUTOR_NUM_BUFS}")
+
+    if mem == MemoryType.TPU:
+        from ..utils.jaxshim import ensure_live_backend
+        ensure_live_backend(virtual_cpu_devices=1)
+        import jax
+        import jax.numpy as jnp
+    ec = create_executor(mem)
+
+    def alloc(count):
+        if mem == MemoryType.TPU:
+            return jnp.ones((count,), jnp.dtype(nd.str)
+                            if nd.name != "bfloat16" else jnp.bfloat16)
+        return np.ones(count, nd)
+
+    def block(task):
+        if mem == MemoryType.TPU:
+            import jax
+            jax.block_until_ready(task.array)
+
+    print(f"# ucc_perftest: {args.coll} {args.dtype}"
+          + (f" {args.op}" if args.coll != "memcpy" else "")
+          + f" mem={args.mem} nbufs={nbufs}")
+    hdr = f"{'count':>12} {'size':>10} {'time avg(us)':>14} " \
+          f"{'min(us)':>10} {'max(us)':>10}"
+    if args.full:
+        hdr += f" {'bw(GB/s)':>10}"
+    print(hdr)
+
+    size = max(parse_memunits(args.begin), esz)
+    bmax = parse_memunits(args.end)
+    while size <= bmax:
+        count = max(1, size // esz)
+        nbytes = count * esz
+        if args.coll == "memcpy":
+            srcs = [alloc(count) for _ in range(nbufs)]
+            dsts = [alloc(count) for _ in range(nbufs)]
+
+            def round_fn():
+                if nbufs == 1:
+                    return ec.copy(dsts[0], srcs[0], nbytes)
+                return ec.copy_multi(list(zip(dsts, srcs,
+                                              [nbytes] * nbufs)))
+            # reference sums ALL copy_multi vectors before the x2
+            # read+write factor (ucc_pt_op_memcpy.cc get_bw)
+            factor = 2.0 * nbufs
+        elif args.coll == "reducedt":
+            srcs = [alloc(count) for _ in range(nbufs)]
+            dst = alloc(count)
+
+            def round_fn():
+                return ec.reduce(dst, srcs, count, dt, op)
+            factor = float(nbufs + 1)
+        else:                                    # reducedt_strided
+            src1 = alloc(count)
+            base = alloc(count * (nbufs - 1))
+            dst = alloc(count)
+
+            def round_fn():
+                return ec.reduce_strided(dst, src1, base, nbytes,
+                                         nbufs - 1, count, dt, op)
+            factor = float(nbufs + 1)
+
+        lats = []
+        for i in range(args.warmup + args.iters):
+            t0 = time.perf_counter()
+            block(round_fn())
+            t1 = time.perf_counter()
+            if i >= args.warmup:
+                lats.append(t1 - t0)
+        avg = sum(lats) / len(lats)
+        line = f"{count:>12} {memunits_str(nbytes):>10} " \
+               f"{avg * 1e6:>14.2f} {min(lats) * 1e6:>10.2f} " \
+               f"{max(lats) * 1e6:>10.2f}"
+        if args.full:
+            line += f" {factor * nbytes / avg / 1e9:>10.3f}"
+        print(line)
+        size *= 2
+    return 0
 
 
 def _wait_reqs(job, reqs) -> None:
@@ -372,7 +483,8 @@ class StoreJob:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ucc_perftest")
-    p.add_argument("-c", "--coll", default="allreduce", choices=sorted(COLLS))
+    p.add_argument("-c", "--coll", default="allreduce",
+                   choices=sorted(COLLS) + list(OP_BENCHES))
     p.add_argument("-b", "--begin", default="8", help="min size (bytes)")
     p.add_argument("-e", "--end", default="1M", help="max size (bytes)")
     p.add_argument("-n", "--iters", type=int, default=20)
@@ -405,11 +517,19 @@ def main(argv=None) -> int:
                    help="post through execution engines (triggered-post "
                         "lifecycle, ucc_pt_benchmark.cc:217-246; "
                         "in-process jobs only)")
+    p.add_argument("--nbufs", type=int, default=0,
+                   help="buffer count for the executor-op benchmarks "
+                        "(memcpy/reducedt/reducedt_strided; default 1 "
+                        "copy / 2 reduce sources; caps 7 copy / 9 "
+                        "reduce, ucc_ec_base.h)")
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--store", default="", help="host:port for multi-process")
     p.add_argument("--rank", type=int, default=0)
     p.add_argument("--np", type=int, dest="world", default=1)
     args = p.parse_args(argv)
+
+    if args.coll in OP_BENCHES:
+        return run_op_bench(args)
 
     global _TRAFFIC_MATRIX
     coll = COLLS[args.coll]
