@@ -1,0 +1,117 @@
+"""Team-shape sweep — the reference gtest strategy of one big in-process
+job with teams of many sizes including ODD ones (test_ucc.h:209-211:
+16-rank UccJob, teams {1,2,8,11,16}), plus root rotation for rooted colls
+(test/mpi/main.cc:60). Odd sizes (5, 11) stress the knomial extra-rank,
+DBT remainder, and ring non-divisible paths that power-of-two teams never
+reach."""
+import numpy as np
+import pytest
+
+from ucc_tpu import (BufferInfo, BufferInfoV, CollArgs, CollType, DataType,
+                     MemoryType, ReductionOp)
+
+from harness import UccJob
+
+N = 16
+
+# group-rank subsets of the 16-rank job, one per reference shape (5 added:
+# a second odd size below the knomial radix default)
+SHAPES = {
+    1: [7],
+    2: [3, 12],
+    5: [0, 2, 4, 6, 8],
+    8: list(range(8, 16)),
+    11: list(range(11)),
+    16: list(range(16)),
+}
+
+
+@pytest.fixture(scope="module")
+def job():
+    j = UccJob(N)
+    yield j
+    j.cleanup()
+
+
+@pytest.fixture(scope="module")
+def teams_by_size(job):
+    return {size: job.create_team(ranks) for size, ranks in SHAPES.items()}
+
+
+def host_buf(arr, dt=DataType.FLOAT32):
+    a = np.ascontiguousarray(arr)
+    return BufferInfo(a, a.size, dt, mem_type=MemoryType.HOST), a
+
+
+@pytest.mark.parametrize("size", sorted(SHAPES))
+class TestTeamShapes:
+    def test_allreduce(self, teams_by_size, job, size):
+        teams = teams_by_size[size]
+        count = 129                      # odd count: remainder paths too
+        srcs = [np.arange(count, dtype=np.float32) * (r + 1)
+                for r in range(size)]
+        argses = []
+        for r in range(size):
+            src, _ = host_buf(srcs[r])
+            dst, darr = host_buf(np.zeros(count, np.float32))
+            argses.append((CollArgs(coll_type=CollType.ALLREDUCE, src=src,
+                                    dst=dst, op=ReductionOp.SUM), darr))
+        job.run_coll(teams, lambda r: argses[r][0])
+        expect = np.sum(srcs, axis=0)
+        for r in range(size):
+            np.testing.assert_allclose(argses[r][1], expect)
+
+    def test_bcast_root_rotation(self, teams_by_size, job, size):
+        teams = teams_by_size[size]
+        count = 65
+        for root in sorted({0, size // 2, size - 1}):
+            data = np.arange(count, dtype=np.float32) * (root + 3)
+            argses = []
+            for r in range(size):
+                buf, arr = host_buf(data.copy() if r == root
+                                    else np.zeros(count, np.float32))
+                argses.append((CollArgs(coll_type=CollType.BCAST, src=buf,
+                                        root=root), arr))
+            job.run_coll(teams, lambda r: argses[r][0])
+            for r in range(size):
+                np.testing.assert_array_equal(argses[r][1], data,
+                                              err_msg=f"root={root}")
+
+    def test_reduce_root_rotation(self, teams_by_size, job, size):
+        teams = teams_by_size[size]
+        count = 33
+        srcs = [np.full(count, float(r + 1), np.float32)
+                for r in range(size)]
+        for root in sorted({0, size - 1}):
+            argses = []
+            for r in range(size):
+                src, _ = host_buf(srcs[r])
+                dst, darr = host_buf(np.zeros(count, np.float32))
+                argses.append((CollArgs(coll_type=CollType.REDUCE, src=src,
+                                        dst=dst, op=ReductionOp.SUM,
+                                        root=root), darr))
+            job.run_coll(teams, lambda r: argses[r][0])
+            np.testing.assert_allclose(argses[root][1],
+                                       np.sum(srcs, axis=0),
+                                       err_msg=f"root={root}")
+
+    def test_allgatherv(self, teams_by_size, job, size):
+        """Uneven per-rank counts: v-coll displacement handling at every
+        shape."""
+        teams = teams_by_size[size]
+        counts = [(r % 3) + 1 for r in range(size)]
+        total = sum(counts)
+        srcs = [np.full(counts[r], float(r + 1), np.float32)
+                for r in range(size)]
+        argses = []
+        for r in range(size):
+            src, _ = host_buf(srcs[r])
+            darr = np.zeros(total, np.float32)
+            dst = BufferInfoV(darr, [int(c) for c in counts], None,
+                              DataType.FLOAT32, mem_type=MemoryType.HOST)
+            argses.append((CollArgs(coll_type=CollType.ALLGATHERV,
+                                    src=src, dst=dst), darr))
+        job.run_coll(teams, lambda r: argses[r][0])
+        expect = np.concatenate(srcs)
+        for r in range(size):
+            np.testing.assert_array_equal(argses[r][1], expect)
