@@ -21,7 +21,17 @@ from typing import Any, Dict, List, Optional, Sequence
 from ..constants import DataType, MemoryType, ReductionOp
 from ..status import Status, UccError
 
-EXECUTOR_NUM_BUFS = 9   # ucc_ec_base.h: UCC_EE_EXECUTOR_NUM_BUFS
+EXECUTOR_NUM_BUFS = 9    # ucc_ec_base.h: UCC_EE_EXECUTOR_NUM_BUFS
+MULTI_OP_NUM_BUFS = 7    # ucc_ec_base.h:83 UCC_EE_EXECUTOR_MULTI_OP_NUM_BUFS
+
+
+def check_multi_op_bufs(n: int) -> None:
+    """copy_multi/reduce_multi_dst vector cap shared by every executor
+    (the reference sizes the fixed arg arrays to 7 entries)."""
+    if n > MULTI_OP_NUM_BUFS:
+        raise UccError(Status.ERR_INVALID_PARAM,
+                       f"multi-op takes at most {MULTI_OP_NUM_BUFS} "
+                       "vectors")
 
 
 class ExecutorTaskType(enum.IntEnum):

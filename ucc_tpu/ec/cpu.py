@@ -20,6 +20,7 @@ import numpy as np
 from ..constants import DataType, ReductionOp, dt_numpy, dt_size
 from ..status import Status, UccError
 from .base import (EXECUTOR_NUM_BUFS, Executor, ExecutorTask,
+                   check_multi_op_bufs,
                    ExecutorTaskType)
 
 _LOGICAL = (ReductionOp.LAND, ReductionOp.LOR, ReductionOp.LXOR)
@@ -161,6 +162,7 @@ class EcCpu(Executor):
         return ExecutorTask(ExecutorTaskType.REDUCE_STRIDED, Status.OK)
 
     def reduce_multi_dst(self, jobs) -> ExecutorTask:
+        check_multi_op_bufs(len(jobs))
         for j in jobs:
             self.reduce(j["dst"], [j["src1"], j["src2"]], j["count"],
                         j["dt"], j["op"], j.get("alpha"))
@@ -172,6 +174,7 @@ class EcCpu(Executor):
         return ExecutorTask(ExecutorTaskType.COPY, Status.OK)
 
     def copy_multi(self, pairs) -> ExecutorTask:
+        check_multi_op_bufs(len(pairs))
         for dst, src, nb in pairs:
             self.copy(dst, src, nb)
         return ExecutorTask(ExecutorTaskType.COPY_MULTI, Status.OK)

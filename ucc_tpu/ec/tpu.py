@@ -29,6 +29,7 @@ import numpy as np
 from ..constants import DataType, MemoryType, ReductionOp, dt_numpy
 from ..status import Status, UccError
 from .base import (EXECUTOR_NUM_BUFS, Executor, ExecutorTask,
+                   check_multi_op_bufs,
                    ExecutorTaskType, register_ec)
 
 _LANE = 128
@@ -198,6 +199,7 @@ class EcTpu(Executor):
         return t
 
     def reduce_multi_dst(self, jobs) -> ExecutorTask:
+        check_multi_op_bufs(len(jobs))
         arrays = []
         for j in jobs:
             t = self.reduce(j.get("dst"), [j["src1"], j["src2"]], j["count"],
@@ -235,6 +237,7 @@ class EcTpu(Executor):
         return task
 
     def copy_multi(self, pairs) -> ExecutorTask:
+        check_multi_op_bufs(len(pairs))
         task = ExecutorTask(ExecutorTaskType.COPY_MULTI, Status.IN_PROGRESS)
         task.array = [self._copy_one(d, s, n) for d, s, n in pairs]
         return task

@@ -193,10 +193,8 @@ def run_op_bench(args) -> int:
     directly — no team, no transport. BW formulas match the reference:
     memcpy 2*S/t (read+write); reduce (nbufs+1)*S/t (nbufs reads + one
     write)."""
-    from ..ec.base import EXECUTOR_NUM_BUFS, create_executor
-
-    # ucc_ec_base.h:83 UCC_EE_EXECUTOR_MULTI_OP_NUM_BUFS
-    MULTI_OP_NUM_BUFS = 7
+    from ..ec.base import (EXECUTOR_NUM_BUFS, MULTI_OP_NUM_BUFS,
+                           create_executor)
 
     dt = DTS[args.dtype]
     op = OPS[args.op]
@@ -205,7 +203,10 @@ def run_op_bench(args) -> int:
     nd = dt_numpy(dt)
     if args.iters < 1:
         raise SystemExit("perftest: -n must be >= 1")
-    nbufs = args.nbufs or (1 if args.coll == "memcpy" else 2)
+    if args.warmup < 0:
+        raise SystemExit("perftest: -w must be >= 0")
+    nbufs = args.nbufs if args.nbufs is not None else \
+        (1 if args.coll == "memcpy" else 2)
     if args.coll == "memcpy":
         # copy_multi's vector cap (ucc_ec_base.h:83) is 7, tighter than
         # the 9-source reduce cap
@@ -517,7 +518,7 @@ def main(argv=None) -> int:
                    help="post through execution engines (triggered-post "
                         "lifecycle, ucc_pt_benchmark.cc:217-246; "
                         "in-process jobs only)")
-    p.add_argument("--nbufs", type=int, default=0,
+    p.add_argument("--nbufs", type=int, default=None,
                    help="buffer count for the executor-op benchmarks "
                         "(memcpy/reducedt/reducedt_strided; default 1 "
                         "copy / 2 reduce sources; caps 7 copy / 9 "
