@@ -201,10 +201,6 @@ def run_op_bench(args) -> int:
     mem = MemoryType.parse(args.mem)
     esz = dt_size(dt)
     nd = dt_numpy(dt)
-    if args.iters < 1:
-        raise SystemExit("perftest: -n must be >= 1")
-    if args.warmup < 0:
-        raise SystemExit("perftest: -w must be >= 0")
     nbufs = args.nbufs if args.nbufs is not None else \
         (1 if args.coll == "memcpy" else 2)
     if args.coll == "memcpy":
@@ -528,6 +524,14 @@ def main(argv=None) -> int:
     p.add_argument("--rank", type=int, default=0)
     p.add_argument("--np", type=int, dest="world", default=1)
     args = p.parse_args(argv)
+
+    # shared across the collective and executor-op paths: negative
+    # warmup skews the timed-round bookkeeping silently, zero iters
+    # divides by zero
+    if args.iters < 1:
+        raise SystemExit("perftest: -n must be >= 1")
+    if args.warmup < 0:
+        raise SystemExit("perftest: -w must be >= 0")
 
     if args.coll in OP_BENCHES:
         return run_op_bench(args)
