@@ -1,23 +1,34 @@
 #!/usr/bin/env python
-"""Periodic real-TPU liveness probe + artifact auto-capture (round 3).
+"""Periodic real-TPU liveness probe + artifact auto-capture (round 4).
 
-The axon TPU tunnel has been wedged since round 2 (device discovery
-hangs inside PJRT plugin init, so any in-process ``jax.devices()`` call
-never returns).  This daemon makes the recovery attempt *evidence*:
+The axon TPU tunnel wedges for hours and comes alive for minutes-long
+windows (round 3 saw exactly two, at 10:25Z and 13:56Z).  This daemon
+makes every recovery attempt *evidence*:
 
 - every ``--interval`` seconds it spawns a throwaway subprocess that
   tries to enumerate devices and run one tiny matmul on the default
   (non-forced) platform, with a hard timeout + process-group kill;
-- every attempt is appended to ``TPU_PROBE_r03.log`` with a timestamp
+- every attempt is appended to ``TPU_PROBE_r04.log`` with a timestamp
   and outcome (``hang``/``error``/``ok platform=...``);
-- on the FIRST success it runs the real-chip capture suite:
-    * ``bench.py`` single-chip latency mode -> ``BENCH_TPU_r03.json``
-    * the ring_dma real-chip compile test (the one standing skip)
-    * the Pallas EC kernel smoke
-  and records each result in the log, then keeps probing at a lower
-  cadence so a later wedge is also visible in the history.
+- on success it runs the real-chip capture suite in INFORMATION-VALUE
+  order (round-3 verdict: the window closed before the highest-value
+  capture ran).  Round 4 order:
+    1. the 8-family ring_dma real-chip compile suite — the standing
+       unknown: the only round-3 hardware run said "2 failed, 1
+       passed" and the fix (454c1ef) was never re-validated.  On
+       failure it RETRIES ONCE immediately to split flake from
+       deterministic.  Full pytest output appends to
+       ``TPU_CAPTURE_ring_dma.log`` whatever the outcome.
+    2. the Pallas EC kernel smoke (seconds),
+    3. ``bench.py`` -> ``BENCH_TPU_r04.json`` (platform-stamped),
+    4. the short-path crossover sweep -> ``TPU_CROSSOVER_r04.json``
+       (data for the accelerator SHORT_MSG_MAX auto value),
+    5. the full size sweep -> ``BENCH_TPU_SWEEP_r04.json`` (longest).
 
-Run detached:  nohup python tools/tpu_probe.py >/dev/null 2>&1 &
+Run supervised (restarts the probe loop if it ever dies — round-3
+verdict #10: the daemon must stay armed across the whole round):
+
+    nohup python tools/tpu_probe.py --supervise >/dev/null 2>&1 &
 
 Mirrors the intent of the reference's perf capture flow
 (/root/reference/tools/perf/ucc_pt_benchmark.cc) being run on real
@@ -34,7 +45,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(REPO, "TPU_PROBE_r03.log")
+LOG = os.path.join(REPO, "TPU_PROBE_r04.log")
 
 PROBE_SRC = r"""
 import jax
@@ -124,66 +135,52 @@ def _exhausted(state, name):
     return False
 
 
+def _ring_dma_once():
+    """One run of the 8-family real-chip compile suite; returns
+    (rc, out, tail).  UCC_TPU_REAL_CHIP=1 tells tests/conftest.py NOT
+    to force the cpu platform — without it the "real chip" tests skip
+    even during a live window (that is exactly what happened on the
+    round-3 10:25 capture: rc=0 but '2 skipped')."""
+    rc, out = run_sub(
+        [sys.executable, "-m", "pytest", "tests/test_ring_dma.py",
+         "-q", "--no-header", "-k", "RealChip or compiles_on_tpu",
+         "--override-ini", "addopts="],
+        timeout=900, env={"UCC_TPU_REAL_CHIP": "1"})
+    tail = out.strip().splitlines()[-1] if out.strip() else ""
+    # chip windows are minutes long: persist the FULL output so a
+    # hardware-only failure is diagnosable after the tunnel wedges.
+    # APPEND with a header — a later wedged attempt (empty out) must
+    # not destroy the previous attempt's evidence
+    with open(os.path.join(REPO, "TPU_CAPTURE_ring_dma.log"), "a") as f:
+        f.write(f"==== attempt {time.strftime('%Y-%m-%dT%H:%M:%S%z')}"
+                f" rc={rc} ====\n{out}\n")
+    return rc, out, tail
+
+
 def capture_artifacts():
-    """Chip is alive: grab bench + ring_dma compile + EC kernel evidence.
-    Per-artifact success is persisted in TPU_PROBE_STATE.json so a daemon
-    restart after a partial capture retries only what is missing."""
+    """Chip is alive: capture in information-value order (ring_dma
+    families FIRST — the standing hardware unknown — then EC smoke,
+    bench, crossover, full sweep).  Per-artifact success is persisted
+    in TPU_PROBE_STATE.json so a daemon restart after a partial
+    capture retries only what is missing."""
     state = _load_state()
     log("CAPTURE: starting real-chip artifact capture "
         f"(already done: {[k for k, v in state.items() if v is True]})")
 
-    if not _exhausted(state, "bench"):
-        rc, out = run_sub([sys.executable, "bench.py"], timeout=1200,
-                          env={"UCC_BENCH_NO_FALLBACK": "1"})
-        if rc == 0 and out.strip():
-            line = out.strip().splitlines()[-1]
-            try:
-                rec = json.loads(line)
-                # bench.py can fall back to the CPU mesh and still exit
-                # 0 — a record without platform=tpu is NOT chip evidence
-                if rec.get("detail", {}).get("platform") != "tpu":
-                    log("CAPTURE: bench record not from tpu "
-                        f"(platform={rec.get('detail', {}).get('platform')})"
-                        " — rejected")
-                else:
-                    rec["captured_by"] = "tools/tpu_probe.py"
-                    rec["captured_at"] = time.strftime(
-                        "%Y-%m-%dT%H:%M:%S%z")
-                    with open(os.path.join(REPO, "BENCH_TPU_r03.json"),
-                              "w") as f:
-                        json.dump(rec, f, indent=1)
-                    log(f"CAPTURE: bench ok -> BENCH_TPU_r03.json {line}")
-                    state["bench"] = True
-            except ValueError:
-                log(f"CAPTURE: bench output unparseable: {line[:200]}")
-        else:
-            log(f"CAPTURE: bench failed rc={rc} "
-                f"tail={out.strip()[-200:]!r}")
-        _save_state(state)
-
     if not _exhausted(state, "ring_dma"):
-        # UCC_TPU_REAL_CHIP=1 tells tests/conftest.py NOT to force the
-        # cpu platform — without it the "real chip" tests skip even
-        # during a live window (that is exactly what happened on the
-        # 10:25 capture: rc=0 but '2 skipped').
-        rc, out = run_sub(
-            [sys.executable, "-m", "pytest", "tests/test_ring_dma.py",
-             "-q", "--no-header", "-k", "RealChip or compiles_on_tpu",
-             "--override-ini", "addopts="],
-            timeout=900, env={"UCC_TPU_REAL_CHIP": "1"})
-        tail = out.strip().splitlines()[-1] if out.strip() else ""
+        rc, out, tail = _ring_dma_once()
         log(f"CAPTURE: ring_dma real-chip test rc={rc} tail={tail!r}")
-        # chip windows are minutes long: persist the FULL output so a
-        # hardware-only failure is diagnosable after the tunnel wedges.
-        # APPEND with a header — a later wedged attempt (empty out) must
-        # not destroy the previous attempt's evidence
-        with open(os.path.join(REPO, "TPU_CAPTURE_ring_dma.log"),
-                  "a") as f:
-            f.write(f"==== attempt {time.strftime('%Y-%m-%dT%H:%M:%S%z')}"
-                    f" rc={rc} ====\n{out}\n")
         # rc==0 with everything skipped is NOT success
-        state["ring_dma"] = rc == 0 and " passed" in out \
-            and " skipped" not in tail
+        ok = rc == 0 and " passed" in out and " skipped" not in tail
+        if not ok and rc is not None:
+            # immediate one-retry in the same window: a second identical
+            # failure means deterministic, a pass means flake — either
+            # way the distinction is evidence (round-3 verdict #1)
+            log("CAPTURE: ring_dma failed — immediate same-window retry")
+            rc2, out2, tail2 = _ring_dma_once()
+            log(f"CAPTURE: ring_dma retry rc={rc2} tail={tail2!r}")
+            ok = rc2 == 0 and " passed" in out2 and " skipped" not in tail2
+        state["ring_dma"] = ok
         _save_state(state)
 
     if not _exhausted(state, "ec"):
@@ -203,6 +200,62 @@ def capture_artifacts():
         log(f"CAPTURE: EC pallas smoke rc={rc} "
             f"tail={out.strip().splitlines()[-1] if out.strip() else ''!r}")
         state["ec"] = rc == 0
+        _save_state(state)
+
+    if not _exhausted(state, "bench"):
+        rc, out = run_sub([sys.executable, "bench.py"], timeout=1200,
+                          env={"UCC_BENCH_NO_FALLBACK": "1"})
+        if rc == 0 and out.strip():
+            line = out.strip().splitlines()[-1]
+            try:
+                rec = json.loads(line)
+                # bench.py can fall back to the CPU mesh and still exit
+                # 0 — a record without platform=tpu is NOT chip evidence
+                if rec.get("detail", {}).get("platform") != "tpu":
+                    log("CAPTURE: bench record not from tpu "
+                        f"(platform={rec.get('detail', {}).get('platform')})"
+                        " — rejected")
+                else:
+                    rec["captured_by"] = "tools/tpu_probe.py"
+                    rec["captured_at"] = time.strftime(
+                        "%Y-%m-%dT%H:%M:%S%z")
+                    with open(os.path.join(REPO, "BENCH_TPU_r04.json"),
+                              "w") as f:
+                        json.dump(rec, f, indent=1)
+                    log(f"CAPTURE: bench ok -> BENCH_TPU_r04.json {line}")
+                    state["bench"] = True
+            except ValueError:
+                log(f"CAPTURE: bench output unparseable: {line[:200]}")
+        else:
+            log(f"CAPTURE: bench failed rc={rc} "
+                f"tail={out.strip()[-200:]!r}")
+        _save_state(state)
+
+    if not _exhausted(state, "crossover"):
+        # short-path crossover: where does host-staged eager actually
+        # beat compiled dispatch on a real chip?  Sets the accelerator
+        # SHORT_MSG_MAX auto value from data instead of the 4K guess
+        # (tl/xla.py _short_msg_max; round-3 verdict weak #3)
+        rc, out = run_sub(
+            [sys.executable, "tools/crossover_bench.py"], timeout=1200)
+        lines = [ln for ln in (out or "").strip().splitlines()
+                 if ln.startswith("{")]
+        rec = None
+        if lines:
+            try:
+                rec = json.loads(lines[-1])
+            except ValueError:
+                rec = None
+        if rc == 0 and rec and rec.get("platform") == "tpu":
+            with open(os.path.join(REPO, "TPU_CROSSOVER_r04.json"),
+                      "w") as f:
+                json.dump(rec, f, indent=1)
+            log("CAPTURE: crossover ok -> TPU_CROSSOVER_r04.json "
+                f"crossover_bytes={rec.get('crossover_bytes')}")
+            state["crossover"] = True
+        else:
+            log(f"CAPTURE: crossover failed rc={rc} "
+                f"tail={(out or '').strip()[-200:]!r}")
         _save_state(state)
 
     if not _exhausted(state, "sweep"):
@@ -229,12 +282,12 @@ def capture_artifacts():
         on_tpu = lines and all(
             r.get("detail", {}).get("platform") == "tpu" for r in lines)
         if rc == 0 and on_tpu:
-            with open(os.path.join(REPO, "BENCH_TPU_SWEEP_r03.json"),
+            with open(os.path.join(REPO, "BENCH_TPU_SWEEP_r04.json"),
                       "w") as f:
                 json.dump({"captured_at":
                            time.strftime("%Y-%m-%dT%H:%M:%S%z"),
                            "points": lines}, f, indent=1)
-            log(f"CAPTURE: sweep ok -> BENCH_TPU_SWEEP_r03.json "
+            log(f"CAPTURE: sweep ok -> BENCH_TPU_SWEEP_r04.json "
                 f"({len(lines)} points)")
             state["sweep"] = True
         else:
@@ -244,21 +297,43 @@ def capture_artifacts():
     log("CAPTURE: done")
     return all(state.get(k) or
                state.get(k + "_attempts", 0) >= MAX_ATTEMPTS
-               for k in ("bench", "ring_dma", "ec", "sweep"))
+               for k in ARTIFACTS)
+
+
+ARTIFACTS = ("ring_dma", "ec", "bench", "crossover", "sweep")
+
+
+def supervise(argv):
+    """Keep the probe loop armed for the whole round (round-3 verdict
+    #10: the daemon died repeatedly and live windows were nearly
+    missed).  Restart the child on ANY exit, with a short backoff."""
+    child_args = [sys.executable, os.path.abspath(__file__)] + argv
+    while True:
+        log(f"supervisor: launching probe loop {child_args[2:]}")
+        proc = subprocess.Popen(child_args, cwd=REPO,
+                                start_new_session=True)
+        rc = proc.wait()
+        log(f"supervisor: probe loop exited rc={rc}; restart in 30s")
+        time.sleep(30)
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--interval", type=float, default=900.0)
-    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--interval", type=float, default=90.0)
+    ap.add_argument("--timeout", type=float, default=90.0)
     ap.add_argument("--once", action="store_true")
+    ap.add_argument("--supervise", action="store_true")
     args = ap.parse_args()
+
+    if args.supervise:
+        supervise([a for a in sys.argv[1:] if a != "--supervise"])
+        return
 
     log(f"probe daemon start pid={os.getpid()} interval={args.interval}s "
         f"timeout={args.timeout}s")
     st = _load_state()
     captured = all(st.get(k) or st.get(k + "_attempts", 0) >= MAX_ATTEMPTS
-                   for k in ("bench", "ring_dma", "ec", "sweep"))
+                   for k in ARTIFACTS)
     while True:
         outcome, detail = probe_once(args.timeout)
         log(f"probe outcome={outcome} {detail}")
