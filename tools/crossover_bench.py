@@ -64,9 +64,9 @@ def _measure(ctxs, teams, devices, count, iters=40, warmup=4):
 
 
 def main() -> None:
+    from bench import _force_cpu_if_requested, _make_job
+    _force_cpu_if_requested()           # UCC_BENCH_CPU=1 smoke path
     import jax
-
-    from bench import _make_job
 
     devices = jax.devices()
     n = len(devices)
@@ -78,22 +78,41 @@ def main() -> None:
         ctxs, teams = _make_job(n)
         results[mode] = [
             _measure(ctxs, teams, devices, c) for c in SIZES_ELEMS]
+        # tear the mode's job down before building the next one: on a
+        # single real chip the second measurement must not share the
+        # first job's contexts/cached programs/resident buffers
+        for t in teams:
+            t.destroy()
+        for c in ctxs:
+            c.destroy()
 
-    crossover = None
     points = []
     for i, c in enumerate(SIZES_ELEMS):
-        s_us = results["short"][i] * 1e6
-        x_us = results["compiled"][i] * 1e6
-        points.append({"bytes": c * 4, "short_us": round(s_us, 2),
-                       "compiled_us": round(x_us, 2)})
-        if crossover is None and x_us < s_us:
-            crossover = c * 4
+        points.append({"bytes": c * 4,
+                       "short_us": round(results["short"][i] * 1e6, 2),
+                       "compiled_us": round(
+                           results["compiled"][i] * 1e6, 2)})
+    # the crossover must PERSIST: a single noisy compiled win below a
+    # larger short win must not set the threshold (the CPU smoke showed
+    # exactly that shape). Take the largest size where short wins; the
+    # crossover is the next swept size — compiled wins everywhere above.
+    last_short_win = None
+    for i, c in enumerate(SIZES_ELEMS):
+        if results["short"][i] < results["compiled"][i]:
+            last_short_win = i
+    if last_short_win is None:
+        crossover = SIZES_ELEMS[0] * 4     # compiled wins everywhere
+    elif last_short_win == len(SIZES_ELEMS) - 1:
+        crossover = None                   # short wins at the top size
+    else:
+        crossover = SIZES_ELEMS[last_short_win + 1] * 4
     print(json.dumps({
         "platform": plat, "n_chips": n,
         "crossover_bytes": crossover,   # None = short wins everywhere swept
         "points": points,
-        "note": "first size where compiled dispatch beats host-staged "
-                "eager; feeds the SHORT_MSG_MAX auto default"}))
+        "note": "smallest swept size above which compiled dispatch beats "
+                "host-staged eager PERSISTENTLY; feeds the SHORT_MSG_MAX "
+                "auto default"}))
 
 
 if __name__ == "__main__":
