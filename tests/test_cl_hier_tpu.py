@@ -404,3 +404,82 @@ class TestStagedPipelined:
                                            expect)
         finally:
             job.cleanup()
+
+
+class TestHierTpuSplitRail:
+    """split_rail with ON-DEVICE node stages over HBM (round-3 verdict
+    next #5; allreduce_split_rail.c:163-197): TL/XLA reduce_scatter on
+    the NODE unit, per-rail DCN allreduce on the count/ppn block only,
+    TL/XLA allgather back — every rank stages just its block, so D2H
+    traffic drops ppn-fold vs the staged wrapper."""
+
+    def _job(self, monkeypatch):
+        monkeypatch.setenv("UCC_TOPO_FAKE_PPN", "4")
+        monkeypatch.setenv("UCC_CL_HIER_TUNE",
+                           "allreduce:@split_rail_tpu:inf")
+        from harness import UccJob
+        return UccJob(N)
+
+    def test_selected_and_sum(self, monkeypatch):
+        job = self._job(monkeypatch)
+        try:
+            teams = job.create_team()
+            count = 64                      # divisible by ppn=4
+            cands = teams[0].score_map.lookup(CollType.ALLREDUCE,
+                                              MemoryType.TPU, count * 4)
+            assert cands[0].alg_name == "split_rail_tpu"
+            argses = [CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=dev_buf(job, r, np.arange(count, dtype=np.float32)
+                            + r + 1.0, DataType.FLOAT32),
+                dst=BufferInfo(None, count, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
+                op=ReductionOp.SUM) for r in range(N)]
+            job.run_coll(teams, lambda r: argses[r])
+            expect = np.arange(count, dtype=np.float32) * N + \
+                N * (N + 1) / 2
+            for r in range(N):
+                np.testing.assert_allclose(
+                    np.asarray(argses[r].dst.buffer), expect)
+        finally:
+            job.cleanup()
+
+    def test_avg_inplace(self, monkeypatch):
+        from ucc_tpu import CollArgsFlags
+        job = self._job(monkeypatch)
+        try:
+            teams = job.create_team()
+            count = 160
+            argses = [CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                dst=dev_buf(job, r, np.full(count, r + 1.0, np.float32),
+                            DataType.FLOAT32),
+                op=ReductionOp.AVG,
+                flags=CollArgsFlags.IN_PLACE) for r in range(N)]
+            job.run_coll(teams, lambda r: argses[r])
+            for r in range(N):
+                np.testing.assert_allclose(
+                    np.asarray(argses[r].dst.buffer), (N + 1) / 2)
+        finally:
+            job.cleanup()
+
+    def test_non_divisible_falls_back_staged(self, monkeypatch):
+        """count % ppn != 0 needs allgatherv over ICI — served by the
+        host split_rail under the staged wrapper, same result."""
+        job = self._job(monkeypatch)
+        try:
+            teams = job.create_team()
+            count = 66                      # not divisible by ppn=4
+            argses = [CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=dev_buf(job, r, np.full(count, r + 1.0, np.float32),
+                            DataType.FLOAT32),
+                dst=BufferInfo(None, count, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
+                op=ReductionOp.SUM) for r in range(N)]
+            job.run_coll(teams, lambda r: argses[r])
+            for r in range(N):
+                np.testing.assert_allclose(
+                    np.asarray(argses[r].dst.buffer), N * (N + 1) / 2)
+        finally:
+            job.cleanup()

@@ -1175,7 +1175,8 @@ def build_hier_scores(hier_team) -> CollScore:
     import os
 
     from ...utils.config import SIZE_INF
-    from .tpu import allreduce_rab_tpu_init, staged_init
+    from .tpu import (allreduce_rab_tpu_init, allreduce_split_rail_tpu_init,
+                      staged_init)
     s = CollScore()
     mem = MemoryType.HOST
     by_name = {}    # (coll, name) -> init fn, for the TUNE resolver
@@ -1230,6 +1231,13 @@ def build_hier_scores(hier_team) -> CollScore:
     # TLs per sbgp).
     add_tpu(CollType.ALLREDUCE, HIER_SCORE, allreduce_rab_tpu_init,
             "rab_tpu", staged=False)
+    if hier_team.sbgp(SbgpType.NET) is not None:
+        # split_rail with ON-DEVICE node stages: rail-parallel DCN on
+        # count/ppn blocks (allreduce_split_rail.c:163-197); one score
+        # below rab_tpu like the host pair, TUNE-selectable
+        add_tpu(CollType.ALLREDUCE, HIER_SCORE - 1,
+                allreduce_split_rail_tpu_init, "split_rail_tpu",
+                staged=False)
     add_tpu(CollType.BCAST, HIER_SCORE, bcast_2step_init, "2step_staged")
     add_tpu(CollType.REDUCE, HIER_SCORE, reduce_2step_init, "2step_staged")
     add_tpu(CollType.ALLGATHERV, HIER_SCORE, allgatherv_hier_init,

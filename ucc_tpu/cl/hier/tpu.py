@@ -194,6 +194,40 @@ def staged_init(init_args, hier_team, host_init_fn) -> CollTask:
     return sched
 
 
+def _dcn_allreduce_trio(sched, prev, unit, ar_dst, inner_op, read_dev,
+                        finish):
+    """The D2H -> host in-place allreduce (DCN unit team) -> finish()
+    stage trio shared by the hier HBM paths (RAB leader stage, split_rail
+    rail stage, pipelined RAB fragments). ``ar_dst`` is the HOST-memory
+    BufferInfo the DCN allreduce runs in-place on; ``read_dev()`` returns
+    the device array to stage down (read at RUN time — persistent
+    re-posts and fragment retargets rebind buffers); ``finish`` lands the
+    result (H2D + AVG scale at the caller's choosing). Returns
+    (t_ar, t_finish) so pipelined callers can retarget t_ar per fragment.
+    """
+    def d2h():
+        buf = ar_dst.buffer
+        buf[:] = np.asarray(read_dev()).reshape(-1)[:buf.size]
+
+    t_d2h = _FnTask(d2h)
+    sched.add_task(t_d2h)
+    t_d2h.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+
+    ar_args = CollArgs(coll_type=CollType.ALLREDUCE, op=inner_op,
+                       dst=ar_dst, flags=CollArgsFlags.IN_PLACE)
+    ar_args.src = ar_args.dst
+    esz = dt_numpy(ar_dst.datatype).itemsize
+    t_ar = unit.coll_init(ar_args, MemoryType.HOST,
+                          int(ar_dst.count) * esz)
+    sched.add_task(t_ar)
+    t_ar.subscribe_dep(t_d2h, EventType.EVENT_COMPLETED)
+
+    t_fin = _FnTask(finish)
+    sched.add_task(t_fin)
+    t_fin.subscribe_dep(t_ar, EventType.EVENT_COMPLETED)
+    return t_ar, t_fin
+
+
 # ---------------------------------------------------------------------------
 # allreduce RAB with on-device NODE stages
 # ---------------------------------------------------------------------------
@@ -266,22 +300,7 @@ def _rab_tpu_single(init_args, hier_team) -> CollTask:
     # stages 2-4 (leader only): D2H, leaders host allreduce over DCN, H2D
     if is_leader and leaders is not None and leaders.sbgp.is_member:
         scratch = np.zeros(count, dtype=nd)
-
-        def d2h():
-            scratch[:] = np.asarray(red_dst.buffer).reshape(-1)[:count]
-
-        t_d2h = _FnTask(d2h)
-        sched.add_task(t_d2h)
-        t_d2h.subscribe_dep(prev, EventType.EVENT_COMPLETED)
-
-        ar_args = CollArgs(coll_type=CollType.ALLREDUCE, op=inner_op,
-                           dst=BufferInfo(scratch, count, dt,
-                                          mem_type=MemoryType.HOST),
-                           flags=CollArgsFlags.IN_PLACE)
-        ar_args.src = ar_args.dst
-        t_ar = leaders.coll_init(ar_args, MemoryType.HOST, msg)
-        sched.add_task(t_ar)
-        t_ar.subscribe_dep(t_d2h, EventType.EVENT_COMPLETED)
+        ar_dst = BufferInfo(scratch, count, dt, mem_type=MemoryType.HOST)
 
         def h2d():
             buf = scratch
@@ -289,10 +308,9 @@ def _rab_tpu_single(init_args, hier_team) -> CollTask:
                 buf = (buf / team_size).astype(nd)
             red_dst.buffer = jax.device_put(buf, dev)
 
-        t_h2d = _FnTask(h2d)
-        sched.add_task(t_h2d)
-        t_h2d.subscribe_dep(t_ar, EventType.EVENT_COMPLETED)
-        prev = t_h2d
+        _, prev = _dcn_allreduce_trio(
+            sched, prev, leaders, ar_dst, inner_op,
+            lambda: red_dst.buffer, h2d)
     elif is_leader:
         # single leader in its unit (degenerate): result already reduced
         if op == ReductionOp.AVG:
@@ -317,6 +335,111 @@ def _rab_tpu_single(init_args, hier_team) -> CollTask:
     t_bc = node.coll_init(bc_args, MemoryType.TPU, msg)
     sched.add_task(t_bc)
     t_bc.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# allreduce split_rail with on-device NODE stages
+# ---------------------------------------------------------------------------
+
+def allreduce_split_rail_tpu_init(init_args, hier_team) -> CollTask:
+    """split_rail over HBM: node reduce_scatter (TL/XLA, ICI) -> my-block
+    D2H -> per-rail NET allreduce (host, DCN) on the SCATTERED BLOCK only
+    -> H2D -> node allgather (TL/XLA).
+
+    Matches allreduce_split_rail.c:163-197 with the reference's CUDA TLs
+    replaced by compiled XLA programs for the intra-node stages. Every
+    rank is its rail's leader, so each stages count/ppn elements through
+    host — a ppn-fold cut in D2H traffic vs the staged wrapper (which
+    moves the whole vector at one leader) and every ICI+DCN link busy at
+    once (round-3 verdict next #5).
+
+    Near-equal (count % ppn != 0) geometries would need allgatherv over
+    ICI; they take the host split_rail under the staged wrapper instead.
+    """
+    from .algs import split_rail_init
+
+    args = init_args.args
+    node = hier_team.sbgp(SbgpType.NODE)
+    net = hier_team.sbgp(SbgpType.NET)
+    if node is None or net is None:
+        raise UccError(Status.ERR_NOT_SUPPORTED,
+                       "split_rail requires NODE and NET units (equal ppn)")
+    count = int(args.dst.count)
+    ppn = node.sbgp.size
+    if not _node_has_xla(hier_team) or count < ppn or count % ppn:
+        return staged_init(init_args, hier_team, split_rail_init)
+    return _split_rail_tpu_single(init_args, hier_team)
+
+
+def _split_rail_tpu_single(init_args, hier_team) -> CollTask:
+    import jax
+
+    args = init_args.args
+    node = hier_team.sbgp(SbgpType.NODE)
+    net = hier_team.sbgp(SbgpType.NET)
+    count = int(args.dst.count)
+    dt = args.dst.datatype
+    nd = dt_numpy(dt)
+    esz = nd.itemsize
+    ppn = node.sbgp.size
+    blk = count // ppn
+    op = args.op if args.op is not None else ReductionOp.SUM
+    inner_op = ReductionOp.SUM if op == ReductionOp.AVG else op
+    team_size = hier_team.core_team.size
+    dev = _rank_device(hier_team, args)
+    rail_solo = net.sbgp.size <= 1
+
+    sched = Schedule(team=hier_team, args=args)
+
+    # stage 1: on-device node reduce_scatter (ICI) — my reduced block
+    rs_dst = BufferInfo(None, blk, dt, mem_type=MemoryType.TPU)
+    rs_args = CollArgs(coll_type=CollType.REDUCE_SCATTER, op=inner_op,
+                       src=args.dst if args.is_inplace else args.src,
+                       dst=rs_dst)
+    t_rs = node.coll_init(rs_args, MemoryType.TPU, count * esz)
+    sched.add_task(t_rs)
+    sched.add_dep_on_schedule_start(t_rs)
+    prev = t_rs
+
+    ag_src = BufferInfo(None, blk, dt, mem_type=MemoryType.TPU)
+
+    if not rail_solo:
+        # stages 2-4: my-block D2H -> rail allreduce over DCN -> H2D.
+        # Every rank runs these (each rank IS its rail's member), so the
+        # DCN carries count/ppn per rail, all rails concurrent.
+        scratch = np.zeros(blk, dtype=nd)
+        ar_dst = BufferInfo(scratch, blk, dt, mem_type=MemoryType.HOST)
+
+        def h2d():
+            buf = scratch
+            if op == ReductionOp.AVG:
+                buf = (buf / team_size).astype(nd)
+            ag_src.buffer = jax.device_put(buf, dev)
+
+        _, prev = _dcn_allreduce_trio(
+            sched, prev, net, ar_dst, inner_op,
+            lambda: rs_dst.buffer, h2d)
+    else:
+        # degenerate single-node rail: the reduced block is final
+        def seed():
+            buf = rs_dst.buffer
+            if op == ReductionOp.AVG:
+                buf = (buf / team_size).astype(buf.dtype)
+            ag_src.buffer = buf
+
+        t_seed = _FnTask(seed)
+        sched.add_task(t_seed)
+        t_seed.subscribe_dep(prev, EventType.EVENT_COMPLETED)
+        prev = t_seed
+
+    # stage 5: on-device node allgather (ICI) into the user's dst
+    # (TL/XLA rebinds args.dst.buffer on every node member)
+    ag_args = CollArgs(coll_type=CollType.ALLGATHER, src=ag_src,
+                       dst=args.dst)
+    t_ag = node.coll_init(ag_args, MemoryType.TPU, count * esz)
+    sched.add_task(t_ag)
+    t_ag.subscribe_dep(prev, EventType.EVENT_COMPLETED)
     return sched
 
 
@@ -400,33 +523,18 @@ def _rab_tpu_pipelined(init_args, hier_team, n_frags: int, pdepth: int,
                                 mem_type=MemoryType.HOST)
             st["ar_dst"] = ar_dst
 
-            def d2h(s=st):
-                view = scratch[s["off"]:s["off"] + s["cnt"]]
-                view[:] = np.asarray(
-                    s["red_dst"].buffer).reshape(-1)[:s["cnt"]]
-
-            t_d2h = _FnTask(d2h)
-            frag.add_task(t_d2h)
-            t_d2h.subscribe_dep(prev, EventType.EVENT_COMPLETED)
-
-            ar_args = CollArgs(coll_type=CollType.ALLREDUCE, op=inner_op,
-                               dst=ar_dst, flags=CollArgsFlags.IN_PLACE)
-            ar_args.src = ar_args.dst
-            t_ar = leaders.coll_init(ar_args, MemoryType.HOST, cnt * esz)
-            st["t_ar"] = t_ar    # host tasks capture count at init;
-            frag.add_task(t_ar)  # frag_setup retargets it per fragment
-            t_ar.subscribe_dep(t_d2h, EventType.EVENT_COMPLETED)
-
             def h2d(s=st):
-                view = scratch[s["off"]:s["off"] + s["cnt"]]
+                view = s["ar_dst"].buffer
                 if op == ReductionOp.AVG:
                     view = (view * (1.0 / team_size)).astype(nd)
                 s["bc_src"].buffer = jax.device_put(view, dev)
 
-            t_h2d = _FnTask(h2d)
-            frag.add_task(t_h2d)
-            t_h2d.subscribe_dep(t_ar, EventType.EVENT_COMPLETED)
-            prev = t_h2d
+            # shared trio; host tasks capture count at init, so
+            # frag_setup retargets st["t_ar"] per fragment
+            t_ar, prev = _dcn_allreduce_trio(
+                frag, prev, leaders, ar_dst, inner_op,
+                lambda s=st: s["red_dst"].buffer, h2d)
+            st["t_ar"] = t_ar
         elif is_leader:
             # degenerate single-node team: reduced vector is final
             def seed(s=st):

@@ -70,15 +70,65 @@ def print_algorithms() -> None:
                     print(f"  {coll_type_str(c)}: (runtime)")
 
 
-def print_scores() -> None:
-    """Default score map of a 1-rank probe team (the reference prints the
-    score map at team create; -s does it standalone)."""
-    lib = ucc_tpu.init()
-    ctx = ucc_tpu.Context(lib)
-    team = ctx.create_team(ucc_tpu.TeamParams())
-    print(team.score_map.print_info("probe team (size 1)"))
-    team.destroy()
-    ctx.destroy()
+def print_scores(team_size: int = 1) -> None:
+    """Default score map of a probe team (the reference prints the score
+    map at team create; -s does it standalone). ``team_size > 1`` builds
+    an in-process multi-rank job (thread OOB, the gtest UccJob shape) so
+    multi-rank-only rows show — e.g. the CL/HIER rows, which need a
+    NODE/NET decomposition: ``UCC_TOPO_FAKE_PPN=2 ucc_info -s 4``."""
+    if team_size <= 1:
+        lib = ucc_tpu.init()
+        ctx = ucc_tpu.Context(lib)
+        team = ctx.create_team(ucc_tpu.TeamParams())
+        print(team.score_map.print_info("probe team (size 1)"))
+        team.destroy()
+        ctx.destroy()
+        return
+
+    import threading
+    import time
+
+    from ucc_tpu import ContextParams, Status, TeamParams, ThreadOobWorld
+    n = team_size
+    world = ThreadOobWorld(n)
+    libs = [ucc_tpu.init() for _ in range(n)]
+    ctxs: list = [None] * n
+    errs: list = []
+
+    def mk(r):
+        try:
+            ctxs[r] = ucc_tpu.Context(libs[r],
+                                      ContextParams(oob=world.endpoint(r)))
+        except Exception as e:  # noqa: BLE001 - reported below
+            errs.append((r, e))
+
+    ths = [threading.Thread(target=mk, args=(r,)) for r in range(n)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    if errs:
+        raise RuntimeError(f"probe context create failed: {errs}")
+    tw = ThreadOobWorld(n)
+    teams = [c.create_team_post(TeamParams(oob=tw.endpoint(i)))
+             for i, c in enumerate(ctxs)]
+    deadline = time.monotonic() + 60
+    while True:
+        sts = [t.create_test() for t in teams]
+        for c in ctxs:
+            c.progress()
+        if all(s == Status.OK for s in sts):
+            break
+        bad = [s for s in sts if s.is_error]
+        if bad:
+            raise RuntimeError(f"probe team create failed: {bad}")
+        if time.monotonic() > deadline:
+            raise RuntimeError("probe team create timed out (60s)")
+    print(teams[0].score_map.print_info(f"probe team (size {n})"))
+    for t in teams:
+        t.destroy()
+    for c in ctxs:
+        c.destroy()
 
 
 def print_caps() -> None:
@@ -97,16 +147,21 @@ def main(argv=None) -> int:
     p.add_argument("-v", "--version", action="store_true")
     p.add_argument("-cf", "--config", action="store_true",
                    help="print all config variables")
-    p.add_argument("-s", "--scores", action="store_true",
-                   help="print default score map")
+    p.add_argument("-s", "--scores", nargs="?", const=1, type=int,
+                   default=None, metavar="N",
+                   help="print default score map (optional N = probe "
+                        "team size; N>1 shows multi-rank-only rows, "
+                        "e.g. CL/HIER under UCC_TOPO_FAKE_PPN)")
     p.add_argument("-A", "--algorithms", action="store_true",
                    help="print per-TL algorithm lists")
     p.add_argument("-c", "--caps", action="store_true",
                    help="print capability matrix")
     args = p.parse_args(argv)
-    if not any(vars(args).values()):
+    if args.scores is not None and args.scores < 1:
+        p.error("-s team size must be >= 1")
+    if not any(v not in (None, False) for v in vars(args).values()):
         args.version = True
-    if args.scores or args.caps:
+    if args.scores is not None or args.caps:
         # these create contexts (device TLs probe the backend): make sure
         # the backend is reachable first — one probe with CPU fallback
         # instead of a per-TL discovery timeout on a wedged accelerator
@@ -120,8 +175,8 @@ def main(argv=None) -> int:
         print_config()
     if args.algorithms:
         print_algorithms()
-    if args.scores:
-        print_scores()
+    if args.scores is not None:
+        print_scores(args.scores)
     return 0
 
 
