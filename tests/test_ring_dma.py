@@ -216,6 +216,27 @@ class TestRingDmaRealChip:
              for d in tpus])
         assert program.lower(garr).compile() is not None
 
+    @pytest.mark.parametrize("mesh_shape", ["1d", "dp_sp"])
+    def test_fused_attention_compiles_on_tpu(self, mesh_shape):
+        """The fused ring flash-attention kernel shares ring_dma's
+        slot/ack protocol — same hardware gate. dp_sp compiles the
+        MULTI-AXIS path (dict MESH device ids over the sp axis of a
+        ('dp','sp') mesh — round-4 lift of the lax-only fallback)."""
+        tpus = self._tpus()
+        n = len(tpus)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ucc_tpu.fused_attention import make_ring_flash_attention
+        if mesh_shape == "1d":
+            mesh = jax.sharding.Mesh(np.array(tpus), ("sp",))
+        else:
+            mesh = jax.sharding.Mesh(np.array(tpus).reshape(1, n),
+                                     ("dp", "sp"))
+        prog = make_ring_flash_attention(mesh, causal=True, axis="sp")
+        h, s_loc, d = 2, 128, 128
+        sh = NamedSharding(mesh, P(None, "sp", None))
+        q = jax.device_put(jnp.ones((h, n * s_loc, d), jnp.bfloat16), sh)
+        assert prog.lower(q, q, q).compile() is not None
+
 
 class TestRingDmaChunked:
     """Vectors beyond one VMEM working set split into independent ring

@@ -40,6 +40,10 @@ def _worker_main(proc_id: int, base_port: int, mode: str = "flat") -> None:
     if mode == "hier":
         # 4 ranks -> 2 fake nodes of 2; node boundary == process boundary
         os.environ["UCC_TOPO_FAKE_PPN"] = "2"
+    if mode == "ring_dma":
+        # the kernels' LOGICAL device ids and the rendezvous path had
+        # only ever run single-controller (round-3 verdict next #6)
+        os.environ["UCC_TL_RING_DMA_TUNE"] = "allreduce:@ring_dma:inf"
     import jax
     jax.config.update("jax_platforms", "cpu")
     try:
@@ -146,6 +150,67 @@ def _worker_main(proc_id: int, base_port: int, mode: str = "flat") -> None:
                 np.asarray(a.dst.buffer), expect),
             timeout=180, label="hier-allreduce")
         print(f"MULTIPROC-HIER-OK {proc_id}", flush=True)
+        return
+
+    if mode == "ring_dma":
+        # 1) device-initiated ring allreduce through the full stack: the
+        #    Pallas kernel (interpret on this CPU mesh) runs over the
+        #    SPANNING 4-device mesh — interpret's remote-DMA discharge
+        #    lowers to lax.all_gather, which rides the gloo backend
+        #    across the two controllers
+        t0 = teams[my_ranks[0]]
+        cands = t0.score_map.lookup(CollType.ALLREDUCE, MemoryType.TPU,
+                                    1 << 10)
+        assert cands and cands[0].alg_name == "ring_dma", \
+            [c.alg_name for c in cands]
+        expect = n * (n + 1) / 2
+        run(lambda r: CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=dev_buf(r, np.full(count, r + 1.0, np.float32)),
+                dst=BufferInfo(None, count, DataType.FLOAT32,
+                               mem_type=MemoryType.TPU),
+                op=ReductionOp.SUM),
+            lambda r, a: np.testing.assert_allclose(
+                np.asarray(a.dst.buffer), expect),
+            timeout=240, label="ring_dma-allreduce")
+
+        # 2) fused ring flash-attention forward over the spanning mesh
+        #    (jitted global-array entry; the K/V ring crosses the process
+        #    boundary)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ucc_tpu.fused_attention import make_ring_flash_attention
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("sp",))
+        prog = make_ring_flash_attention(mesh, axis="sp")
+        h, s_loc, d = 2, 8, 4
+        seq = n * s_loc
+        rng = np.random.RandomState(7)
+        qn, kn, vn = (rng.randn(h, seq, d).astype(np.float32)
+                      for _ in range(3))
+        sh = NamedSharding(mesh, P(None, "sp", None))
+        all_devs = list(mesh.devices.flat)
+
+        def garr(full):
+            shards = [jax.device_put(
+                jnp.asarray(full[:, i * s_loc:(i + 1) * s_loc, :]), dv)
+                for i, dv in enumerate(all_devs) if dv.process_index ==
+                jax.process_index()]
+            return jax.make_array_from_single_device_arrays(
+                (h, seq, d), sh, shards)
+
+        out = jax.block_until_ready(prog(garr(qn), garr(kn), garr(vn)))
+        # dense reference, checked on this process's addressable shards
+        s = np.einsum("hqd,hkd->hqk", qn / np.sqrt(d), kn)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        ref = np.einsum("hqk,hkd->hqd", p / p.sum(-1, keepdims=True), vn)
+        for shard in out.addressable_shards:
+            i = list(mesh.devices.flat).index(shard.device)
+            np.testing.assert_allclose(
+                np.asarray(shard.data),
+                ref[:, i * s_loc:(i + 1) * s_loc, :], rtol=2e-5,
+                atol=2e-6)
+        print(f"COLL-OK fused-attention {proc_id}", flush=True)
+        print(f"MULTIPROC-RINGDMA-OK {proc_id}", flush=True)
         return
 
     # ---- flat XLA team over 4 devices / 2 processes ----------------------
@@ -312,6 +377,13 @@ def test_two_process_xla_collectives():
 
 def test_two_process_hier_hbm_allreduce():
     _run_workers("hier", "MULTIPROC-HIER-OK")
+
+
+def test_two_process_ring_dma_and_fused_attention():
+    """ring_dma allreduce + fused ring attention across OS processes
+    (round-3 verdict next #6): the kernels' logical device ids and the
+    rendezvous path prove out on a genuine multi-controller mesh."""
+    _run_workers("ring_dma", "MULTIPROC-RINGDMA-OK")
 
 
 if __name__ == "__main__":

@@ -101,7 +101,8 @@ def main() -> None:
         if results["short"][i] < results["compiled"][i]:
             last_short_win = i
     if last_short_win is None:
-        crossover = SIZES_ELEMS[0] * 4     # compiled wins everywhere
+        crossover = 0                      # compiled wins everywhere:
+                                           # nothing belongs on short
     elif last_short_win == len(SIZES_ELEMS) - 1:
         crossover = None                   # short wins at the top size
     else:

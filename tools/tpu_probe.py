@@ -13,7 +13,7 @@ makes every recovery attempt *evidence*:
 - on success it runs the real-chip capture suite in INFORMATION-VALUE
   order (round-3 verdict: the window closed before the highest-value
   capture ran).  Round 4 order:
-    1. the 10-family ring_dma real-chip compile suite — the standing
+    1. the 12-case real-chip compile suite (10 ring_dma kernel families + 2 fused-attention mesh shapes) — the standing
        unknown: the only round-3 hardware run said "2 failed, 1
        passed" and the fix (454c1ef) was never re-validated.  On
        failure it RETRIES ONCE immediately to split flake from
@@ -136,7 +136,7 @@ def _exhausted(state, name):
 
 
 def _ring_dma_once():
-    """One run of the 10-family real-chip compile suite; returns
+    """One run of the 12-case real-chip compile suite; returns
     (rc, out, tail).  UCC_TPU_REAL_CHIP=1 tells tests/conftest.py NOT
     to force the cpu platform — without it the "real chip" tests skip
     even during a live window (that is exactly what happened on the

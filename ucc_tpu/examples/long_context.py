@@ -6,12 +6,14 @@ a single-head-block attention "model" whose sequence axis is sharded
 over the `sp` mesh axis and whose batch is sharded over `dp` —
 
   - attention runs as ``fused_attention.ring_flash_attention`` with
-    ``fused=False``: the multi-axis ('dp','sp') mesh forces the lax
-    ring schedule (the fused Pallas kernel's LOGICAL device ids need a
-    1-axis mesh) — same ring math and gradients, O(seq/n_sp) activation
-    memory per chip, compiler-scheduled overlap instead of in-kernel
-    DMA. 1-axis fused-kernel coverage lives in
-    ``make_ring_flash_attention`` and tests/test_ring_attention.py;
+    ``fused=None`` (auto): on real hardware the FUSED Pallas kernel
+    runs on this multi-axis ('dp','sp') mesh too (dict MESH device ids
+    address the sp-ring neighbor within the dp group — round 4); only
+    interpret mode (this CPU dryrun) takes the lax ring schedule, whose
+    discharge rule is 1-axis-only — same ring math and gradients,
+    O(seq/n_sp) activation memory per chip. 1-axis fused-kernel
+    coverage lives in ``make_ring_flash_attention`` and
+    tests/test_ring_attention.py;
   - gradients flow through the kernel's custom_vjp (lax ring-schedule
     backward, flash-style recompute);
   - DP gradient synchronization is ``ops.allreduce(AVG)`` — the
@@ -60,10 +62,10 @@ def make_train_step(mesh: Mesh, lr: float = 1e-2, causal: bool = True):
                 q.reshape(b * h, s_loc, e), k.reshape(b * h, s_loc, e),
                 v.reshape(b * h, s_loc, e), axis_name="sp",
                 causal=causal,
-                # this mesh is ('dp','sp'): the Pallas kernel's LOGICAL
-                # device ids need a 1-axis mesh, so take the lax ring
-                # schedule explicitly rather than via the probe
-                fused=False).reshape(b, h, s_loc, e)
+                # auto: fused kernel on real chips (dict MESH device
+                # ids serve the ('dp','sp') mesh), lax ring under
+                # interpret (its discharge rule is 1-axis-only)
+                fused=None).reshape(b, h, s_loc, e)
             out = jnp.einsum("bhse,hed->bhsd", attn, wo)
             local = jnp.mean((out - y) ** 2)
             # mean over data AND sequence shards in ONE collective (the

@@ -38,7 +38,7 @@ import numpy as np
 
 
 def _kernel(n: int, scale: float, causal: bool, s_local: int,
-            axis: str, barrier: bool):
+            axis: str, barrier: bool, multi_axis: bool = False):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -46,13 +46,27 @@ def _kernel(n: int, scale: float, causal: bool, s_local: int,
 
     from .tl.ring_dma import _neighbor_barrier
 
+    def dev_kw(idx):
+        # multi-axis meshes (dp x sp training): address the sp-ring
+        # neighbor with a dict MESH device id — unnamed axes default to
+        # the caller's own coordinate, so the DMA stays within the dp
+        # group. Mosaic lowers this via mesh strides
+        # (jax pallas primitives.device_id_to_logical); the interpret
+        # discharge rule is 1-axis-only, so interpret callers take the
+        # lax ring instead (ring_flash_attention's auto-detect).
+        if multi_axis:
+            return dict(device_id={axis: idx},
+                        device_id_type=pltpu.DeviceIdType.MESH)
+        return dict(device_id=idx,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+
     def kernel(q_ref, k_ref, v_ref, o_ref, comm_ref, send_sem, recv_sem,
                ack_sem, m_ref, l_ref, acc_ref):
         me = lax.axis_index(axis)
         right = lax.rem(me + 1, n)
         left = lax.rem(me - 1 + n, n)
         if barrier:
-            _neighbor_barrier(n, axis)
+            _neighbor_barrier(n, axis, multi_axis=multi_axis)
         # resident K/V starts as the local block in slot 0
         comm_ref[0, 0] = k_ref[:]
         comm_ref[0, 1] = v_ref[:]
@@ -86,8 +100,7 @@ def _kernel(n: int, scale: float, causal: bool, s_local: int,
                     dst_ref=comm_ref.at[nxt],
                     send_sem=send_sem.at[cur],
                     recv_sem=recv_sem.at[nxt],
-                    device_id=right,
-                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                    **dev_kw(right),
                 )
                 rdma.start()
 
@@ -122,9 +135,7 @@ def _kernel(n: int, scale: float, causal: bool, s_local: int,
                 # neighbor may now overwrite it (its step t+1 targets
                 # exactly this slot). n-2 signals balance the n-2 waits,
                 # so the semaphore drains to zero at kernel exit.
-                pltpu.semaphore_signal(
-                    ack_sem, inc=1, device_id=left,
-                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                pltpu.semaphore_signal(ack_sem, inc=1, **dev_kw(left))
 
         l = l_ref[:]
         out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)[..., None]
@@ -135,7 +146,8 @@ def _kernel(n: int, scale: float, causal: bool, s_local: int,
 
 @functools.lru_cache(maxsize=64)
 def _build(n: int, h: int, s_local: int, d: int, dtype_str: str,
-           scale: float, causal: bool, axis: str):
+           scale: float, causal: bool, axis: str,
+           multi_axis: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -144,12 +156,13 @@ def _build(n: int, h: int, s_local: int, d: int, dtype_str: str,
     from .tl.ring_dma import _compiler_params, _warn_no_barrier
 
     interpret = jax.devices()[0].platform == "cpu"
-    cp = _compiler_params(collective_id=7)
+    cp = _compiler_params(collective_id=8 if multi_axis else 7)
     if cp is None:
         _warn_no_barrier()
     nd = jnp.dtype(dtype_str)
     kernel = _kernel(n, scale, causal, s_local, axis,
-                     barrier=not interpret and cp is not None)
+                     barrier=not interpret and cp is not None,
+                     multi_axis=multi_axis)
     kw = {"compiler_params": cp} if cp is not None and not interpret else {}
 
     def shard_fn(q, k, v):
@@ -219,10 +232,12 @@ def _xla_ring_shard(q, k, v, n: int, scale: float, causal: bool,
 
 def _mesh_multi_axis() -> bool:
     """True iff the enclosing shard_map mesh has more than one named
-    axis — the fused kernel's LOGICAL device ids only lower on 1-axis
-    meshes. Probes the abstract mesh first (vmap/pmap axis_names around
-    the shard_map must NOT count — they don't change the device mesh);
-    falls back to the trace-time axis env on API drift."""
+    axis — those meshes address the ring with dict MESH device ids
+    (compiled path) and fall back to the lax ring under interpret (the
+    interpret discharge rule is 1-axis-only). Probes the abstract mesh
+    first (vmap/pmap axis_names around the shard_map must NOT count —
+    they don't change the device mesh); falls back to the trace-time
+    axis env on API drift."""
     import jax
 
     try:
@@ -251,13 +266,17 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
     backward recomputes through the equivalent lax ring schedule
     (flash-style rematerialization) via custom_vjp.
 
-    ``fused``: None (default) auto-detects — the Pallas kernel's LOGICAL
-    device ids only lower on single-axis meshes, so multi-axis meshes
-    (e.g. ('dp','sp')) take the equivalent lax ring schedule (same math
-    and gradients, compiler-scheduled overlap instead of in-kernel DMA).
-    Callers that know their mesh shape should pass it explicitly
-    (``make_ring_flash_attention`` does); forcing ``fused=True`` on a
-    multi-axis mesh fails at Mosaic lowering time.
+    ``fused``: None (default) auto-detects. Multi-axis meshes (the
+    realistic dp x sp training mesh) run the FUSED kernel when compiled:
+    the sp-ring neighbor is addressed with dict MESH device ids, which
+    Mosaic lowers via mesh strides (round-4 lift of the old lax-only
+    multi-axis fallback). Only Pallas INTERPRET mode (the CPU test mesh)
+    lacks multi-axis remote-DMA support (its discharge rule is
+    1-axis-only, jax pallas mosaic/primitives.py dma_start_p), so
+    interpret + multi-axis takes the equivalent lax ring schedule (same
+    math and gradients, compiler-scheduled overlap instead of in-kernel
+    DMA). Forcing ``fused=True`` under interpret on a multi-axis mesh
+    raises NotImplementedError from the discharge rule.
     """
     import jax
 
@@ -267,13 +286,15 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
     h, s_local, d = q.shape
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
+    multi = _mesh_multi_axis()
     if fused is None:
-        fused = not _mesh_multi_axis()
+        interpret = jax.devices()[0].platform == "cpu"
+        fused = not (multi and interpret)
     if not fused:
         return _xla_ring_shard(q, k, v, int(n), float(scale),
                                bool(causal), axis_name)
     fused = _build(int(n), h, s_local, d, str(q.dtype), float(scale),
-                   bool(causal), axis_name)
+                   bool(causal), axis_name, multi_axis=multi)
 
     @jax.custom_vjp
     def attn(q, k, v):
@@ -309,10 +330,13 @@ def make_ring_flash_attention(mesh, *, causal: bool = False,
 
     def body(q, k, v):
         # the mesh is known here: choose the path explicitly instead of
-        # relying on the trace-time probe
+        # relying on the trace-time probe. Fused everywhere except
+        # interpret (CPU) on a multi-axis mesh — the one shape the
+        # interpret discharge rule cannot run.
+        fused = len(mesh.axis_names) == 1 or \
+            mesh.devices.flat[0].platform != "cpu"
         return ring_flash_attention(q, k, v, axis_name=axis, scale=scale,
-                                    causal=causal,
-                                    fused=len(mesh.axis_names) == 1)
+                                    causal=causal, fused=fused)
 
     return jax.jit(shard_map_compat(
         body, mesh, (P(None, axis, None),) * 3, P(None, axis, None)))

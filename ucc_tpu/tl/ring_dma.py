@@ -137,14 +137,19 @@ def _compiler_params(collective_id: int):
             return None
 
 
-def _neighbor_barrier(n: int, axis: str):
+def _neighbor_barrier(n: int, axis: str, multi_axis: bool = False):
     """Initial ring-neighbor handshake (the standard Pallas distributed
     entry barrier): a remote DMA must not land in a peer's comm slots
     before that peer's kernel instance owns them, and the one-step-skew
     argument that makes 2-slot double buffering safe assumes neighbors
     start within one step of each other. Skipped in interpret mode
     (no barrier-semaphore model there; the compiled path is what needs
-    it — hardware validation pending, see module docstring)."""
+    it — hardware validation pending, see module docstring).
+
+    ``multi_axis``: the ring runs along ``axis`` of a multi-axis mesh
+    (e.g. the sp axis of a dp x sp training mesh) — neighbors are
+    addressed with dict MESH device ids (unnamed axes default to the
+    caller's own coordinate), which Mosaic lowers via mesh strides."""
     import jax
     from jax.experimental.pallas import tpu as pltpu
 
@@ -155,8 +160,12 @@ def _neighbor_barrier(n: int, axis: str):
     right = jax.lax.rem(me + 1, n)
     barrier = pltpu.get_barrier_semaphore()
     for nb in (left, right):
-        pltpu.semaphore_signal(barrier, inc=1, device_id=nb,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        if multi_axis:
+            pltpu.semaphore_signal(barrier, inc=1, device_id={axis: nb},
+                                   device_id_type=pltpu.DeviceIdType.MESH)
+        else:
+            pltpu.semaphore_signal(barrier, inc=1, device_id=nb,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
     pltpu.semaphore_wait(barrier, 2)
 
 
@@ -729,7 +738,15 @@ def _hbm_alltoall_kernel(local_ref, out_ref, comm_ref, fetch_sem,
     from every partner, each sent only after that partner drained my
     chunk g-1 block from its recv slot to HBM. A partner racing ahead
     can therefore never overwrite an undrained slot; its early
-    recv_sem signals are just counts my next rdma.wait consumes."""
+    recv_sem signals are just counts my next rdma.wait consumes.
+
+    The staging PIPELINES around the ICI transfers: step s+1's
+    HBM->VMEM fetch is started before step s's remote DMA (it rides
+    behind the ICI), and step s's VMEM->HBM flush drains one step later
+    (behind step s+1's work) — fetch/flush semaphores alternate 2-slot
+    parity, and the ack to step s's writer is emitted only after that
+    flush's completion is observed at s+1 (the ack licenses the slot's
+    next-chunk reuse, so it must trail the drain)."""
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -751,14 +768,31 @@ def _hbm_alltoall_kernel(local_ref, out_ref, comm_ref, fetch_sem,
     if barrier and n > 1:
         _sem_wait_when(g > 0, ack_sem, n - 1)
 
-    for s in range(1, n):
+    def fetch(s):
         to = jax.lax.rem(me + s, n)
-        frm = jax.lax.rem(me - s + n + n, n)
-        fetch = pltpu.make_async_copy(
+        return pltpu.make_async_copy(
             local_ref.at[pl.ds(to * blk_tot + g * cblk, cblk)],
-            comm_ref.at[pl.ds((s - 1) * cblk, cblk)], fetch_sem)
-        fetch.start()
-        fetch.wait()
+            comm_ref.at[pl.ds((s - 1) * cblk, cblk)],
+            fetch_sem.at[(s - 1) % 2])
+
+    def flush(s):
+        frm = jax.lax.rem(me - s + n + n, n)
+        return pltpu.make_async_copy(
+            comm_ref.at[pl.ds((n - 1 + s - 1) * cblk, cblk)],
+            out_ref.at[pl.ds(frm * blk_tot + g * cblk, cblk)],
+            flush_sem.at[(s - 1) % 2])
+
+    def ack(s):
+        frm = jax.lax.rem(me - s + n + n, n)
+        _sem_signal_when(g + 1 < n_chunks, ack_sem, frm)
+
+    if n > 1:
+        fetch(1).start()
+    for s in range(1, n):
+        fetch(s).wait()
+        if s + 1 < n:
+            fetch(s + 1).start()       # rides behind this step's ICI
+        to = jax.lax.rem(me + s, n)
         rdma = pltpu.make_async_remote_copy(
             src_ref=comm_ref.at[pl.ds((s - 1) * cblk, cblk)],
             dst_ref=comm_ref.at[pl.ds((n - 1 + s - 1) * cblk, cblk)],
@@ -769,16 +803,18 @@ def _hbm_alltoall_kernel(local_ref, out_ref, comm_ref, fetch_sem,
         )
         rdma.start()
         rdma.wait()
-        # drain the arrived block to HBM, then ack its writer: the ack
-        # is what licenses frm's next-chunk write into this slot, so it
-        # must follow the flush's completion
-        flush = pltpu.make_async_copy(
-            comm_ref.at[pl.ds((n - 1 + s - 1) * cblk, cblk)],
-            out_ref.at[pl.ds(frm * blk_tot + g * cblk, cblk)], flush_sem)
-        flush.start()
-        flush.wait()
+        flush(s).start()
+        if s >= 2:
+            # drain the PREVIOUS step's flush behind this one, then ack
+            # its writer (single-use slots: nothing in chunk g rereads
+            # the slot, the ack only licenses next-chunk reuse)
+            flush(s - 1).wait()
+            if barrier and n > 1:
+                ack(s - 1)
+    if n > 1:
+        flush(n - 1).wait()
         if barrier and n > 1:
-            _sem_signal_when(g + 1 < n_chunks, ack_sem, frm)
+            ack(n - 1)
 
     self_copy.wait()
 
@@ -808,7 +844,11 @@ def build_hbm_alltoall_program(mesh, n: int, nd, count: int):
         blk_tot += cblk - blk_tot % cblk
     n_chunks = blk_tot // cblk
 
-    cp = _compiler_params(collective_id=7)
+    # collective_id 9: 7/8 belong to the fused attention kernels
+    # (fused_attention._build) — a shared id would key one global
+    # barrier semaphore across overlapping dispatches of DIFFERENT
+    # kernels, letting one kernel's barrier signals satisfy the other's
+    cp = _compiler_params(collective_id=9)
     if cp is None:
         _warn_no_barrier()
     kernel = functools.partial(
@@ -832,9 +872,9 @@ def build_hbm_alltoall_program(mesh, n: int, nd, count: int):
             out_shape=jax.ShapeDtypeStruct((n * blk_tot,), x.dtype),
             scratch_shapes=[
                 pltpu.VMEM((max(1, 2 * (n - 1) * cblk),), x.dtype),
-                pltpu.SemaphoreType.DMA,              # fetch
+                pltpu.SemaphoreType.DMA((2,)),        # fetch (pipelined)
                 pltpu.SemaphoreType.DMA,              # my-block copy
-                pltpu.SemaphoreType.DMA,              # flush
+                pltpu.SemaphoreType.DMA((2,)),        # flush (pipelined)
                 pltpu.SemaphoreType.DMA((max(1, n - 1),)),   # send
                 pltpu.SemaphoreType.DMA((max(1, n - 1),)),   # recv
                 pltpu.SemaphoreType.REGULAR,          # consumption acks
