@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Sweep the sliding-window allreduce knobs (window bytes x in-flight
+buffers) over real loopback TCP and print one JSON line per point.
+
+Round-3 verdict weak #6: the one-sided win faded by 16 MiB (-2%) but
+window=1M/inflight=2 were never swept; the reference exposes
+num_buffers/window tuning for exactly this regime
+(/root/reference/src/components/tl/ucp/allreduce/allreduce_sliding_window.h:36-38).
+This tool measures each (msg, window, inflight) cell through
+``perftest -c allreduce -p 4 -O`` with the socket TL forced, plus the
+two-sided baseline per size, so the defaults can be set from data
+(recorded in BASELINE.md).
+
+Usage:  python tools/sw_sweep.py [--quick]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MSGS = (4 << 20, 16 << 20, 64 << 20)
+WINDOWS = (256 << 10, 1 << 20, 4 << 20)
+INFLIGHTS = (2, 4, 8)
+
+
+def _run_point(msg: int, onesided: bool, window: int = 0,
+               inflight: int = 0, iters: int = 6) -> float:
+    """avg latency (us) of one perftest cell, or -1 on failure."""
+    env = dict(os.environ)
+    env["UCC_TLS"] = "socket,self"
+    # host-memory sweep: pin the cpu platform so each child skips the
+    # (possibly wedged) accelerator probe instead of burning its timeout
+    env["JAX_PLATFORMS"] = "cpu"
+    if window:
+        env["UCC_TL_SOCKET_ALLREDUCE_SW_WINDOW"] = str(window)
+    if inflight:
+        env["UCC_TL_SOCKET_ALLREDUCE_SW_INFLIGHT"] = str(inflight)
+    argv = [sys.executable, "-m", "ucc_tpu.tools.perftest",
+            "-c", "allreduce", "-p", "4", "-b", str(msg), "-e", str(msg),
+            "-n", str(iters), "-w", "2"]
+    if onesided:
+        argv.append("-O")
+    try:
+        r = subprocess.run(argv, env=env, capture_output=True, text=True,
+                           timeout=900, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return -1.0
+    if r.returncode != 0:
+        return -1.0
+    for ln in reversed(r.stdout.strip().splitlines()):
+        parts = ln.split()
+        if len(parts) >= 3 and parts[0].isdigit():
+            return float(parts[2])
+    return -1.0
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    msgs = MSGS[:1] if quick else MSGS
+    out = []
+    for msg in msgs:
+        iters = 4 if msg >= (64 << 20) else 6
+        base = _run_point(msg, onesided=False, iters=iters)
+        print(json.dumps({"msg": msg, "mode": "two_sided",
+                          "avg_us": base}), flush=True)
+        for w in WINDOWS:
+            for infl in INFLIGHTS:
+                if quick and (w, infl) != (1 << 20, 2) and \
+                        (w, infl) != (4 << 20, 4):
+                    continue
+                us = _run_point(msg, onesided=True, window=w,
+                                inflight=infl, iters=iters)
+                rec = {"msg": msg, "mode": "sliding_window", "window": w,
+                       "inflight": infl, "avg_us": us,
+                       "vs_two_sided": round(base / us, 3)
+                       if us > 0 and base > 0 else None}
+                out.append(rec)
+                print(json.dumps(rec), flush=True)
+    best = {}
+    for rec in out:
+        if rec["avg_us"] <= 0:
+            continue
+        m = rec["msg"]
+        if m not in best or rec["avg_us"] < best[m]["avg_us"]:
+            best[m] = rec
+    print(json.dumps({"best_per_msg": {str(m): {
+        "window": r["window"], "inflight": r["inflight"],
+        "avg_us": r["avg_us"], "vs_two_sided": r["vs_two_sided"]}
+        for m, r in sorted(best.items())}}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -255,7 +255,7 @@ def _mesh_multi_axis() -> bool:
 
 def ring_flash_attention(q, k, v, *, axis_name: str = "r",
                          scale: float = None, causal: bool = False,
-                         fused: bool = None):
+                         fused: bool = None, multi_axis: bool = None):
     """Shard-level fused ring attention (call inside shard_map).
 
     q, k, v: (heads, seq_local, head_dim) — this rank's sequence block.
@@ -286,7 +286,10 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
     h, s_local, d = q.shape
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
-    multi = _mesh_multi_axis()
+    # callers that know their mesh pass multi_axis explicitly (the
+    # addressing mode — LOGICAL vs dict MESH device ids — must not ride
+    # on the trace-time probe when the mesh shape is in hand)
+    multi = _mesh_multi_axis() if multi_axis is None else bool(multi_axis)
     if fused is None:
         interpret = jax.devices()[0].platform == "cpu"
         fused = not (multi and interpret)
@@ -333,10 +336,11 @@ def make_ring_flash_attention(mesh, *, causal: bool = False,
         # relying on the trace-time probe. Fused everywhere except
         # interpret (CPU) on a multi-axis mesh — the one shape the
         # interpret discharge rule cannot run.
-        fused = len(mesh.axis_names) == 1 or \
-            mesh.devices.flat[0].platform != "cpu"
+        multi = len(mesh.axis_names) > 1
+        fused = not multi or mesh.devices.flat[0].platform != "cpu"
         return ring_flash_attention(q, k, v, axis_name=axis, scale=scale,
-                                    causal=causal, fused=fused)
+                                    causal=causal, fused=fused,
+                                    multi_axis=multi)
 
     return jax.jit(shard_map_compat(
         body, mesh, (P(None, axis, None),) * 3, P(None, axis, None)))

@@ -52,10 +52,37 @@ OS_CTR = "__os_ctr__"
 OS_FLUSH = "__os_flush__"
 OS_OPS = frozenset((OS_PUT, OS_GET, OS_CTR, OS_FLUSH))
 
-#: bounded in-flight get buffers for the sliding window (reference
-#: num_buffers, allreduce_sliding_window.h:36); also sizes the
-#: context-attr global_work_buffer_size contract
-SW_INFLIGHT = 2
+def sw_knobs(cfg, msg_bytes: int):
+    """Resolve the sliding-window (window_bytes, inflight) knobs.
+
+    ``auto`` values come from the round-4 TCP sweep (BASELINE.md,
+    tools/sw_sweep.py): the optimal window and in-flight depth GROW with
+    message size — 4 MiB ran best at 256K windows x 4 buffers, 64 MiB at
+    4M x 8 (7.2x over two-sided) — so auto scales window to msg/16
+    clamped to [256K, 4M] and deepens the pipeline for >= 32 MiB.
+    Mirrors the reference's num_buffers/window tuning surface
+    (allreduce_sliding_window.h:36-38)."""
+    from ...utils.config import parse_memunits as _pm
+
+    raw_w = raw_i = "auto"
+    if cfg is not None:
+        try:
+            raw_w = str(cfg.get("allreduce_sw_window")).strip()
+        except KeyError:
+            pass
+        try:
+            raw_i = str(cfg.get("allreduce_sw_inflight")).strip()
+        except KeyError:
+            pass
+    if raw_w.lower() == "auto":
+        window = max(256 << 10, min(4 << 20, int(msg_bytes) // 16))
+    else:
+        window = int(_pm(raw_w))
+    if raw_i.lower() == "auto":
+        inflight = 8 if msg_bytes >= (32 << 20) else 4
+    else:
+        inflight = int(raw_i)
+    return window, max(1, inflight)
 
 
 class _Registry:
@@ -475,6 +502,14 @@ class AlltoallvOnesided(OneSidedMixin, HostCollTask):
     atomic_inc protocol, :55-57) — rank r completes when all team
     members' blocks have landed in its destination segment.
 
+    PUT-only by design: the reference's alltoallv_onesided.c is also
+    put-based (only the non-v alltoall grew a get variant,
+    tl_ucp.h:46-51 ALLTOALL_ONESIDED_{PUT,GET}). A get variant here
+    would need every initiator to know each peer's SOURCE displacement
+    table — an extra exchange the target-relative-displacement
+    convention exists to avoid — so it was considered and rejected for
+    parity and for that extra round-trip.
+
     WITHOUT explicit memh the task self-bootstraps (see _memh_descs) and
     the exchange carries each rank's OWN receive displacements, so puts
     target ``peer's d_displs[me]`` — i.e. bootstrap mode keeps standard
@@ -577,7 +612,7 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
     """
 
     def __init__(self, init_args, team, window_bytes: Optional[int] = None,
-                 inflight: int = SW_INFLIGHT):
+                 inflight: Optional[int] = None):
         super().__init__(init_args, team)
         args = init_args.args
         # absent memh -> self-bootstrap at run time (mem_map own buffers
@@ -591,18 +626,17 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
         self.count = int(args.dst.count)
         self.dt = args.dst.datatype
         self.op = args.op if args.op is not None else ReductionOp.SUM
-        if window_bytes is None:
-            cfg = team.comp_context.config
-            try:
-                window_bytes = int(cfg.get("allreduce_sw_window")) if cfg \
-                    else 1 << 20
-            except KeyError:
-                window_bytes = 1 << 20
         esz = dt_size(self.dt)
+        auto_w, auto_i = sw_knobs(team.comp_context.config,
+                                  self.count * esz)
+        if window_bytes is None:
+            window_bytes = auto_w
+        if inflight is None:
+            inflight = auto_i
         self.window = max(1, int(window_bytes) // esz)
         #: bounded get buffers (reference num_buffers / avail_buffs,
         #: allreduce_sliding_window.h:36-38)
-        self.inflight = max(1, inflight)
+        self.inflight = max(1, int(inflight))
 
     def _nwin(self, owner: int) -> int:
         return div_round_up(block_count(self.count, self.gsize, owner),

@@ -19,7 +19,8 @@ from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
 from ..ec.cpu import EcCpu
 from ..status import Status, UccError
 from ..utils.config import (ConfigField, ConfigTable, parse_memunits,
-                            parse_mrange_uint, parse_string, register_table)
+                            parse_mrange_uint, parse_string,
+                            register_table)
 from .host.team import HostTlTeam
 from .host.transport import InProcTransport
 
@@ -38,8 +39,15 @@ TL_SHM_CONFIG = register_table(ConfigTable(
         ConfigField("ALLTOALL_ONESIDED_ALG", "put", "one-sided alltoall "
                     "variant: put (counter completion) | get (barrier)",
                     parse_string),
-        ConfigField("ALLREDUCE_SW_WINDOW", "1M", "sliding-window allreduce "
-                    "window bytes", parse_memunits),
+        ConfigField("ALLREDUCE_SW_WINDOW", "auto", "sliding-window "
+                    "allreduce window bytes; auto = max(256K, min(4M, "
+                    "msg/16)) from the round-4 TCP sweep (BASELINE.md)",
+                    parse_memunits),
+        ConfigField("ALLREDUCE_SW_INFLIGHT", "auto", "sliding-window "
+                    "allreduce in-flight get buffers (reference "
+                    "num_buffers, allreduce_sliding_window.h:36-38); "
+                    "auto = 8 for msgs >= 32M else 4 (round-4 sweep)",
+                    parse_uint_auto),
     ]))
 
 
@@ -104,9 +112,8 @@ class TlShmContext(BaseContext):
         return SendReq(done=True)
 
     def global_work_buffer_size(self) -> int:
-        from .host.onesided import SW_INFLIGHT
-        window = self.config.allreduce_sw_window if self.config else 1 << 20
-        return SW_INFLIGHT * int(window)
+        from .host.onesided import sw_max_work_buffer
+        return sw_max_work_buffer(self.config)
 
     def destroy(self) -> None:
         self.transport.close()

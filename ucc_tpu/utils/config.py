@@ -99,6 +99,13 @@ def memunits_str(n: int) -> str:
     return str(n)
 
 
+def parse_uint_auto(s: str) -> int:
+    """Unsigned int or 'auto' -> SIZE_AUTO (the per-use-site default)."""
+    if s.strip().lower() == "auto":
+        return SIZE_AUTO
+    return parse_uint(s)
+
+
 def parse_list(s: str) -> List[str]:
     """Comma-separated allow-list; empty string -> []."""
     s = s.strip()
