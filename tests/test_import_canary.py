@@ -1,0 +1,38 @@
+"""Import canary: every module under ucc_tpu must import cleanly.
+
+The round-4 snapshot shipped two TL modules whose import lists were
+missing helpers they used (NameError at import), which silently removed
+both host TLs from the registry and turned 600 green tests red. The
+reference cannot have this failure class — a broken .c file fails the
+build — so the Python analog is this walk: if a module exists, it loads.
+"""
+import importlib
+import pkgutil
+
+import pytest
+
+import ucc_tpu
+
+
+def _all_modules():
+    mods = ["ucc_tpu"]
+    for info in pkgutil.walk_packages(ucc_tpu.__path__,
+                                      prefix="ucc_tpu."):
+        mods.append(info.name)
+    return mods
+
+
+@pytest.mark.parametrize("modname", _all_modules())
+def test_module_imports(modname):
+    importlib.import_module(modname)
+
+
+def test_discovery_registers_full_component_set():
+    """Discovery tolerates a broken module by skipping it (warning) — so
+    an import bug shows up as a HOLE in the registry, not an exception.
+    Pin the full expected set; a missing name is the round-4 bug."""
+    from ucc_tpu.core import components
+
+    assert set(components.available_tls()) >= {
+        "shm", "socket", "xla", "ring_dma", "self"}
+    assert set(components.available_cls()) >= {"basic", "hier"}

@@ -37,6 +37,70 @@ def job4(monkeypatch, request):
 
 
 # ---------------------------------------------------------------------------
+# sliding-window knob resolution (allreduce_sliding_window.h:36-38 analog)
+# ---------------------------------------------------------------------------
+
+class TestSwKnobs:
+    """Pin sw_knobs auto outputs to the round-4 sweep table (BASELINE.md):
+    the knobs are how the sweep's conclusions reach the collective, and
+    round 4 shipped them broken (string-compared a parsed sentinel)."""
+
+    @staticmethod
+    def _default_cfg():
+        from ucc_tpu.tl.shm import TL_SHM_CONFIG
+        from ucc_tpu.utils.config import Config
+        return Config(TL_SHM_CONFIG, env={})
+
+    @pytest.mark.parametrize("msg,want_w,want_i", [
+        (4 << 20, 256 << 10, 4),    # 4 MiB: 256K windows x 4 buffers
+        (16 << 20, 1 << 20, 4),     # 16 MiB: msg/16 = 1M, shallow
+        (64 << 20, 4 << 20, 8),     # 64 MiB: 4M clamp x deep pipeline
+    ])
+    def test_auto_matches_sweep_table(self, msg, want_w, want_i):
+        from ucc_tpu.tl.host.onesided import sw_knobs
+        # the default config carries the PARSED 'auto' sentinel — the
+        # exact value class the round-4 bug mishandled
+        w, i = sw_knobs(self._default_cfg(), msg)
+        assert (w, i) == (want_w, want_i)
+        # no config at all resolves identically
+        assert sw_knobs(None, msg) == (want_w, want_i)
+
+    def test_explicit_values_win(self):
+        from ucc_tpu.tl.shm import TL_SHM_CONFIG
+        from ucc_tpu.tl.host.onesided import sw_knobs
+        from ucc_tpu.utils.config import Config
+        cfg = Config(TL_SHM_CONFIG, env={
+            "UCC_TL_SHM_ALLREDUCE_SW_WINDOW": "512k",
+            "UCC_TL_SHM_ALLREDUCE_SW_INFLIGHT": "2",
+        })
+        assert sw_knobs(cfg, 64 << 20) == (512 << 10, 2)
+
+    def test_inf_sentinels_fall_back_to_auto(self):
+        """'inf' parses to SIZE_INF/UINT_MAX — meaningless as scratch
+        sizes; both must resolve like auto, not allocate from 2^64."""
+        from ucc_tpu.tl.shm import TL_SHM_CONFIG
+        from ucc_tpu.tl.host.onesided import sw_knobs, sw_max_work_buffer
+        from ucc_tpu.utils.config import Config
+        cfg = Config(TL_SHM_CONFIG, env={
+            "UCC_TL_SHM_ALLREDUCE_SW_WINDOW": "inf",
+            "UCC_TL_SHM_ALLREDUCE_SW_INFLIGHT": "inf",
+        })
+        assert sw_knobs(cfg, 64 << 20) == (4 << 20, 8)
+        assert sw_max_work_buffer(cfg) == (4 << 20) * 8
+
+    def test_max_work_buffer_auto_and_explicit(self):
+        from ucc_tpu.tl.shm import TL_SHM_CONFIG
+        from ucc_tpu.tl.host.onesided import sw_max_work_buffer
+        from ucc_tpu.utils.config import Config
+        assert sw_max_work_buffer(self._default_cfg()) == (4 << 20) * 8
+        cfg = Config(TL_SHM_CONFIG, env={
+            "UCC_TL_SHM_ALLREDUCE_SW_WINDOW": "1m",
+            "UCC_TL_SHM_ALLREDUCE_SW_INFLIGHT": "2",
+        })
+        assert sw_max_work_buffer(cfg) == (1 << 20) * 2
+
+
+# ---------------------------------------------------------------------------
 # mem_map export/import/unmap (ucc.h:2265-2320)
 # ---------------------------------------------------------------------------
 

@@ -61,28 +61,59 @@ def sw_knobs(cfg, msg_bytes: int):
     4M x 8 (7.2x over two-sided) — so auto scales window to msg/16
     clamped to [256K, 4M] and deepens the pipeline for >= 32 MiB.
     Mirrors the reference's num_buffers/window tuning surface
-    (allreduce_sliding_window.h:36-38)."""
-    from ...utils.config import parse_memunits as _pm
+    (allreduce_sliding_window.h:36-38).
 
-    raw_w = raw_i = "auto"
+    ``Config.get`` returns PARSED values: ``parse_memunits``/
+    ``parse_uint_auto`` map the string "auto" to the ``SIZE_AUTO``
+    sentinel (and "inf" to ``SIZE_INF``/``UINT_MAX``), so detection
+    compares against the sentinels, never the raw string. ``inf`` has
+    no literal meaning for a scratch-buffer knob: both sentinels fall
+    back to auto rather than sizing an allocation from 2^64."""
+    from ...utils.config import SIZE_AUTO, SIZE_INF, UINT_MAX
+
+    w = i = SIZE_AUTO
     if cfg is not None:
         try:
-            raw_w = str(cfg.get("allreduce_sw_window")).strip()
+            w = int(cfg.get("allreduce_sw_window"))
         except KeyError:
             pass
         try:
-            raw_i = str(cfg.get("allreduce_sw_inflight")).strip()
+            i = int(cfg.get("allreduce_sw_inflight"))
         except KeyError:
             pass
-    if raw_w.lower() == "auto":
-        window = max(256 << 10, min(4 << 20, int(msg_bytes) // 16))
+    if w in (SIZE_AUTO, SIZE_INF):
+        window = max(SW_AUTO_MIN_WINDOW,
+                     min(SW_AUTO_MAX_WINDOW, int(msg_bytes) // 16))
     else:
-        window = int(_pm(raw_w))
-    if raw_i.lower() == "auto":
-        inflight = 8 if msg_bytes >= (32 << 20) else 4
+        window = w
+    if i in (SIZE_AUTO, UINT_MAX):
+        inflight = SW_AUTO_MAX_INFLIGHT \
+            if msg_bytes >= SW_DEEP_PIPELINE_MSG else SW_AUTO_MIN_INFLIGHT
     else:
-        inflight = int(raw_i)
+        inflight = i
     return window, max(1, inflight)
+
+
+#: auto-formula operating points from the round-4 TCP sweep (BASELINE.md):
+#: window clamps to [256K, 4M] at msg/16; the pipeline deepens from 4 to 8
+#: in-flight buffers at 32 MiB.
+SW_AUTO_MIN_WINDOW = 256 << 10
+SW_AUTO_MAX_WINDOW = 4 << 20
+SW_AUTO_MIN_INFLIGHT = 4
+SW_AUTO_MAX_INFLIGHT = 8
+SW_DEEP_PIPELINE_MSG = 32 << 20
+
+
+def sw_max_work_buffer(cfg) -> int:
+    """Upper bound on sliding-window scratch for a context attr query
+    (ucc_context_get_attr GLOBAL_WORK_BUFFER — the reference sizes it as
+    num_buffers x buffer segments before any collective is posted,
+    ucc_context.c get_attr path). Resolves explicit window/inflight from
+    ``cfg``; auto values take the auto-formula maxima 4M x 8 (probed with
+    a message large enough to hit both ceilings)."""
+    window, inflight = sw_knobs(cfg, max(SW_AUTO_MAX_WINDOW * 16,
+                                         SW_DEEP_PIPELINE_MSG))
+    return int(window) * int(inflight)
 
 
 class _Registry:

@@ -20,7 +20,7 @@ from ..ec.cpu import EcCpu
 from ..status import Status, UccError
 from ..utils.config import (ConfigField, ConfigTable, parse_memunits,
                             parse_mrange_uint, parse_string,
-                            register_table)
+                            parse_uint_auto, register_table)
 from .host.team import HostTlTeam
 from .host.transport import InProcTransport
 

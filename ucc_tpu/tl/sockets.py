@@ -26,8 +26,9 @@ from ..constants import COLL_TYPE_ALL, MemoryType
 from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
 from ..ec.cpu import EcCpu
 from ..status import Status, UccError
-from ..utils.config import (ConfigField, ConfigTable, parse_mrange_uint,
-                            parse_string, register_table)
+from ..utils.config import (ConfigField, ConfigTable, parse_memunits,
+                            parse_mrange_uint, parse_string,
+                            parse_uint_auto, register_table)
 from ..utils.log import get_logger
 from .host.onesided import (OS_FLUSH, OS_GET, OS_OPS, OS_PUT, REGISTRY,
                             local_os_get, local_os_put)
