@@ -13,6 +13,7 @@ ordered-allgather contract over DCN.
 from __future__ import annotations
 
 import pickle
+import select
 import socket
 import struct
 import threading
@@ -210,22 +211,47 @@ class TcpStoreOob(OobColl):
 
 
 class _TcpOobRequest(OobRequest):
+    """Genuinely nonblocking: ``test`` drains whatever bytes are ready
+    and returns IN_PROGRESS until the full blob (one pickled list of all
+    contributions) has arrived. Blocking here would deadlock drivers
+    that post team-OOB rounds at staggered times across ranks (e.g. the
+    CL-agreement allgather inside create_test): a rank stuck in recv
+    never lets the same process's next rank post its contribution."""
+
     def __init__(self, sock: socket.socket, size: int):
         self.sock = sock
         self.size = size
+        self._buf = b""
+        self._need: Optional[int] = None
         self._result: Optional[List[bytes]] = None
 
     def test(self) -> Status:
-        if self._result is None:
-            # one blob: pickled list of all contributions
-            hdr = _recv_exact(self.sock, 4)
-            (ln,) = struct.unpack("!I", hdr)
-            self._result = pickle.loads(_recv_exact(self.sock, ln))
-        return Status.OK
+        if self._result is not None:
+            return Status.OK
+        while True:
+            ready, _, _ = select.select([self.sock], [], [], 0)
+            if not ready:
+                return Status.IN_PROGRESS
+            # never read past THIS request's blob: surplus bytes would
+            # belong to the next allgather's response on the shared
+            # socket and dropping them would desync the stream
+            want = (4 - len(self._buf)) if self._need is None \
+                else (self._need - len(self._buf))
+            chunk = self.sock.recv(want)
+            if not chunk:
+                raise ConnectionError("OOB peer closed")
+            self._buf += chunk
+            if self._need is None and len(self._buf) >= 4:
+                (ln,) = struct.unpack("!I", self._buf[:4])
+                self._need = 4 + ln
+            if self._need is not None and len(self._buf) >= self._need:
+                self._result = pickle.loads(self._buf[4:self._need])
+                return Status.OK
 
     @property
     def result(self) -> List[bytes]:
-        self.test()
+        while self.test() == Status.IN_PROGRESS:
+            select.select([self.sock], [], [], 0.05)
         assert self._result is not None
         return self._result
 

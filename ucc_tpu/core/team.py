@@ -44,8 +44,9 @@ class TeamState(enum.IntEnum):
     SERVICE_TEAM = 1
     ALLOC_ID = 2
     CL_CREATE = 3
-    ACTIVE = 4
-    FAILED = 5
+    CL_AGREE = 4
+    ACTIVE = 5
+    FAILED = 6
 
 
 class Team:
@@ -169,6 +170,12 @@ class Team:
             st = self._cl_create_step()
             if st == Status.IN_PROGRESS:
                 return st
+            self.state = TeamState.CL_AGREE
+
+        if self.state == TeamState.CL_AGREE:
+            st = self._cl_agree_step()
+            if st == Status.IN_PROGRESS:
+                return st
             # build topo before activating (ucc_team.c:280-289)
             assert self.context.topo is not None and self.ctx_map is not None
             self.topo = TeamTopo(self.context.topo, self.ctx_map, self.rank)
@@ -256,6 +263,56 @@ class Team:
         if not self.cl_teams:
             raise UccError(Status.ERR_NO_RESOURCE,
                            "no CL could create a team")
+        return Status.OK
+
+    def _cl_agree_step(self) -> Status:
+        """Agree on the surviving CL set across the team.
+
+        In the reference, a CL team create fails COLLECTIVELY because its
+        TL subteam creates ride service collectives — so ucc_team.c's
+        local fallback (:295-317) cannot diverge across ranks. Our CL
+        creates can fail asymmetrically (e.g. cl/hier's NODE_LEADERS unit
+        has no TL only on leader ranks), which would leave ranks with
+        different score maps and deadlock the first collective. One
+        cheap agreement round closes that hole: allgather the local CL
+        name set, keep only CLs that exist EVERYWHERE."""
+        if self.size == 1:
+            return Status.OK
+        # The channel must be chosen from TEAM-INVARIANT facts only:
+        # every member has an OOB or none does, and SubsetOob-ness is
+        # uniform (create_from_parent gives it to all members). A
+        # per-rank choice (e.g. "service team if I have one") would
+        # itself diverge under exactly the component-load asymmetry this
+        # step exists to reconcile, and deadlock. SubsetOob rounds would
+        # require non-member participation (core/oob.py contract) and
+        # ep_map teams have no OOB at all — both skip: their CL sets can
+        # only diverge through component-load asymmetry, which the
+        # OOB-rooted parent team has already reconciled.
+        from .oob import SubsetOob
+        if self.oob is None or isinstance(self.oob, SubsetOob):
+            return Status.OK
+        if self._pending_req is None:
+            names = sorted(t.name for t in self.cl_teams)
+            self._pending_req = self.oob.allgather(pickle.dumps(names))
+        req = self._pending_req
+        if req.test() == Status.IN_PROGRESS:
+            return Status.IN_PROGRESS
+        per_rank = [set(pickle.loads(b)) for b in req.result]
+        req.free()
+        self._pending_req = None
+        common = set.intersection(*per_rank) if per_rank else set()
+        dropped = [t for t in self.cl_teams if t.name not in common]
+        if dropped:
+            logger.warning(
+                "CL(s) %s created on this rank but not team-wide; "
+                "dropping for a consistent score map",
+                ",".join(t.name for t in dropped))
+            for t in dropped:
+                t.destroy()
+            self.cl_teams = [t for t in self.cl_teams if t.name in common]
+        if not self.cl_teams:
+            raise UccError(Status.ERR_NO_RESOURCE,
+                           "no CL survived team-wide agreement")
         return Status.OK
 
     def _build_score_map(self) -> None:
