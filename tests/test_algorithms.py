@@ -355,3 +355,114 @@ class TestAllreduceDbt:
             dst=BufferInfo(bufs[r], count, DataType.FLOAT64),
             op=ReductionOp.AVG,
             flags=CollArgsFlags.IN_PLACE), check, monkeypatch)
+
+
+class TestSraSrgRadix:
+    """Arbitrary-radix SRA/SRG (sra_knomial.h generalizes the halving to
+    radix r): radices {2,3,4} x pow2/non-pow2 team sizes, with the
+    mrange radix knob steering selection."""
+
+    @pytest.mark.parametrize("radix", [2, 3, 4])
+    @pytest.mark.parametrize("n", [4, 5, 8, 9])
+    @pytest.mark.parametrize("count", [1, 17, 4096])
+    def test_sra_allreduce(self, radix, n, count, monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_ALLREDUCE_SRA_RADIX",
+                           f"0-inf:{radix}")
+        rng = np.random.default_rng(7 + radix)
+        srcs = [(rng.random(count) * 4 - 2).astype(np.float32)
+                for _ in range(n)]
+        dsts = [np.zeros(count, np.float32) for _ in range(n)]
+        expect = np.sum(srcs, axis=0)
+
+        def check():
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r], expect, rtol=1e-4,
+                                           atol=1e-5)
+
+        run_with_tune("allreduce:@sra_knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+            op=ReductionOp.SUM), check, monkeypatch)
+
+    @pytest.mark.parametrize("radix", [2, 3, 4])
+    @pytest.mark.parametrize("n", [4, 9])
+    def test_sra_allreduce_avg(self, radix, n, monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_ALLREDUCE_SRA_RADIX",
+                           f"0-inf:{radix}")
+        count = 333
+        srcs = [np.full(count, float(r + 1), np.float64) for r in range(n)]
+        dsts = [np.zeros(count, np.float64) for _ in range(n)]
+        expect = np.mean(srcs, axis=0)
+
+        def check():
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r], expect, rtol=1e-12)
+
+        run_with_tune("allreduce:@sra_knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+            op=ReductionOp.AVG), check, monkeypatch)
+
+    @pytest.mark.parametrize("radix", [2, 3, 4])
+    @pytest.mark.parametrize("n", [4, 5, 9])
+    @pytest.mark.parametrize("root", [0, 1])
+    def test_srg_reduce(self, radix, n, root, monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_REDUCE_SRG_RADIX",
+                           f"0-inf:{radix}")
+        count = 1025
+        srcs = [np.arange(count, dtype=np.int64) + r for r in range(n)]
+        dsts = [np.zeros(count, np.int64) for _ in range(n)]
+        expect = np.sum(srcs, axis=0)
+
+        def check():
+            np.testing.assert_array_equal(dsts[root], expect)
+
+        run_with_tune("reduce:@srg_knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.REDUCE,
+            src=BufferInfo(srcs[r], count, DataType.INT64),
+            dst=BufferInfo(dsts[r], count, DataType.INT64),
+            op=ReductionOp.SUM, root=root), check, monkeypatch)
+
+    def test_srg_reduce_extra_root(self, monkeypatch):
+        """Root beyond the power-of-radix boundary (an EXTRA rank): the
+        proxy must forward the gathered result to it."""
+        monkeypatch.setenv("UCC_TL_SHM_REDUCE_SRG_RADIX", "0-inf:3")
+        n, count, root = 5, 257, 4     # full=3, ranks 3,4 are extras
+        srcs = [np.full(count, r + 1.0, np.float32) for r in range(n)]
+        dsts = [np.zeros(count, np.float32) for _ in range(n)]
+
+        def check():
+            np.testing.assert_allclose(dsts[root],
+                                       np.full(count, 15.0), rtol=1e-5)
+
+        run_with_tune("reduce:@srg_knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.REDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+            op=ReductionOp.SUM, root=root), check, monkeypatch)
+
+    def test_mrange_knob_steers_radix_per_size(self, monkeypatch):
+        """The per-msg-range knob surface: small msgs radix 4, large
+        radix 2 — both must select and complete."""
+        monkeypatch.setenv("UCC_TL_SHM_ALLREDUCE_SRA_RADIX",
+                           "0-4k:4,4k-inf:2")
+        n = 8
+        for count in (64, 4096):
+            srcs = [np.full(count, r + 1.0, np.float32) for r in range(n)]
+            dsts = [np.zeros(count, np.float32) for _ in range(n)]
+            expect = np.sum(srcs, axis=0)
+
+            def check():
+                for r in range(n):
+                    np.testing.assert_allclose(dsts[r], expect, rtol=1e-4)
+
+            run_with_tune("allreduce:@sra_knomial:inf", n,
+                          lambda r: CollArgs(
+                              coll_type=CollType.ALLREDUCE,
+                              src=BufferInfo(srcs[r], count,
+                                             DataType.FLOAT32),
+                              dst=BufferInfo(dsts[r], count,
+                                             DataType.FLOAT32),
+                              op=ReductionOp.SUM), check, monkeypatch)

@@ -1,0 +1,50 @@
+"""Config fields shared by every host-algorithm TL (shm + socket).
+
+Both transports run the identical algorithm suite (tl/host/), so the
+algorithm-tuning knob surface is defined ONCE here and extended with
+per-transport fields (shm: EAGER_THRESH, socket: BIND_HOST) in the TL
+modules. The reference keeps the analogous shared surface in
+tl_ucp_lib_config (tl_ucp.h) used by every UCP transport path.
+
+ConfigField instances are immutable descriptors; the env-var prefix
+comes from the owning table, so sharing the objects between tables is
+safe (UCC_TL_SHM_ALLREDUCE_SW_WINDOW / UCC_TL_SOCKET_... resolve
+independently).
+"""
+from __future__ import annotations
+
+from ...utils.config import (ConfigField, parse_memunits, parse_mrange_uint,
+                             parse_string, parse_uint_auto)
+
+HOST_ALG_FIELDS = [
+    ConfigField("ALLREDUCE_KN_RADIX", "0-inf:4",
+                "allreduce knomial radix per msg range", parse_mrange_uint),
+    ConfigField("ALLREDUCE_SRA_RADIX", "0-inf:auto", "SRA allreduce "
+                "scatter-reduce-allgather radix per msg range "
+                "(auto = 2, the canonical halving instance)",
+                parse_mrange_uint),
+    ConfigField("REDUCE_SRG_RADIX", "0-inf:auto", "SRG reduce "
+                "scatter-reduce-gather radix per msg range (auto = 2)",
+                parse_mrange_uint),
+    ConfigField("BCAST_KN_RADIX", "0-inf:4", "bcast tree radix",
+                parse_mrange_uint),
+    ConfigField("REDUCE_KN_RADIX", "0-inf:4", "reduce tree radix",
+                parse_mrange_uint),
+    ConfigField("BARRIER_KN_RADIX", "0-inf:4",
+                "barrier dissemination radix", parse_mrange_uint),
+    ConfigField("ALLTOALL_ONESIDED_ALG", "put", "one-sided alltoall "
+                "variant: put (counter completion) | get (barrier)",
+                parse_string),
+    ConfigField("ALLTOALLV_ONESIDED_ALG", "put", "one-sided alltoallv "
+                "variant: put (counter completion; reference parity) | "
+                "get (barrier; beyond-reference)", parse_string),
+    ConfigField("ALLREDUCE_SW_WINDOW", "auto", "sliding-window "
+                "allreduce window bytes; auto = max(256K, min(4M, "
+                "msg/16)) from the round-4 TCP sweep (BASELINE.md)",
+                parse_memunits),
+    ConfigField("ALLREDUCE_SW_INFLIGHT", "auto", "sliding-window "
+                "allreduce in-flight get buffers (reference "
+                "num_buffers, allreduce_sliding_window.h:36-38); "
+                "auto = 8 for msgs >= 32M else 4 (round-4 sweep)",
+                parse_uint_auto),
+]

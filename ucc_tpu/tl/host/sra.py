@@ -1,15 +1,19 @@
-"""SRA allreduce — scatter-reduce + allgather (bandwidth algorithm).
+"""SRA allreduce / SRG reduce — scatter-reduce + (all)gather, radix r.
 
 Ports the semantics of the reference's SRA-knomial allreduce
 (/root/reference/src/components/tl/ucp/coll_patterns/sra_knomial.h and
-allreduce/allreduce_sra_knomial.c): reduce-scatter by recursive vector
-halving, then allgather by recursive doubling, with the extra/proxy fold
-for non-power-of-two team sizes. O(log N) rounds moving ~2·(N-1)/N of the
-vector — the bandwidth-optimal tree algorithm for large messages.
+allreduce/allreduce_sra_knomial.c) and SRG-knomial reduce
+(reduce/reduce_srg_knomial.c): reduce-scatter by recursive vector
+splitting at radix r, then allgather (SRA) or gather-to-root (SRG) by
+replaying the splits in reverse, with the extra/proxy fold for
+non-power-of-radix team sizes. O(log_r N) rounds moving ~(N-1)/N of the
+vector each direction — bandwidth-optimal at every radix; higher radix
+trades per-round fan-out ((r-1) concurrent messages) for fewer rounds.
 
-(The reference generalizes to radix r; radix 2 is the canonical and most
-bandwidth-efficient instance and is what this port implements. The ring
-algorithm covers the very-large-message regime.)
+Radix comes from the per-mrange config knobs ``ALLREDUCE_SRA_RADIX`` /
+``REDUCE_SRG_RADIX`` (reference: UCC_TL_UCP_ALLREDUCE_SRA_KN_RADIX,
+tl_ucp.h mrange knobs) or an explicit constructor arg; default 2, the
+canonical halving instance.
 """
 from __future__ import annotations
 
@@ -19,22 +23,105 @@ import numpy as np
 
 from ...constants import ReductionOp, dt_numpy
 from ...ec.cpu import reduce_arrays
-from .knomial import largest_pow
+from .knomial import clamp_radix, largest_pow
 from .task import HostCollTask
 
 
-class AllreduceSraKnomial(HostCollTask):
-    def __init__(self, init_args, team, subset=None, radix: Optional[int] = None):
+def _part(lo: int, hi: int, r: int, t: int) -> Tuple[int, int]:
+    """Balanced sub-segment t of [lo, hi) split r ways (pure — every
+    group member computes identical bounds)."""
+    n = hi - lo
+    return lo + (t * n) // r, lo + ((t + 1) * n) // r
+
+
+def _owned_segment(rank: int, count: int, full: int, r: int) -> Tuple[int, int]:
+    """Replay the radix-r splits: the (lo, hi) segment ``rank`` owns
+    after the reduce-scatter phase."""
+    lo, hi = 0, count
+    dist = full // r
+    while dist >= 1:
+        lo, hi = _part(lo, hi, r, (rank // dist) % r)
+        dist //= r
+    return lo, hi
+
+
+class _SraBase(HostCollTask):
+    """Shared radix-r scatter-reduce phase + extra/proxy fold.
+
+    Extra ranks (>= full = r^k) fold into proxy ``me % full`` before the
+    loop and are unfolded after, the same multi-extra-per-proxy
+    distribution the knomial patterns use
+    (coll_patterns/recursive_knomial.h:98-105,172-179).
+    """
+
+    def _fold_extras(self, work, op, slot_base: int):
+        """Proxy side: receive + reduce every extra's vector."""
+        size, me = self.gsize, self.grank
+        full = self.full
+        nd = work.dtype
+        gen = 1
+        pending = []
+        while gen * full + me < size:
+            buf = np.empty(self.count, dtype=nd)
+            pending.append((buf, self.recv_nb(gen * full + me, buf,
+                                              slot=slot_base + gen)))
+            gen += 1
+        if pending:
+            yield from self.wait(*[rq for _, rq in pending])
+            work[:] = reduce_arrays([work] + [b for b, _ in pending],
+                                    op, self.dt)
+
+    def _scatter_reduce(self, work, op, slot_base: int):
+        """Radix-r recursive vector splitting; returns my (lo, hi)."""
+        me, r, full = self.grank, self.radix, self.full
+        lo, hi = 0, self.count
+        # round-0 pieces are the largest: (r-1) peer copies of my part
+        max_piece = (self.count + r - 1) // r + 1
+        scratch = np.empty((r - 1, max_piece), dtype=work.dtype)
+        dist = full // r
+        rnd = 0
+        while dist >= 1:
+            d = (me // dist) % r
+            base = me - d * dist
+            keep = _part(lo, hi, r, d)
+            reqs, pieces = [], []
+            for t in range(r):
+                if t == d:
+                    continue
+                peer = base + t * dist
+                give = _part(lo, hi, r, t)
+                reqs.append(self.send_nb(peer, work[give[0]:give[1]],
+                                         slot=slot_base + rnd))
+                piece = scratch[len(pieces), :keep[1] - keep[0]]
+                pieces.append(piece)
+                reqs.append(self.recv_nb(peer, piece,
+                                         slot=slot_base + rnd))
+            yield from self.wait(*reqs)
+            seg = work[keep[0]:keep[1]]
+            if keep[1] > keep[0]:
+                seg[:] = reduce_arrays([seg] + pieces, op, self.dt)
+            lo, hi = keep
+            dist //= r
+            rnd += 1
+        self._seg = (lo, hi)
+
+
+class AllreduceSraKnomial(_SraBase):
+    def __init__(self, init_args, team, subset=None,
+                 radix: Optional[int] = None):
         super().__init__(init_args, team, subset)
         args = init_args.args
         self.count = int(args.dst.count)
         self.dt = args.dst.datatype
         self.op = args.op if args.op is not None else ReductionOp.SUM
+        self.radix = clamp_radix(
+            radix or team.cfg_radix("allreduce_sra_radix",
+                                    init_args.msgsize, default=2),
+            self.gsize)
+        self.full = largest_pow(self.gsize, self.radix)
 
     def run(self):
         args = self.args
-        nd = dt_numpy(self.dt)
-        dst = binfo = None
         from ..base import binfo_typed
         dst = binfo_typed(args.dst, self.count)
         if not args.is_inplace:
@@ -46,83 +133,73 @@ class AllreduceSraKnomial(HostCollTask):
                 dst[:] = reduce_arrays([dst], ReductionOp.SUM, self.dt,
                                        alpha=1.0)
             return
-        full = largest_pow(size, 2)
-        n_extra = size - full
+        r, full = self.radix, self.full
 
-        # EXTRA fold (same structure as allreduce_knomial EXTRA phase)
+        # EXTRA fold: hand the vector to the proxy, get the result back
         if me >= full:
-            proxy = me - full
-            yield from self.wait(self.send_nb(proxy, dst, slot=0))
-            yield from self.wait(self.recv_nb(proxy, dst, slot=1))
+            proxy = me % full
+            gen = me // full
+            yield from self.wait(self.send_nb(proxy, dst, slot=1000 + gen))
+            yield from self.wait(self.recv_nb(proxy, dst, slot=2000 + gen))
             return
-        if me < n_extra:
-            extra = np.empty(self.count, dtype=nd)
-            yield from self.wait(self.recv_nb(full + me, extra, slot=0))
-            dst[:] = reduce_arrays([dst, extra], op, self.dt)
+        yield from self._fold_extras(dst, op, slot_base=1000)
 
-        # reduce-scatter: recursive vector halving
-        lo, hi = 0, self.count
-        dist = full // 2
-        scratch = np.empty((self.count + 1) // 2, dtype=nd)
-        rnd = 0
-        while dist >= 1:
-            partner = me ^ dist
-            mid = lo + (hi - lo) // 2
-            if me & dist == 0:
-                keep = (lo, mid)
-                give = (mid, hi)
-            else:
-                keep = (mid, hi)
-                give = (lo, mid)
-            rview = scratch[:keep[1] - keep[0]]
-            yield from self.sendrecv(partner, dst[give[0]:give[1]],
-                                     partner, rview, slot=2 + rnd)
-            seg = dst[keep[0]:keep[1]]
-            seg[:] = reduce_arrays([seg, rview], op, self.dt)
-            lo, hi = keep
-            dist //= 2
-            rnd += 1
+        # reduce-scatter: radix-r recursive vector splitting
+        yield from self._scatter_reduce(dst, op, slot_base=2)
+        lo, hi = self._seg
 
         if self.op == ReductionOp.AVG and hi > lo:
-            dst[lo:hi] = reduce_arrays([dst[lo:hi]], ReductionOp.SUM, self.dt,
-                                       alpha=1.0 / size)
+            dst[lo:hi] = reduce_arrays([dst[lo:hi]], ReductionOp.SUM,
+                                       self.dt, alpha=1.0 / size)
 
-        # allgather: recursive doubling, segments mirror the halving path
-        # replay the segment splits to know each round's partner segment
-        segs: List[Tuple[int, int, int]] = []   # (dist, lo, hi) per round
+        # allgather: replay the splits in reverse — at each level every
+        # group member broadcasts its (now fully reduced+gathered deeper
+        # levels) part to the r-1 peers and receives theirs
+        segs: List[Tuple[int, int, int]] = []   # (dist, lo, hi) pre-split
         lo2, hi2 = 0, self.count
-        dist = full // 2
+        dist = full // r
         while dist >= 1:
-            mid = lo2 + (hi2 - lo2) // 2
             segs.append((dist, lo2, hi2))
-            lo2, hi2 = (lo2, mid) if me & dist == 0 else (mid, hi2)
-            dist //= 2
+            lo2, hi2 = _part(lo2, hi2, r, (me // dist) % r)
+            dist //= r
         for rnd, (dist, slo, shi) in enumerate(reversed(segs)):
-            partner = me ^ dist
-            mid = slo + (shi - slo) // 2
-            if me & dist == 0:
-                mine = (slo, mid)
-                theirs = (mid, shi)
-            else:
-                mine = (mid, shi)
-                theirs = (slo, mid)
-            yield from self.sendrecv(partner, dst[mine[0]:mine[1]],
-                                     partner, dst[theirs[0]:theirs[1]],
-                                     slot=100 + rnd)
+            d = (me // dist) % r
+            base = me - d * dist
+            mine = _part(slo, shi, r, d)
+            reqs = []
+            for t in range(r):
+                if t == d:
+                    continue
+                peer = base + t * dist
+                theirs = _part(slo, shi, r, t)
+                if mine[1] > mine[0]:
+                    reqs.append(self.send_nb(peer, dst[mine[0]:mine[1]],
+                                             slot=100 + rnd))
+                if theirs[1] > theirs[0]:
+                    reqs.append(self.recv_nb(peer, dst[theirs[0]:theirs[1]],
+                                             slot=100 + rnd))
+            yield from self.wait(*reqs)
 
-        # PROXY unfold
-        if me < n_extra:
-            yield from self.wait(self.send_nb(full + me, dst, slot=1))
+        # PROXY unfold: send the full result to every folded extra
+        gen = 1
+        reqs = []
+        while gen * full + me < size:
+            reqs.append(self.send_nb(gen * full + me, dst,
+                                     slot=2000 + gen))
+            gen += 1
+        if reqs:
+            yield from self.wait(*reqs)
 
 
-class ReduceSrgKnomial(HostCollTask):
+class ReduceSrgKnomial(_SraBase):
     """SRG reduce (reduce_srg_knomial.c): Scatter-Reduce + Gather — the
-    bandwidth-optimal rooted reduce for large vectors. Phase 1 is the same
-    recursive vector-halving reduce-scatter SRA uses; phase 2 gathers the
-    reduced segments to the root instead of allgathering them. AVG runs
-    SUM with each owner scaling its segment before the gather."""
+    bandwidth-optimal rooted reduce for large vectors. Phase 1 is the
+    radix-r reduce-scatter SRA uses; phase 2 gathers the reduced segments
+    to the root instead of allgathering. AVG runs SUM with each owner
+    scaling its segment before the gather."""
 
-    def __init__(self, init_args, team, subset=None):
+    def __init__(self, init_args, team, subset=None,
+                 radix: Optional[int] = None):
         super().__init__(init_args, team, subset)
         args = init_args.args
         src_bi = args.dst if args.is_inplace or args.src is None else args.src
@@ -130,18 +207,11 @@ class ReduceSrgKnomial(HostCollTask):
         self.dt = src_bi.datatype
         self.op = args.op if args.op is not None else ReductionOp.SUM
         self.root = int(args.root)
-
-    @staticmethod
-    def _segment_of(rank: int, count: int, full: int) -> Tuple[int, int]:
-        """Replay the halving splits: the (lo, hi) segment `rank` owns
-        after the reduce-scatter phase (pure function, both ends agree)."""
-        lo, hi = 0, count
-        dist = full // 2
-        while dist >= 1:
-            mid = lo + (hi - lo) // 2
-            lo, hi = (lo, mid) if rank & dist == 0 else (mid, hi)
-            dist //= 2
-        return lo, hi
+        self.radix = clamp_radix(
+            radix or team.cfg_radix("reduce_srg_radix",
+                                    init_args.msgsize, default=2),
+            self.gsize)
+        self.full = largest_pow(self.gsize, self.radix)
 
     def run(self):
         from ..base import binfo_typed
@@ -169,42 +239,22 @@ class ReduceSrgKnomial(HostCollTask):
                                         alpha=1.0)
             return
 
-        full = largest_pow(size, 2)
-        n_extra = size - full
+        r, full = self.radix, self.full
 
-        # EXTRA fold (knomial pattern): extras hand their vector to the
-        # proxy; an extra ROOT receives the final result back
+        # EXTRA fold: extras hand their vector to the proxy; an extra
+        # ROOT receives the final result back
         if me >= full:
-            proxy = me - full
-            yield from self.wait(self.send_nb(proxy, work, slot=170))
+            proxy = me % full
+            gen = me // full
+            yield from self.wait(self.send_nb(proxy, work, slot=170 * 100 + gen))
             if is_root:
                 yield from self.wait(self.recv_nb(proxy, work, slot=171))
             return
-        if me < n_extra:
-            extra = np.empty(self.count, dtype=nd)
-            yield from self.wait(self.recv_nb(full + me, extra, slot=170))
-            work[:] = reduce_arrays([work, extra], op, self.dt)
+        yield from self._fold_extras(work, op, slot_base=170 * 100)
 
-        # phase 1: recursive vector halving reduce-scatter
-        lo, hi = 0, self.count
-        dist = full // 2
-        scratch = np.empty((self.count + 1) // 2, dtype=nd)
-        rnd = 0
-        while dist >= 1:
-            partner = me ^ dist
-            mid = lo + (hi - lo) // 2
-            if me & dist == 0:
-                keep, give = (lo, mid), (mid, hi)
-            else:
-                keep, give = (mid, hi), (lo, mid)
-            rview = scratch[:keep[1] - keep[0]]
-            yield from self.sendrecv(partner, work[give[0]:give[1]],
-                                     partner, rview, slot=172 + rnd)
-            seg = work[keep[0]:keep[1]]
-            seg[:] = reduce_arrays([seg, rview], op, self.dt)
-            lo, hi = keep
-            dist //= 2
-            rnd += 1
+        # phase 1: radix-r reduce-scatter
+        yield from self._scatter_reduce(work, op, slot_base=172)
+        lo, hi = self._seg
 
         if self.op == ReductionOp.AVG and hi > lo:
             work[lo:hi] = reduce_arrays([work[lo:hi]], ReductionOp.SUM,
@@ -212,20 +262,18 @@ class ReduceSrgKnomial(HostCollTask):
 
         # phase 2: gather segments to the root (root's proxy when the
         # root is an extra rank)
-        sink = self.root if self.root < full else self.root - full
+        sink = self.root % full
         if me == sink:
             reqs = []
             for p in range(full):
                 if p == sink:
                     continue
-                plo, phi = self._segment_of(p, self.count, full)
+                plo, phi = _owned_segment(p, self.count, full, r)
                 if phi > plo:
                     reqs.append(self.recv_nb(p, work[plo:phi], slot=190))
             yield from self.wait(*reqs)
             if self.root >= full:           # forward to the extra root
                 yield from self.wait(self.send_nb(self.root, work,
                                                   slot=171))
-            elif not is_root:
-                pass
         elif hi > lo:
             yield from self.wait(self.send_nb(sink, work[lo:hi], slot=190))

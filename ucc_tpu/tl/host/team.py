@@ -92,18 +92,18 @@ class HostTlTeam(TlTeamBase):
         self._coll_tag += 1
         return self._coll_tag
 
-    def cfg_radix(self, knob: str, msgsize: int) -> int:
+    def cfg_radix(self, knob: str, msgsize: int, default: int = 4) -> int:
         cfg = self.comp_context.config
         if cfg is None:
-            return 4
+            return default
         try:
             val = cfg.get(knob)
         except KeyError:
-            return 4
+            return default
         from ...utils.config import MRangeUint, SIZE_AUTO
         if isinstance(val, MRangeUint):
             v = val.get(msgsize)
-            return 4 if v == SIZE_AUTO else int(v)
+            return default if v == SIZE_AUTO else int(v)
         return int(val)
 
     # -- p2p by group rank ---------------------------------------------

@@ -19,42 +19,29 @@ from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
 from ..ec.cpu import EcCpu
 from ..status import Status, UccError
 from ..utils.config import (ConfigField, ConfigTable, parse_memunits,
-                            parse_mrange_uint, parse_string,
-                            parse_uint_auto, register_table)
+                            register_table)
+from .host.config_fields import HOST_ALG_FIELDS
 from .host.team import HostTlTeam
 from .host.transport import InProcTransport
 
 TL_SHM_CONFIG = register_table(ConfigTable(
-    prefix="TL_SHM_", name="tl/shm", fields=[
-        ConfigField("ALLREDUCE_KN_RADIX", "0-inf:4",
-                    "allreduce knomial radix per msg range", parse_mrange_uint),
-        ConfigField("BCAST_KN_RADIX", "0-inf:4", "bcast tree radix",
-                    parse_mrange_uint),
-        ConfigField("REDUCE_KN_RADIX", "0-inf:4", "reduce tree radix",
-                    parse_mrange_uint),
-        ConfigField("BARRIER_KN_RADIX", "0-inf:4", "barrier dissemination "
-                    "radix", parse_mrange_uint),
+    prefix="TL_SHM_", name="tl/shm", fields=HOST_ALG_FIELDS + [
         ConfigField("EAGER_THRESH", "8k", "eager copy threshold; larger "
                     "sends are zero-copy rendezvous", parse_memunits),
-        ConfigField("ALLTOALL_ONESIDED_ALG", "put", "one-sided alltoall "
-                    "variant: put (counter completion) | get (barrier)",
-                    parse_string),
-        ConfigField("ALLREDUCE_SW_WINDOW", "auto", "sliding-window "
-                    "allreduce window bytes; auto = max(256K, min(4M, "
-                    "msg/16)) from the round-4 TCP sweep (BASELINE.md)",
-                    parse_memunits),
-        ConfigField("ALLREDUCE_SW_INFLIGHT", "auto", "sliding-window "
-                    "allreduce in-flight get buffers (reference "
-                    "num_buffers, allreduce_sliding_window.h:36-38); "
-                    "auto = 8 for msgs >= 32M else 4 (round-4 sweep)",
-                    parse_uint_auto),
     ]))
 
 
 class TlShmContext(BaseContext):
     def __init__(self, comp_lib, core_context, config):
         super().__init__(comp_lib, core_context, config)
-        self.transport = InProcTransport()
+        # GIL-released C++ matching wins 3.6x when many OS threads drive
+        # progress concurrently (tools/native_bench.py, BASELINE.md), so
+        # MULTIPLE defaults to the native matcher; single-threaded it
+        # loses ~2x to the in-GIL matcher and stays Python. The
+        # UCC_TL_SHM_NATIVE env knob still overrides either way.
+        from ..constants import ThreadMode
+        mt = core_context.lib.params.thread_mode == ThreadMode.MULTIPLE
+        self.transport = InProcTransport(default_native=mt)
         if config is not None:
             self.transport.EAGER_THRESHOLD = config.eager_thresh
         self.executor = EcCpu()

@@ -26,10 +26,10 @@ from ..constants import COLL_TYPE_ALL, MemoryType
 from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
 from ..ec.cpu import EcCpu
 from ..status import Status, UccError
-from ..utils.config import (ConfigField, ConfigTable, parse_memunits,
-                            parse_mrange_uint, parse_string,
-                            parse_uint_auto, register_table)
+from ..utils.config import (ConfigField, ConfigTable, parse_string,
+                            register_table)
 from ..utils.log import get_logger
+from .host.config_fields import HOST_ALG_FIELDS
 from .host.onesided import (OS_FLUSH, OS_GET, OS_OPS, OS_PUT, REGISTRY,
                             local_os_get, local_os_put)
 from .host.team import HostTlTeam
@@ -68,29 +68,9 @@ class FlushReq:
         return True
 
 TL_SOCKET_CONFIG = register_table(ConfigTable(
-    prefix="TL_SOCKET_", name="tl/socket", fields=[
-        ConfigField("ALLREDUCE_KN_RADIX", "0-inf:4", "allreduce knomial "
-                    "radix", parse_mrange_uint),
-        ConfigField("BCAST_KN_RADIX", "0-inf:4", "bcast tree radix",
-                    parse_mrange_uint),
-        ConfigField("REDUCE_KN_RADIX", "0-inf:4", "reduce tree radix",
-                    parse_mrange_uint),
-        ConfigField("BARRIER_KN_RADIX", "0-inf:4", "barrier radix",
-                    parse_mrange_uint),
+    prefix="TL_SOCKET_", name="tl/socket", fields=HOST_ALG_FIELDS + [
         ConfigField("BIND_HOST", "", "address to bind/advertise (default: "
                     "auto-detect, 127.0.0.1 fallback)", parse_string),
-        ConfigField("ALLTOALL_ONESIDED_ALG", "put", "one-sided alltoall "
-                    "variant: put (counter completion) | get (barrier)",
-                    parse_string),
-        ConfigField("ALLREDUCE_SW_WINDOW", "auto", "sliding-window "
-                    "allreduce window bytes; auto = max(256K, min(4M, "
-                    "msg/16)) from the round-4 TCP sweep (BASELINE.md)",
-                    parse_memunits),
-        ConfigField("ALLREDUCE_SW_INFLIGHT", "auto", "sliding-window "
-                    "allreduce in-flight get buffers (reference "
-                    "num_buffers, allreduce_sliding_window.h:36-38); "
-                    "auto = 8 for msgs >= 32M else 4 (round-4 sweep)",
-                    parse_uint_auto),
     ]))
 
 
