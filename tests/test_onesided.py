@@ -361,6 +361,118 @@ def _sw_args(srcs, dsts, sh, dh, op, dt, count, inplace=False):
     return make
 
 
+class TestAlltoallvOnesidedGet:
+    """Beyond-reference GET variant (the reference alltoallv_onesided.c
+    is put-only): readers pull blocks out of peers' source segments; a
+    closing barrier keeps src segments readable (same protocol as the
+    non-v alltoall get, tl_ucp.h:46-51)."""
+
+    @staticmethod
+    def _job_get(monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_TUNE", "alltoallv:@onesided")
+        monkeypatch.setenv("UCC_TL_SHM_ALLTOALLV_ONESIDED_ALG", "get")
+        return UccJob(4)
+
+    def test_explicit_memh_target_relative(self, monkeypatch):
+        """src.displacements are TARGET-relative in get mode: the offset
+        inside PEER's source buffer of the block destined for me — the
+        exact mirror of the put convention."""
+        job = self._job_get(monkeypatch)
+        try:
+            n = 4
+            teams = job.create_team()
+            m = [[(r + p) % 3 + 1 for p in range(n)] for r in range(n)]
+            recv_counts = [[m[q][p] for q in range(n)] for p in range(n)]
+            srcs, dsts, s_displ_target = [], [], []
+            for r in range(n):
+                srcs.append(np.arange(sum(m[r]), dtype=np.int32) + 1000 * r)
+                dsts.append(np.full(sum(recv_counts[r]), -1, np.int32))
+                # target-relative: block-for-me's offset inside peer p's
+                # SOURCE buffer = sum of p's sends to ranks before me
+                s_displ_target.append(
+                    [sum(m[p][q] for q in range(r)) for p in range(n)])
+            handles = [job.contexts[r].mem_map(srcs[r]) for r in range(n)]
+            from ucc_tpu import BufferInfoV
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.ALLTOALLV,
+                src=BufferInfoV(srcs[r], m[r], s_displ_target[r],
+                                DataType.INT32),
+                dst=BufferInfoV(dsts[r], recv_counts[r], None,
+                                DataType.INT32),
+                src_memh=list(handles),
+                flags=CollArgsFlags.MEM_MAP_SRC_MEMH))
+            for p in range(n):
+                sdispl = {q: np.cumsum([0] + m[q][:-1]) for q in range(n)}
+                expect = np.concatenate([
+                    srcs[q][sdispl[q][p]:sdispl[q][p] + m[q][p]]
+                    for q in range(n)])
+                np.testing.assert_array_equal(dsts[p], expect)
+        finally:
+            job.cleanup()
+
+    def test_bootstrap_mode_standard_semantics(self, monkeypatch):
+        """Without memh the get-mode bootstrap exchange carries each
+        rank's SEND displacements, so standard MPI alltoallv args just
+        work."""
+        job = self._job_get(monkeypatch)
+        try:
+            n = 4
+            teams = job.create_team()
+            m = [[(r * 2 + p) % 3 + 1 for p in range(n)] for r in range(n)]
+            recv_counts = [[m[q][p] for q in range(n)] for p in range(n)]
+            srcs, dsts = [], []
+            for r in range(n):
+                srcs.append(np.arange(sum(m[r]), dtype=np.int32) + 1000 * r)
+                dsts.append(np.full(sum(recv_counts[r]), -1, np.int32))
+            from ucc_tpu import BufferInfoV
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.ALLTOALLV,
+                src=BufferInfoV(srcs[r], m[r], None, DataType.INT32),
+                dst=BufferInfoV(dsts[r], recv_counts[r], None,
+                                DataType.INT32)))
+            for p in range(n):
+                sdispl = {q: np.cumsum([0] + m[q][:-1]) for q in range(n)}
+                expect = np.concatenate([
+                    srcs[q][sdispl[q][p]:sdispl[q][p] + m[q][p]]
+                    for q in range(n)])
+                np.testing.assert_array_equal(dsts[p], expect)
+        finally:
+            job.cleanup()
+
+    def test_zero_count_peer(self, monkeypatch):
+        """A peer that sends me nothing: zero-byte get + barrier still
+        complete (the put path has the mirror-image test above)."""
+        job = self._job_get(monkeypatch)
+        try:
+            n = 4
+            teams = job.create_team()
+            # rank 0 sends nothing to anyone; others send 2 elems each
+            m = [[0] * n] + [[2] * n for _ in range(n - 1)]
+            recv_counts = [[m[q][p] for q in range(n)] for p in range(n)]
+            srcs, dsts = [], []
+            for r in range(n):
+                srcs.append(np.arange(max(sum(m[r]), 1),
+                                      dtype=np.int32) + 1000 * r)
+                dsts.append(np.full(max(sum(recv_counts[r]), 1), -1,
+                                    np.int32))
+            from ucc_tpu import BufferInfoV
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.ALLTOALLV,
+                src=BufferInfoV(srcs[r], m[r], None, DataType.INT32),
+                dst=BufferInfoV(dsts[r], recv_counts[r], None,
+                                DataType.INT32)))
+            for p in range(n):
+                got = dsts[p][:sum(recv_counts[p])]
+                sdispl = {q: np.cumsum([0] + m[q][:-1]) for q in range(n)}
+                expect = np.concatenate([
+                    srcs[q][sdispl[q][p]:sdispl[q][p] + m[q][p]]
+                    for q in range(n)]) if sum(recv_counts[p]) else \
+                    np.empty(0, np.int32)
+                np.testing.assert_array_equal(got, expect)
+        finally:
+            job.cleanup()
+
+
 class TestSlidingWindowAllreduce:
     @pytest.mark.parametrize("job4", ["allreduce:@sliding_window"],
                              indirect=True)

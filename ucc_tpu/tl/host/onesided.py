@@ -533,46 +533,76 @@ class AlltoallvOnesided(OneSidedMixin, HostCollTask):
     atomic_inc protocol, :55-57) — rank r completes when all team
     members' blocks have landed in its destination segment.
 
-    PUT-only by design: the reference's alltoallv_onesided.c is also
-    put-based (only the non-v alltoall grew a get variant,
-    tl_ucp.h:46-51 ALLTOALL_ONESIDED_{PUT,GET}). A get variant here
-    would need every initiator to know each peer's SOURCE displacement
-    table — an extra exchange the target-relative-displacement
-    convention exists to avoid — so it was considered and rejected for
-    parity and for that extra round-trip.
+    Two variants, selected by ``UCC_TL_<X>_ALLTOALLV_ONESIDED_ALG``:
+
+    - ``put`` (default; the reference's alltoallv_onesided.c is
+      put-ONLY — only the non-v alltoall grew a get variant,
+      tl_ucp.h:46-51 ALLTOALL_ONESIDED_{PUT,GET}).
+    - ``get`` (beyond-reference): rank r gets peer p's block-for-r out
+      of p's *source* segment into its own dst, then a closing barrier
+      keeps every src segment readable until all readers finish (the
+      same liveness protocol as the alltoall get path). In explicit-memh
+      mode ``src.displacements[peer]`` is TARGET-RELATIVE — the offset
+      inside *peer's* source buffer of the block destined for this rank
+      (the exact mirror of the put convention below); byte counts come
+      from the initiator's own ``dst.counts``.
 
     WITHOUT explicit memh the task self-bootstraps (see _memh_descs) and
-    the exchange carries each rank's OWN receive displacements, so puts
-    target ``peer's d_displs[me]`` — i.e. bootstrap mode keeps standard
-    MPI alltoallv semantics (no transposed table needed), while the
-    explicit-memh path keeps the reference convention bit-for-bit.
+    the exchange carries each rank's OWN displacement table (receive
+    displacements for put, send displacements for get), so both variants
+    keep standard MPI alltoallv semantics in bootstrap mode, while the
+    explicit-memh path keeps the reference's target-relative convention
+    bit-for-bit.
     """
 
-    def __init__(self, init_args, team):
+    def __init__(self, init_args, team, variant: Optional[str] = None):
         super().__init__(init_args, team)
         args = init_args.args
         if args.is_inplace:
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            "onesided alltoallv does not support in-place")
-        self.descs = _memh_descs(self, getattr(args, "dst_memh", None),
-                                 "dst", allow_none=True)
+        if variant is None:
+            cfg = team.comp_context.config
+            try:
+                variant = cfg.get("alltoallv_onesided_alg") if cfg \
+                    else "put"
+            except KeyError:
+                variant = "put"
+        self.variant = variant or "put"
+        if self.variant not in ("put", "get"):
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           f"unknown onesided alltoallv variant "
+                           f"'{self.variant}' (put|get)")
+        self.which = "dst" if self.variant == "put" else "src"
+        self.descs = _memh_descs(
+            self, getattr(args, f"{self.which}_memh", None), self.which,
+            allow_none=True)
         for bi, name in ((args.src, "src"), (args.dst, "dst")):
             if bi is None or bi.counts is None:
                 raise UccError(Status.ERR_INVALID_PARAM,
                                f"alltoallv requires {name} counts")
 
+    @staticmethod
+    def _displ(bi, counts):
+        d = bi.displacements
+        if d is None:
+            d = np.cumsum([0] + counts[:-1])
+        return d
+
     def run(self):
+        if self.variant == "put":
+            yield from self._run_put()
+        else:
+            yield from self._run_get()
+
+    def _run_put(self):
         args = self.args
         size, me = self.gsize, self.grank
         s_esz = dt_size(args.src.datatype)
         d_esz = dt_size(args.dst.datatype)
         s_counts = [int(c) for c in args.src.counts]
-        s_displ = args.src.displacements
-        if s_displ is None:
-            s_displ = np.cumsum([0] + s_counts[:-1])
-        d_displ = args.dst.displacements
-        if d_displ is None:
-            d_displ = np.cumsum([0] + [int(c) for c in args.dst.counts[:-1]])
+        s_displ = self._displ(args.src, s_counts)
+        d_displ = self._displ(args.dst, [int(c) for c in args.dst.counts])
         descs = self.descs
         unmap = None
         peer_doffs = None      # bootstrap mode: peer -> my offset there
@@ -612,6 +642,54 @@ class AlltoallvOnesided(OneSidedMixin, HostCollTask):
             if descs:
                 REGISTRY.counter_del(
                     self.ctr_key(descs[me]["ctx_uid"]))
+
+    def _run_get(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        s_esz = dt_size(args.src.datatype)
+        d_esz = dt_size(args.dst.datatype)
+        d_counts = [int(c) for c in args.dst.counts]
+        s_displ = self._displ(args.src, [int(c) for c in args.src.counts])
+        d_displ = self._displ(args.dst, d_counts)
+        descs = self.descs
+        unmap = None
+        peer_soffs = None      # bootstrap mode: peer -> my block's offset
+        try:
+            if descs is None:
+                import pickle
+                handles, unmap = _self_map(self, args.src.buffer)
+                payload = pickle.dumps(
+                    (handles[0], [int(d) for d in s_displ]))
+                blobs = yield from _bootstrap_exchange(self, payload)
+                decoded = [pickle.loads(b) for b in blobs]
+                descs = [import_memh(h) for h, _ in decoded]
+                # standard semantics: get from peer p at p's OWN send
+                # displacement for destination rank me
+                peer_soffs = [int(sd[me]) for _, sd in decoded]
+            total_dst = max(int(d_displ[p]) + d_counts[p]
+                            for p in range(size))
+            dst_u8 = binfo_typed(args.dst, total_dst).view(np.uint8) \
+                if total_dst else np.empty(0, dtype=np.uint8)
+            reqs = []
+            for i in range(1, size + 1):
+                peer = (me + i) % size
+                nb = d_counts[peer] * d_esz
+                if peer_soffs is not None:
+                    so = peer_soffs[peer] * s_esz
+                else:
+                    so = int(s_displ[peer]) * s_esz  # TARGET-relative (doc)
+                do = int(d_displ[peer]) * d_esz
+                reqs.append((self.os_get(peer, descs[peer], so,
+                                         dst_u8[do:do + nb]), nb))
+            yield from self.wait(*[r for r, _ in reqs])
+            for r, n in reqs:
+                self._check_get(r, n)
+            # src segments must outlive every reader (same closing
+            # barrier as the alltoall get path)
+            yield from _dissemination_barrier(self)
+        finally:
+            if unmap is not None:
+                unmap()
 
 
 # ---------------------------------------------------------------------------
@@ -749,48 +827,78 @@ class AllreduceSlidingWindow(OneSidedMixin, HostCollTask):
         peers = [(me + i) % size for i in range(1, size)]
         wlen = min(self.window, max(my_count, 1))
         getbuf = self._scratch(args.global_work_buffer, wlen, nd, esz)
-        for w0 in range(0, my_count, self.window):
-            wn = min(self.window, my_count - w0)
-            goff = (my_off + w0) * esz
-            acc = src[my_off + w0:my_off + w0 + wn].copy()
-            # windowed gets from every peer's src segment, bounded
-            # in-flight; slots come from a free-list — a slot is only
-            # reissued after ITS request completed (gets finish out of
-            # order across peers, so `issued % inflight` would alias a
-            # buffer that a pending reply still targets)
-            pending: List[Tuple[RecvReq, int]] = []
-            free_slots = list(range(self.inflight))
-            issued = 0
-            while issued < len(peers) or pending:
-                while issued < len(peers) and free_slots:
-                    slot = free_slots.pop()
-                    req = self.os_get(peers[issued],
-                                      src_descs[peers[issued]], goff,
-                                      getbuf[slot, :wn].view(np.uint8))
-                    pending.append((req, slot))
-                    issued += 1
-                # reduce whichever get has landed (reference REDUCING state)
-                done_i = None
-                for i, (req, slot) in enumerate(pending):
-                    if req.test():
-                        done_i = i
-                        break
-                if done_i is None:
-                    yield
-                    continue
-                req, slot = pending.pop(done_i)
-                self._check_get(req, wn * esz)
-                acc = reduce_arrays([acc, getbuf[slot, :wn]], op, self.dt)
-                free_slots.append(slot)
+        # CROSS-WINDOW pipeline (round 5, attacking the 16 MiB pocket —
+        # BASELINE.md r4 sweep): the in-flight get-buffer bound is
+        # GLOBAL, so window w+1's gets issue while window w is still
+        # reducing/putting — the reference's num_buffers semantics
+        # (multiple buffers in flight ACROSS the message, not per
+        # window). The old per-window loop drained the pipe at every
+        # window boundary: get-wait -> reduce -> put, serialized nwin
+        # times. In-place stays safe across windows: my gets and my puts
+        # for MY partition touch disjoint window ranges of the peers'
+        # buffers, and within one window all gets complete before its
+        # puts (see class docstring invariant).
+        nwin = self._nwin(me)
+
+        def w_n(w_idx: int) -> int:
+            return min(self.window, my_count - w_idx * self.window)
+
+        tasks = [(w, p) for w in range(nwin) for p in peers]
+        accs: Dict[int, np.ndarray] = {}
+        remaining: Dict[int, int] = {}
+        pending: List[Tuple[RecvReq, int, int]] = []   # (req, slot, w)
+        free_slots = list(range(self.inflight))
+        issued = 0
+
+        def finalize(w_idx: int) -> None:
+            acc = accs.pop(w_idx)
+            del remaining[w_idx]
             if alpha is not None:
                 acc = reduce_arrays([acc], ReductionOp.SUM, self.dt,
                                     alpha=alpha)
-            # distribute the reduced window into every dst segment
+            w0 = w_idx * self.window
+            goff = (my_off + w0) * esz
             for p in peers:
                 self.os_put(p, dst_descs[p], goff,
                             np.ascontiguousarray(acc).view(np.uint8),
                             notify=self.ctr_key(dst_descs[p]["ctx_uid"]))
-            dst[my_off + w0:my_off + w0 + wn] = acc
+            dst[my_off + w0:my_off + w0 + w_n(w_idx)] = acc
+
+        while issued < len(tasks) or pending:
+            while issued < len(tasks) and free_slots:
+                w_idx, peer = tasks[issued]
+                if w_idx not in accs:
+                    w0 = w_idx * self.window
+                    accs[w_idx] = src[my_off + w0:
+                                      my_off + w0 + w_n(w_idx)].copy()
+                    remaining[w_idx] = len(peers)
+                slot = free_slots.pop()
+                wn = w_n(w_idx)
+                goff = (my_off + w_idx * self.window) * esz
+                req = self.os_get(peer, src_descs[peer], goff,
+                                  getbuf[slot, :wn].view(np.uint8))
+                pending.append((req, slot, w_idx))
+                issued += 1
+            # reduce whichever get has landed (reference REDUCING state);
+            # slots come from a free-list — a slot is only reissued after
+            # ITS request completed (gets finish out of order)
+            done_i = None
+            for i, (req, slot, w_idx) in enumerate(pending):
+                if req.test():
+                    done_i = i
+                    break
+            if done_i is None:
+                yield
+                continue
+            req, slot, w_idx = pending.pop(done_i)
+            wn = w_n(w_idx)
+            self._check_get(req, wn * esz)
+            accs[w_idx] = reduce_arrays([accs[w_idx], getbuf[slot, :wn]],
+                                        op, self.dt)
+            free_slots.append(slot)
+            remaining[w_idx] -= 1
+            if remaining[w_idx] == 0:
+                finalize(w_idx)
         # completion: all owners' windows have landed in my dst — which
         # also proves every owner has read my src (see class docstring).
         # Counter full also makes the bootstrap unmap safe: nobody will
