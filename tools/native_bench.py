@@ -1,0 +1,144 @@
+"""Native-vs-Python tag matcher benchmark under ThreadMode.MULTIPLE.
+
+The C++ matcher (native/ucc_tpu_core.cc) exists for exactly one claim:
+GIL-released matching should win when MANY OS threads drive progress
+concurrently (single-threaded it measured ~2x SLOWER — per-call ffi +
+key serialization dominate; tl/host/transport.py). This harness measures
+that claim: an 8-rank ThreadMode.MULTIPLE world, every rank in its own
+OS thread, a storm of small allreduces (tag-matcher thrash, the
+ucc_progress_queue_mt.c regime). Run directly for one mode, or with
+--compare to spawn both modes in subprocesses and print the verdict.
+
+Output: one JSON line per mode
+  {"mode": "native"|"python", "threads": N, "colls": K, "wall_s": ...,
+   "colls_per_s": ...}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_once(n: int, iters: int, count: int) -> dict:
+    import numpy as np
+    import ucc_tpu
+    from ucc_tpu import (BufferInfo, CollArgs, CollType, Context,
+                         ContextParams, DataType, LibParams, ReductionOp,
+                         TeamParams, ThreadMode, ThreadOobWorld)
+
+    world = ThreadOobWorld(n)
+    libs = [ucc_tpu.init(LibParams(thread_mode=ThreadMode.MULTIPLE))
+            for _ in range(n)]
+    ctxs = [None] * n
+
+    def mk(r):
+        ctxs[r] = Context(libs[r], ContextParams(oob=world.endpoint(r)))
+
+    ths = [threading.Thread(target=mk, args=(r,)) for r in range(n)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(120)
+
+    tw = ThreadOobWorld(n)
+    teams = [None] * n
+    errors = []
+    barrier = threading.Barrier(n)
+    t_wall = [0.0]
+
+    def rank_main(r):
+        try:
+            team = ctxs[r].create_team(TeamParams(oob=tw.endpoint(r)))
+            teams[r] = team
+            src = np.full(count, float(r + 1), np.float64)
+            dst = np.zeros(count, np.float64)
+
+            def one():
+                req = team.collective_init(CollArgs(
+                    coll_type=CollType.ALLREDUCE,
+                    src=BufferInfo(src, count, DataType.FLOAT64),
+                    dst=BufferInfo(dst, count, DataType.FLOAT64),
+                    op=ReductionOp.SUM))
+                req.post()
+                req.wait(timeout=120)
+
+            for _ in range(max(2, iters // 10)):   # warmup
+                one()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                one()
+            if r == 0:
+                t_wall[0] = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001
+            errors.append((r, repr(e)))
+
+    ths = [threading.Thread(target=rank_main, args=(r,)) for r in range(n)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(600)
+    if errors:
+        raise RuntimeError(f"bench failed: {errors}")
+    # label from what actually ran, not the env: ThreadMode.MULTIPLE
+    # defaults to the native matcher, so an unset env IS a native run
+    mode = "native" if ctxs[0].tl_contexts["shm"].obj.transport.native \
+        is not None else "python"
+    for t in teams:
+        t.destroy()
+    for c in ctxs:
+        c.destroy()
+    wall = t_wall[0]
+    return {"mode": mode,
+            "threads": n, "colls": iters, "count": count,
+            "wall_s": round(wall, 4),
+            "colls_per_s": round(iters / wall, 1) if wall else None}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=8, help="ranks/threads")
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--count", type=int, default=64,
+                    help="elements per allreduce (small = matcher-bound)")
+    ap.add_argument("--compare", action="store_true",
+                    help="run both modes in subprocesses")
+    args = ap.parse_args(argv)
+
+    if not args.compare:
+        print(json.dumps(run_once(args.n, args.iters, args.count)))
+        return 0
+
+    results = {}
+    for mode, flag in (("python", "n"), ("native", "y")):
+        env = dict(os.environ, UCC_TL_SHM_NATIVE=flag,
+                   JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "-n", str(args.n),
+             "--iters", str(args.iters), "--count", str(args.count)],
+            env=env, capture_output=True, text=True, timeout=900)
+        line = (out.stdout or "").strip().splitlines()[-1] if out.stdout \
+            else ""
+        if out.returncode != 0 or not line:
+            print(f"# {mode} run failed rc={out.returncode}: "
+                  f"{(out.stderr or '')[-300:]}", file=sys.stderr)
+            return 1
+        results[mode] = json.loads(line)
+        print(line)
+    ratio = results["python"]["wall_s"] / results["native"]["wall_s"]
+    print(json.dumps({"native_speedup_vs_python": round(ratio, 3),
+                      "verdict": "native wins" if ratio > 1.05 else
+                      ("parity" if ratio > 0.95 else "python wins")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,7 +8,7 @@ makes every recovery attempt *evidence*:
 - every ``--interval`` seconds it spawns a throwaway subprocess that
   tries to enumerate devices and run one tiny matmul on the default
   (non-forced) platform, with a hard timeout + process-group kill;
-- every attempt is appended to ``TPU_PROBE_r04.log`` with a timestamp
+- every attempt is appended to ``TPU_PROBE_r05.log`` with a timestamp
   and outcome (``hang``/``error``/``ok platform=...``);
 - on success it runs the real-chip capture suite in INFORMATION-VALUE
   order (round-3 verdict: the window closed before the highest-value
@@ -20,10 +20,10 @@ makes every recovery attempt *evidence*:
        deterministic.  Full pytest output appends to
        ``TPU_CAPTURE_ring_dma.log`` whatever the outcome.
     2. the Pallas EC kernel smoke (seconds),
-    3. ``bench.py`` -> ``BENCH_TPU_r04.json`` (platform-stamped),
-    4. the short-path crossover sweep -> ``TPU_CROSSOVER_r04.json``
+    3. ``bench.py`` -> ``BENCH_TPU_r05.json`` (platform-stamped),
+    4. the short-path crossover sweep -> ``TPU_CROSSOVER_r05.json``
        (data for the accelerator SHORT_MSG_MAX auto value),
-    5. the full size sweep -> ``BENCH_TPU_SWEEP_r04.json`` (longest).
+    5. the full size sweep -> ``BENCH_TPU_SWEEP_r05.json`` (longest).
 
 Run supervised (restarts the probe loop if it ever dies — round-3
 verdict #10: the daemon must stay armed across the whole round):
@@ -45,7 +45,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(REPO, "TPU_PROBE_r04.log")
+LOG = os.path.join(REPO, "TPU_PROBE_r05.log")
 
 PROBE_SRC = r"""
 import jax
@@ -219,10 +219,10 @@ def capture_artifacts():
                     rec["captured_by"] = "tools/tpu_probe.py"
                     rec["captured_at"] = time.strftime(
                         "%Y-%m-%dT%H:%M:%S%z")
-                    with open(os.path.join(REPO, "BENCH_TPU_r04.json"),
+                    with open(os.path.join(REPO, "BENCH_TPU_r05.json"),
                               "w") as f:
                         json.dump(rec, f, indent=1)
-                    log(f"CAPTURE: bench ok -> BENCH_TPU_r04.json {line}")
+                    log(f"CAPTURE: bench ok -> BENCH_TPU_r05.json {line}")
                     state["bench"] = True
             except ValueError:
                 log(f"CAPTURE: bench output unparseable: {line[:200]}")
@@ -247,10 +247,10 @@ def capture_artifacts():
             except ValueError:
                 rec = None
         if rc == 0 and rec and rec.get("platform") == "tpu":
-            with open(os.path.join(REPO, "TPU_CROSSOVER_r04.json"),
+            with open(os.path.join(REPO, "TPU_CROSSOVER_r05.json"),
                       "w") as f:
                 json.dump(rec, f, indent=1)
-            log("CAPTURE: crossover ok -> TPU_CROSSOVER_r04.json "
+            log("CAPTURE: crossover ok -> TPU_CROSSOVER_r05.json "
                 f"crossover_bytes={rec.get('crossover_bytes')}")
             state["crossover"] = True
         else:
@@ -282,12 +282,12 @@ def capture_artifacts():
         on_tpu = lines and all(
             r.get("detail", {}).get("platform") == "tpu" for r in lines)
         if rc == 0 and on_tpu:
-            with open(os.path.join(REPO, "BENCH_TPU_SWEEP_r04.json"),
+            with open(os.path.join(REPO, "BENCH_TPU_SWEEP_r05.json"),
                       "w") as f:
                 json.dump({"captured_at":
                            time.strftime("%Y-%m-%dT%H:%M:%S%z"),
                            "points": lines}, f, indent=1)
-            log(f"CAPTURE: sweep ok -> BENCH_TPU_SWEEP_r04.json "
+            log(f"CAPTURE: sweep ok -> BENCH_TPU_SWEEP_r05.json "
                 f"({len(lines)} points)")
             state["sweep"] = True
         else:

@@ -45,4 +45,4 @@ from .core.oob import SubsetOob, TcpStoreOob, ThreadOob, ThreadOobWorld  # noqa:
 from .core.ee import Ee, UccEvent  # noqa: F401
 from . import ops  # noqa: F401
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"

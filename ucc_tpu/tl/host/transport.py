@@ -129,20 +129,25 @@ class InProcTransport:
 
     EAGER_THRESHOLD = 8192
 
-    def __init__(self, use_native: Optional[bool] = None):
+    def __init__(self, use_native: Optional[bool] = None,
+                 default_native: bool = False):
         self.uid = uuid.uuid4().hex
         self.mailbox = Mailbox()
         self.native = None
         if use_native is None:
             import os
-            # measured on this machine: the ctypes-bound C++ matcher is
-            # ~2x slower than the in-GIL python matcher for single-threaded
-            # progress (per-call ffi + key serialization dominate), and the
-            # python path additionally does zero-copy rendezvous for large
-            # messages. The native matcher's value is GIL-released matching
-            # under ThreadMode.MULTIPLE with many progress threads -> opt-in.
-            use_native = os.environ.get("UCC_TL_SHM_NATIVE", "n").lower() \
-                in ("y", "yes", "1", "on")
+            # measured on this machine (tools/native_bench.py, numbers in
+            # BASELINE.md): the ctypes-bound C++ matcher is ~2x slower
+            # than the in-GIL python matcher for single-threaded progress
+            # (per-call ffi + key serialization dominate) but 3.6x FASTER
+            # when 8 OS threads drive progress concurrently (GIL-released
+            # matching). Callers set default_native for ThreadMode.
+            # MULTIPLE; UCC_TL_SHM_NATIVE overrides in either direction.
+            env = os.environ.get("UCC_TL_SHM_NATIVE", "").lower()
+            if env:
+                use_native = env in ("y", "yes", "1", "on")
+            else:
+                use_native = default_native
         if use_native:
             try:
                 from ...native import NativeMailbox, available
@@ -150,6 +155,13 @@ class InProcTransport:
                     self.native = NativeMailbox()
             except Exception:  # noqa: BLE001 - fall back to python matcher
                 self.native = None
+            if self.native is None:
+                from ...utils.log import get_logger
+                get_logger("tl_shm").warning(
+                    "native matcher requested but unavailable (no source "
+                    "checkout / build failed) — falling back to the "
+                    "python matcher; ThreadMode.MULTIPLE loses ~3.6x "
+                    "(tools/native_bench.py)")
         with _SHM_LOCK:
             _SHM_WORLD[self.uid] = self
 

@@ -64,12 +64,24 @@ TL_XLA_CONFIG = register_table(ConfigTable(
     ]))
 
 
+_probe_failed: Optional[str] = None
+
+
 def _discover_devices_guarded(timeout_s: float):
     """jax.local_devices() in a worker thread with a timeout: cold backend
     init can block indefinitely when the accelerator tunnel is down, and
     that must disable TL/XLA (CL fallback covers host colls), not wedge
-    context creation."""
+    context creation.
+
+    A timed-out probe is cached for the process lifetime: the hung
+    backend-init thread never finishes, so re-probing from every
+    subsequent context create would serially burn the timeout N times
+    (4 ranks x 60s wedged a whole job bootstrap). A healed tunnel is
+    picked up by new processes (e.g. the probe supervisor's children)."""
+    global _probe_failed
     import threading
+    if _probe_failed is not None:
+        raise UccError(Status.ERR_NO_RESOURCE, _probe_failed)
     result = {}
 
     def probe():
@@ -83,9 +95,9 @@ def _discover_devices_guarded(timeout_s: float):
     t.start()
     t.join(timeout=timeout_s)
     if t.is_alive():
-        raise UccError(Status.ERR_NO_RESOURCE,
-                       f"jax device discovery did not complete in "
-                       f"{timeout_s}s (accelerator tunnel wedged?)")
+        _probe_failed = (f"jax device discovery did not complete in "
+                         f"{timeout_s}s (accelerator tunnel wedged?)")
+        raise UccError(Status.ERR_NO_RESOURCE, _probe_failed)
     if "error" in result:
         raise UccError(Status.ERR_NO_RESOURCE,
                        f"jax device discovery failed: {result['error']}")

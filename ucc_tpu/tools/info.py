@@ -25,8 +25,16 @@ def print_version() -> None:
     print(f"#  TLs: {', '.join(available_tls())}")
     try:
         import jax
-        print(f"#  jax {jax.__version__}, default backend: "
-              f"{jax.default_backend()}")
+        # backend init can block indefinitely when the accelerator
+        # tunnel is wedged — probe it with the same timeout guard
+        # TL/XLA context creation uses (tl/xla.py), never inline
+        from ucc_tpu.tl.xla import _discover_devices_guarded
+        try:
+            devs = _discover_devices_guarded(10.0)
+            backend = devs[0].platform if devs else "none"
+        except Exception as e:  # noqa: BLE001 - UccError or probe error
+            backend = f"unavailable ({e})"
+        print(f"#  jax {jax.__version__}, default backend: {backend}")
     except Exception:  # noqa: BLE001
         print("#  jax: unavailable")
 
