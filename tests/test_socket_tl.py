@@ -705,3 +705,112 @@ def test_peer_death_surfaces_as_error(tmp_path):
     r0 = (tmp_path / "r0.txt").read_text()
     assert r0 in ("ERR_TIMED_OUT", "ERR_NO_MESSAGE",
                   "ERR_NO_RESOURCE"), r0
+
+
+class TestReaderDesyncHardening:
+    """A corrupt frame stream must drop THAT connection with one ERROR
+    line — never kill the reader thread (stranding future frames) or
+    allocate from a garbage header."""
+
+    @staticmethod
+    def _transport():
+        from ucc_tpu.tl.sockets import SocketTransport
+        return SocketTransport(bind_host="127.0.0.1")
+
+    @staticmethod
+    def _capture():
+        """The ucc_tpu root logger does not propagate (utils/log.py), so
+        caplog never sees it — attach a list handler directly."""
+        import logging
+
+        class _ListHandler(logging.Handler):
+            def __init__(self):
+                super().__init__(level=logging.ERROR)
+                self.lines = []
+
+            def emit(self, record):
+                self.lines.append(record.getMessage())
+
+        h = _ListHandler()
+        logging.getLogger("ucc_tpu").addHandler(h)
+        return h
+
+    @staticmethod
+    def _uncapture(h):
+        import logging
+        logging.getLogger("ucc_tpu").removeHandler(h)
+
+    def _send_raw(self, tr, blob: bytes):
+        import socket as pysock
+        c = pysock.create_connection((tr.host, tr.port), timeout=10)
+        c.sendall(blob)
+        return c
+
+    def test_implausible_header_drops_connection(self):
+        import struct
+        import time
+        h = self._capture()
+        tr = self._transport()
+        try:
+            # header claiming a 2.4 GB key: must be rejected BEFORE any
+            # recv/allocation of that size
+            bad = struct.pack("!IQ", 0x912CE0A1, 7) + b"x" * 32
+            c = self._send_raw(tr, bad)
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 10:
+                if any("desync" in ln for ln in h.lines):
+                    break
+                time.sleep(0.05)
+            assert any("desync" in ln for ln in h.lines), "no desync log"
+            # the connection is dropped: our end sees EOF or a reset
+            c.settimeout(5)
+            try:
+                assert c.recv(1) == b""
+            except ConnectionError:
+                pass
+            c.close()
+        finally:
+            tr.close()
+            self._uncapture(h)
+
+    def test_garbage_key_drops_connection_not_thread(self):
+        import struct
+        import time
+        h = self._capture()
+        tr = self._transport()
+        try:
+            kb = b"\x00garbage-not-pickle"
+            bad = struct.pack("!IQ", len(kb), 4) + kb + b"DATA"
+            c = self._send_raw(tr, bad)
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 10:
+                if any("desync" in ln for ln in h.lines):
+                    break
+                time.sleep(0.05)
+            assert any("desync" in ln for ln in h.lines)
+            c.settimeout(5)
+            try:
+                assert c.recv(1) == b""
+            except ConnectionError:
+                pass
+            c.close()
+            # a GOOD frame on a NEW connection still gets delivered:
+            # the transport survived the poison
+            key = ("team", 1, 0, 0)
+            kb2 = pickle.dumps(key)
+            payload = b"\x01\x02\x03\x04"
+            good = struct.pack("!IQ", len(kb2), len(payload)) + kb2 + payload
+            c2 = self._send_raw(tr, good)
+            dst = np.zeros(4, np.uint8)
+            from ucc_tpu.tl.host.transport import RecvReq
+            req = RecvReq(dst)
+            tr.mailbox.post_recv(key, req)
+            t0 = time.monotonic()
+            while not req.test():
+                assert time.monotonic() - t0 < 10, "good frame not delivered"
+                time.sleep(0.02)
+            np.testing.assert_array_equal(dst, [1, 2, 3, 4])
+            c2.close()
+        finally:
+            tr.close()
+            self._uncapture(h)

@@ -1,0 +1,78 @@
+"""End-of-round snapshot gate (round-4 verdict #1d).
+
+Round 4 shipped a red tree because the final commit was made without
+running anything. This gate is the mechanical fix: it runs the FULL
+suite and the driver's multichip dryrun and exits nonzero unless both
+pass — run it before any end-of-round (or otherwise significant)
+commit:
+
+    python tools/snapshot_gate.py          # full gate (~5 min)
+    python tools/snapshot_gate.py --quick  # import canary only (~5 s)
+
+Exit 0 = safe to commit. Anything else = the tree is NOT shippable.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(title: str, argv, timeout: float, env=None) -> bool:
+    print(f"[gate] {title} ...", flush=True)
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(argv, cwd=REPO, env=env, timeout=timeout,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        print(f"[gate] {title}: TIMEOUT after {timeout:.0f}s", flush=True)
+        return False
+    dt = time.monotonic() - t0
+    tail = "\n".join((r.stdout or "").strip().splitlines()[-3:])
+    print(f"[gate] {title}: rc={r.returncode} in {dt:.0f}s\n{tail}",
+          flush=True)
+    if r.returncode != 0:
+        print((r.stdout or "")[-3000:])
+        print((r.stderr or "")[-2000:], file=sys.stderr)
+    return r.returncode == 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="import canary only (catches the round-4 class "
+                    "of breakage in seconds)")
+    args = ap.parse_args(argv)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
+
+    ok = True
+    if args.quick:
+        ok &= _run("import canary",
+                   [sys.executable, "-m", "pytest",
+                    "tests/test_import_canary.py", "-q"],
+                   timeout=300, env=env)
+    else:
+        ok &= _run("full suite",
+                   [sys.executable, "-m", "pytest", "tests/", "-q"],
+                   timeout=2700, env=env)
+        ok &= _run("dryrun_multichip(8)",
+                   [sys.executable, "-c",
+                    "import __graft_entry__ as g; g.dryrun_multichip(8); "
+                    "print('DRYRUN OK')"],
+                   timeout=1200, env=env)
+    print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,12 @@ logger = get_logger("tl_socket")
 
 _HDR = struct.Struct("!IQ")
 
+#: desync sanity bounds (tagged keys are small pickled tuples; one frame
+#: carries at most one collective's fragment — 1 GiB is far above any
+#: window/eager size this stack produces)
+_MAX_KEY_BYTES = 1 << 20
+_MAX_FRAME_BYTES = 1 << 30
+
 
 class FlushReq:
     """Waitable remote-completion fence (ucp_ep_flush analog): completes
@@ -132,20 +138,56 @@ class SocketTransport:
         # ack a correct fence for exactly the initiator's prior ops
         errbox = [0]
         try:
+            peer = conn.getpeername()
+        except OSError:
+            peer = "?"
+        try:
             while True:
                 hdr = _recv_exact(conn, _HDR.size)
                 klen, plen = _HDR.unpack(hdr)
-                key = pickle.loads(_recv_exact(conn, klen))
-                payload = _recv_exact(conn, plen)
-                data = np.frombuffer(payload, dtype=np.uint8)
-                if isinstance(key, tuple) and key and key[0] in OS_OPS:
-                    # one-sided frames are applied HERE, by the passive
-                    # side's reader thread — the target's user thread never
-                    # participates (the UCX am-emulated-RDMA progress model)
-                    self._handle_onesided(key, data, errbox)
-                    continue
-                ps = _PendingSend(data, SendReq(done=True), copied=True)
-                self.mailbox.push(key, ps)
+                # a desynced stream decodes payload bytes as a header, so
+                # validate BEFORE allocating/reading: keys are small
+                # pickled tuples, payloads are bounded by what one
+                # collective moves
+                if klen > _MAX_KEY_BYTES or plen > _MAX_FRAME_BYTES:
+                    logger.error(
+                        "socket frame desync from %s (implausible header "
+                        "klen=%d plen=%d) — dropping connection",
+                        peer, klen, plen)
+                    conn.close()
+                    return
+                kb = _recv_exact(conn, klen)
+                try:
+                    # the whole frame-processing body is the desync blast
+                    # radius: a corrupt key can fail to unpickle, unpickle
+                    # to a malformed OS_OPS tuple (unpack ValueError in
+                    # _handle_onesided), or be unhashable (mailbox.push
+                    # TypeError). Any of these means the connection's byte
+                    # stream is garbage; it cannot be resynced, so treat
+                    # it exactly like a broken connection (sender eviction
+                    # + reconnect recovers) instead of letting the reader
+                    # thread die and silently strand every future frame
+                    key = pickle.loads(kb)
+                    payload = _recv_exact(conn, plen)
+                    data = np.frombuffer(payload, dtype=np.uint8)
+                    if isinstance(key, tuple) and key and key[0] in OS_OPS:
+                        # one-sided frames are applied HERE, by the
+                        # passive side's reader thread — the target's user
+                        # thread never participates (the UCX am-emulated-
+                        # RDMA progress model)
+                        self._handle_onesided(key, data, errbox)
+                        continue
+                    ps = _PendingSend(data, SendReq(done=True), copied=True)
+                    self.mailbox.push(key, ps)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:  # noqa: BLE001 - stream desync
+                    logger.error(
+                        "socket frame desync from %s (%d-byte key, head "
+                        "%r): %r — dropping connection",
+                        peer, klen, kb[:16], e)
+                    conn.close()
+                    return
         except (ConnectionError, OSError):
             return
 
