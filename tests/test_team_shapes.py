@@ -15,7 +15,9 @@ from harness import UccJob
 N = 16
 
 # group-rank subsets of the 16-rank job, one per reference shape (5 added:
-# a second odd size below the knomial radix default)
+# a second odd size below the knomial radix default). Keys are labels:
+# "r16" = WORLD REVERSED (test/mpi TEAM_REVERSE — group rank 0 is ctx
+# rank 15, non-identity ep_map order), "oe8" = SPLIT_ODD_EVEN's odd half.
 SHAPES = {
     1: [7],
     2: [3, 12],
@@ -23,6 +25,8 @@ SHAPES = {
     8: list(range(8, 16)),
     11: list(range(11)),
     16: list(range(16)),
+    "r16": list(range(15, -1, -1)),
+    "oe8": list(range(1, 16, 2)),
 }
 
 
@@ -35,7 +39,7 @@ def job():
 
 @pytest.fixture(scope="module")
 def teams_by_size(job):
-    return {size: job.create_team(ranks) for size, ranks in SHAPES.items()}
+    return {shape: job.create_team(ranks) for shape, ranks in SHAPES.items()}
 
 
 def host_buf(arr, dt=DataType.FLOAT32):
@@ -43,10 +47,14 @@ def host_buf(arr, dt=DataType.FLOAT32):
     return BufferInfo(a, a.size, dt, mem_type=MemoryType.HOST), a
 
 
-@pytest.mark.parametrize("size", sorted(SHAPES))
+@pytest.mark.parametrize("shape", list(SHAPES))
 class TestTeamShapes:
-    def test_allreduce(self, teams_by_size, job, size):
-        teams = teams_by_size[size]
+    @pytest.fixture()
+    def size(self, shape):
+        return len(SHAPES[shape])
+
+    def test_allreduce(self, teams_by_size, job, shape, size):
+        teams = teams_by_size[shape]
         count = 129                      # odd count: remainder paths too
         srcs = [np.arange(count, dtype=np.float32) * (r + 1)
                 for r in range(size)]
@@ -61,8 +69,8 @@ class TestTeamShapes:
         for r in range(size):
             np.testing.assert_allclose(argses[r][1], expect)
 
-    def test_bcast_root_rotation(self, teams_by_size, job, size):
-        teams = teams_by_size[size]
+    def test_bcast_root_rotation(self, teams_by_size, job, shape, size):
+        teams = teams_by_size[shape]
         count = 65
         for root in sorted({0, size // 2, size - 1}):
             data = np.arange(count, dtype=np.float32) * (root + 3)
@@ -77,8 +85,8 @@ class TestTeamShapes:
                 np.testing.assert_array_equal(argses[r][1], data,
                                               err_msg=f"root={root}")
 
-    def test_reduce_root_rotation(self, teams_by_size, job, size):
-        teams = teams_by_size[size]
+    def test_reduce_root_rotation(self, teams_by_size, job, shape, size):
+        teams = teams_by_size[shape]
         count = 33
         srcs = [np.full(count, float(r + 1), np.float32)
                 for r in range(size)]
@@ -95,10 +103,10 @@ class TestTeamShapes:
                                        np.sum(srcs, axis=0),
                                        err_msg=f"root={root}")
 
-    def test_allgatherv(self, teams_by_size, job, size):
+    def test_allgatherv(self, teams_by_size, job, shape, size):
         """Uneven per-rank counts: v-coll displacement handling at every
         shape."""
-        teams = teams_by_size[size]
+        teams = teams_by_size[shape]
         counts = [(r % 3) + 1 for r in range(size)]
         total = sum(counts)
         srcs = [np.full(counts[r], float(r + 1), np.float32)

@@ -25,9 +25,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _run(title: str, argv, timeout: float, env=None) -> bool:
     print(f"[gate] {title} ...", flush=True)
     t0 = time.monotonic()
+    # own session + group kill on timeout: pytest spawns multiprocessing
+    # workers that inherit the captured pipes — killing only pytest would
+    # leave the pipe open and block the post-kill read forever, hanging
+    # the gate on exactly the broken tree it exists to catch
     try:
-        r = subprocess.run(argv, cwd=REPO, env=env, timeout=timeout,
-                           capture_output=True, text=True)
+        import signal
+        proc = subprocess.Popen(argv, cwd=REPO, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True,
+                                start_new_session=True)
+        try:
+            out, err = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+            raise
+        r = subprocess.CompletedProcess(argv, proc.returncode, out, err)
     except subprocess.TimeoutExpired:
         print(f"[gate] {title}: TIMEOUT after {timeout:.0f}s", flush=True)
         return False

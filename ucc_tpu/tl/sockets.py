@@ -250,6 +250,17 @@ class SocketTransport:
     def send_to_addr(self, addr: Tuple[str, int], key, data: np.ndarray) -> SendReq:
         payload = data.reshape(-1).view(np.uint8).tobytes()
         kb = pickle.dumps(key)
+        # mirror the reader's desync sanity bounds: a frame the receiver
+        # would reject as implausible must fail LOUDLY here, not be
+        # transmitted and dropped there (fragmentation above this bound
+        # is the pipelined-schedule / sliding-window layer's job)
+        if len(kb) > _MAX_KEY_BYTES or len(payload) > _MAX_FRAME_BYTES:
+            raise UccError(
+                Status.ERR_INVALID_PARAM,
+                f"socket frame exceeds transport bounds (key {len(kb)}B > "
+                f"{_MAX_KEY_BYTES} or payload {len(payload)}B > "
+                f"{_MAX_FRAME_BYTES}); fragment the collective (pipelined "
+                f"schedule / sliding window) instead")
         frame = _HDR.pack(len(kb), len(payload)) + kb + payload
         with self._addr_lock(addr):
             conn = self._conn_to(addr)
