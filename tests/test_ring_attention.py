@@ -259,3 +259,103 @@ class TestLongContextTraining:
         out2 = np.asarray(jax.device_get(jax.jit(f2)(
             *(jax.device_put(t, sh2) for t in (q, k, v)))))
         np.testing.assert_allclose(out1, out2, rtol=2e-5, atol=2e-6)
+
+
+class TestGroupedQueryAttention:
+    """GQA: q heads grouped over fewer K/V heads — the ring rotates only
+    the kv_heads blocks (heads/kv_heads less ICI traffic). Validated
+    against dense attention with K/V heads repeated per group."""
+
+    @staticmethod
+    def _gqa_inputs(h, h_kv, seq, d, seed=21):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (h, seq, d), jnp.float32)
+        k = jax.random.normal(ks[1], (h_kv, seq, d), jnp.float32)
+        v = jax.random.normal(ks[2], (h_kv, seq, d), jnp.float32)
+        return q, k, v
+
+    @staticmethod
+    def _dense(q, k, v, causal):
+        h, seq, d = q.shape
+        g = h // k.shape[0]
+        kr = np.repeat(np.asarray(k), g, axis=0)
+        vr = np.repeat(np.asarray(v), g, axis=0)
+        s = np.einsum("hqd,hkd->hqk", np.asarray(q), kr) / np.sqrt(d)
+        if causal:
+            mask = np.tril(np.ones((seq, seq), bool))
+            s = np.where(mask[None], s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.einsum("hqk,hkd->hqd", p, vr)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("h,h_kv", [(4, 2), (8, 2), (6, 6)])
+    def test_exact_vs_dense(self, mesh, causal, h, h_kv):
+        from ucc_tpu.fused_attention import make_ring_flash_attention
+        seq, d = 64, 8
+        q, k, v = self._gqa_inputs(h, h_kv, seq, d)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P(None, "sp", None))
+        fn = make_ring_flash_attention(mesh, causal=causal, axis="sp")
+        out = np.asarray(jax.device_get(
+            fn(*(jax.device_put(x, sh) for x in (q, k, v)))))
+        np.testing.assert_allclose(out, self._dense(q, k, v, causal),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_mismatched_heads_rejected(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ucc_tpu.fused_attention import ring_flash_attention
+        from ucc_tpu.utils.jaxshim import shard_map_compat
+        q, k, v = self._gqa_inputs(5, 2, 16, 4)   # 5 % 2 != 0
+        sh = NamedSharding(mesh, P(None, "sp", None))
+
+        def body(a, b, c):
+            return ring_flash_attention(a, b, c, axis_name="sp")
+        f = shard_map_compat(body, mesh, (P(None, "sp", None),) * 3,
+                             P(None, "sp", None))
+        with pytest.raises(ValueError, match="GQA"):
+            f(*(jax.device_put(x, sh) for x in (q, k, v)))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_vs_dense(self, mesh, causal):
+        """Group-summed dK/dV: differentiating through jnp.repeat in the
+        dense reference gives exactly the per-group gradient sums the
+        ring backward must produce."""
+        import contextlib
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ucc_tpu.fused_attention import ring_flash_attention
+        from ucc_tpu.utils.jaxshim import shard_map_compat
+        h, h_kv, seq, d = 4, 2, 24, 4
+        q, k, v = self._gqa_inputs(h, h_kv, seq, d, seed=23)
+        sh = NamedSharding(mesh, P(None, "sp", None))
+        qs, ks_, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+        def body(a, b, c):
+            return ring_flash_attention(a, b, c, axis_name="sp",
+                                        causal=causal)
+        f = shard_map_compat(body, mesh, (P(None, "sp", None),) * 3,
+                             P(None, "sp", None))
+
+        @jax.jit
+        def loss(a, b, c):
+            return jnp.sum(f(a, b, c) ** 2)
+
+        def loss_ref(a, b, c):
+            g = h // h_kv
+            kr = jnp.repeat(b, g, axis=0)
+            vr = jnp.repeat(c, g, axis=0)
+            s = jnp.einsum("hqd,hkd->hqk", a, kr) / jnp.sqrt(jnp.float32(d))
+            if causal:
+                m = jnp.tril(jnp.ones((seq, seq), bool))
+                s = jnp.where(m[None], s, -jnp.inf)
+            p = jax.nn.softmax(s, -1)
+            return jnp.sum(jnp.einsum("hqk,hkd->hqd", p, vr) ** 2)
+
+        ctx = jax.set_mesh(mesh) if hasattr(jax, "set_mesh") \
+            else contextlib.nullcontext()
+        with ctx:
+            g1 = jax.grad(loss, argnums=(0, 1, 2))(qs, ks_, vs)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)

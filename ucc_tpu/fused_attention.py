@@ -25,10 +25,14 @@ Compiled on real TPU meshes; Pallas interpret mode on the virtual CPU
 mesh (tests). Same hardware gate as ring_dma: the compiled ICI path
 needs real-chip validation.
 
-VMEM budget: per chip the kernel holds q/k/v/o blocks, the f32
-accumulators, and 2 double-buffer K/V slots — roughly
-``(4 + 3·bytes32/bytes_in)·H·S_local·D + 4·H·S_local`` elements; size
-S_local so this stays under ~16 MiB/core.
+VMEM budget: per chip the kernel holds the q/o blocks (H heads), the
+f32 accumulators (H·S_local rows folded as h_kv·g·S_local), and the k/v
+inputs plus 2x2 double-buffer K/V slots at h_kv heads only — roughly
+``(2 + bytes32/bytes_in)·H·S_local·D + 6·h_kv·S_local·D +
+2·bytes32/bytes_in·H·S_local·D + 4·H·S_local`` elements, i.e. for MHA
+(h_kv = H): ``(4 + 3·bytes32/bytes_in)·H·S_local·D + 4·H·S_local``;
+under GQA the K/V-slot term shrinks by H/h_kv. Size S_local so this
+stays under ~16 MiB/core.
 """
 from __future__ import annotations
 
@@ -38,7 +42,8 @@ import numpy as np
 
 
 def _kernel(n: int, scale: float, causal: bool, s_local: int,
-            axis: str, barrier: bool, multi_axis: bool = False):
+            axis: str, barrier: bool, h_kv: int, g: int,
+            multi_axis: bool = False):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -73,9 +78,15 @@ def _kernel(n: int, scale: float, causal: bool, s_local: int,
         m_ref[:] = jnp.full_like(m_ref[:], -jnp.inf)
         l_ref[:] = jnp.zeros_like(l_ref[:])
         acc_ref[:] = jnp.zeros_like(acc_ref[:])
-        q = q_ref[:].astype(jnp.float32) * scale
-        iq = lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0)
-        ik = lax.broadcasted_iota(jnp.int32, (s_local, s_local), 1)
+        # GQA: q heads are grouped g-per-KV-head — fold the group into
+        # the query rows so every block update is one batched matmul per
+        # KV head; row r of the folded dim is (group r // s_local,
+        # position r % s_local). g == 1 is plain MHA.
+        q = q_ref[:].astype(jnp.float32).reshape(
+            h_kv, g * s_local, q_ref.shape[-1]) * scale
+        iq = lax.broadcasted_iota(jnp.int32, (g * s_local, s_local), 0)
+        iq = lax.rem(iq, s_local)              # row -> sequence position
+        ik = lax.broadcasted_iota(jnp.int32, (g * s_local, s_local), 1)
 
         for t in range(n):
             cur = t % 2
@@ -139,14 +150,14 @@ def _kernel(n: int, scale: float, causal: bool, s_local: int,
 
         l = l_ref[:]
         out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)[..., None]
-        o_ref[:] = out.astype(o_ref.dtype)
+        o_ref[:] = out.reshape(o_ref.shape).astype(o_ref.dtype)
 
     return kernel
 
 
 @functools.lru_cache(maxsize=64)
 def _build(n: int, h: int, s_local: int, d: int, dtype_str: str,
-           scale: float, causal: bool, axis: str,
+           scale: float, causal: bool, axis: str, h_kv: int,
            multi_axis: bool = False):
     import jax
     import jax.numpy as jnp
@@ -160,9 +171,10 @@ def _build(n: int, h: int, s_local: int, d: int, dtype_str: str,
     if cp is None:
         _warn_no_barrier()
     nd = jnp.dtype(dtype_str)
+    g = h // h_kv
     kernel = _kernel(n, scale, causal, s_local, axis,
                      barrier=not interpret and cp is not None,
-                     multi_axis=multi_axis)
+                     h_kv=h_kv, g=g, multi_axis=multi_axis)
     kw = {"compiler_params": cp} if cp is not None and not interpret else {}
 
     def shard_fn(q, k, v):
@@ -170,13 +182,15 @@ def _build(n: int, h: int, s_local: int, d: int, dtype_str: str,
             kernel,
             out_shape=jax.ShapeDtypeStruct((h, s_local, d), nd),
             scratch_shapes=[
-                pltpu.VMEM((2, 2, h, s_local, d), nd),    # K/V slots
+                # K/V slots hold h_kv heads only — the ring rotates g x
+                # less data under GQA (the whole point of grouping)
+                pltpu.VMEM((2, 2, h_kv, s_local, d), nd),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.REGULAR,              # consumption acks
-                pltpu.VMEM((h, s_local), jnp.float32),    # running max
-                pltpu.VMEM((h, s_local), jnp.float32),    # normalizer
-                pltpu.VMEM((h, s_local, d), jnp.float32),  # accumulator
+                pltpu.VMEM((h_kv, g * s_local), jnp.float32),   # run. max
+                pltpu.VMEM((h_kv, g * s_local), jnp.float32),   # normizer
+                pltpu.VMEM((h_kv, g * s_local, d), jnp.float32),  # accum
             ],
             interpret=interpret,
             **kw,
@@ -200,9 +214,15 @@ def _xla_ring_shard(q, k, v, n: int, scale: float, causal: bool,
 
     me = lax.axis_index(axis)
     h, s_local, d = q.shape
-    qf = q.astype(jnp.float32) * scale
-    iq = lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0)
-    ik = lax.broadcasted_iota(jnp.int32, (s_local, s_local), 1)
+    h_kv = k.shape[0]
+    g = h // h_kv
+    # GQA folding mirrors the fused kernel: q (h, s, d) -> (h_kv, g*s, d)
+    # with row r = (group r // s, position r % s); only h_kv K/V heads
+    # rotate around the ring. g == 1 is plain MHA.
+    qf = q.astype(jnp.float32).reshape(h_kv, g * s_local, d) * scale
+    iq = lax.rem(lax.broadcasted_iota(jnp.int32,
+                                      (g * s_local, s_local), 0), s_local)
+    ik = lax.broadcasted_iota(jnp.int32, (g * s_local, s_local), 1)
 
     def step(t, carry):
         acc, m_run, l_run, kc, vc = carry
@@ -222,12 +242,12 @@ def _xla_ring_shard(q, k, v, n: int, scale: float, causal: bool,
         return (acc, m_new, l_new, ops.ring_shift(kc, axis),
                 ops.ring_shift(vc, axis))
 
-    acc0 = jnp.zeros((h, s_local, d), jnp.float32)
-    m0 = jnp.full((h, s_local), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((h, s_local), jnp.float32)
+    acc0 = jnp.zeros((h_kv, g * s_local, d), jnp.float32)
+    m0 = jnp.full((h_kv, g * s_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((h_kv, g * s_local), jnp.float32)
     acc, _, l_run, _, _ = lax.fori_loop(0, n, step, (acc0, m0, l0, k, v))
     out = acc / jnp.where(l_run == 0.0, 1.0, l_run)[..., None]
-    return out.astype(q.dtype)
+    return out.reshape(h, s_local, d).astype(q.dtype)
 
 
 def _mesh_multi_axis() -> bool:
@@ -258,7 +278,13 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
                          fused: bool = None, multi_axis: bool = None):
     """Shard-level fused ring attention (call inside shard_map).
 
-    q, k, v: (heads, seq_local, head_dim) — this rank's sequence block.
+    q: (heads, seq_local, head_dim); k, v: (kv_heads, seq_local,
+    head_dim) with heads % kv_heads == 0 — this rank's sequence block.
+    kv_heads < heads is grouped-query attention (GQA): consecutive
+    groups of heads/kv_heads query heads share one K/V head, and the
+    ring rotates ONLY the kv_heads K/V blocks — heads/kv_heads times
+    less ICI traffic than MHA at the same query width, which is the
+    GQA memory/bandwidth saving realized at the communication layer.
     Returns (heads, seq_local, head_dim): exact attention of the local
     queries against the FULL sequence-sharded context.
 
@@ -284,6 +310,12 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
 
     n = int(axis_size(axis_name))
     h, s_local, d = q.shape
+    h_kv = k.shape[0]
+    if h % h_kv != 0 or v.shape[0] != h_kv:
+        raise ValueError(
+            f"GQA shapes: q has {h} heads but k/v have {k.shape[0]}/"
+            f"{v.shape[0]} — q heads must be a multiple of kv heads and "
+            f"k/v must agree")
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
     # callers that know their mesh pass multi_axis explicitly (the
@@ -297,7 +329,8 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "r",
         return _xla_ring_shard(q, k, v, int(n), float(scale),
                                bool(causal), axis_name)
     fused = _build(int(n), h, s_local, d, str(q.dtype), float(scale),
-                   bool(causal), axis_name, multi_axis=multi)
+                   bool(causal), axis_name, multi_axis=multi,
+                   h_kv=h_kv)
 
     @jax.custom_vjp
     def attn(q, k, v):
