@@ -41,6 +41,32 @@ def init_params(heads: int, d: int, key=None):
     return {"wq": mk(kq), "wk": mk(kk), "wv": mk(kv), "wo": mk(ko)}
 
 
+def _make_step(mesh: Mesh, make_loss, xspec, pspec, lr: float):
+    """Shared SGD scaffolding for the train-step variants: per-shard
+    loss -> value_and_grad -> joint-axis (sp x dp) gradient mean ->
+    update. ``make_loss(params..., x, y)`` returns the per-shard scalar
+    loss fn; weight grads are PER-RANK partials (the ring backward only
+    aggregates activation grads dK/dV, never weight grads), so the
+    global-mean loss needs the mean over BOTH mesh axes — one joint-axis
+    collective per weight. Verified exact vs a dense single-device
+    reference in tests/test_ring_attention.py::test_grads_match_dense."""
+
+    def step_shard(wq, wk, wv, wo, x, y):
+        loss_fn = make_loss(x, y)
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2, 3))(
+            wq, wk, wv, wo)
+        grads = [ops.allreduce(g, ReductionOp.AVG, axis_name=("sp", "dp"))
+                 for g in grads]
+        new = [p - lr * g for p, g in zip((wq, wk, wv, wo), grads)]
+        return (loss, *new)
+
+    fn = shard_map_compat(
+        step_shard, mesh,
+        (pspec, pspec, pspec, pspec, xspec, xspec),
+        (P(), pspec, pspec, pspec, pspec))
+    return jax.jit(fn)
+
+
 def make_train_step(mesh: Mesh, lr: float = 1e-2, causal: bool = True):
     """Jitted train step over mesh axes ('dp', 'sp').
 
@@ -48,7 +74,7 @@ def make_train_step(mesh: Mesh, lr: float = 1e-2, causal: bool = True):
     'sp'; params replicated.
     """
 
-    def step_shard(wq, wk, wv, wo, x, y):
+    def make_loss(x, y):
         def loss_fn(wq, wk, wv, wo):
             # per-head projections on the local (batch, seq) block
             q = jnp.einsum("bhsd,hde->bhse", x, wq)
@@ -72,28 +98,10 @@ def make_train_step(mesh: Mesh, lr: float = 1e-2, causal: bool = True):
             # loss is a global scalar; every rank holds seq/n_sp tokens)
             return ops.allreduce(local[None], ReductionOp.AVG,
                                  axis_name=("sp", "dp"))[0]
+        return loss_fn
 
-        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2, 3))(
-            wq, wk, wv, wo)
-        # local autodiff yields PER-RANK partials dlocal_r/dw (the ring
-        # backward only aggregates activation grads dK/dV, never weight
-        # grads); the global-mean loss needs the mean of the partials
-        # over BOTH mesh axes — sp (sequence shards of the same batch)
-        # and dp (the optimizer-side allreduce role) — one joint-axis
-        # collective per weight. Verified exact vs a dense single-device
-        # reference in tests/test_ring_attention.py::test_grads_match_dense.
-        grads = [ops.allreduce(g, ReductionOp.AVG, axis_name=("sp", "dp"))
-                 for g in grads]
-        new = [p - lr * g for p, g in zip((wq, wk, wv, wo), grads)]
-        return (loss, *new)
-
-    pspec = P(None, None, None)          # params replicated
-    xspec = P("dp", None, "sp", None)    # batch × seq sharded
-    fn = shard_map_compat(
-        step_shard, mesh,
-        (pspec, pspec, pspec, pspec, xspec, xspec),
-        (P(), pspec, pspec, pspec, pspec))
-    return jax.jit(fn)
+    return _make_step(mesh, make_loss, P("dp", None, "sp", None),
+                      P(None, None, None), lr)
 
 
 def run_one_step(mesh: Mesh, batch: int, heads: int, seq: int, d: int,
@@ -109,3 +117,58 @@ def run_one_step(mesh: Mesh, batch: int, heads: int, seq: int, d: int,
     out = step(params["wq"], params["wk"], params["wv"], params["wo"],
                x, y)
     return float(jax.device_get(out[0]))
+
+
+# ---------------------------------------------------------------------------
+# GQA variant: standard token-stream block (round 5)
+# ---------------------------------------------------------------------------
+
+def init_gqa_params(dm: int, heads: int, kv_heads: int, e: int, key=None):
+    """Token-stream projections: wq (dm, heads*e), wk/wv (dm, kv_heads*e),
+    wo (heads*e, dm) — the LLM GQA shape (fewer K/V than Q heads)."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    kq, kk, kv, ko = jax.random.split(key, 4)
+    s = 0.1
+    return {
+        "wq": jax.random.normal(kq, (dm, heads * e), jnp.float32) * s,
+        "wk": jax.random.normal(kk, (dm, kv_heads * e), jnp.float32) * s,
+        "wv": jax.random.normal(kv, (dm, kv_heads * e), jnp.float32) * s,
+        "wo": jax.random.normal(ko, (heads * e, dm), jnp.float32) * s,
+    }
+
+
+def make_gqa_train_step(mesh: Mesh, heads: int, kv_heads: int, e: int,
+                        lr: float = 1e-2, causal: bool = True):
+    """Jitted GQA train step over mesh axes ('dp', 'sp').
+
+    x, y: (batch, seq, dm) — batch on 'dp', seq on 'sp'; params
+    replicated. The ring rotates only kv_heads K/V blocks per step
+    (heads/kv_heads less ICI traffic than MHA at the same query width),
+    and the batch folds into the head axis EXACTLY compatibly with the
+    kernel's grouping: folded q index bi*heads + hi maps to folded kv
+    index (bi*heads + hi) // (heads/kv_heads) = bi*kv_heads + hi//g.
+    """
+    g = heads // kv_heads
+    assert heads == kv_heads * g, "heads must divide by kv_heads"
+
+    def make_loss(x, y):
+        def loss_fn(wq, wk, wv, wo):
+            b, s_loc, dm = x.shape
+            q = (x @ wq).reshape(b, s_loc, heads, e)
+            k = (x @ wk).reshape(b, s_loc, kv_heads, e)
+            v = (x @ wv).reshape(b, s_loc, kv_heads, e)
+            # (b, s, h, e) -> (b*h, s, e): heads independent in-kernel
+            fold = lambda t, h: t.transpose(0, 2, 1, 3).reshape(
+                b * h, s_loc, e)
+            attn = ring_flash_attention(
+                fold(q, heads), fold(k, kv_heads), fold(v, kv_heads),
+                axis_name="sp", causal=causal, fused=None)
+            out = attn.reshape(b, heads, s_loc, e).transpose(0, 2, 1, 3) \
+                .reshape(b, s_loc, heads * e) @ wo
+            local = jnp.mean((out - y) ** 2)
+            return ops.allreduce(local[None], ReductionOp.AVG,
+                                 axis_name=("sp", "dp"))[0]
+        return loss_fn
+
+    return _make_step(mesh, make_loss, P("dp", "sp", None),
+                      P(None, None), lr)
