@@ -41,9 +41,10 @@ def job4(monkeypatch, request):
 # ---------------------------------------------------------------------------
 
 class TestSwKnobs:
-    """Pin sw_knobs auto outputs to the round-4 sweep table (BASELINE.md):
-    the knobs are how the sweep's conclusions reach the collective, and
-    round 4 shipped them broken (string-compared a parsed sentinel)."""
+    """Pin sw_knobs auto outputs to the round-5 re-sweep table
+    (BASELINE.md): the knobs are how the sweep's conclusions reach the
+    collective, and round 4 shipped them broken (string-compared a
+    parsed sentinel)."""
 
     @staticmethod
     def _default_cfg():
@@ -52,9 +53,9 @@ class TestSwKnobs:
         return Config(TL_SHM_CONFIG, env={})
 
     @pytest.mark.parametrize("msg,want_w,want_i", [
-        (4 << 20, 256 << 10, 4),    # 4 MiB: 256K windows x 4 buffers
-        (16 << 20, 1 << 20, 4),     # 16 MiB: msg/16 = 1M, shallow
-        (64 << 20, 4 << 20, 8),     # 64 MiB: 4M clamp x deep pipeline
+        (4 << 20, 256 << 10, 4),    # 4 MiB: 256K floor
+        (16 << 20, 256 << 10, 4),   # 16 MiB: msg/64 = 256K (sweep best)
+        (64 << 20, 1 << 20, 4),     # 64 MiB: 1M ceiling (sweep best)
     ])
     def test_auto_matches_sweep_table(self, msg, want_w, want_i):
         from ucc_tpu.tl.host.onesided import sw_knobs
@@ -85,14 +86,14 @@ class TestSwKnobs:
             "UCC_TL_SHM_ALLREDUCE_SW_WINDOW": "inf",
             "UCC_TL_SHM_ALLREDUCE_SW_INFLIGHT": "inf",
         })
-        assert sw_knobs(cfg, 64 << 20) == (4 << 20, 8)
-        assert sw_max_work_buffer(cfg) == (4 << 20) * 8
+        assert sw_knobs(cfg, 64 << 20) == (1 << 20, 4)
+        assert sw_max_work_buffer(cfg) == (1 << 20) * 4
 
     def test_max_work_buffer_auto_and_explicit(self):
         from ucc_tpu.tl.shm import TL_SHM_CONFIG
         from ucc_tpu.tl.host.onesided import sw_max_work_buffer
         from ucc_tpu.utils.config import Config
-        assert sw_max_work_buffer(self._default_cfg()) == (4 << 20) * 8
+        assert sw_max_work_buffer(self._default_cfg()) == (1 << 20) * 4
         cfg = Config(TL_SHM_CONFIG, env={
             "UCC_TL_SHM_ALLREDUCE_SW_WINDOW": "1m",
             "UCC_TL_SHM_ALLREDUCE_SW_INFLIGHT": "2",
@@ -147,8 +148,8 @@ class TestMemMap:
         address + the global_work_buffer scratch contract."""
         attr = job4.contexts[0].get_attr()
         assert attr.ctx_addr_len == len(attr.ctx_addr) > 0
-        # default sliding window is 1 MiB with 2 in-flight buffers
-        assert attr.global_work_buffer_size >= 2 * (1 << 20)
+        # auto sliding-window scratch bound: 1M window x 4 in-flight
+        assert attr.global_work_buffer_size >= 4 * (1 << 20)
 
     def test_tpu_buffer_exports_metadata_only(self, job4):
         jax = pytest.importorskip("jax")

@@ -39,12 +39,13 @@ HOST_ALG_FIELDS = [
                 "variant: put (counter completion; reference parity) | "
                 "get (barrier; beyond-reference)", parse_string),
     ConfigField("ALLREDUCE_SW_WINDOW", "auto", "sliding-window "
-                "allreduce window bytes; auto = max(256K, min(4M, "
-                "msg/16)) from the round-4 TCP sweep (BASELINE.md)",
-                parse_memunits),
+                "allreduce window bytes; auto = max(256K, min(1M, "
+                "msg/64)) from the round-5 pipelined TCP re-sweep "
+                "(BASELINE.md)", parse_memunits),
     ConfigField("ALLREDUCE_SW_INFLIGHT", "auto", "sliding-window "
                 "allreduce in-flight get buffers (reference "
                 "num_buffers, allreduce_sliding_window.h:36-38); "
-                "auto = 8 for msgs >= 32M else 4 (round-4 sweep)",
+                "auto = 4 — depth stopped mattering once windows "
+                "pipeline across the message (round-5 re-sweep)",
                 parse_uint_auto),
 ]

@@ -55,13 +55,14 @@ OS_OPS = frozenset((OS_PUT, OS_GET, OS_CTR, OS_FLUSH))
 def sw_knobs(cfg, msg_bytes: int):
     """Resolve the sliding-window (window_bytes, inflight) knobs.
 
-    ``auto`` values come from the round-4 TCP sweep (BASELINE.md,
-    tools/sw_sweep.py): the optimal window and in-flight depth GROW with
-    message size — 4 MiB ran best at 256K windows x 4 buffers, 64 MiB at
-    4M x 8 (7.2x over two-sided) — so auto scales window to msg/16
-    clamped to [256K, 4M] and deepens the pipeline for >= 32 MiB.
-    Mirrors the reference's num_buffers/window tuning surface
-    (allreduce_sliding_window.h:36-38).
+    ``auto`` values come from the round-5 27-cell TCP re-sweep
+    (BASELINE.md, tools/sw_sweep.py) AFTER the cross-window pipeline
+    landed: with windows pipelining across the whole message, in-flight
+    depth stopped mattering (row averages within noise at every size —
+    4 is kept flat) and the optimal window SHRANK (256K best at 16 MiB,
+    1M at 64 MiB, 4M worst at both) — so auto scales window to msg/64
+    clamped to [256K, 1M]. Mirrors the reference's num_buffers/window
+    tuning surface (allreduce_sliding_window.h:36-38).
 
     ``Config.get`` returns PARSED values: ``parse_memunits``/
     ``parse_uint_auto`` map the string "auto" to the ``SIZE_AUTO``
@@ -83,25 +84,24 @@ def sw_knobs(cfg, msg_bytes: int):
             pass
     if w in (SIZE_AUTO, SIZE_INF):
         window = max(SW_AUTO_MIN_WINDOW,
-                     min(SW_AUTO_MAX_WINDOW, int(msg_bytes) // 16))
+                     min(SW_AUTO_MAX_WINDOW,
+                         int(msg_bytes) // SW_AUTO_WINDOW_DIVISOR))
     else:
         window = w
     if i in (SIZE_AUTO, UINT_MAX):
-        inflight = SW_AUTO_MAX_INFLIGHT \
-            if msg_bytes >= SW_DEEP_PIPELINE_MSG else SW_AUTO_MIN_INFLIGHT
+        inflight = SW_AUTO_INFLIGHT
     else:
         inflight = i
     return window, max(1, inflight)
 
 
-#: auto-formula operating points from the round-4 TCP sweep (BASELINE.md):
-#: window clamps to [256K, 4M] at msg/16; the pipeline deepens from 4 to 8
-#: in-flight buffers at 32 MiB.
+#: auto-formula operating points from the round-5 TCP re-sweep
+#: (BASELINE.md): window clamps to [256K, 1M] at msg/64; in-flight depth
+#: is flat 4 — the cross-window pipeline made deeper buffers worthless.
 SW_AUTO_MIN_WINDOW = 256 << 10
-SW_AUTO_MAX_WINDOW = 4 << 20
-SW_AUTO_MIN_INFLIGHT = 4
-SW_AUTO_MAX_INFLIGHT = 8
-SW_DEEP_PIPELINE_MSG = 32 << 20
+SW_AUTO_MAX_WINDOW = 1 << 20
+SW_AUTO_WINDOW_DIVISOR = 64
+SW_AUTO_INFLIGHT = 4
 
 
 def sw_max_work_buffer(cfg) -> int:
@@ -109,10 +109,10 @@ def sw_max_work_buffer(cfg) -> int:
     (ucc_context_get_attr GLOBAL_WORK_BUFFER — the reference sizes it as
     num_buffers x buffer segments before any collective is posted,
     ucc_context.c get_attr path). Resolves explicit window/inflight from
-    ``cfg``; auto values take the auto-formula maxima 4M x 8 (probed with
-    a message large enough to hit both ceilings)."""
-    window, inflight = sw_knobs(cfg, max(SW_AUTO_MAX_WINDOW * 16,
-                                         SW_DEEP_PIPELINE_MSG))
+    ``cfg``; auto values take the auto-formula maxima 1M x 4 (probed with
+    a message large enough to hit the window ceiling)."""
+    window, inflight = sw_knobs(cfg,
+                                SW_AUTO_MAX_WINDOW * SW_AUTO_WINDOW_DIVISOR)
     return int(window) * int(inflight)
 
 
