@@ -466,3 +466,50 @@ class TestSraSrgRadix:
                               dst=BufferInfo(dsts[r], count,
                                              DataType.FLOAT32),
                               op=ReductionOp.SUM), check, monkeypatch)
+
+
+class TestAllgatherLinearBatched:
+    """Bounded-in-flight linear allgather (allgather_linear.c batched
+    init): correctness at every window depth incl. nreqs=1 (fully
+    serialized) and the auto one-shot clamp."""
+
+    @pytest.mark.parametrize("n", [2, 4, 7])
+    @pytest.mark.parametrize("posts", ["1", "2", "auto"])
+    def test_allgather(self, n, posts, monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_ALLGATHER_BATCHED_NUM_POSTS", posts)
+        per = 9
+        srcs = [np.arange(per, dtype=np.int64) + 100 * r for r in range(n)]
+        dsts = [np.zeros(per * n, dtype=np.int64) for _ in range(n)]
+        expect = np.concatenate(srcs)
+
+        def check():
+            for r in range(n):
+                np.testing.assert_array_equal(dsts[r], expect)
+
+        run_with_tune(f"allgather:@linear_batched:inf", n,
+                      lambda r: CollArgs(
+                          coll_type=CollType.ALLGATHER,
+                          src=BufferInfo(srcs[r], per, DataType.INT64),
+                          dst=BufferInfo(dsts[r], per * n, DataType.INT64)),
+                      check, monkeypatch)
+
+    def test_inplace(self, monkeypatch):
+        n, per = 4, 5
+        monkeypatch.setenv("UCC_TL_SHM_ALLGATHER_BATCHED_NUM_POSTS", "2")
+        from ucc_tpu import CollArgsFlags
+        bufs = [np.zeros(per * n, np.float32) for _ in range(n)]
+        for r in range(n):
+            bufs[r][r * per:(r + 1) * per] = np.arange(per) + 10.0 * r
+        expect = np.concatenate([np.arange(per) + 10.0 * r
+                                 for r in range(n)]).astype(np.float32)
+
+        def check():
+            for r in range(n):
+                np.testing.assert_allclose(bufs[r], expect)
+
+        run_with_tune("allgather:@linear_batched:inf", n,
+                      lambda r: CollArgs(
+                          coll_type=CollType.ALLGATHER,
+                          dst=BufferInfo(bufs[r], per * n,
+                                         DataType.FLOAT32),
+                          flags=CollArgsFlags.IN_PLACE), check, monkeypatch)

@@ -9,7 +9,7 @@ Ring lives in ring.py.
 from __future__ import annotations
 
 import functools
-from typing import List
+from typing import List, Optional
 
 import numpy as np
 
@@ -161,6 +161,77 @@ class AllgatherLinear(HostCollTask):
             reqs.append(self.recv_nb(p, dst[p * blk:(p + 1) * blk],
                                      slot=130))
         yield from self.wait(*reqs)
+
+
+class AllgatherLinearBatched(HostCollTask):
+    """Linear allgather with BOUNDED in-flight requests
+    (allgather_linear.c ucc_tl_ucp_allgather_linear_batched_init): the
+    one-shot linear alg posts 2*(n-1) requests at once, which floods the
+    transport at scale; this variant keeps at most ``nreqs`` sends and
+    ``nreqs`` recvs outstanding (knob ``ALLGATHER_BATCHED_NUM_POSTS``,
+    auto = n-1 i.e. one-shot; reference get_num_reqs clamps the same
+    way). Sends walk clockwise from rank+1, recvs counter-clockwise from
+    rank-1 — opposite directions so bounded windows cannot deadlock
+    (the reference's 'avoid deadlock' pairing)."""
+
+    def __init__(self, init_args, team, subset=None,
+                 nreqs: Optional[int] = None):
+        super().__init__(init_args, team, subset)
+        _require_divisible(init_args, self.gsize)
+        if nreqs is None:
+            cfg = team.comp_context.config
+            from ...utils.config import SIZE_AUTO, UINT_MAX
+            raw = SIZE_AUTO
+            if cfg is not None:
+                try:
+                    raw = int(cfg.get("allgather_batched_num_posts"))
+                except KeyError:
+                    pass
+            max_req = max(1, self.gsize - 1)
+            # reference get_num_reqs: auto OR 0 OR > n-1 all mean
+            # one-shot (n-1 in flight); only 1..n-1 narrow the window
+            nreqs = max_req if raw in (SIZE_AUTO, UINT_MAX, 0) \
+                else min(int(raw), max_req)
+        self.nreqs = max(1, int(nreqs))
+
+    def run(self):
+        args = self.args
+        size, me = self.gsize, self.grank
+        total = int(args.dst.count)
+        blk = total // size
+        dst = binfo_typed(args.dst, total)
+        own = dst[me * blk:(me + 1) * blk]
+        if not args.is_inplace:
+            own[:] = binfo_typed(args.src, blk)
+        n_peers = size - 1
+        sends: List = []
+        recvs: List = []
+        s_posted = r_posted = 0
+        while (s_posted < n_peers or r_posted < n_peers or
+               sends or recvs):
+            while s_posted < n_peers and len(sends) < self.nreqs:
+                peer = (me + 1 + s_posted) % size
+                sends.append(self.send_nb(peer, own, slot=131))
+                s_posted += 1
+            while r_posted < n_peers and len(recvs) < self.nreqs:
+                peer = (size + me - 1 - r_posted) % size
+                recvs.append(self.recv_nb(
+                    peer, dst[peer * blk:(peer + 1) * blk], slot=131))
+                r_posted += 1
+            sends = [r for r in sends if not r.test()]
+            live = []
+            for r in recvs:
+                if not r.test():
+                    live.append(r)
+                elif getattr(r, "error", None):
+                    # same contract as HostCollTask.wait(): a delivered-
+                    # with-error recv (e.g. truncation) fails the coll
+                    raise UccError(Status.ERR_NO_MESSAGE,
+                                   f"allgather linear_batched recv "
+                                   f"failed: {r.error}")
+            recvs = live
+            if sends or recvs or s_posted < n_peers or r_posted < n_peers:
+                yield
 
 
 class AllgatherSparbit(HostCollTask):

@@ -32,6 +32,10 @@ HOST_ALG_FIELDS = [
                 parse_mrange_uint),
     ConfigField("BARRIER_KN_RADIX", "0-inf:4",
                 "barrier dissemination radix", parse_mrange_uint),
+    ConfigField("ALLGATHER_BATCHED_NUM_POSTS", "auto", "max in-flight "
+                "sends/recvs of the allgather linear_batched algorithm "
+                "(reference ALLGATHER_BATCHED_NUM_POSTS); auto = team "
+                "size - 1 (one-shot)", parse_uint_auto),
     ConfigField("ALLTOALL_ONESIDED_ALG", "put", "one-sided alltoall "
                 "variant: put (counter completion) | get (barrier)",
                 parse_string),

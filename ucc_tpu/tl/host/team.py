@@ -20,8 +20,8 @@ from ...status import Status, UccError
 from ...utils.ep_map import EpMap, EpMapType, Subset
 from ..base import AlgSpec, TlTeamBase, build_scores
 from .allgather import (AllgatherBruck, AllgatherKnomial, AllgatherLinear,
-                        AllgatherNeighbor, AllgatherSparbit,
-                        AllgathervKnomial)
+                        AllgatherLinearBatched, AllgatherNeighbor,
+                        AllgatherSparbit, AllgathervKnomial)
 from .alltoall import (AlltoallBruck, AlltoallLinear, AlltoallPairwise,
                        AlltoallvHybrid, AlltoallvPairwise)
 from .dbt import AllreduceDbt, BcastDbt, ReduceDbt
@@ -170,6 +170,7 @@ class HostTlTeam(TlTeamBase):
                      sel=f"0-8k:{S + 4},8k-inf:{S - 3}"),
                 spec(5, "knomial", AllgatherKnomial,
                      sel=f"0-8k:{S + 3},8k-inf:{S - 1}"),
+                spec(6, "linear_batched", AllgatherLinearBatched),
             ],
             CollType.ALLGATHERV: [
                 spec(0, "ring", AllgathervRing),
