@@ -447,3 +447,20 @@ class TestTriggeredAfterFastLane:
                     ee.destroy()
         finally:
             job.cleanup()
+
+
+class TestInfoAlgorithmListing:
+    """ucc_info -a must print the full per-TL algorithm lists — the
+    stub-team introspection path silently degrades to '(runtime)' if
+    alg_table ever requires live-team state (caught in round 5)."""
+
+    def test_host_tl_algs_listed(self, capsys):
+        from ucc_tpu.tools.info import print_algorithms
+        print_algorithms()
+        out = capsys.readouterr().out
+        for needle in ("sra_knomial", "sliding_window", "linear_batched",
+                       "sag_knomial", "bruck"):
+            assert needle in out, f"missing {needle} in -a output"
+        assert "tl/shm" in out and "tl/socket" in out
+        # the degraded marker must not replace every list
+        assert out.count("(runtime)") < out.count(":")

@@ -147,3 +147,48 @@ class TestUpdateFromStr:
         m = ScoreMap(self._score())
         info = m.print_info("t0")
         assert "allreduce/host" in info and "knomial:10" in info
+
+
+class TestTopologyAwareAllgatherDefault:
+    """The large-message allgather winner is topology-dependent, like
+    the reference's dynamic score string (allgather.c:55-100)."""
+
+    @staticmethod
+    def _selected(teams, n, count):
+        """Which algorithm the score map picks for a host allgather of
+        ``count`` elements per rank (peek, no run)."""
+        sm = teams[0].score_map
+        cands = sm.lookup(CollType.ALLGATHER, MemoryType.HOST,
+                          count * 8 * n)
+        return cands[0].alg_name if cands else None
+
+    def test_even_single_node_prefers_neighbor(self):
+        from harness import UccJob
+        job = UccJob(4)
+        try:
+            teams = job.create_team()
+            assert self._selected(teams, 4, 64 << 10) == "neighbor"
+        finally:
+            job.cleanup()
+
+    def test_odd_team_prefers_ring(self):
+        from harness import UccJob
+        job = UccJob(5)
+        try:
+            teams = job.create_team()
+            assert self._selected(teams, 5, 64 << 10) == "ring"
+        finally:
+            job.cleanup()
+
+    def test_multinode_reordered_prefers_ring(self, monkeypatch):
+        """Even size BUT multi-node with a non-identity host-ordered
+        map: ring keeps n-1 of n hops intra-node (use_reordering
+        branch)."""
+        from harness import UccJob
+        monkeypatch.setenv("UCC_TOPO_FAKE_PPN", "2")
+        job = UccJob(4)
+        try:
+            teams = job.create_team()
+            assert self._selected(teams, 4, 64 << 10) == "ring"
+        finally:
+            job.cleanup()

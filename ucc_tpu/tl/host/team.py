@@ -124,10 +124,33 @@ class HostTlTeam(TlTeamBase):
         peer_ctx = self._peer_ctx_rank(subset, peer_grank)
         return self.transport.recv_nb(self._key(coll_tag, slot, peer_ctx), dst)
 
+    def _ag_large_alg(self) -> str:
+        """Topology-aware large-message allgather default
+        (ucc_tl_ucp_allgather_score_str_get, allgather.c:55-100): even
+        team size -> neighbor (half the rounds of ring), UNLESS the
+        host-ordered reorder map is non-identity (multi-node teams where
+        ring locality wins) or the size is odd (neighbor unsupported).
+
+        getattr-guarded: ucc_info -a introspects alg_table on a STUB
+        team (tools/info.py) that has no size/core_team — the listing
+        must still work (scores are incidental there)."""
+        if getattr(self, "size", 0) % 2 != 0:
+            return "ring"
+        if getattr(self, "core_team", None) is not None and \
+                self.topo_ordered_subset() is not None:
+            return "ring"
+        return "neighbor"
+
     # ------------------------------------------------------------------
     # algorithm table (tl_ucp_coll.c alg lists; ids stable for @N tuning)
     def alg_table(self) -> Dict[CollType, List[AlgSpec]]:
         S = self.TL_CLS.DEFAULT_SCORE
+        # stub-safe team size (see _ag_large_alg) + hoisted topology
+        # decisions so the paired sel strings cannot desynchronize
+        tsize = max(1, getattr(self, "size", 2))
+        ring_large, nbr_large = (S + 5, S + 3) \
+            if self._ag_large_alg() == "ring" else (S + 3, S + 5)
+        a2a_switch = 129 * tsize
 
         def spec(i, name, cls, sel=None, **kw):
             def init(ia, team, _cls=cls, _kw=kw):
@@ -157,14 +180,18 @@ class HostTlTeam(TlTeamBase):
                      sel="0-inf:1"),
             ],
             CollType.ALLGATHER: [
-                # bruck for small msgs, neighbor for medium even teams,
-                # ring for large (tl_ucp_coll.c:207-233 alg list)
+                # bruck for small msgs; the LARGE-message winner is
+                # topology-aware like the reference's dynamic score str
+                # (allgather.c:55-100): neighbor halves ring's rounds on
+                # even teams, but odd sizes can't run it and reordered
+                # multi-node teams keep ring (host-ordered neighbors stay
+                # intra-node — the use_reordering branch)
                 spec(0, "ring", AllgatherRing,
-                     sel=f"0-8k:{S - 2},8k-inf:{S + 5}"),
+                     sel=f"0-8k:{S - 2},8k-inf:{ring_large}"),
                 spec(1, "bruck", AllgatherBruck,
                      sel=f"0-8k:{S + 5},8k-inf:{S - 2}"),
                 spec(2, "neighbor", AllgatherNeighbor,
-                     sel=f"0-8k:{S - 4},8k-inf:{S + 3}"),
+                     sel=f"0-8k:{S - 4},8k-inf:{nbr_large}"),
                 spec(3, "linear", AllgatherLinear),
                 spec(4, "sparbit", AllgatherSparbit,
                      sel=f"0-8k:{S + 4},8k-inf:{S - 3}"),
@@ -178,10 +205,16 @@ class HostTlTeam(TlTeamBase):
                      sel=f"0-8k:{S + 2},8k-inf:{S - 1}"),
             ],
             CollType.ALLTOALL: [
+                # the bruck/pairwise crossover SCALES WITH TEAM SIZE
+                # (alltoall.c:12,28: switch at 129 * tsize bytes) — bruck's
+                # log-round advantage grows with n while its extra copies
+                # cost per byte
                 spec(0, "pairwise", AlltoallPairwise,
-                     sel=f"0-256:{S - 5},256-inf:{S + 5}"),
+                     sel=f"0-{a2a_switch}:{S - 5},"
+                         f"{a2a_switch}-inf:{S + 5}"),
                 spec(1, "bruck", AlltoallBruck,
-                     sel=f"0-256:{S + 5},256-inf:{S - 5}"),
+                     sel=f"0-{a2a_switch}:{S + 5},"
+                         f"{a2a_switch}-inf:{S - 5}"),
                 spec(2, "linear", AlltoallLinear),
                 # TUNE-selected one-sided variant (tl_ucp onesided role)
                 spec(3, "onesided", AlltoallOnesided, sel="0-inf:1"),
