@@ -814,3 +814,56 @@ class TestReaderDesyncHardening:
         finally:
             tr.close()
             self._uncapture(h)
+
+
+class TestPreconnect:
+    """UCC_TL_SOCKET_PRECONNECT (tl_ucp PRECONNECT role): teams at or
+    under the threshold establish every TCP connection during team
+    create via a zero-byte tagged exchange, so the first collective
+    pays no connect latency."""
+
+    def _job(self, monkeypatch, preconnect):
+        from harness import UccJob
+        monkeypatch.setenv("UCC_TLS", "socket,self")
+        monkeypatch.setenv("UCC_TL_SOCKET_PRECONNECT", str(preconnect))
+        return UccJob(3)
+
+    def test_connections_up_at_team_create(self, monkeypatch):
+        job = self._job(monkeypatch, 16)
+        try:
+            teams = job.create_team()
+            # every context has outbound conns to both peers BEFORE any
+            # collective was posted
+            for ctx in job.contexts:
+                tr = ctx.tl_contexts["socket"].obj.transport
+                assert len(tr._conns) >= 2, tr._conns.keys()
+            # and collectives still work on the preconnected team
+            srcs = [np.full(16, r + 1.0, np.float32) for r in range(3)]
+            dsts = [np.zeros(16, np.float32) for _ in range(3)]
+            from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType,
+                                 ReductionOp)
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(srcs[r], 16, DataType.FLOAT32),
+                dst=BufferInfo(dsts[r], 16, DataType.FLOAT32),
+                op=ReductionOp.SUM))
+            for d in dsts:
+                np.testing.assert_allclose(d, np.full(16, 6.0))
+        finally:
+            job.cleanup()
+
+    def test_disabled_means_lazy(self, monkeypatch):
+        """Default (0): the preconnect machinery never engages — note
+        service collectives at team create may still open connections,
+        so the observable is the team flag, not the conn count."""
+        job = self._job(monkeypatch, 0)
+        try:
+            teams = job.create_team()
+            for t in teams:
+                for cl in t.cl_teams:
+                    for tlt in getattr(cl, "tl_teams", []):
+                        if tlt.NAME == "socket":
+                            assert not tlt._want_preconnect
+                            assert tlt._preconnect_reqs is None
+        finally:
+            job.cleanup()

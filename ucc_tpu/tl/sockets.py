@@ -4,8 +4,10 @@ The stand-in for TL/UCP's inter-node transport (UCX is absent on TPU
 pods — SURVEY §7.6): every context runs a small listener; worker addresses
 (host, port) ride the context OOB address exchange exactly like UCX worker
 addresses do in the reference (ucc_context.c:839-852); connections are
-established lazily on first send (tl/ucp preconnect analog would go in
-create_epilog). Reader threads demultiplex frames into the same Mailbox
+established lazily on first send, or eagerly at team create for teams up
+to UCC_TL_SOCKET_PRECONNECT ranks (the tl/ucp PRECONNECT zero-byte
+exchange, tl_ucp_team.c:197-236). Reader threads demultiplex frames into
+the same Mailbox
 matching structure the in-process transport uses, so the entire host
 algorithm suite runs unchanged over TCP.
 
@@ -27,7 +29,7 @@ from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
 from ..ec.cpu import EcCpu
 from ..status import Status, UccError
 from ..utils.config import (ConfigField, ConfigTable, parse_string,
-                            register_table)
+                            parse_uint, register_table)
 from ..utils.log import get_logger
 from .host.config_fields import HOST_ALG_FIELDS
 from .host.onesided import (OS_FLUSH, OS_GET, OS_OPS, OS_PUT, REGISTRY,
@@ -77,6 +79,11 @@ TL_SOCKET_CONFIG = register_table(ConfigTable(
     prefix="TL_SOCKET_", name="tl/socket", fields=HOST_ALG_FIELDS + [
         ConfigField("BIND_HOST", "", "address to bind/advertise (default: "
                     "auto-detect, 127.0.0.1 fallback)", parse_string),
+        ConfigField("PRECONNECT", "0", "team sizes up to this many ranks "
+                    "establish ALL TCP connections during team create via "
+                    "a zero-byte tagged exchange (tl_ucp PRECONNECT, "
+                    "tl_ucp_team.c:197-236); 0 = lazy connect on first "
+                    "send", parse_uint),
     ]))
 
 
@@ -411,6 +418,43 @@ class TlSocketContext(BaseContext):
 
 class TlSocketTeam(HostTlTeam):
     NAME = "socket"
+
+    def __init__(self, comp_context, core_team, scope: str = "cl"):
+        super().__init__(comp_context, core_team, scope)
+        cfg = comp_context.config
+        thresh = 0
+        if cfg is not None:
+            try:
+                thresh = int(cfg.get("preconnect"))
+            except KeyError:
+                pass
+        self._preconnect_reqs = None
+        self._want_preconnect = 1 < self.size <= thresh
+
+    def create_test(self) -> Status:
+        """Preconnect (tl_ucp_team.c:197-236): a zero-byte tagged
+        exchange with every peer forces TCP connection establishment at
+        team create, so the first collective pays no connect latency.
+        Tag 0 cannot collide: real collectives allocate tags from 1."""
+        if not self._want_preconnect:
+            return Status.OK
+        if self._preconnect_reqs is None:
+            sub = self.full_subset()
+            empty = np.zeros(0, dtype=np.uint8)
+            reqs = []
+            for i in range(1, self.size):
+                dst = (self.rank + i) % self.size
+                src = (self.rank - i + self.size) % self.size
+                reqs.append(self.send_nb(sub, dst, 0, 0, empty))
+                # zero-byte recv writes nothing; RecvReq retains its dst
+                reqs.append(self.recv_nb(sub, src, 0, 0, empty))
+            self._preconnect_reqs = reqs
+        self._preconnect_reqs = [r for r in self._preconnect_reqs
+                                 if not r.test()]
+        if self._preconnect_reqs:
+            return Status.IN_PROGRESS
+        self._want_preconnect = False   # idempotent completion
+        return Status.OK
 
 
 @register_tl
