@@ -513,3 +513,130 @@ class TestAllgatherLinearBatched:
                           dst=BufferInfo(bufs[r], per * n,
                                          DataType.FLOAT32),
                           flags=CollArgsFlags.IN_PLACE), check, monkeypatch)
+
+
+class TestPairwiseNumPosts:
+    """ALLTOALL(V)_PAIRWISE_NUM_POSTS (alltoall_pairwise.c get_num_posts):
+    every window depth must stay correct; auto resolves by msg/team size."""
+
+    @pytest.mark.parametrize("posts", ["1", "2", "0", "auto"])
+    def test_alltoall(self, posts, monkeypatch):
+        n, per = 5, 6
+        monkeypatch.setenv("UCC_TL_SHM_ALLTOALL_PAIRWISE_NUM_POSTS", posts)
+        srcs = [np.arange(per * n, dtype=np.int32) + 1000 * r
+                for r in range(n)]
+        dsts = [np.zeros(per * n, np.int32) for _ in range(n)]
+
+        def check():
+            for r in range(n):
+                expect = np.concatenate(
+                    [srcs[q][r * per:(r + 1) * per] for q in range(n)])
+                np.testing.assert_array_equal(dsts[r], expect)
+
+        run_with_tune("alltoall:@pairwise:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALL,
+            src=BufferInfo(srcs[r], per * n, DataType.INT32),
+            dst=BufferInfo(dsts[r], per * n, DataType.INT32)),
+            check, monkeypatch)
+
+    def test_resolution_rules(self):
+        """Pin the auto/0/clamp rules to the reference's get_num_posts."""
+        from ucc_tpu.tl.host.alltoall import _pairwise_num_posts
+        from ucc_tpu.utils.config import SIZE_AUTO
+
+        class _Cfg:
+            def __init__(self, v):
+                self.v = v
+
+            def get(self, k):
+                return self.v
+
+        class _Team:
+            def __init__(self, v):
+                self.comp_context = type("C", (), {"config": _Cfg(v)})()
+
+        # alltoall auto: big msg + big team -> 1; else all (= tsize)
+        assert _pairwise_num_posts(_Team(SIZE_AUTO), "k", 100_000, 64, 4) == 1
+        assert _pairwise_num_posts(_Team(SIZE_AUTO), "k", 100_000, 8, 4) == 8
+        assert _pairwise_num_posts(_Team(SIZE_AUTO), "k", 1024, 64, 4) == 64
+        # alltoallv auto (data_size None): team-size-only
+        assert _pairwise_num_posts(_Team(SIZE_AUTO), "k", None, 64, 4) == 1
+        assert _pairwise_num_posts(_Team(SIZE_AUTO), "k", None, 8, 4) == 8
+        # explicit 0 / inf / oversize clamp to tsize; in-range passes
+        from ucc_tpu.utils.config import UINT_MAX
+        assert _pairwise_num_posts(_Team(0), "k", 1024, 8, 4) == 8
+        assert _pairwise_num_posts(_Team(UINT_MAX), "k", 100_000, 64, 4) == 64
+        assert _pairwise_num_posts(_Team(99), "k", 1024, 8, 4) == 8
+        assert _pairwise_num_posts(_Team(3), "k", 1024, 8, 4) == 3
+
+
+class TestSraPipelined:
+    """ALLREDUCE_SRA_PIPELINE (the reference ALLREDUCE_SRA_KN_PIPELINE
+    role): above the threshold the vector fragments through the
+    PipelinedSchedule engine; below it the plain task runs."""
+
+    @pytest.mark.parametrize("n", [4, 5])
+    @pytest.mark.parametrize("count", [4096, 10001])
+    @pytest.mark.parametrize("order", ["ordered", "parallel"])
+    def test_fragmented_correct(self, n, count, order, monkeypatch):
+        monkeypatch.setenv(
+            "UCC_TL_SHM_ALLREDUCE_SRA_PIPELINE",
+            f"thresh=1K:fragsize=8K:nfrags=4:pdepth=2:{order}")
+        rng = np.random.default_rng(33)
+        srcs = [(rng.random(count) * 4 - 2).astype(np.float32)
+                for _ in range(n)]
+        dsts = [np.zeros(count, np.float32) for _ in range(n)]
+        expect = np.sum(srcs, axis=0)
+
+        def check():
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r], expect, rtol=1e-4,
+                                           atol=1e-5)
+
+        run_with_tune("allreduce:@sra_knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+            op=ReductionOp.SUM), check, monkeypatch)
+
+    def test_below_thresh_runs_plain(self, monkeypatch):
+        """Under the threshold the init returns the plain task (no
+        schedule wrapping) — pin via the returned type."""
+        monkeypatch.setenv("UCC_TL_SHM_ALLREDUCE_SRA_PIPELINE",
+                           "thresh=1M:fragsize=1M:nfrags=4")
+        monkeypatch.setenv("UCC_TL_SHM_TUNE", "allreduce:@sra_knomial:inf")
+        from harness import UccJob
+        from ucc_tpu.tl.host.sra import AllreduceSraKnomial
+        job = UccJob(2)
+        try:
+            teams = job.create_team()
+            src = np.ones(64, np.float32)
+            dst = np.zeros(64, np.float32)
+            req = teams[0].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(src, 64, DataType.FLOAT32),
+                dst=BufferInfo(dst, 64, DataType.FLOAT32),
+                op=ReductionOp.SUM))
+            assert isinstance(getattr(req, "task", req),
+                              (AllreduceSraKnomial,)) or \
+                "Sra" in type(getattr(req, "task", req)).__name__
+        finally:
+            job.cleanup()
+
+    def test_avg_fragmented(self, monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_ALLREDUCE_SRA_PIPELINE",
+                           "thresh=1K:fragsize=4K:nfrags=3")
+        n, count = 4, 5000
+        srcs = [np.full(count, float(r + 1), np.float64) for r in range(n)]
+        dsts = [np.zeros(count, np.float64) for _ in range(n)]
+        expect = np.mean(srcs, axis=0)
+
+        def check():
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r], expect, rtol=1e-12)
+
+        run_with_tune("allreduce:@sra_knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+            op=ReductionOp.AVG), check, monkeypatch)

@@ -25,8 +25,51 @@ from ..base import binfo_typed, binfo_v_block
 from .task import HostCollTask
 
 
+#: reference auto-posts thresholds (alltoall_pairwise.c:15-16): big
+#: messages on big teams serialize (1 post) to avoid flooding; otherwise
+#: everything goes in flight (linear regime)
+_MSG_MEDIUM = 66000
+_NP_THRESH = 32
+
+
+def _pairwise_num_posts(team, knob: str, data_size: int, tsize: int,
+                        window_default: int) -> int:
+    """ALLTOALL(V)_PAIRWISE_NUM_POSTS resolution. The auto rules differ
+    per collective, matching the reference exactly:
+
+    - alltoall (alltoall_pairwise.c:30-51): serialize (1) only for BIG
+      messages (>64KB) on BIG teams (>32); else all in flight;
+    - alltoallv (alltoallv_pairwise.c:30-46, ``data_size`` is None):
+      team-size-ONLY — v-counts are peer-dependent so no single message
+      size exists; >32 ranks always serialize to avoid flooding.
+
+    'inf' (UINT_MAX) is maximum concurrency — clamped to tsize like any
+    oversize value, NOT treated as auto. 0 also means all in flight.
+    ``window_default`` keeps this port's historical mid-ground when the
+    knob is absent from the config table entirely."""
+    cfg = team.comp_context.config
+    from ...utils.config import SIZE_AUTO, UINT_MAX
+    raw = None
+    if cfg is not None:
+        try:
+            raw = int(cfg.get(knob))
+        except KeyError:
+            raw = None
+    if raw is None:
+        return window_default
+    if raw == SIZE_AUTO:
+        if data_size is None:        # alltoallv: team-size-only rule
+            return 1 if tsize > _NP_THRESH else max(1, tsize)
+        return 1 if (data_size > _MSG_MEDIUM and tsize > _NP_THRESH) \
+            else max(1, tsize)
+    if raw == UINT_MAX or raw == 0 or raw > tsize:
+        return max(1, tsize)
+    return int(raw)
+
+
 class AlltoallPairwise(HostCollTask):
-    WINDOW = 4   # in-flight exchanges (pairwise num_posts flavor)
+    WINDOW = 4   # historical default when the knob is unavailable
+    USES_NUM_POSTS_KNOB = True
 
     def __init__(self, init_args, team, subset=None):
         super().__init__(init_args, team, subset)
@@ -34,6 +77,12 @@ class AlltoallPairwise(HostCollTask):
             from ...status import Status, UccError
             raise UccError(Status.ERR_INVALID_PARAM,
                            "alltoall needs count divisible by team size")
+        if self.USES_NUM_POSTS_KNOB:
+            self.window = _pairwise_num_posts(
+                team, "alltoall_pairwise_num_posts",
+                int(init_args.msgsize), self.gsize, self.WINDOW)
+        else:
+            self.window = self.WINDOW
 
     def run(self):
         args = self.args
@@ -53,7 +102,7 @@ class AlltoallPairwise(HostCollTask):
                                      slot=80 + step))
             reqs.append(self.recv_nb(frm, dst[frm * blk:(frm + 1) * blk],
                                      slot=80 + step))
-            if len(reqs) >= 2 * self.WINDOW:
+            if len(reqs) >= 2 * self.window:
                 yield from self.wait(*reqs)
                 reqs = []
         if reqs:
@@ -62,6 +111,7 @@ class AlltoallPairwise(HostCollTask):
 
 class AlltoallLinear(AlltoallPairwise):
     WINDOW = 1 << 30  # post everything, single wait
+    USES_NUM_POSTS_KNOB = False
 
 
 class AlltoallBruck(HostCollTask):
@@ -111,6 +161,12 @@ class AlltoallBruck(HostCollTask):
 class AlltoallvPairwise(HostCollTask):
     WINDOW = 4
 
+    def __init__(self, init_args, team, subset=None):
+        super().__init__(init_args, team, subset)
+        self.window = _pairwise_num_posts(
+            team, "alltoallv_pairwise_num_posts",
+            None, self.gsize, self.WINDOW)
+
     def run(self):
         args = self.args
         size, me = self.gsize, self.grank
@@ -139,7 +195,7 @@ class AlltoallvPairwise(HostCollTask):
             reqs.append(self.send_nb(to, sblock(to), slot=88 + step))
             reqs.append(self.recv_nb(frm, binfo_v_block(dstv, frm),
                                      slot=88 + step))
-            if len(reqs) >= 2 * self.WINDOW:
+            if len(reqs) >= 2 * self.window:
                 yield from self.wait(*reqs)
                 reqs = []
         if reqs:

@@ -23,6 +23,11 @@ HOST_ALG_FIELDS = [
                 "scatter-reduce-allgather radix per msg range "
                 "(auto = 2, the canonical halving instance)",
                 parse_mrange_uint),
+    ConfigField("ALLREDUCE_SRA_PIPELINE", "n", "fragmentation pipeline "
+                "spec for SRA allreduce (reference "
+                "ALLREDUCE_SRA_KN_PIPELINE), e.g. "
+                "thresh=64K:fragsize=1M:nfrags=4:pdepth=2:ordered; n = off",
+                parse_string),
     ConfigField("REDUCE_SRG_RADIX", "0-inf:auto", "SRG reduce "
                 "scatter-reduce-gather radix per msg range (auto = 2)",
                 parse_mrange_uint),
@@ -32,6 +37,15 @@ HOST_ALG_FIELDS = [
                 parse_mrange_uint),
     ConfigField("BARRIER_KN_RADIX", "0-inf:4",
                 "barrier dissemination radix", parse_mrange_uint),
+    ConfigField("ALLTOALL_PAIRWISE_NUM_POSTS", "auto", "max in-flight "
+                "pairwise alltoall exchanges (reference "
+                "ALLTOALL_PAIRWISE_NUM_POSTS); auto = 1 for >64KB on "
+                ">32-rank teams else all; 0 = all", parse_uint_auto),
+    ConfigField("ALLTOALLV_PAIRWISE_NUM_POSTS", "auto", "max in-flight "
+                "pairwise alltoallv exchanges; auto = 1 on >32-rank "
+                "teams else all (team-size-only, "
+                "alltoallv_pairwise.c:30-46); 0/inf = all",
+                parse_uint_auto),
     ConfigField("ALLGATHER_BATCHED_NUM_POSTS", "auto", "max in-flight "
                 "sends/recvs of the allgather linear_batched algorithm "
                 "(reference ALLGATHER_BATCHED_NUM_POSTS); auto = team "

@@ -277,3 +277,78 @@ class ReduceSrgKnomial(_SraBase):
                                                   slot=171))
         elif hi > lo:
             yield from self.wait(self.send_nb(sink, work[lo:hi], slot=190))
+
+
+def sra_pipelined_init(init_args, team, radix=None):
+    """SRA allreduce with optional fragmentation pipelining — the
+    ALLREDUCE_SRA_KN_PIPELINE role (allreduce_sra_knomial.c:58-171 +
+    get_pipeline_params): above the spec's threshold the vector splits
+    into fragments driven through the PipelinedSchedule engine, so
+    fragment k+1's reduce-scatter overlaps fragment k's allgather.
+    Knob ``ALLREDUCE_SRA_PIPELINE`` uses the standard pipeline DSL
+    (thresh=64K:fragsize=1M:nfrags=4:pdepth=2:ordered); default off."""
+    from ...api.types import BufferInfo, CollArgs
+    from ...constants import CollArgsFlags, CollType
+    from ...schedule.pipelined import (PipelinedSchedule, PipelineOrder,
+                                       parse_pipeline_params)
+    from ...schedule.schedule import Schedule
+    from ..base import binfo_typed
+
+    args = init_args.args
+    cfg = team.comp_context.config
+    pp = None
+    if cfg is not None:
+        try:
+            pp = parse_pipeline_params(cfg.get("allreduce_sra_pipeline"))
+        except KeyError:
+            pp = None
+    count = int(args.dst.count)
+    esz = dt_numpy(args.dst.datatype).itemsize
+    n_frags = pdepth = 1
+    if pp is not None:
+        n_frags, pdepth = pp.nfrags_pdepth(count * esz)
+    if n_frags <= 1 or count < n_frags:
+        return AllreduceSraKnomial(init_args, team, radix=radix)
+
+    from ...utils.mathutils import block_count, block_offset
+    dt = args.dst.datatype
+    full_dst = binfo_typed(args.dst, count)
+    full_src = full_dst if args.is_inplace else binfo_typed(args.src, count)
+
+    def frag_args(frag_num):
+        off = block_offset(count, n_frags, frag_num)
+        cnt = block_count(count, n_frags, frag_num)
+        return CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(full_src[off:off + cnt], cnt, dt),
+            dst=BufferInfo(full_dst[off:off + cnt], cnt, dt),
+            op=args.op,
+            flags=args.flags & ~(CollArgsFlags.PERSISTENT
+                                 | CollArgsFlags.IN_PLACE))
+
+    ia_cls = type(init_args)
+
+    def frag_init(sched_p, idx):
+        frag = Schedule(team=team)
+        fa = frag_args(idx)
+        fia = ia_cls(args=fa, team=init_args.team,
+                     mem_type=init_args.mem_type,
+                     msgsize=int(fa.dst.count) * esz)
+        t = AllreduceSraKnomial(fia, team, radix=radix)
+        frag.add_task(t)
+        frag.add_dep_on_schedule_start(t)
+        return frag
+
+    def frag_setup(sched_p, frag, frag_num):
+        fa = frag_args(frag_num)
+        for t in frag.tasks:
+            t.args.src = fa.src
+            t.args.dst = fa.dst
+            t.count = int(fa.dst.count)
+        from ...status import Status as _S
+        return _S.OK
+
+    return PipelinedSchedule(
+        team=team, args=args, frag_init=frag_init, frag_setup=frag_setup,
+        n_frags=pdepth, n_frags_total=n_frags,
+        order=pp.order if pp else PipelineOrder.SEQUENTIAL)

@@ -35,7 +35,8 @@ from .onesided import (AllreduceSlidingWindow, AlltoallOnesided,
 from .ring import (AllgatherRing, AllgathervRing, AllreduceRing,
                    ReduceScatterRing, ReduceScatterRingBidirectional,
                    ReduceScattervRing)
-from .sra import AllreduceSraKnomial, ReduceSrgKnomial
+from .sra import (AllreduceSraKnomial, ReduceSrgKnomial,
+                  sra_pipelined_init)
 from .task import HostCollTask
 from .transport import Mailbox, TagKey
 
@@ -167,7 +168,10 @@ class HostTlTeam(TlTeamBase):
                 # (default select mirrors tl_ucp allreduce.h:24-25)
                 spec(0, "knomial", AllreduceKnomial,
                      sel=f"0-4k:{S + 5},4k-inf:{S - 5}"),
-                spec(1, "sra_knomial", AllreduceSraKnomial,
+                # sra_pipelined_init returns the plain task unless the
+                # ALLREDUCE_SRA_PIPELINE knob fragments it (the
+                # ALLREDUCE_SRA_KN_PIPELINE role)
+                spec(1, "sra_knomial", sra_pipelined_init,
                      sel=f"0-4k:{S - 5},4k-inf:{S + 5}"),
                 spec(2, "ring", AllreduceRing,
                      sel=f"0-4k:{S - 6},4k-inf:{S + 4}"),
