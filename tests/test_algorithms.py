@@ -640,3 +640,93 @@ class TestSraPipelined:
             src=BufferInfo(srcs[r], count, DataType.FLOAT64),
             dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
             op=ReductionOp.AVG), check, monkeypatch)
+
+
+class TestSrgPipelined:
+    """REDUCE_SRG_PIPELINE: rooted reduce fragments through the same
+    engine; root and non-root (dst=None) shapes both retarget."""
+
+    @pytest.mark.parametrize("n,root", [(4, 0), (5, 2)])
+    def test_fragmented_correct(self, n, root, monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_REDUCE_SRG_PIPELINE",
+                           "thresh=1K:fragsize=8K:nfrags=3:pdepth=2")
+        count = 6000
+        srcs = [np.arange(count, dtype=np.int64) + r for r in range(n)]
+        dsts = [np.zeros(count, np.int64) for _ in range(n)]
+        expect = np.sum(srcs, axis=0)
+
+        def check():
+            np.testing.assert_array_equal(dsts[root], expect)
+
+        run_with_tune("reduce:@srg_knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.REDUCE,
+            src=BufferInfo(srcs[r], count, DataType.INT64),
+            dst=BufferInfo(dsts[r], count, DataType.INT64),
+            op=ReductionOp.SUM, root=root), check, monkeypatch)
+
+    def test_avg_fragmented(self, monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_REDUCE_SRG_PIPELINE",
+                           "thresh=1K:fragsize=4K:nfrags=4")
+        n, count, root = 4, 3000, 1
+        srcs = [np.full(count, float(r + 1), np.float64) for r in range(n)]
+        dsts = [np.zeros(count, np.float64) for _ in range(n)]
+
+        def check():
+            np.testing.assert_allclose(dsts[root],
+                                       np.full(count, 2.5), rtol=1e-12)
+
+        run_with_tune("reduce:@srg_knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.REDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+            op=ReductionOp.AVG, root=root), check, monkeypatch)
+
+
+class TestLinearNumPosts:
+    """GATHERV/SCATTERV_LINEAR_NUM_POSTS: the root's request window is
+    bounded; every depth stays correct (incl. 1 = fully serialized)."""
+
+    @pytest.mark.parametrize("posts", ["1", "2", "0"])
+    @pytest.mark.parametrize("coll,alg", [
+        (CollType.GATHERV, "gatherv:@linear"),
+        (CollType.SCATTERV, "scatterv:@linear"),
+    ])
+    def test_v_colls(self, posts, coll, alg, monkeypatch):
+        from ucc_tpu import BufferInfoV
+        n, root = 5, 1
+        knob = "GATHERV_LINEAR_NUM_POSTS" if coll == CollType.GATHERV \
+            else "SCATTERV_LINEAR_NUM_POSTS"
+        monkeypatch.setenv(f"UCC_TL_SHM_{knob}", posts)
+        counts = [(r % 3) + 1 for r in range(n)]
+        total = sum(counts)
+        if coll == CollType.GATHERV:
+            srcs = [np.full(counts[r], float(r + 1), np.float32)
+                    for r in range(n)]
+            dsts = [np.zeros(total, np.float32) for _ in range(n)]
+
+            def check():
+                np.testing.assert_allclose(
+                    dsts[root], np.concatenate(srcs))
+
+            run_with_tune(f"{alg}:inf", n, lambda r: CollArgs(
+                coll_type=coll, root=root,
+                src=BufferInfo(srcs[r], counts[r], DataType.FLOAT32),
+                dst=BufferInfoV(dsts[r], counts, None, DataType.FLOAT32)
+                if r == root else None), check, monkeypatch)
+        else:
+            src_all = np.arange(total, dtype=np.float32)
+            dsts = [np.zeros(counts[r], np.float32) for r in range(n)]
+
+            def check():
+                off = 0
+                for r in range(n):
+                    np.testing.assert_allclose(
+                        dsts[r], src_all[off:off + counts[r]])
+                    off += counts[r]
+
+            run_with_tune(f"{alg}:inf", n, lambda r: CollArgs(
+                coll_type=coll, root=root,
+                src=BufferInfoV(src_all, counts, None, DataType.FLOAT32)
+                if r == root else None,
+                dst=BufferInfo(dsts[r], counts[r], DataType.FLOAT32)),
+                check, monkeypatch)

@@ -192,3 +192,23 @@ class TestTopologyAwareAllgatherDefault:
             assert self._selected(teams, 4, 64 << 10) == "ring"
         finally:
             job.cleanup()
+
+
+class TestRanksReorderingKnob:
+    """RANKS_REORDERING=n disables the FULL_HOST_ORDERED reorder — the
+    multinode allgather default flips back to neighbor (even team), and
+    ring algorithms run in natural rank order."""
+
+    def test_knob_off_restores_neighbor_default(self, monkeypatch):
+        from harness import UccJob
+        monkeypatch.setenv("UCC_TOPO_FAKE_PPN", "2")
+        monkeypatch.setenv("UCC_TL_SHM_RANKS_REORDERING", "n")
+        job = UccJob(4)
+        try:
+            teams = job.create_team()
+            sm = teams[0].score_map
+            cands = sm.lookup(CollType.ALLGATHER, MemoryType.HOST,
+                              64 << 13)
+            assert cands[0].alg_name == "neighbor", cands[0].alg_name
+        finally:
+            job.cleanup()

@@ -164,27 +164,53 @@ class _SubsetOobRequest(OobRequest):
 _MSG = struct.Struct("!II")  # rank, payload length
 
 
+def _store_cookie(key: str, size: int) -> bytes:
+    """Per-job handshake cookie: magic + digest of (user key, size) so a
+    client that reaches a DIFFERENT job's store (shared default port) is
+    rejected, not silently enrolled."""
+    import hashlib
+    return b"UCCS" + hashlib.sha1(
+        f"{key}:{size}".encode()).digest()[:8]
+
+
 class TcpStoreOob(OobColl):
     """Rank 0 hosts a tiny allgather server; everyone else connects.
     Synchronous under the hood but exposed through the nonblocking
     OobRequest contract."""
 
     def __init__(self, rank: int, size: int, host: str = "127.0.0.1",
-                 port: int = 29999):
+                 port: int = 29999, key: str = ""):
         self.rank = rank
         self.size = size
         self.addr = (host, port)
+        cookie = _store_cookie(key, size)
         self._server: Optional[_StoreServer] = None
         self._sock: Optional[socket.socket] = None
         if rank == 0:
-            self._server = _StoreServer(size, (host, port))
+            self._server = _StoreServer(size, (host, port), cookie)
         deadline = time.monotonic() + 30
         while True:
             try:
                 self._sock = socket.create_connection(self.addr, timeout=5)
                 self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # two-way handshake: the server identifies itself (cookie
+                # covers job key + size, so another job's store on a
+                # shared port is rejected), then the client registers its
+                # rank; the server only counts VALIDATED registrations,
+                # so a foreign listener, a half-dead probe, or a stranger
+                # client can neither poison a stream nor eat a slot
+                got = _recv_exact(self._sock, len(cookie))
+                if got != cookie:
+                    raise OSError(f"not this job's ucc store (got {got!r})")
+                self._sock.sendall(cookie + struct.pack("!I", rank))
                 break
             except OSError:
+                try:
+                    if self._sock is not None:
+                        self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.05)
@@ -267,27 +293,55 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class _StoreServer:
-    def __init__(self, size: int, addr):
+    def __init__(self, size: int, addr, cookie: bytes):
         self.size = size
+        self.cookie = cookie
         self.lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.lsock.bind(addr)
-        self.lsock.listen(size)
+        self.lsock.listen(size + 8)
         self.conns: List[socket.socket] = []
         self.thread = threading.Thread(target=self._run, daemon=True)
         self.thread.start()
 
+    def _register(self, c: socket.socket) -> Optional[int]:
+        """Cookie out, cookie+rank back, rank bound-checked. Returns the
+        validated rank, or None (conn closed) for strangers/dead probes
+        — unvalidated connections never consume a slot."""
+        try:
+            c.settimeout(10)
+            c.sendall(self.cookie)
+            echo = _recv_exact(c, len(self.cookie) + 4)
+            if echo[:len(self.cookie)] != self.cookie:
+                raise OSError("bad cookie echo")
+            (rank,) = struct.unpack("!I", echo[len(self.cookie):])
+            if not 0 <= rank < self.size:
+                raise OSError(f"rank {rank} out of range")
+            c.settimeout(None)
+            return rank
+        except (ConnectionError, OSError):
+            try:
+                c.close()
+            except OSError:
+                pass
+            return None
+
     def _run(self) -> None:
         try:
-            while len(self.conns) < self.size:
+            registered = 0
+            while registered < self.size:
                 c, _ = self.lsock.accept()
                 c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self.conns.append(c)
+                if self._register(c) is not None:
+                    self.conns.append(c)
+                    registered += 1
             while True:
                 contribs: List[Optional[bytes]] = [None] * self.size
                 for c in list(self.conns):
                     hdr = _recv_exact(c, _MSG.size)
                     rank, ln = _MSG.unpack(hdr)
+                    if not 0 <= rank < self.size:
+                        raise OSError(f"stray rank {rank} on store conn")
                     contribs[rank] = _recv_exact(c, ln)
                 blob = pickle.dumps(contribs)
                 out = struct.pack("!I", len(blob)) + blob

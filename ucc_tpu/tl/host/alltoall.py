@@ -32,21 +32,13 @@ _MSG_MEDIUM = 66000
 _NP_THRESH = 32
 
 
-def _pairwise_num_posts(team, knob: str, data_size: int, tsize: int,
-                        window_default: int) -> int:
-    """ALLTOALL(V)_PAIRWISE_NUM_POSTS resolution. The auto rules differ
-    per collective, matching the reference exactly:
-
-    - alltoall (alltoall_pairwise.c:30-51): serialize (1) only for BIG
-      messages (>64KB) on BIG teams (>32); else all in flight;
-    - alltoallv (alltoallv_pairwise.c:30-46, ``data_size`` is None):
-      team-size-ONLY — v-counts are peer-dependent so no single message
-      size exists; >32 ranks always serialize to avoid flooding.
-
-    'inf' (UINT_MAX) is maximum concurrency — clamped to tsize like any
-    oversize value, NOT treated as auto. 0 also means all in flight.
-    ``window_default`` keeps this port's historical mid-ground when the
-    knob is absent from the config table entirely."""
+def resolve_num_posts(team, knob: str, size: int, auto,
+                      missing_default: int) -> int:
+    """Shared NUM_POSTS knob resolution (every reference get_num_posts
+    flavor agrees on the clamp shell): explicit 1..size-1 passes
+    through; 0 / 'inf' / oversize mean everything in flight; 'auto'
+    defers to the per-collective ``auto()`` rule;
+    ``missing_default`` applies when the config table lacks the knob."""
     cfg = team.comp_context.config
     from ...utils.config import SIZE_AUTO, UINT_MAX
     raw = None
@@ -56,15 +48,31 @@ def _pairwise_num_posts(team, knob: str, data_size: int, tsize: int,
         except KeyError:
             raw = None
     if raw is None:
-        return window_default
+        return missing_default
     if raw == SIZE_AUTO:
-        if data_size is None:        # alltoallv: team-size-only rule
-            return 1 if tsize > _NP_THRESH else max(1, tsize)
-        return 1 if (data_size > _MSG_MEDIUM and tsize > _NP_THRESH) \
-            else max(1, tsize)
-    if raw == UINT_MAX or raw == 0 or raw > tsize:
-        return max(1, tsize)
+        return max(1, min(int(auto()), max(1, size)))
+    if raw == UINT_MAX or raw == 0 or raw >= size:
+        return max(1, size)
     return int(raw)
+
+
+def _pairwise_num_posts(team, knob: str, data_size: int, tsize: int,
+                        window_default: int) -> int:
+    """ALLTOALL(V)_PAIRWISE_NUM_POSTS auto rules, matching the reference:
+
+    - alltoall (alltoall_pairwise.c:30-51): serialize (1) only for BIG
+      messages (>64KB) on BIG teams (>32); else all in flight;
+    - alltoallv (alltoallv_pairwise.c:30-46, ``data_size`` is None):
+      team-size-ONLY — v-counts are peer-dependent so no single message
+      size exists; >32 ranks always serialize to avoid flooding."""
+
+    def auto():
+        if data_size is None:        # alltoallv: team-size-only rule
+            return 1 if tsize > _NP_THRESH else tsize
+        return 1 if (data_size > _MSG_MEDIUM and tsize > _NP_THRESH) \
+            else tsize
+
+    return resolve_num_posts(team, knob, tsize, auto, window_default)
 
 
 class AlltoallPairwise(HostCollTask):
@@ -102,9 +110,12 @@ class AlltoallPairwise(HostCollTask):
                                      slot=80 + step))
             reqs.append(self.recv_nb(frm, dst[frm * blk:(frm + 1) * blk],
                                      slot=80 + step))
-            if len(reqs) >= 2 * self.window:
-                yield from self.wait(*reqs)
-                reqs = []
+            # SLIDING window (reference keeps nreqs continuously
+            # posted): drain completions only, never the whole batch
+            while len(reqs) >= 2 * self.window:
+                reqs = self._drain_window(reqs)
+                if len(reqs) >= 2 * self.window:
+                    yield
         if reqs:
             yield from self.wait(*reqs)
 
@@ -195,9 +206,10 @@ class AlltoallvPairwise(HostCollTask):
             reqs.append(self.send_nb(to, sblock(to), slot=88 + step))
             reqs.append(self.recv_nb(frm, binfo_v_block(dstv, frm),
                                      slot=88 + step))
-            if len(reqs) >= 2 * self.window:
-                yield from self.wait(*reqs)
-                reqs = []
+            while len(reqs) >= 2 * self.window:
+                reqs = self._drain_window(reqs)
+                if len(reqs) >= 2 * self.window:
+                    yield
         if reqs:
             yield from self.wait(*reqs)
 

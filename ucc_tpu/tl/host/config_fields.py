@@ -13,10 +13,15 @@ independently).
 """
 from __future__ import annotations
 
-from ...utils.config import (ConfigField, parse_memunits, parse_mrange_uint,
-                             parse_string, parse_uint_auto)
+from ...utils.config import (ConfigField, parse_bool, parse_memunits,
+                             parse_mrange_uint, parse_string,
+                             parse_uint_auto)
 
 HOST_ALG_FIELDS = [
+    ConfigField("RANKS_REORDERING", "y", "reorder ranks so ring "
+                "neighbors are host-local on multi-node teams "
+                "(FULL_HOST_ORDERED sbgp; reference RANKS_REORDERING)",
+                parse_bool),
     ConfigField("ALLREDUCE_KN_RADIX", "0-inf:4",
                 "allreduce knomial radix per msg range", parse_mrange_uint),
     ConfigField("ALLREDUCE_SRA_RADIX", "0-inf:auto", "SRA allreduce "
@@ -31,6 +36,10 @@ HOST_ALG_FIELDS = [
     ConfigField("REDUCE_SRG_RADIX", "0-inf:auto", "SRG reduce "
                 "scatter-reduce-gather radix per msg range (auto = 2)",
                 parse_mrange_uint),
+    ConfigField("REDUCE_SRG_PIPELINE", "n", "fragmentation pipeline "
+                "spec for SRG reduce (reference REDUCE_SRG_KN_PIPELINE); "
+                "same DSL as ALLREDUCE_SRA_PIPELINE; n = off",
+                parse_string),
     ConfigField("BCAST_KN_RADIX", "0-inf:4", "bcast tree radix",
                 parse_mrange_uint),
     ConfigField("REDUCE_KN_RADIX", "0-inf:4", "reduce tree radix",
@@ -50,6 +59,14 @@ HOST_ALG_FIELDS = [
                 "sends/recvs of the allgather linear_batched algorithm "
                 "(reference ALLGATHER_BATCHED_NUM_POSTS); auto = team "
                 "size - 1 (one-shot)", parse_uint_auto),
+    ConfigField("GATHERV_LINEAR_NUM_POSTS", "0", "root-side in-flight "
+                "recv bound for linear gather(v) (reference "
+                "GATHERV_LINEAR_NUM_POSTS); 0 = all at once",
+                parse_uint_auto),
+    ConfigField("SCATTERV_LINEAR_NUM_POSTS", "16", "root-side in-flight "
+                "send bound for linear scatter(v) (reference "
+                "SCATTERV_LINEAR_NUM_POSTS default 16); 0 = all",
+                parse_uint_auto),
     ConfigField("ALLTOALL_ONESIDED_ALG", "put", "one-sided alltoall "
                 "variant: put (counter completion) | get (barrier)",
                 parse_string),

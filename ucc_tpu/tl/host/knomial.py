@@ -344,8 +344,16 @@ class FanoutKnomial(HostCollTask):
 # linear rooted colls
 # ---------------------------------------------------------------------------
 
+def _linear_num_posts(team, knob: str, size: int) -> int:
+    """GATHERV/SCATTERV_LINEAR_NUM_POSTS (tl_ucp.c:202-221): bound on
+    the root's in-flight requests; 0/auto/oversize = all at once."""
+    from .alltoall import resolve_num_posts
+    return resolve_num_posts(team, knob, size, lambda: size, size)
+
+
 class GatherLinear(HostCollTask):
-    """Linear gather(v) (tl_ucp gatherv linear, gatherv.c)."""
+    """Linear gather(v) (tl_ucp gatherv linear, gatherv.c), root-side
+    in-flight recvs bounded by GATHERV_LINEAR_NUM_POSTS."""
 
     def run(self):
         args = self.args
@@ -356,6 +364,8 @@ class GatherLinear(HostCollTask):
             yield from self.wait(self.send_nb(root, src, slot=50))
             return
         # root; gather: src.count = per-rank, dst.count = total
+        nreqs = _linear_num_posts(self.tl_team,
+                                  "gatherv_linear_num_posts", size)
         reqs = []
         for peer in range(size):
             block = binfo_v_block(args.dst, peer) if is_v else \
@@ -365,6 +375,12 @@ class GatherLinear(HostCollTask):
                     block[:] = binfo_typed(args.src, count=block.size)
             else:
                 reqs.append(self.recv_nb(peer, block, slot=50))
+                # SLIDING window (tl_ucp num-posts semantics): keep
+                # nreqs in flight continuously; drain only completions
+                while len(reqs) >= nreqs:
+                    reqs = self._drain_window(reqs)
+                    if len(reqs) >= nreqs:
+                        yield
         yield from self.wait(*reqs)
 
 
@@ -380,6 +396,8 @@ class ScatterLinear(HostCollTask):
             yield from self.wait(self.recv_nb(root, dst, slot=51))
             return
         # scatter: src.count = total, dst.count = per-rank
+        nreqs = _linear_num_posts(self.tl_team,
+                                  "scatterv_linear_num_posts", size)
         reqs = []
         for peer in range(size):
             block = binfo_v_block(args.src, peer) if is_v else \
@@ -390,6 +408,10 @@ class ScatterLinear(HostCollTask):
                     binfo_typed(args.dst, count=block.size)[:] = block
             else:
                 reqs.append(self.send_nb(peer, block, slot=51))
+                while len(reqs) >= nreqs:
+                    reqs = self._drain_window(reqs)
+                    if len(reqs) >= nreqs:
+                        yield
         yield from self.wait(*reqs)
 
 

@@ -279,6 +279,60 @@ class ReduceSrgKnomial(_SraBase):
             yield from self.wait(self.send_nb(sink, work[lo:hi], slot=190))
 
 
+def _pipelined_init(init_args, team, knob: str, make_task, count: int,
+                    esz: int, frag_args):
+    """Shared fragmentation-pipeline wiring for the SRA/SRG inits: parse
+    the knob's pipeline DSL, gate on nfrags_pdepth, and build a
+    PipelinedSchedule whose window entries wrap ``make_task`` over
+    ``frag_args(frag_num, n_frags)`` slices; retargeting rebinds the task's
+    buffer views in place (the allreduce_sra_knomial.c frag_setup
+    role). Returns ``make_task(init_args)`` unfragmented when the knob
+    is off or the message is below threshold."""
+    from ...schedule.pipelined import (PipelinedSchedule, PipelineOrder,
+                                       parse_pipeline_params)
+    from ...schedule.schedule import Schedule
+    from ...status import Status as _S
+
+    cfg = team.comp_context.config
+    pp = None
+    if cfg is not None:
+        try:
+            pp = parse_pipeline_params(cfg.get(knob))
+        except KeyError:
+            pp = None
+    n_frags = pdepth = 1
+    if pp is not None:
+        n_frags, pdepth = pp.nfrags_pdepth(count * esz)
+    if n_frags <= 1 or count < n_frags:
+        return make_task(init_args)
+
+    ia_cls = type(init_args)
+
+    def frag_init(sched_p, idx):
+        frag = Schedule(team=team)
+        fa = frag_args(idx, n_frags)
+        n = int((fa.dst or fa.src).count)
+        fia = ia_cls(args=fa, team=init_args.team,
+                     mem_type=init_args.mem_type, msgsize=n * esz)
+        t = make_task(fia)
+        frag.add_task(t)
+        frag.add_dep_on_schedule_start(t)
+        return frag
+
+    def frag_setup(sched_p, frag, frag_num):
+        fa = frag_args(frag_num, n_frags)
+        for t in frag.tasks:
+            t.args.src = fa.src
+            t.args.dst = fa.dst
+            t.count = int((fa.dst or fa.src).count)
+        return _S.OK
+
+    return PipelinedSchedule(
+        team=team, args=init_args.args, frag_init=frag_init,
+        frag_setup=frag_setup, n_frags=pdepth, n_frags_total=n_frags,
+        order=pp.order if pp else PipelineOrder.SEQUENTIAL)
+
+
 def sra_pipelined_init(init_args, team, radix=None):
     """SRA allreduce with optional fragmentation pipelining — the
     ALLREDUCE_SRA_KN_PIPELINE role (allreduce_sra_knomial.c:58-171 +
@@ -289,33 +343,17 @@ def sra_pipelined_init(init_args, team, radix=None):
     (thresh=64K:fragsize=1M:nfrags=4:pdepth=2:ordered); default off."""
     from ...api.types import BufferInfo, CollArgs
     from ...constants import CollArgsFlags, CollType
-    from ...schedule.pipelined import (PipelinedSchedule, PipelineOrder,
-                                       parse_pipeline_params)
-    from ...schedule.schedule import Schedule
+    from ...utils.mathutils import block_count, block_offset
     from ..base import binfo_typed
 
     args = init_args.args
-    cfg = team.comp_context.config
-    pp = None
-    if cfg is not None:
-        try:
-            pp = parse_pipeline_params(cfg.get("allreduce_sra_pipeline"))
-        except KeyError:
-            pp = None
     count = int(args.dst.count)
-    esz = dt_numpy(args.dst.datatype).itemsize
-    n_frags = pdepth = 1
-    if pp is not None:
-        n_frags, pdepth = pp.nfrags_pdepth(count * esz)
-    if n_frags <= 1 or count < n_frags:
-        return AllreduceSraKnomial(init_args, team, radix=radix)
-
-    from ...utils.mathutils import block_count, block_offset
     dt = args.dst.datatype
+    esz = dt_numpy(dt).itemsize
     full_dst = binfo_typed(args.dst, count)
     full_src = full_dst if args.is_inplace else binfo_typed(args.src, count)
 
-    def frag_args(frag_num):
+    def frag_args(frag_num, n_frags):
         off = block_offset(count, n_frags, frag_num)
         cnt = block_count(count, n_frags, frag_num)
         return CollArgs(
@@ -326,29 +364,45 @@ def sra_pipelined_init(init_args, team, radix=None):
             flags=args.flags & ~(CollArgsFlags.PERSISTENT
                                  | CollArgsFlags.IN_PLACE))
 
-    ia_cls = type(init_args)
+    return _pipelined_init(
+        init_args, team, "allreduce_sra_pipeline",
+        lambda ia: AllreduceSraKnomial(ia, team, radix=radix),
+        count, esz, frag_args)
 
-    def frag_init(sched_p, idx):
-        frag = Schedule(team=team)
-        fa = frag_args(idx)
-        fia = ia_cls(args=fa, team=init_args.team,
-                     mem_type=init_args.mem_type,
-                     msgsize=int(fa.dst.count) * esz)
-        t = AllreduceSraKnomial(fia, team, radix=radix)
-        frag.add_task(t)
-        frag.add_dep_on_schedule_start(t)
-        return frag
 
-    def frag_setup(sched_p, frag, frag_num):
-        fa = frag_args(frag_num)
-        for t in frag.tasks:
-            t.args.src = fa.src
-            t.args.dst = fa.dst
-            t.count = int(fa.dst.count)
-        from ...status import Status as _S
-        return _S.OK
+def srg_pipelined_init(init_args, team, radix=None):
+    """SRG reduce with optional fragmentation pipelining — the
+    REDUCE_SRG_KN_PIPELINE role (reduce_srg_knomial.c pipeline wiring,
+    same engine as SRA). Knob ``REDUCE_SRG_PIPELINE``; default off."""
+    from ...api.types import BufferInfo, CollArgs
+    from ...constants import CollArgsFlags, CollType
+    from ...utils.mathutils import block_count, block_offset
+    from ..base import binfo_typed
 
-    return PipelinedSchedule(
-        team=team, args=args, frag_init=frag_init, frag_setup=frag_setup,
-        n_frags=pdepth, n_frags_total=n_frags,
-        order=pp.order if pp else PipelineOrder.SEQUENTIAL)
+    args = init_args.args
+    src_bi = args.dst if args.is_inplace or args.src is None else args.src
+    count = int(src_bi.count)
+    dt = src_bi.datatype
+    esz = dt_numpy(dt).itemsize
+    is_root = team.rank == int(args.root)
+    full_src = binfo_typed(src_bi, count)
+    full_dst = binfo_typed(args.dst, count) \
+        if is_root and args.dst is not None and args.dst.buffer is not None \
+        else None
+
+    def frag_args(frag_num, n_frags):
+        off = block_offset(count, n_frags, frag_num)
+        cnt = block_count(count, n_frags, frag_num)
+        return CollArgs(
+            coll_type=CollType.REDUCE, root=args.root,
+            src=BufferInfo(full_src[off:off + cnt], cnt, dt),
+            dst=BufferInfo(full_dst[off:off + cnt], cnt, dt)
+            if full_dst is not None else None,
+            op=args.op,
+            flags=args.flags & ~(CollArgsFlags.PERSISTENT
+                                 | CollArgsFlags.IN_PLACE))
+
+    return _pipelined_init(
+        init_args, team, "reduce_srg_pipeline",
+        lambda ia: ReduceSrgKnomial(ia, team, radix=radix),
+        count, esz, frag_args)

@@ -97,6 +97,19 @@ class HostCollTask(CollTask):
         return self.tl_team.recv_nb(self.subset, peer_grank, self.tag, slot,
                                     dst)
 
+    def _drain_window(self, reqs):
+        """Sliding-window helper for NUM_POSTS-bounded algorithms:
+        filter completed requests, failing the collective on a
+        delivered-with-error recv exactly like wait()."""
+        live = []
+        for r in reqs:
+            if not r.test():
+                live.append(r)
+            elif getattr(r, "error", None):
+                raise UccError(Status.ERR_NO_MESSAGE,
+                               f"window request failed: {r.error}")
+        return live
+
     def wait(self, *reqs):
         """Yield until all requests complete; fail on delivery errors."""
         pending: List = [r for r in reqs if not r.test()]

@@ -36,7 +36,7 @@ from .ring import (AllgatherRing, AllgathervRing, AllreduceRing,
                    ReduceScatterRing, ReduceScatterRingBidirectional,
                    ReduceScattervRing)
 from .sra import (AllreduceSraKnomial, ReduceSrgKnomial,
-                  sra_pipelined_init)
+                  sra_pipelined_init, srg_pipelined_init)
 from .task import HostCollTask
 from .transport import Mailbox, TagKey
 
@@ -73,6 +73,13 @@ class HostTlTeam(TlTeamBase):
         return self._topo_subset
 
     def _compute_topo_subset(self):
+        cfg = self.comp_context.config
+        if cfg is not None:
+            try:
+                if not cfg.get("ranks_reordering"):
+                    return None       # knob off: natural rank order
+            except KeyError:
+                pass
         core = self.core_team
         topo = getattr(core, "topo", None)
         if topo is None:
@@ -260,7 +267,7 @@ class HostTlTeam(TlTeamBase):
                      sel=f"0-8k:{S + 5},8k-inf:{S - 3}"),
                 spec(1, "dbt", ReduceDbt,
                      sel=f"0-8k:{S - 3},8k-inf:{S + 5}"),
-                spec(2, "srg_knomial", ReduceSrgKnomial,
+                spec(2, "srg_knomial", srg_pipelined_init,
                      sel=f"0-8k:{S - 4},8k-inf:{S + 4}"),
             ],
             CollType.REDUCE_SCATTER: [
