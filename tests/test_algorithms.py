@@ -730,3 +730,38 @@ class TestLinearNumPosts:
                 if r == root else None,
                 dst=BufferInfo(dsts[r], counts[r], DataType.FLOAT32)),
                 check, monkeypatch)
+
+
+class TestHybridKnobs:
+    """ALLTOALLV_HYBRID_CHUNK_BYTE_LIMIT / _PAIRWISE_NUM_POSTS: routing
+    split and phase-1 window are knob-driven; correctness at both
+    extremes (everything direct / everything forwarded)."""
+
+    @pytest.mark.parametrize("limit", ["1", "12k", "1m"])
+    def test_routing_split_extremes(self, limit, monkeypatch):
+        from ucc_tpu import BufferInfoV
+        monkeypatch.setenv("UCC_TL_SHM_ALLTOALLV_HYBRID_CHUNK_BYTE_LIMIT",
+                           limit)
+        monkeypatch.setenv(
+            "UCC_TL_SHM_ALLTOALLV_HYBRID_PAIRWISE_NUM_POSTS", "1")
+        n = 5
+        m = [[(r * 3 + p) % 5 + 1 for p in range(n)] for r in range(n)]
+        recv_counts = [[m[q][p] for q in range(n)] for p in range(n)]
+        srcs, dsts = [], []
+        for r in range(n):
+            srcs.append(np.arange(sum(m[r]), dtype=np.int64) + 1000 * r)
+            dsts.append(np.full(sum(recv_counts[r]), -1, np.int64))
+
+        def check():
+            for p in range(n):
+                sdispl = {q: np.cumsum([0] + m[q][:-1]) for q in range(n)}
+                expect = np.concatenate([
+                    srcs[q][sdispl[q][p]:sdispl[q][p] + m[q][p]]
+                    for q in range(n)])
+                np.testing.assert_array_equal(dsts[p], expect)
+
+        run_with_tune("alltoallv:@hybrid:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLTOALLV,
+            src=BufferInfoV(srcs[r], m[r], None, DataType.INT64),
+            dst=BufferInfoV(dsts[r], recv_counts[r], None,
+                            DataType.INT64)), check, monkeypatch)

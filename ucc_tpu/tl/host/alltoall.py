@@ -20,7 +20,7 @@ from typing import List, Optional
 import numpy as np
 
 from ...api.types import BufferInfoV
-from ...constants import dt_numpy
+from ...constants import dt_numpy, dt_size
 from ..base import binfo_typed, binfo_v_block
 from .task import HostCollTask
 
@@ -112,10 +112,7 @@ class AlltoallPairwise(HostCollTask):
                                      slot=80 + step))
             # SLIDING window (reference keeps nreqs continuously
             # posted): drain completions only, never the whole batch
-            while len(reqs) >= 2 * self.window:
-                reqs = self._drain_window(reqs)
-                if len(reqs) >= 2 * self.window:
-                    yield
+            reqs = yield from self._throttle(reqs, 2 * self.window)
         if reqs:
             yield from self.wait(*reqs)
 
@@ -206,10 +203,7 @@ class AlltoallvPairwise(HostCollTask):
             reqs.append(self.send_nb(to, sblock(to), slot=88 + step))
             reqs.append(self.recv_nb(frm, binfo_v_block(dstv, frm),
                                      slot=88 + step))
-            while len(reqs) >= 2 * self.window:
-                reqs = self._drain_window(reqs)
-                if len(reqs) >= 2 * self.window:
-                    yield
+            reqs = yield from self._throttle(reqs, 2 * self.window)
         if reqs:
             yield from self.wait(*reqs)
 
@@ -229,8 +223,7 @@ class AlltoallvHybrid(HostCollTask):
     finished payloads in dst and keep forwarding the rest.
     """
 
-    #: per-pair element-count threshold below which messages are
-    #: aggregated through the Bruck phase
+    #: fallback per-pair element threshold when the byte knob is absent
     SMALL_THRESH = 256
 
     def __init__(self, init_args, team, subset=None,
@@ -241,7 +234,29 @@ class AlltoallvHybrid(HostCollTask):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            "hybrid alltoallv: in-place not supported "
                            "(pairwise serves it)")
-        self.thresh = thresh if thresh is not None else self.SMALL_THRESH
+        if thresh is not None:
+            self.thresh = thresh
+        else:
+            # reference ALLTOALLV_HYBRID_CHUNK_BYTE_LIMIT (tl_ucp.c:100,
+            # default 12k): per-pair BYTE bound under which messages
+            # aggregate through the forwarding phase
+            from ...utils.config import SIZE_AUTO, SIZE_INF, UINT_MAX
+            cfg = team.comp_context.config
+            esz = dt_size(init_args.args.dst.datatype)
+            try:
+                limit = int(cfg.get("alltoallv_hybrid_chunk_byte_limit")) \
+                    if cfg is not None else None
+            except KeyError:
+                limit = None
+            if limit in (SIZE_AUTO, SIZE_INF, UINT_MAX):
+                limit = 12 << 10      # sentinel -> reference default 12k
+            self.thresh = max(1, limit // esz) if limit is not None \
+                else self.SMALL_THRESH
+        # phase-1 in-flight bound (reference
+        # ALLTOALLV_HYBRID_PAIRWISE_NUM_POSTS, tl_ucp.c:89, default 3)
+        self.p1_window = resolve_num_posts(
+            team, "alltoallv_hybrid_pairwise_num_posts", self.gsize,
+            lambda: 3, 3)
 
     def run(self):
         args = self.args
@@ -259,17 +274,24 @@ class AlltoallvHybrid(HostCollTask):
         # phase 1: direct pairwise for LARGE pairs (both ends derive the
         # routing from their own counts — sender checks scount, receiver
         # rcount; the threshold rule makes them agree)
-        reqs: List = []
+        # per-DIRECTION bounds like the reference (send_posted and
+        # recv_posted each capped at num_posts): hybrid's posts are
+        # conditional per pair, so a shared list would let a one-sided
+        # traffic pattern run 2x the configured window
+        s_reqs: List = []
+        r_reqs: List = []
         for step in range(1, size):
             to = (me + step) % size
             frm = (me - step) % size
             if scounts[to] > self.thresh:
-                reqs.append(self.send_nb(to, binfo_v_block(srcv, to),
-                                         slot=240))
+                s_reqs.append(self.send_nb(to, binfo_v_block(srcv, to),
+                                           slot=240))
             if rcounts[frm] > self.thresh:
-                reqs.append(self.recv_nb(frm, binfo_v_block(dstv, frm),
-                                         slot=240))
-        yield from self.wait(*reqs)
+                r_reqs.append(self.recv_nb(frm, binfo_v_block(dstv, frm),
+                                           slot=240))
+            s_reqs = yield from self._throttle(s_reqs, self.p1_window)
+            r_reqs = yield from self._throttle(r_reqs, self.p1_window)
+        yield from self.wait(*(s_reqs + r_reqs))
 
         # phase 2: Bruck forwarding of SMALL pairs
         pending: List = []          # (origin, dest, np payload)

@@ -59,6 +59,13 @@ HOST_ALG_FIELDS = [
                 "sends/recvs of the allgather linear_batched algorithm "
                 "(reference ALLGATHER_BATCHED_NUM_POSTS); auto = team "
                 "size - 1 (one-shot)", parse_uint_auto),
+    ConfigField("ALLTOALLV_HYBRID_CHUNK_BYTE_LIMIT", "12k", "per-pair "
+                "byte bound under which hybrid alltoallv aggregates "
+                "messages through the forwarding phase (reference "
+                "ALLTOALLV_HYBRID_CHUNK_BYTE_LIMIT)", parse_memunits),
+    ConfigField("ALLTOALLV_HYBRID_PAIRWISE_NUM_POSTS", "3", "in-flight "
+                "bound of hybrid alltoallv's direct (large-pair) phase "
+                "(reference default 3)", parse_uint_auto),
     ConfigField("GATHERV_LINEAR_NUM_POSTS", "0", "root-side in-flight "
                 "recv bound for linear gather(v) (reference "
                 "GATHERV_LINEAR_NUM_POSTS); 0 = all at once",

@@ -377,10 +377,7 @@ class GatherLinear(HostCollTask):
                 reqs.append(self.recv_nb(peer, block, slot=50))
                 # SLIDING window (tl_ucp num-posts semantics): keep
                 # nreqs in flight continuously; drain only completions
-                while len(reqs) >= nreqs:
-                    reqs = self._drain_window(reqs)
-                    if len(reqs) >= nreqs:
-                        yield
+                reqs = yield from self._throttle(reqs, nreqs)
         yield from self.wait(*reqs)
 
 
@@ -408,10 +405,7 @@ class ScatterLinear(HostCollTask):
                     binfo_typed(args.dst, count=block.size)[:] = block
             else:
                 reqs.append(self.send_nb(peer, block, slot=51))
-                while len(reqs) >= nreqs:
-                    reqs = self._drain_window(reqs)
-                    if len(reqs) >= nreqs:
-                        yield
+                reqs = yield from self._throttle(reqs, nreqs)
         yield from self.wait(*reqs)
 
 

@@ -110,6 +110,16 @@ class HostCollTask(CollTask):
                                f"window request failed: {r.error}")
         return live
 
+    def _throttle(self, reqs, max_live):
+        """Keep at most ``max_live`` requests outstanding: drain
+        completions (error-checked) and cooperatively yield while the
+        window is still full. Returns the surviving list."""
+        while len(reqs) >= max_live:
+            reqs = self._drain_window(reqs)
+            if len(reqs) >= max_live:
+                yield
+        return reqs
+
     def wait(self, *reqs):
         """Yield until all requests complete; fail on delivery errors."""
         pending: List = [r for r in reqs if not r.test()]
