@@ -72,3 +72,94 @@ def test_world_bootstrap_two_processes(tmp_path):
     for r in range(nprocs):
         out = (tmp_path / f"r{r}.txt").read_text()
         assert out == "ok", out
+
+
+class TestStoreHandshake:
+    """Round-5 bootstrap hardening: the store handshake must reject
+    foreign listeners and strangers (a fixed store port can collide
+    with ephemeral TL listener ports — observed in the wild as a TL
+    frame desync)."""
+
+    def test_client_rejects_foreign_listener(self):
+        """A listener that is NOT a ucc store (sends no cookie): the
+        client must NOT enroll; it retries until deadline and raises."""
+        import socket as pysock
+        import threading
+        from ucc_tpu.core.oob import TcpStoreOob
+
+        lsock = pysock.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(4)
+        port = lsock.getsockname()[1]
+        accepted = []
+
+        def silent_accept():
+            try:
+                while True:
+                    c, _ = lsock.accept()
+                    accepted.append(c)   # never send anything
+            except OSError:
+                return
+
+        t = threading.Thread(target=silent_accept, daemon=True)
+        t.start()
+        import time as _t
+        t0 = _t.monotonic()
+        with pytest.raises(OSError):
+            # rank 1 (no server side); 5s magic-read timeout per try
+            TcpStoreOob(1, 2, port=port, timeout_s=6)
+        assert _t.monotonic() - t0 >= 4, "gave up before the magic wait"
+        lsock.close()
+
+    def test_wrong_job_cookie_rejected(self):
+        """A REAL store of a different job (different key): clients of
+        this job must refuse to enroll."""
+        from ucc_tpu.core.oob import TcpStoreOob, _StoreServer, _store_cookie
+        import socket as pysock
+
+        srv = _StoreServer(2, ("127.0.0.1", 0), _store_cookie("jobA", 2))
+        port = srv.lsock.getsockname()[1]
+        with pytest.raises(OSError):
+            TcpStoreOob(1, 2, port=port, key="jobB", timeout_s=4)
+        srv.close()
+
+    def test_stranger_cannot_eat_slot(self):
+        """A stranger that connects and hangs must not consume one of
+        the size slots: real clients still bootstrap."""
+        import socket as pysock
+        import threading
+        from ucc_tpu.core.oob import TcpStoreOob
+
+        ends = [None, None]
+        errs = []
+
+        def mk(r, port):
+            try:
+                ends[r] = TcpStoreOob(r, 2, port=port)
+            except Exception as e:  # noqa: BLE001
+                errs.append((r, e))
+
+        # rank 0 binds an ephemeral port via a probe socket
+        probe = pysock.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        t0 = threading.Thread(target=mk, args=(0, port))
+        t0.start()
+        import time as _t
+        _t.sleep(0.3)
+        # stranger connects and sends garbage, then hangs
+        stranger = pysock.create_connection(("127.0.0.1", port), timeout=5)
+        stranger.sendall(b"\x00garbage")
+        t1 = threading.Thread(target=mk, args=(1, port))
+        t1.start()
+        t0.join(40)
+        t1.join(40)
+        assert not errs, errs
+        assert ends[0] is not None and ends[1] is not None
+        r0 = ends[0].allgather(b"a")
+        r1 = ends[1].allgather(b"b")
+        assert r0.result == [b"a", b"b"] == r1.result
+        stranger.close()
+        ends[0].close()
+        ends[1].close()

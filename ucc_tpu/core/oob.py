@@ -179,7 +179,8 @@ class TcpStoreOob(OobColl):
     OobRequest contract."""
 
     def __init__(self, rank: int, size: int, host: str = "127.0.0.1",
-                 port: int = 29999, key: str = ""):
+                 port: int = 29999, key: str = "",
+                 timeout_s: float = 30.0):
         self.rank = rank
         self.size = size
         self.addr = (host, port)
@@ -188,7 +189,7 @@ class TcpStoreOob(OobColl):
         self._sock: Optional[socket.socket] = None
         if rank == 0:
             self._server = _StoreServer(size, (host, port), cookie)
-        deadline = time.monotonic() + 30
+        deadline = time.monotonic() + timeout_s
         while True:
             try:
                 self._sock = socket.create_connection(self.addr, timeout=5)
