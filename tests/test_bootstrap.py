@@ -115,7 +115,6 @@ class TestStoreHandshake:
         """A REAL store of a different job (different key): clients of
         this job must refuse to enroll."""
         from ucc_tpu.core.oob import TcpStoreOob, _StoreServer, _store_cookie
-        import socket as pysock
 
         srv = _StoreServer(2, ("127.0.0.1", 0), _store_cookie("jobA", 2))
         port = srv.lsock.getsockname()[1]
@@ -125,41 +124,57 @@ class TestStoreHandshake:
 
     def test_stranger_cannot_eat_slot(self):
         """A stranger that connects and hangs must not consume one of
-        the size slots: real clients still bootstrap."""
+        the size slots: real clients still bootstrap. Port selection is
+        probe-then-close (TOCTOU), so the whole setup retries on a
+        collision instead of flaking."""
         import socket as pysock
         import threading
+        import time as _t
         from ucc_tpu.core.oob import TcpStoreOob
 
-        ends = [None, None]
-        errs = []
+        last_errs = None
+        for _attempt in range(3):
+            ends = [None, None]
+            errs = []
 
-        def mk(r, port):
+            def mk(r, port):
+                try:
+                    ends[r] = TcpStoreOob(r, 2, port=port)
+                except Exception as e:  # noqa: BLE001
+                    errs.append((r, e))
+
+            probe = pysock.socket()
+            probe.bind(("127.0.0.1", 0))
+            port = probe.getsockname()[1]
+            probe.close()
+            t0 = threading.Thread(target=mk, args=(0, port))
+            t0.start()
+            _t.sleep(0.3)
             try:
-                ends[r] = TcpStoreOob(r, 2, port=port)
-            except Exception as e:  # noqa: BLE001
-                errs.append((r, e))
-
-        # rank 0 binds an ephemeral port via a probe socket
-        probe = pysock.socket()
-        probe.bind(("127.0.0.1", 0))
-        port = probe.getsockname()[1]
-        probe.close()
-        t0 = threading.Thread(target=mk, args=(0, port))
-        t0.start()
-        import time as _t
-        _t.sleep(0.3)
-        # stranger connects and sends garbage, then hangs
-        stranger = pysock.create_connection(("127.0.0.1", port), timeout=5)
-        stranger.sendall(b"\x00garbage")
-        t1 = threading.Thread(target=mk, args=(1, port))
-        t1.start()
-        t0.join(40)
-        t1.join(40)
-        assert not errs, errs
-        assert ends[0] is not None and ends[1] is not None
-        r0 = ends[0].allgather(b"a")
-        r1 = ends[1].allgather(b"b")
-        assert r0.result == [b"a", b"b"] == r1.result
-        stranger.close()
-        ends[0].close()
-        ends[1].close()
+                stranger = pysock.create_connection(("127.0.0.1", port),
+                                                    timeout=5)
+                stranger.sendall(b"\x00garbage")
+            except OSError:
+                stranger = None
+            t1 = threading.Thread(target=mk, args=(1, port))
+            t1.start()
+            t0.join(40)
+            t1.join(40)
+            if errs:
+                last_errs = errs         # port collision: retry fresh
+                for e in ends:
+                    if e is not None:
+                        e.close()
+                if stranger is not None:
+                    stranger.close()
+                continue
+            assert ends[0] is not None and ends[1] is not None
+            r0 = ends[0].allgather(b"a")
+            r1 = ends[1].allgather(b"b")
+            assert r0.result == [b"a", b"b"] == r1.result
+            if stranger is not None:
+                stranger.close()
+            ends[0].close()
+            ends[1].close()
+            return
+        pytest.fail(f"bootstrap failed on all attempts: {last_errs}")

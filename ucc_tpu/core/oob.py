@@ -13,7 +13,7 @@ ordered-allgather contract over DCN.
 from __future__ import annotations
 
 import pickle
-import select
+import selectors
 import socket
 import struct
 import threading
@@ -191,8 +191,13 @@ class TcpStoreOob(OobColl):
             self._server = _StoreServer(size, (host, port), cookie)
         deadline = time.monotonic() + timeout_s
         while True:
+            # per-attempt socket timeout capped to the REMAINING deadline
+            # so a silent listener cannot stretch a small timeout_s to
+            # 2x the 5s default per retry round
+            att = max(0.2, min(5.0, deadline - time.monotonic()))
             try:
-                self._sock = socket.create_connection(self.addr, timeout=5)
+                self._sock = socket.create_connection(self.addr,
+                                                      timeout=att)
                 self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 # two-way handshake: the server identifies itself (cookie
                 # covers job key + size, so another job's store on a
@@ -213,6 +218,11 @@ class TcpStoreOob(OobColl):
                     pass
                 self._sock = None
                 if time.monotonic() > deadline:
+                    # failing construction must not leak the server this
+                    # rank already started (bound port + daemon thread)
+                    if self._server is not None:
+                        self._server.close()
+                        self._server = None
                     raise
                 time.sleep(0.05)
 
@@ -256,8 +266,7 @@ class _TcpOobRequest(OobRequest):
         if self._result is not None:
             return Status.OK
         while True:
-            ready, _, _ = select.select([self.sock], [], [], 0)
-            if not ready:
+            if not _readable(self.sock, 0):
                 return Status.IN_PROGRESS
             # never read past THIS request's blob: surplus bytes would
             # belong to the next allgather's response on the shared
@@ -278,9 +287,21 @@ class _TcpOobRequest(OobRequest):
     @property
     def result(self) -> List[bytes]:
         while self.test() == Status.IN_PROGRESS:
-            select.select([self.sock], [], [], 0.05)
+            _readable(self.sock, 0.05)
         assert self._result is not None
         return self._result
+
+
+def _readable(sock: socket.socket, timeout: float) -> bool:
+    """Poll one socket for readability. selectors (epoll/kqueue), NOT
+    select.select: late in a long process fd numbers exceed the
+    select() FD_SETSIZE cap of 1024 and select raises ValueError."""
+    sel = selectors.DefaultSelector()
+    try:
+        sel.register(sock, selectors.EVENT_READ)
+        return bool(sel.select(timeout))
+    finally:
+        sel.close()
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
