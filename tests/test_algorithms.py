@@ -765,3 +765,65 @@ class TestHybridKnobs:
             src=BufferInfoV(srcs[r], m[r], None, DataType.INT64),
             dst=BufferInfoV(dsts[r], recv_counts[r], None,
                             DataType.INT64)), check, monkeypatch)
+
+
+class TestGlobalKnRadix:
+    """KN_RADIX (tl_ucp_lib.c:30-37): a positive value supersedes the
+    barrier/rs/bcast/reduce/scatter/gather KN radixes; allreduce keeps
+    its own knob (the reference does NOT copy into it); 0 and the
+    auto/inf sentinels defer."""
+
+    @staticmethod
+    def _host_team(job):
+        t = job.create_team()[0]
+        return [tl for cl in t.cl_teams
+                for tl in getattr(cl, "tl_teams", [])
+                if tl.NAME == "shm"][0]
+
+    def test_override_scope(self, monkeypatch):
+        from harness import UccJob
+        monkeypatch.setenv("UCC_TL_SHM_KN_RADIX", "3")
+        monkeypatch.setenv("UCC_TL_SHM_ALLREDUCE_KN_RADIX", "0-inf:8")
+        monkeypatch.setenv("UCC_TL_SHM_BCAST_KN_RADIX", "0-inf:8")
+        job = UccJob(2)
+        try:
+            host = self._host_team(job)
+            # copied-into set IS overridden
+            assert host.cfg_radix("bcast_kn_radix", 1024) == 3
+            assert host.cfg_radix("barrier_kn_radix", 1024) == 3
+            # allreduce is NOT (tl_ucp_lib.c copies selectively)
+            assert host.cfg_radix("allreduce_kn_radix", 1024) == 8
+            # non-kn knobs are NOT
+            assert host.cfg_radix("allreduce_sra_radix", 1024,
+                                  default=2) == 2
+        finally:
+            job.cleanup()
+
+    @pytest.mark.parametrize("val", ["0", "auto", "inf"])
+    def test_non_positive_and_sentinels_defer(self, val, monkeypatch):
+        from harness import UccJob
+        monkeypatch.setenv("UCC_TL_SHM_KN_RADIX", val)
+        monkeypatch.setenv("UCC_TL_SHM_BCAST_KN_RADIX", "0-inf:8")
+        job = UccJob(2)
+        try:
+            host = self._host_team(job)
+            assert host.cfg_radix("bcast_kn_radix", 1024) == 8
+        finally:
+            job.cleanup()
+
+    def test_collectives_run_under_override(self, monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_KN_RADIX", "3")
+        n, count = 5, 257
+        srcs = [np.full(count, r + 1.0, np.float32) for r in range(n)]
+        dsts = [np.zeros(count, np.float32) for _ in range(n)]
+
+        def check():
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r],
+                                           np.full(count, 15.0), rtol=1e-5)
+
+        run_with_tune("allreduce:@knomial:inf", n, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+            op=ReductionOp.SUM), check, monkeypatch)

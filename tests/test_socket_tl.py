@@ -75,17 +75,25 @@ def _worker(rank: int, size: int, port: int, q):
 
 
 def _free_port_pair():
+    """Probe an adjacent port pair holding BOTH sockets simultaneously:
+    the kernel's ephemeral allocator is roughly sequential, so a
+    probe-release-then-bind(+1) dance hands +1 to the next listener any
+    process opens (the collision class the round-5 gate caught)."""
     import socket as _s
     while True:
-        with _s.socket() as a:
-            a.bind(("127.0.0.1", 0))
-            port = a.getsockname()[1]
+        a = _s.socket()
+        a.bind(("127.0.0.1", 0))
+        port = a.getsockname()[1]
+        b = _s.socket()
         try:
-            with _s.socket() as b:
-                b.bind(("127.0.0.1", port + 1))
-            return port
+            b.bind(("127.0.0.1", port + 1))
         except OSError:
+            a.close()
+            b.close()
             continue
+        a.close()
+        b.close()
+        return port
 
 
 def test_socket_tl_three_processes():

@@ -32,7 +32,15 @@ import pytest
 HERE = os.path.abspath(__file__)
 
 
-def _worker_main(proc_id: int, base_port: int, mode: str = "flat") -> None:
+def _worker_main(proc_id: int, base_port: int, mode: str = "flat",
+                 oob_ports=None) -> None:
+    # three rendezvous ports: jax coordinator + the two TcpStoreOob
+    # stores. Passed explicitly (probed SIMULTANEOUSLY by the parent):
+    # deriving them as base+1/base+2 collided with the kernel's roughly
+    # sequential ephemeral allocator — the next listeners any worker
+    # opened landed exactly on base+1/base+2.
+    p_ctx, p_team = (base_port + 1, base_port + 2) if oob_ports is None \
+        else oob_ports
     sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))  # repo root
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
@@ -73,7 +81,7 @@ def _worker_main(proc_id: int, base_port: int, mode: str = "flat") -> None:
 
     def mk(r):
         ctxs[r] = ucc_tpu.Context(libs[r], ContextParams(
-            oob=TcpStoreOob(r, n, port=base_port + 1)))
+            oob=TcpStoreOob(r, n, port=p_ctx)))
 
     ths = [threading.Thread(target=mk, args=(r,)) for r in my_ranks]
     for t in ths:
@@ -87,7 +95,7 @@ def _worker_main(proc_id: int, base_port: int, mode: str = "flat") -> None:
 
     def mkteam(r):
         teams[r] = ctxs[r].create_team_post(TeamParams(
-            oob=TcpStoreOob(r, n, port=base_port + 2)))
+            oob=TcpStoreOob(r, n, port=p_team)))
 
     ths = [threading.Thread(target=mkteam, args=(r,)) for r in my_ranks]
     for t in ths:
@@ -340,15 +348,25 @@ def _run_workers(mode: str, ok_marker: str, timeout: float = 900,
     import socket
     last_fail = ""
     for attempt in range(attempts):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        base_port = s.getsockname()[1]
-        s.close()
+        # hold THREE ephemeral listeners at once, then release: the
+        # kernel's allocator moves past all three, so workers' own
+        # ephemeral listeners cannot land on the rendezvous ports
+        socks = []
+        ports = []
+        for _ in range(3):
+            ps = socket.socket()
+            ps.bind(("127.0.0.1", 0))
+            ports.append(ps.getsockname()[1])
+            socks.append(ps)
+        for ps in socks:
+            ps.close()
+        base_port, p_ctx, p_team = ports
         env = dict(os.environ)
         env.pop("UCC_TLS", None)
         env.pop("UCC_TOPO_FAKE_PPN", None)
         procs = [subprocess.Popen(
-            [sys.executable, HERE, str(i), str(base_port), mode],
+            [sys.executable, HERE, str(i), str(base_port), mode,
+             str(p_ctx), str(p_team)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env) for i in range(2)]
         outs = []
@@ -388,4 +406,6 @@ def test_two_process_ring_dma_and_fused_attention():
 
 if __name__ == "__main__":
     _worker_main(int(sys.argv[1]), int(sys.argv[2]),
-                 sys.argv[3] if len(sys.argv) > 3 else "flat")
+                 sys.argv[3] if len(sys.argv) > 3 else "flat",
+                 (int(sys.argv[4]), int(sys.argv[5]))
+                 if len(sys.argv) > 5 else None)

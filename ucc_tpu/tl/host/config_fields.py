@@ -14,7 +14,7 @@ independently).
 from __future__ import annotations
 
 from ...utils.config import (ConfigField, parse_bool, parse_memunits,
-                             parse_mrange_uint, parse_string,
+                             parse_mrange_uint, parse_string, parse_uint,
                              parse_uint_auto)
 
 HOST_ALG_FIELDS = [
@@ -22,6 +22,11 @@ HOST_ALG_FIELDS = [
                 "neighbors are host-local on multi-node teams "
                 "(FULL_HOST_ORDERED sbgp; reference RANKS_REORDERING)",
                 parse_bool),
+    ConfigField("KN_RADIX", "0", "convenience override: a positive "
+                "value supersedes the barrier/reduce_scatter/bcast/"
+                "reduce/scatter/gather KN radixes (reference KN_RADIX, "
+                "tl_ucp_lib.c:30-37; allreduce keeps its own knob)",
+                parse_uint),
     ConfigField("ALLREDUCE_KN_RADIX", "0-inf:4",
                 "allreduce knomial radix per msg range", parse_mrange_uint),
     ConfigField("ALLREDUCE_SRA_RADIX", "0-inf:auto", "SRA allreduce "

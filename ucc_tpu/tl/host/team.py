@@ -41,6 +41,13 @@ from .task import HostCollTask
 from .transport import Mailbox, TagKey
 
 
+#: knobs the global KN_RADIX convenience override applies to
+#: (tl_ucp_lib.c:30-37)
+_KN_RADIX_GLOBAL = frozenset((
+    "barrier_kn_radix", "reduce_scatter_kn_radix", "bcast_kn_radix",
+    "reduce_kn_radix", "scatter_kn_radix", "gather_kn_radix"))
+
+
 class HostTlTeam(TlTeamBase):
     """Requires: comp_context exposing .transport (endpoint), .peer_mailbox
     or send path by ctx rank, and .executor."""
@@ -104,6 +111,19 @@ class HostTlTeam(TlTeamBase):
         cfg = self.comp_context.config
         if cfg is None:
             return default
+        # the global KN_RADIX convenience knob supersedes exactly the
+        # per-collective radixes the reference copies it into
+        # (tl_ucp_lib.c:30-37: barrier/reduce_scatter/bcast/reduce/
+        # scatter/gather — NOT allreduce, NOT fanin/fanout); sentinel
+        # values (auto/inf) are not positive radixes and defer
+        if knob in _KN_RADIX_GLOBAL:
+            from ...utils.config import SIZE_AUTO, UINT_MAX
+            try:
+                g = int(cfg.get("kn_radix"))
+                if 0 < g < UINT_MAX and g != SIZE_AUTO:
+                    return g
+            except KeyError:
+                pass
         try:
             val = cfg.get(knob)
         except KeyError:
