@@ -769,9 +769,18 @@ class TestHybridKnobs:
 
 class TestGlobalKnRadix:
     """KN_RADIX (tl_ucp_lib.c:30-37): a positive value supersedes the
-    barrier/rs/bcast/reduce/scatter/gather KN radixes; allreduce keeps
-    its own knob (the reference does NOT copy into it); 0 and the
-    auto/inf sentinels defer."""
+    barrier/bcast/reduce KN radixes; allreduce keeps its own knob (the
+    reference does NOT copy into it); 0 and the auto/inf sentinels
+    defer. Unlike the reference's six-knob list, the set is trimmed to
+    radixes that exist: reduce_scatter/scatter/gather trees here are
+    binomial (radix-2 hardwired) and have no radix knob to override."""
+
+    def test_global_set_matches_reachable_knobs(self):
+        from ucc_tpu.tl.host.team import _KN_RADIX_GLOBAL
+        # exactly the knobs cfg_radix is ever called with (knomial.py);
+        # phantom entries would advertise a knob with no effect
+        assert _KN_RADIX_GLOBAL == {"barrier_kn_radix", "bcast_kn_radix",
+                                    "reduce_kn_radix"}
 
     @staticmethod
     def _host_team(job):

@@ -1,0 +1,304 @@
+"""Observability subsystem tests: metrics registry counts, span tracing
+nesting, stall-watchdog state dumps, and the ucc_stats tool."""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType, ReductionOp,
+                     Status)
+from ucc_tpu.obs import metrics, watchdog
+
+from harness import UccJob
+
+
+@pytest.fixture
+def stats(tmp_path):
+    """Runtime-enabled metrics registry, isolated per test."""
+    metrics.reset()
+    metrics.enable(file=str(tmp_path / "stats.json"))
+    yield metrics
+    metrics.disable()
+    metrics.reset()
+
+
+@pytest.fixture
+def wd(tmp_path):
+    """Runtime-enabled watchdog with a tiny deadline."""
+    path = tmp_path / "watchdog.json"
+    watchdog.reset()
+    watchdog.configure(0.05, file=str(path))
+    yield path
+    watchdog.configure(0)
+    watchdog.reset()
+
+
+def _counter(snap, name, pred=None):
+    """Sum a counter across keys (optionally filtered by substring)."""
+    table = snap["counters"].get(name, {})
+    return sum(v for k, v in table.items()
+               if pred is None or pred in k)
+
+
+class TestMetricsRegistry:
+    def test_scripted_run_counts(self, stats, tmp_path):
+        """A scripted run has exactly predictable coll_posted /
+        coll_completed counts and nonzero TL byte counters."""
+        n, n_colls, count = 3, 4, 16
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            srcs = [np.full(count, r + 1.0) for r in range(n)]
+            dsts = [np.zeros(count) for _ in range(n)]
+            for _ in range(n_colls):
+                job.run_coll(teams, lambda r: CollArgs(
+                    coll_type=CollType.ALLREDUCE,
+                    src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+                    dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+                    op=ReductionOp.SUM))
+            snap = metrics.snapshot()
+            # every rank posts+completes each collective exactly once,
+            # keyed core|allreduce|<alg>
+            assert _counter(snap, "coll_posted", "core|allreduce") == \
+                n * n_colls
+            assert _counter(snap, "coll_completed", "core|allreduce") == \
+                n * n_colls
+            assert _counter(snap, "coll_failed") == 0
+            assert _counter(snap, "coll_timed_out") == 0
+            # TL byte/message counters moved, keyed by algorithm
+            assert _counter(snap, "bytes_sent", "tl/host|allreduce") > 0
+            assert _counter(snap, "msgs_sent", "tl/host|allreduce") > 0
+            assert _counter(snap, "progress_iterations") > 0
+            # team create recorded state-machine dwell histograms
+            dwell = snap["histograms"].get("team_state_dwell_us", {})
+            states = {k.split("|")[1] for k in dwell}
+            assert "CL_CREATE" in states or "SERVICE_TEAM" in states
+        finally:
+            job.cleanup()
+
+    def test_zero_cost_shape_when_disabled(self):
+        """With the registry disabled, recording is a no-op and nothing
+        accumulates (the ENABLED guard, not a filter, skips the work)."""
+        metrics.disable()
+        metrics.reset()
+        metrics.inc("x")
+        metrics.gauge("y", 1)
+        metrics.observe("z", 7)
+        snap = metrics.snapshot()
+        assert not snap["counters"] and not snap["gauges"] \
+            and not snap["histograms"]
+
+    def test_log2_histogram_buckets(self, stats):
+        for v, bucket in ((0, 0), (1, 1), (2, 2), (3, 2), (4, 3),
+                          (1023, 10), (1024, 11)):
+            metrics.reset()
+            metrics.observe("h", v)
+            slot = metrics.snapshot()["histograms"]["h"]["||"]
+            assert slot["buckets"] == {bucket: 1}, (v, bucket)
+
+    def test_dump_appends_json_lines(self, stats, tmp_path):
+        metrics.inc("a", 1)
+        p = metrics.dump(reason="one")
+        metrics.inc("a", 2)
+        metrics.dump(reason="two")
+        lines = [json.loads(x) for x in open(p)]
+        assert [ln["reason"] for ln in lines] == ["one", "two"]
+        assert lines[0]["counters"]["a"]["||"] == 1
+        assert lines[1]["counters"]["a"]["||"] == 3
+
+
+class TestSpanTracing:
+    @pytest.fixture
+    def tracer(self, tmp_path, monkeypatch):
+        import importlib
+        trace = tmp_path / "trace.json"
+        monkeypatch.setenv("UCC_PROFILE_MODE", "log")
+        monkeypatch.setenv("UCC_PROFILE_FILE", str(trace))
+        from ucc_tpu.utils import profiling
+        importlib.reload(profiling)
+        yield trace
+        monkeypatch.delenv("UCC_PROFILE_MODE")
+        importlib.reload(profiling)
+
+    def test_spans_nest_schedule_to_tl(self, tracer):
+        """One allreduce produces balanced B/E pairs at every layer and
+        TL send/recv events that reference the algorithm task's span."""
+        n, count = 2, 8
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            srcs = [np.full(count, r + 1.0) for r in range(n)]
+            dsts = [np.zeros(count) for _ in range(n)]
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+                dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+                op=ReductionOp.SUM))
+        finally:
+            job.cleanup()
+        events = [json.loads(x) for x in open(tracer)]
+        # request-level spans: one B and one E per rank, same span id
+        reqs = [e for e in events if e["name"] == "coll_allreduce"]
+        assert sorted(e["ph"] for e in reqs) == ["B", "B", "E", "E"]
+        req_spans = {e["span"] for e in reqs}
+        # task-level spans balance B/E per span id
+        tasks = [e for e in events if e["name"].startswith("task_")]
+        per_span = {}
+        for e in tasks:
+            per_span.setdefault((e["name"], e["span"]), []).append(e["ph"])
+        for phases in per_span.values():
+            assert phases.count("B") == phases.count("E")
+        # the user-facing algorithm task reuses the request span id and
+        # carries the coll/alg labels
+        labeled = [e for e in tasks if e["ph"] == "B" and "coll" in e]
+        assert {e["span"] for e in labeled} == req_spans
+        assert all(e["coll"] == "allreduce" for e in labeled)
+        # TL rounds: instant events whose span links them to a task span
+        tl = [e for e in events if e["name"] in ("tl_send", "tl_recv")]
+        assert tl, "TL rounds were not traced"
+        task_spans = {e["span"] for e in tasks}
+        for e in tl:
+            assert e["span"] in task_spans
+            assert "peer" in e and "slot" in e and "nbytes" in e
+
+    def test_parent_links_in_schedules(self, tracer):
+        """Tasks inside a Schedule carry a parent link to the schedule's
+        span, so offline tools can rebuild the DAG."""
+        from ucc_tpu.schedule.schedule import Schedule
+        from ucc_tpu.schedule.task import CollTask
+
+        class Ok(CollTask):
+            def post_fn(self):
+                self.status = Status.OK
+                return Status.OK
+
+        sched = Schedule()
+        t1, t2 = Ok(), Ok()
+        sched.add_task(t1)
+        sched.add_dep_on_schedule_start(t1)
+        sched.add_task(t2)
+        sched.add_dep_on_schedule_start(t2)
+        sched.post()
+        assert sched.super_status == Status.OK
+        events = [json.loads(x) for x in open(tracer)]
+        children = [e for e in events if e["ph"] == "B" and
+                    e.get("span") in (t1.seq_num, t2.seq_num)]
+        assert len(children) == 2
+        assert all(e["parent"] == sched.seq_num for e in children)
+
+
+class TestWatchdog:
+    def test_injected_stall_names_the_task(self, wd):
+        """A rank whose peer never posts stalls with outstanding recvs;
+        the watchdog dump names collective, algorithm, round slots, and
+        the outstanding peers."""
+        n, count = 2, 8
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            src = np.full(count, 1.0)
+            dst = np.zeros(count)
+            # only rank 0 posts -> its knomial allreduce can never finish
+            req = teams[0].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(src, count, DataType.FLOAT64),
+                dst=BufferInfo(dst, count, DataType.FLOAT64),
+                op=ReductionOp.SUM))
+            req.post()
+            deadline = time.monotonic() + 5.0
+            while not wd.exists() or not wd.read_text().strip():
+                job.contexts[0].progress()
+                watchdog._last_scan = 0.0   # defeat the 1s scan throttle
+                assert time.monotonic() < deadline, "watchdog never fired"
+            report = json.loads(wd.read_text().splitlines()[0])
+            assert report["progress_queue_depth"] >= 1
+            stalled = report["stalled_tasks"]
+            assert stalled, report
+            t = stalled[0]
+            assert t["coll"] == "allreduce"
+            assert t["alg"]                      # algorithm is named
+            assert t["status"] == "IN_PROGRESS"
+            assert t["age_s"] >= 0.05
+            # outstanding peer/slot detail (the stuck round)
+            assert t["outstanding"], t
+            assert {o["peer"] for o in t["outstanding"]} == {1}
+            assert t["round_slots"], t
+            # one-shot: a second scan must not re-report the same task
+            watchdog._last_scan = 0.0
+            job.contexts[0].progress()
+            assert len(wd.read_text().splitlines()) == 1
+            # unblock the peer so cleanup is orderly
+            dst1 = np.zeros(count)
+            req1 = teams[1].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(np.full(count, 2.0), count, DataType.FLOAT64),
+                dst=BufferInfo(dst1, count, DataType.FLOAT64),
+                op=ReductionOp.SUM))
+            req1.post()
+            job.progress_until(lambda: all(
+                r.test() != Status.IN_PROGRESS for r in (req, req1)))
+            assert req.test() == Status.OK
+            np.testing.assert_allclose(dst, 3.0)
+        finally:
+            job.cleanup()
+
+    def test_team_state_dwell_names_cl_agree(self, wd):
+        """A team parked in CL_AGREE past the deadline is reported with
+        an explicit CL_AGREE hint (the known silent-hang state)."""
+        from ucc_tpu.core.team import TeamState
+
+        class FakeTeam:
+            id = 7
+            rank = 0
+            size = 2
+            state = TeamState.CL_AGREE
+            state_since = time.monotonic() - 10.0
+
+        team = FakeTeam()
+        watchdog.register_team(team)
+        queue = type("Q", (), {"_q": []})()
+        watchdog._last_scan = 0.0
+        assert watchdog.check(queue)
+        report = json.loads(wd.read_text().splitlines()[-1])
+        names = {t["state"]: t for t in report["stalled_teams"]}
+        assert "CL_AGREE" in names
+        assert "CL_AGREE" in names["CL_AGREE"]["hint"]
+        assert names["CL_AGREE"]["dwell_s"] > 5
+
+    def test_disabled_watchdog_never_scans(self, tmp_path):
+        watchdog.configure(0)
+        assert not watchdog.ENABLED
+
+
+class TestUccStatsTool:
+    def test_print_and_diff(self, stats, tmp_path, capsys):
+        from ucc_tpu.tools.stats import main
+        metrics.inc("coll_posted", 3, component="core", coll="allreduce",
+                    alg="ring")
+        metrics.observe("lat_us", 100, component="core")
+        p1 = str(tmp_path / "a.json")
+        metrics.dump(p1, reason="t0")
+        metrics.inc("coll_posted", 2, component="core", coll="allreduce",
+                    alg="ring")
+        p2 = str(tmp_path / "b.json")
+        metrics.dump(p2, reason="t1")
+
+        assert main([p1]) == 0
+        out = capsys.readouterr().out
+        assert "coll_posted" in out and "core/allreduce/ring" in out
+        assert main([p1, p2]) == 0
+        out = capsys.readouterr().out
+        assert "+2" in out
+
+    def test_self_diff_and_missing(self, stats, tmp_path, capsys):
+        from ucc_tpu.tools.stats import main
+        p = str(tmp_path / "s.json")
+        metrics.inc("x", 1)
+        metrics.dump(p)
+        metrics.inc("x", 4)
+        metrics.dump(p)
+        assert main([p, "--self-diff"]) == 0
+        assert "+4" in capsys.readouterr().out
+        assert main([str(tmp_path / "nope.json")]) == 1

@@ -156,3 +156,183 @@ class TestTransportTruncation:
         task = object.__new__(HostCollTask)
         with pytest.raises(UccError):
             list(task.wait(req))
+
+
+class _FakeReq:
+    def __init__(self, done=True, error=None):
+        self.done = done
+        self.error = error
+
+    def test(self):
+        return self.done
+
+
+class TestBatchedAllgatherSendErrors:
+    """tl/host/allgather.py linear_batched: completed sends were dropped
+    from the window without checking r.error — an errored send left the
+    collective spinning (recvs never matched) instead of failing it."""
+
+    def _task(self):
+        from ucc_tpu.tl.host.allgather import AllgatherLinearBatched
+        t = object.__new__(AllgatherLinearBatched)
+        count = 8
+        src = np.arange(4, dtype=np.float64)
+        dst = np.zeros(count)
+        t.args = CollArgs(
+            coll_type=CollType.ALLGATHER,
+            src=BufferInfo(src, 4, DataType.FLOAT64),
+            dst=BufferInfo(dst, count, DataType.FLOAT64))
+        t.gsize, t.grank, t.nreqs = 2, 0, 1
+        t.recv_nb = lambda peer, buf, slot=0: _FakeReq(done=False)
+        return t
+
+    def test_errored_send_fails_the_collective(self):
+        t = self._task()
+        t.send_nb = lambda peer, data, slot=0: _FakeReq(
+            done=True, error="connection reset by peer")
+        gen = t.run()
+        with pytest.raises(UccError):
+            # bounded drive: pre-fix the errored send vanished and the
+            # generator yielded forever waiting on the dead recvs
+            for _ in range(50):
+                next(gen)
+            pytest.fail("errored send was dropped without failing")
+
+    def test_errored_send_bumps_coll_errors(self, tmp_path):
+        from ucc_tpu.obs import metrics
+        metrics.reset()
+        metrics.enable(file=str(tmp_path / "s.json"))
+        try:
+            t = self._task()
+            t.send_nb = lambda peer, data, slot=0: _FakeReq(
+                done=True, error="boom")
+            gen = t.run()
+            with pytest.raises(UccError):
+                for _ in range(50):
+                    next(gen)
+            snap = metrics.snapshot()
+            errs = snap["counters"].get("coll_errors", {})
+            assert sum(v for k, v in errs.items()
+                       if "tl/host|allgather" in k) == 1
+        finally:
+            metrics.disable()
+            metrics.reset()
+
+
+class TestClAgreeConvergence:
+    """core/team.py: a rank whose every CL create fails used to raise in
+    CL_CREATE without posting the CL_AGREE allgather — peers that DID
+    create a CL then parked in CL_AGREE forever. The agreement round is
+    now posted with an empty set so everyone converges to
+    ERR_NO_RESOURCE."""
+
+    def test_peers_converge_instead_of_hanging(self):
+        import time as _time
+        from ucc_tpu import TeamParams
+        job = UccJob(2)
+        teams = []
+        try:
+            # rank 1 loses every CL before team create (the asymmetric
+            # component-load failure cl_hier can hit for real)
+            job.contexts[1].cl_contexts.clear()
+            sub_world = ThreadOobWorld(2)
+            teams = [job.contexts[r].create_team_post(
+                TeamParams(oob=sub_world.endpoint(r))) for r in range(2)]
+            deadline = _time.monotonic() + 20.0
+            while True:
+                sts = [t.create_test() for t in teams]
+                for ctx in job.contexts:
+                    ctx.progress()
+                if all(s != Status.IN_PROGRESS for s in sts):
+                    break
+                assert _time.monotonic() < deadline, \
+                    f"peers hung instead of converging: {sts}"
+            assert sts == [Status.ERR_NO_RESOURCE, Status.ERR_NO_RESOURCE]
+        finally:
+            for t in teams:
+                t.destroy()
+            job.cleanup()
+
+
+class TestStoreServerDuplicateRanks:
+    """core/oob.py _StoreServer: duplicate rank registrations counted
+    toward the quota, so a retrying/misconfigured client could eat a
+    genuine member's slot and wedge the whole rendezvous."""
+
+    def test_duplicate_rank_rejected(self):
+        import socket
+        import struct
+        import threading
+        from ucc_tpu.core.oob import (TcpStoreOob, _recv_exact,
+                                      _store_cookie)
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            port = probe.getsockname()[1]
+        oob0 = TcpStoreOob(0, 2, port=port, key="duptest", timeout_s=10)
+        rogue = None
+        oob1 = None
+        try:
+            # rogue duplicate claim of rank 0 BEFORE rank 1 registers
+            cookie = _store_cookie("duptest", 2)
+            rogue = socket.create_connection(("127.0.0.1", port), 5)
+            rogue.settimeout(5)
+            assert _recv_exact(rogue, len(cookie)) == cookie
+            rogue.sendall(cookie + struct.pack("!I", 0))
+            # pre-fix: the dup filled the quota and this ctor timed out
+            oob1 = TcpStoreOob(1, 2, port=port, key="duptest",
+                               timeout_s=10)
+            results = {}
+
+            def gather(rank, oob, payload):
+                results[rank] = oob.allgather(payload).result
+
+            th = [threading.Thread(target=gather, args=(0, oob0, b"a")),
+                  threading.Thread(target=gather, args=(1, oob1, b"b"))]
+            for t in th:
+                t.start()
+            for t in th:
+                t.join(timeout=10)
+            assert results.get(0) == [b"a", b"b"]
+            assert results.get(1) == [b"a", b"b"]
+        finally:
+            if rogue is not None:
+                rogue.close()
+            if oob1 is not None:
+                oob1.close()
+            oob0.close()
+
+
+class TestSrgGatherSlots:
+    """tl/host/sra.py: the SRG gather slot 190 collided with
+    scatter-reduce round slots 172+rnd at round 18 (190 = 172+18); the
+    gather/forward slots now live at a base no round counter reaches."""
+
+    def test_slots_clear_of_round_space(self):
+        from ucc_tpu.tl.host.sra import (_SRG_FORWARD_SLOT,
+                                         _SRG_GATHER_SLOT)
+        # scatter-reduce uses 172+rnd with rnd <= log2(team size); even
+        # a 2**64-rank team stays under 172+64
+        assert _SRG_GATHER_SLOT >= 172 + 64
+        assert _SRG_FORWARD_SLOT >= 172 + 64
+        assert _SRG_GATHER_SLOT != _SRG_FORWARD_SLOT
+
+    def test_srg_reduce_with_extra_root(self, monkeypatch):
+        # root >= full exercises BOTH moved slots (gather + forward to
+        # the extra root)
+        monkeypatch.setenv("UCC_TL_SHM_TUNE", "reduce:@srg_knomial:100")
+        n, count, root = 3, 12, 2
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            srcs = [np.full(count, r + 1.0) for r in range(n)]
+            dsts = [np.zeros(count) if r == root else None
+                    for r in range(n)]
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.REDUCE, root=root,
+                src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+                dst=(BufferInfo(dsts[r], count, DataType.FLOAT64)
+                     if r == root else None),
+                op=ReductionOp.SUM))
+            np.testing.assert_allclose(dsts[root], 6.0)
+        finally:
+            job.cleanup()

@@ -46,6 +46,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "TPU_PROBE_r05.log")
+WATCHDOG_LOG = os.path.join(REPO, "TPU_WATCHDOG_r05.json")
 
 PROBE_SRC = r"""
 import jax
@@ -70,6 +71,12 @@ def run_sub(argv, timeout, env=None):
     # The probe wants the REAL platform: drop any cpu-forcing leftovers.
     full_env.pop("JAX_PLATFORMS", None)
     full_env.pop("XLA_FLAGS", None)
+    # Arm the stall watchdog (ucc_tpu/obs/watchdog.py) in every child:
+    # a wedged-chip round then leaves per-task state dumps (which
+    # collective/algorithm/round/peers were in flight) in WATCHDOG_LOG
+    # instead of this log's bare `hang` lines.
+    full_env.setdefault("UCC_WATCHDOG_TIMEOUT", "60")
+    full_env.setdefault("UCC_WATCHDOG_FILE", WATCHDOG_LOG)
     if env:
         full_env.update(env)
     proc = subprocess.Popen(
@@ -93,10 +100,45 @@ def run_sub(argv, timeout, env=None):
         return None, ""
 
 
+def _watchdog_size() -> int:
+    try:
+        return os.path.getsize(WATCHDOG_LOG)
+    except OSError:
+        return 0
+
+
+def _watchdog_tail(offset: int) -> str:
+    """Summary of the newest watchdog state dump written AFTER ``offset``
+    (the file size before this probe attempt) — turns a bare `hang` line
+    into 'hang (stalled: ...)' evidence. The offset guard matters: the
+    dump file is shared by every child and never truncated, so without
+    it a hang that produced no dump (e.g. wedged at the XLA layer) would
+    be blamed on a stale dump from an earlier round."""
+    try:
+        with open(WATCHDOG_LOG) as f:
+            f.seek(offset)
+            last = None
+            for line in f:
+                if line.strip():
+                    last = line
+            if not last:
+                return ""
+        rep = json.loads(last)
+        stalled = rep.get("stalled_tasks") or rep.get("stalled_teams") or []
+        names = [f"{t.get('coll') or t.get('state')}/"
+                 f"{t.get('alg') or t.get('task') or ''}" for t in stalled]
+        return (f"(watchdog: {len(stalled)} stalled, "
+                f"queue_depth={rep.get('progress_queue_depth')}, "
+                f"{','.join(names[:4])})")
+    except (OSError, ValueError):
+        return ""
+
+
 def probe_once(timeout: float):
+    wd_offset = _watchdog_size()
     rc, out = run_sub([sys.executable, "-c", PROBE_SRC], timeout)
     if rc is None:
-        return "hang", ""
+        return "hang", _watchdog_tail(wd_offset)
     tail = out.strip().splitlines()[-1] if out.strip() else ""
     if rc == 0 and "PROBE_OK" in out:
         return "ok", tail

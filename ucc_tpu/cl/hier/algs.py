@@ -36,6 +36,7 @@ from ...constants import EventType
 from ...score.score import CollScore
 from ...status import Status, UccError
 from ...topo.sbgp import SbgpType
+from ...utils import profiling
 from ...utils.log import get_logger
 from ...utils.mathutils import block_count, block_offset
 
@@ -158,6 +159,7 @@ def _rab_fill_frag(hier_team, sched: Schedule, args: CollArgs, dt,
                         flags=CollArgsFlags.IN_PLACE if args.is_inplace
                         else CollArgsFlags(0))
     t_red = node.coll_init(red_args, MemoryType.HOST, msg)
+    t_red.obs_stage = "rab.node_reduce"
     sched.add_task(t_red)
     sched.add_dep_on_schedule_start(t_red)
     prev = t_red
@@ -168,6 +170,7 @@ def _rab_fill_frag(hier_team, sched: Schedule, args: CollArgs, dt,
                            flags=CollArgsFlags.IN_PLACE)
         ar_args.src = args.dst
         t_ar = leaders.coll_init(ar_args, MemoryType.HOST, msg)
+        t_ar.obs_stage = "rab.leaders_allreduce"
         sched.add_task(t_ar)
         t_ar.subscribe_dep(prev, EventType.EVENT_COMPLETED)
         prev = t_ar
@@ -176,12 +179,14 @@ def _rab_fill_frag(hier_team, sched: Schedule, args: CollArgs, dt,
             # them in place, so the scale always hits the live fragment
             t_scale = _ScaleTask(lambda a=ar_args, d=dt: _dst_view(a, d),
                                  1.0 / team_size)
+            t_scale.obs_stage = "rab.scale"
             sched.add_task(t_scale)
             t_scale.subscribe_dep(prev, EventType.EVENT_COMPLETED)
             prev = t_scale
 
     bc_args = CollArgs(coll_type=CollType.BCAST, root=0, src=args.dst)
     t_bc = node.coll_init(bc_args, MemoryType.HOST, msg)
+    t_bc.obs_stage = "rab.node_bcast"
     sched.add_task(t_bc)
     t_bc.subscribe_dep(prev, EventType.EVENT_COMPLETED)
 
@@ -255,6 +260,9 @@ class SplitRailAllreduce(CollTask):
         if self._sub is not None:
             if not self._sub.is_completed():
                 return
+            if profiling.ENABLED and self.obs_stage:
+                profiling.span_end(f"hier_{self.obs_stage}", self.seq_num,
+                                   status=self._sub.super_status.name)
             if self._sub.super_status.is_error:
                 self.status = self._sub.super_status
                 return
@@ -276,7 +284,7 @@ class SplitRailAllreduce(CollTask):
             rs_args.src = rs_args.dst
             self._sub = node.coll_init(rs_args, MemoryType.HOST,
                                        self._count * esz)
-            self._post_sub()
+            self._post_sub("split_rail.node_reduce_scatter")
         elif self._stage == 1:
             my_block = self._dst[blk_off:blk_off + blk_cnt]
             ar_args = CollArgs(coll_type=CollType.ALLREDUCE, op=inner,
@@ -285,7 +293,7 @@ class SplitRailAllreduce(CollTask):
             ar_args.src = ar_args.dst
             self._sub = net.coll_init(ar_args, MemoryType.HOST,
                                       blk_cnt * esz)
-            self._post_sub()
+            self._post_sub("split_rail.rail_allreduce")
         elif self._stage == 2:
             if op == ReductionOp.AVG:
                 my_block = self._dst[blk_off:blk_off + blk_cnt]
@@ -299,11 +307,15 @@ class SplitRailAllreduce(CollTask):
                                self._dt)
             self._sub = node.coll_init(ag_args, MemoryType.HOST,
                                        self._count * esz)
-            self._post_sub()
+            self._post_sub("split_rail.node_allgather")
         else:
             self.status = Status.OK
 
-    def _post_sub(self) -> None:
+    def _post_sub(self, stage: str) -> None:
+        self.obs_stage = stage
+        self._sub.obs_stage = stage
+        if profiling.ENABLED:
+            profiling.span_begin(f"hier_{stage}", self.seq_num)
         self._sub.progress_queue = self.progress_queue
         self._sub.post()
 
@@ -408,6 +420,7 @@ def _split_rail_fill_frag(hier_team, sched: Schedule, fa: CollArgs,
             live["work"][:] = binfo_typed(f.src)[:live["work"].size]
 
     t0 = _UnpackTask(copy_in)
+    t0.obs_stage = "split_rail.copy_in"
     sched.add_task(t0)
     sched.add_dep_on_schedule_start(t0)
 
@@ -415,6 +428,7 @@ def _split_rail_fill_frag(hier_team, sched: Schedule, fa: CollArgs,
                        dst=_buf(work, dt), flags=CollArgsFlags.IN_PLACE)
     rs_args.src = rs_args.dst
     t1 = node.coll_init(rs_args, MemoryType.HOST, cnt * esz)
+    t1.obs_stage = "split_rail.node_reduce_scatter"
     sched.add_task(t1)
     t1.subscribe_dep(t0, EventType.EVENT_COMPLETED)
 
@@ -422,12 +436,14 @@ def _split_rail_fill_frag(hier_team, sched: Schedule, fa: CollArgs,
                        dst=_buf(my_blk, dt), flags=CollArgsFlags.IN_PLACE)
     ar_args.src = ar_args.dst
     t2 = net.coll_init(ar_args, MemoryType.HOST, my_blk.size * esz)
+    t2.obs_stage = "split_rail.rail_allreduce"
     sched.add_task(t2)
     t2.subscribe_dep(t1, EventType.EVENT_COMPLETED)
     prev = t2
 
     if op == ReductionOp.AVG:
         t_s = _ScaleTask(lambda: live["blk"], 1.0 / team_size)
+        t_s.obs_stage = "split_rail.scale"
         sched.add_task(t_s)
         t_s.subscribe_dep(prev, EventType.EVENT_COMPLETED)
         prev = t_s
@@ -436,6 +452,7 @@ def _split_rail_fill_frag(hier_team, sched: Schedule, fa: CollArgs,
                        dst=_buf(work, dt), flags=CollArgsFlags.IN_PLACE)
     ag_args.src = _buf(my_blk, dt)
     t3 = node.coll_init(ag_args, MemoryType.HOST, cnt * esz)
+    t3.obs_stage = "split_rail.node_allgather"
     sched.add_task(t3)
     t3.subscribe_dep(prev, EventType.EVENT_COMPLETED)
     sched._sr_colls = (rs_args, ar_args, ag_args)
@@ -492,6 +509,7 @@ def bcast_2step_init(init_args, hier_team) -> CollTask:
         b1 = CollArgs(coll_type=CollType.BCAST,
                       root=my_node_ranks.index(root), src=args.src)
         t1 = node.coll_init(b1, MemoryType.HOST, msg)
+        t1.obs_stage = "2step.root_node_bcast"
         sched.add_task(t1)
         sched.add_dep_on_schedule_start(t1)
         prev = t1
@@ -501,6 +519,7 @@ def bcast_2step_init(init_args, hier_team) -> CollTask:
         b2 = CollArgs(coll_type=CollType.BCAST, root=root_leader_idx,
                       src=args.src)
         t2 = leaders.coll_init(b2, MemoryType.HOST, msg)
+        t2.obs_stage = "2step.leaders_bcast"
         sched.add_task(t2)
         if prev is not None:
             t2.subscribe_dep(prev, EventType.EVENT_COMPLETED)
@@ -510,6 +529,7 @@ def bcast_2step_init(init_args, hier_team) -> CollTask:
     if not root_in_my_node:
         b3 = CollArgs(coll_type=CollType.BCAST, root=0, src=args.src)
         t3 = node.coll_init(b3, MemoryType.HOST, msg)
+        t3.obs_stage = "2step.node_bcast"
         sched.add_task(t3)
         if prev is not None:
             t3.subscribe_dep(prev, EventType.EVENT_COMPLETED)
@@ -569,6 +589,7 @@ def reduce_2step_init(init_args, hier_team) -> CollTask:
                                                    use_dst_directly)
                   else CollArgsFlags(0))
     t1 = node.coll_init(r1, MemoryType.HOST, msg)
+    t1.obs_stage = "2step.node_reduce"
     sched.add_task(t1)
     sched.add_dep_on_schedule_start(t1)
     prev = t1
@@ -586,6 +607,7 @@ def reduce_2step_init(init_args, hier_team) -> CollTask:
                       flags=CollArgsFlags.IN_PLACE if at_final else
                       CollArgsFlags(0))
         t2 = leaders.coll_init(r2, MemoryType.HOST, msg)
+        t2.obs_stage = "2step.leaders_reduce"
         sched.add_task(t2)
         t2.subscribe_dep(prev, EventType.EVENT_COMPLETED)
         prev = t2
@@ -597,6 +619,7 @@ def reduce_2step_init(init_args, hier_team) -> CollTask:
              else _buf(np.zeros(count, dtype=nd), dt))
         b = CollArgs(coll_type=CollType.BCAST, root=0, src=hand_buf)
         t3 = node.coll_init(b, MemoryType.HOST, msg)
+        t3.obs_stage = "2step.leader_root_handoff"
         sched.add_task(t3)
         t3.subscribe_dep(prev, EventType.EVENT_COMPLETED)
         prev = t3
@@ -622,17 +645,20 @@ def barrier_init(init_args, hier_team) -> CollTask:
     sched = Schedule(team=hier_team, args=init_args.args)
     t1 = node.coll_init(CollArgs(coll_type=CollType.FANIN, root=0),
                         MemoryType.HOST, 0)
+    t1.obs_stage = "barrier.node_fanin"
     sched.add_task(t1)
     sched.add_dep_on_schedule_start(t1)
     prev = t1
     if leaders is not None and leaders.sbgp.is_member:
         t2 = leaders.coll_init(CollArgs(coll_type=CollType.BARRIER),
                                MemoryType.HOST, 0)
+        t2.obs_stage = "barrier.leaders_barrier"
         sched.add_task(t2)
         t2.subscribe_dep(prev, EventType.EVENT_COMPLETED)
         prev = t2
     t3 = node.coll_init(CollArgs(coll_type=CollType.FANOUT, root=0),
                         MemoryType.HOST, 0)
+    t3.obs_stage = "barrier.node_fanout"
     sched.add_task(t3)
     t3.subscribe_dep(prev, EventType.EVENT_COMPLETED)
     return sched
@@ -969,7 +995,11 @@ class AlltoallvHierNodeAgg(CollTask):
     def progress_fn(self) -> None:
         self._advance()
 
-    def _post_sub(self) -> None:
+    def _post_sub(self, stage: str) -> None:
+        self.obs_stage = stage
+        self._sub.obs_stage = stage
+        if profiling.ENABLED:
+            profiling.span_begin(f"hier_{stage}", self.seq_num)
         self._sub.progress_queue = self.progress_queue
         self._sub.post()
 
@@ -979,6 +1009,9 @@ class AlltoallvHierNodeAgg(CollTask):
         if self._sub is not None:
             if not self._sub.is_completed():
                 return
+            if profiling.ENABLED and self.obs_stage:
+                profiling.span_end(f"hier_{self.obs_stage}", self.seq_num,
+                                   status=self._sub.super_status.name)
             if self._sub.super_status.is_error:
                 self.status = self._sub.super_status
                 return
@@ -998,7 +1031,7 @@ class AlltoallvHierNodeAgg(CollTask):
                          src=_buf(self.scounts, DataType.INT64),
                          dst=_buf(self.m_flat, DataType.INT64))
             self._sub = self.full.coll_init(a, MemoryType.HOST, N * 8)
-            self._post_sub()
+            self._post_sub("a2av_agg.counts_allgather")
             return
 
         m = self.m_flat.reshape(N, N)
@@ -1020,7 +1053,7 @@ class AlltoallvHierNodeAgg(CollTask):
             g = CollArgs(coll_type=CollType.GATHERV, root=0,
                          src=_buf(packed, self.dt), dst=gdst)
             self._sub = self.node.coll_init(g, MemoryType.HOST, msg)
-            self._post_sub()
+            self._post_sub("a2av_agg.node_gatherv")
             return
 
         if self._stage == 2:
@@ -1059,7 +1092,7 @@ class AlltoallvHierNodeAgg(CollTask):
                     dst=BufferInfoV(self.A_in, rcounts_l, None, self.dt))
                 self._sub = self.leaders.coll_init(a2, MemoryType.HOST,
                                                    msg)
-                self._post_sub()
+                self._post_sub("a2av_agg.leaders_alltoallv")
                 return                          # completion -> stage 3
             self._stage = 3                     # non-leader: skip a2av
 
@@ -1104,7 +1137,7 @@ class AlltoallvHierNodeAgg(CollTask):
                           dst=_buf(self.R, self.dt))
             self._sub = self.node.coll_init(s3, MemoryType.HOST,
                                             my_rtotal * nd.itemsize)
-            self._post_sub()
+            self._post_sub("a2av_agg.node_scatterv")
             return                              # completion -> stage 4
 
         if self._stage == 4:

@@ -18,6 +18,7 @@ from ..api.types import (BufferInfo, BufferInfoV, CollArgs,
                          coll_args_msgsize)
 from ..constants import (CollArgsFlags, CollType, MemoryType, coll_type_str)
 from ..mc.base import detect_mem_type
+from ..obs import metrics
 from ..schedule.schedule import Schedule
 from ..schedule.task import CollTask
 from ..status import Status, UccError
@@ -126,10 +127,21 @@ class CollRequest:
                 if task.cb is None and task.triggered_task is None and \
                         task.schedule is None and not task.timeout and \
                         not any(task.em.listeners):
+                    if metrics.ENABLED:
+                        metrics.inc("coll_posted", component="core",
+                                    coll=task.coll_name or "",
+                                    alg=task.alg_name or "")
+                        metrics.inc("coll_fast_repost", component="core",
+                                    coll=task.coll_name or "",
+                                    alg=task.alg_name or "")
                     return task.fast_repost()
             self.task.reset()
         self._posted = True
         self.task.progress_queue = self.team.context.progress_queue
+        if metrics.ENABLED:
+            metrics.inc("coll_posted", component="core",
+                        coll=self.task.coll_name or "",
+                        alg=self.task.alg_name or "")
         if self._trace:
             logger.info("coll post: %s team %s seq %d",
                         coll_type_str(self.args.coll_type), self.team.id,
@@ -235,6 +247,8 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
         # (e.g. the zero-count rank of an uneven scatterv); the device
         # path runs them for real, with typed zero padding.
         task: CollTask = _StubTask()
+        task.coll_name = coll_type_str(ct)
+        task.alg_name = "zero_size_stub"
         req = CollRequest(task, team, args)
         _attach_user_opts(task, args)
         return req
@@ -244,11 +258,20 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
                          msgsize=msgsize)
     assert team.score_map is not None
     task, chosen = team.score_map.init_coll(ct, mem_type, msgsize, init_args)
+    # observability labels: metrics key the (collective, algorithm) pair
+    # and the watchdog dump names both; stamped once at init, read only
+    # on cold paths
+    task.coll_name = coll_type_str(ct)
+    task.alg_name = str(chosen.alg_name or chosen.team)
     if team.context.lib.config.coll_trace:
         logger.info("coll init: %s/%s msgsize %d -> %s (score %d) team %s",
                     coll_type_str(ct), mem_type.name.lower(), msgsize,
                     chosen.alg_name or chosen.team, chosen.score, team.id)
+    inner = task
     task = _maybe_wrap_dt_check(task, args, team, mem_type)
+    if task is not inner:
+        task.coll_name = inner.coll_name
+        task.alg_name = inner.alg_name
     _attach_user_opts(task, args)
     if profiling.ENABLED:
         _attach_profiling(task, ct)
@@ -291,7 +314,10 @@ def _maybe_wrap_dt_check(task: CollTask, args: CollArgs, team: Team,
 
 def _attach_profiling(task: CollTask, ct: CollType) -> None:
     name = coll_type_str(ct)
-    profiling.request_new(name, task.seq_num)
+    # the request span id IS the task seq num; every nested task/TL event
+    # carries the same id (or a parent link to it), so one collective's
+    # full dispatch -> schedule -> TL lifetime reassembles offline
+    profiling.request_new(name, task.seq_num, alg=task.alg_name or "")
     prev = task.cb
 
     def cb(t, st):

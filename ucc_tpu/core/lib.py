@@ -32,6 +32,25 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
     ConfigField("PROFILE_MODE", "", "profiling mode: log,accum", parse_string),
     ConfigField("PROFILE_FILE", "", "profiling output file", parse_string),
     ConfigField("PROFILE_LOG_SIZE", "4m", "profiling buffer size", parse_string),
+    # the obs knobs are read from the environment at import by
+    # ucc_tpu/obs (same zero-cost pattern as PROFILE_MODE above); listed
+    # here so `ucc_info -cf` documents them
+    ConfigField("STATS", "n", "enable the metrics registry "
+                "(counters/gauges/log2 histograms keyed by component/"
+                "collective/algorithm); dumped at exit, on SIGUSR2, and "
+                "every STATS_INTERVAL; read by the ucc_stats tool",
+                parse_bool),
+    ConfigField("STATS_FILE", "ucc_stats.json", "metrics dump file "
+                "(JSON lines, one snapshot per dump)", parse_string),
+    ConfigField("STATS_INTERVAL", "0", "seconds between periodic metric "
+                "dumps (0 = exit/SIGUSR2 only)", parse_string),
+    ConfigField("WATCHDOG_TIMEOUT", "0", "stall watchdog soft deadline in "
+                "seconds: any task IN_PROGRESS longer triggers a one-shot "
+                "diagnostic state dump (collective, algorithm, round, "
+                "outstanding peers/tags, team state positions); 0 = off",
+                parse_string),
+    ConfigField("WATCHDOG_FILE", "ucc_watchdog.json", "watchdog state-dump "
+                "file (JSON lines)", parse_string),
     ConfigField("TEAM_IDS_POOL_SIZE", "32", "team id pool size per context",
                 parse_uint),
     ConfigField("CHECK_ASYMMETRIC_DT", "n", "validate datatype consistency "

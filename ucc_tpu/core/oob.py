@@ -22,6 +22,9 @@ from typing import Dict, List, Optional
 
 from ..api.types import OobColl, OobRequest
 from ..status import Status
+from ..utils.log import get_logger
+
+logger = get_logger("oob")
 
 
 # ---------------------------------------------------------------------------
@@ -350,13 +353,28 @@ class _StoreServer:
 
     def _run(self) -> None:
         try:
-            registered = 0
-            while registered < self.size:
+            registered: set = set()
+            while len(registered) < self.size:
                 c, _ = self.lsock.accept()
                 c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                if self._register(c) is not None:
-                    self.conns.append(c)
-                    registered += 1
+                rank = self._register(c)
+                if rank is None:
+                    continue
+                if rank in registered:
+                    # a re-claimed rank (retrying client, misconfigured
+                    # launcher) must not consume another slot: the quota
+                    # counts DISTINCT ranks, and a duplicate conn in
+                    # self.conns would double-serve one rank while a
+                    # genuine member starves
+                    logger.warning("store server: duplicate registration "
+                                   "for rank %d rejected", rank)
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+                    continue
+                registered.add(rank)
+                self.conns.append(c)
             while True:
                 contribs: List[Optional[bytes]] = [None] * self.size
                 for c in list(self.conns):

@@ -22,12 +22,14 @@ from __future__ import annotations
 
 import enum
 import pickle
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from ..api.types import OobRequest, TeamAttr, TeamParams
 from ..constants import ReductionOp
+from ..obs import metrics, watchdog
 from ..score.score import CollScore
 from ..score.score_map import ScoreMap
 from ..status import Status, UccError
@@ -81,6 +83,9 @@ class Team:
         self.team_key: Any = None
         self.id: Optional[int] = p.id
         self.state = TeamState.ADDR_EXCHANGE
+        # the watchdog enumerates live teams so a create-time hang names
+        # its state-machine position (WeakSet; no lifetime extension)
+        watchdog.register_team(self)
         self.service_team = None
         self.cl_teams: List[Any] = []
         self.score_map: Optional[ScoreMap] = None
@@ -92,6 +97,26 @@ class Team:
         self._cl_current = None
         self._failed_status = Status.OK
         self._start_state_machine()
+
+    # ------------------------------------------------------------------
+    # state property: every transition stamps ``state_since`` (watchdog
+    # dwell) and records the left state's dwell time in the metrics
+    # registry — the team-create state machine is exactly where round-5's
+    # silent hang lived, so its timing is a first-class series
+    @property
+    def state(self) -> "TeamState":
+        return self._state
+
+    @state.setter
+    def state(self, new_state: "TeamState") -> None:
+        now = time.monotonic()
+        old = getattr(self, "_state", None)
+        if old is not None and old != new_state and metrics.ENABLED:
+            metrics.observe("team_state_dwell_us",
+                            (now - self.state_since) * 1e6,
+                            component="core/team", coll=old.name)
+        self._state = new_state
+        self.state_since = now
 
     # ------------------------------------------------------------------
     def _start_state_machine(self) -> None:
@@ -260,9 +285,15 @@ class Team:
             else:
                 self.cl_teams.append(self._cl_current)
             self._cl_current = None
+        # all-CLs-failed is NOT raised here: this rank must still post
+        # its (empty) CL set into the CL_AGREE allgather, or peers that
+        # DID create a CL park in CL_AGREE forever waiting for our
+        # contribution — the advisor-confirmed silent-hang path. The
+        # empty intersection makes every rank converge to
+        # ERR_NO_RESOURCE in _cl_agree_step instead.
         if not self.cl_teams:
-            raise UccError(Status.ERR_NO_RESOURCE,
-                           "no CL could create a team")
+            logger.warning("no CL could create a team on this rank; "
+                           "entering CL agreement with an empty set")
         return Status.OK
 
     def _cl_agree_step(self) -> Status:
@@ -277,6 +308,9 @@ class Team:
         cheap agreement round closes that hole: allgather the local CL
         name set, keep only CLs that exist EVERYWHERE."""
         if self.size == 1:
+            if not self.cl_teams:
+                raise UccError(Status.ERR_NO_RESOURCE,
+                               "no CL could create a team")
             return Status.OK
         # The channel must be chosen from TEAM-INVARIANT facts only:
         # every member has an OOB or none does, and SubsetOob-ness is
@@ -290,8 +324,14 @@ class Team:
         # OOB-rooted parent team has already reconciled.
         from .oob import SubsetOob
         if self.oob is None or isinstance(self.oob, SubsetOob):
+            if not self.cl_teams:
+                raise UccError(Status.ERR_NO_RESOURCE,
+                               "no CL could create a team")
             return Status.OK
         if self._pending_req is None:
+            # posted even when cl_teams is empty: the agreement round is
+            # the convergence channel for all-CLs-failed ranks (see
+            # _cl_create_step) — skipping it wedges every peer here
             names = sorted(t.name for t in self.cl_teams)
             self._pending_req = self.oob.allgather(pickle.dumps(names))
         req = self._pending_req

@@ -1,0 +1,21 @@
+"""Observability subsystem — metrics, span tracing, stall watchdog.
+
+Three pillars (the reference's scattered UCC_COLL_TRACE / profile /
+stats surfaces rebuilt as one subsystem; PAPERS.md "Collective
+Communication for 100k+ GPUs" attributes operability at scale to
+exactly this telemetry + hang-diagnostics pairing):
+
+- ``obs.metrics``  — process-wide counters / gauges / log2 histograms
+  keyed by (component, collective, algorithm); ``UCC_STATS``.
+- span tracing    — lives in ``utils.profiling`` (span ids + parent
+  links threaded through core -> schedule -> TL); ``UCC_PROFILE_MODE``.
+- ``obs.watchdog`` — stalled-task detector + one-shot diagnostic state
+  dumps; ``UCC_WATCHDOG_TIMEOUT``.
+
+Every pillar is zero-cost when its env knob is unset: hot paths guard
+with module-level booleans (``metrics.ENABLED`` / ``watchdog.ENABLED``
+/ ``profiling.ENABLED``) before any formatting or locking.
+"""
+from . import metrics, watchdog  # noqa: F401
+
+__all__ = ["metrics", "watchdog"]

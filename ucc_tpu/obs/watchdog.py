@@ -1,0 +1,178 @@
+"""Stall watchdog — turns silent hangs into actionable state dumps.
+
+Motivation: round 5 ended with the chip wedged for the whole round and
+`TPU_PROBE_r05.log` all ``hang`` — no way to tell WHICH collective,
+task, or peer was stuck. This module hooks the progress queue
+(schedule/progress.py): any task IN_PROGRESS past a soft deadline
+(``UCC_WATCHDOG_TIMEOUT`` seconds; unset/0 = off, the default) fires a
+ONE-SHOT diagnostic dump — every in-flight task with its collective,
+algorithm, round/slots, outstanding peers and tags, the progress-queue
+depth, and every live team's state-machine position (CL_AGREE dwell is
+named explicitly: the advisor-confirmed silent-hang path in
+core/team.py) — to the log at ERROR and as a JSON line appended to
+``UCC_WATCHDOG_FILE``.
+
+Zero-cost when off: the progress loop guards with ``watchdog.ENABLED``
+(a module-level boolean) before calling in, and even when on the scan
+itself is throttled to one per ``_SCAN_PERIOD`` seconds.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..utils.log import get_logger
+
+logger = get_logger("obs")
+
+try:
+    TIMEOUT: float = float(os.environ.get("UCC_WATCHDOG_TIMEOUT", "0") or 0)
+except ValueError:
+    TIMEOUT = 0.0
+ENABLED: bool = TIMEOUT > 0
+_file: str = os.environ.get("UCC_WATCHDOG_FILE", "ucc_watchdog.json")
+
+_SCAN_PERIOD = 1.0
+_last_scan = 0.0
+#: one-shot guards: task seq nums / (team id, state) already reported
+_fired_tasks: Set[int] = set()
+_fired_teams: Set[Tuple[Any, str]] = set()
+
+#: every Team registers here at construction (cheap, not a hot path) so
+#: a dump can name state-machine positions even for teams that never
+#: reach the progress queue (the team-create hang class)
+TEAMS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def configure(timeout: float, file: Optional[str] = None) -> None:
+    """Runtime enable/disable (tests and embedders; env read at import)."""
+    global TIMEOUT, ENABLED, _file, _last_scan
+    TIMEOUT = float(timeout)
+    ENABLED = TIMEOUT > 0
+    if file is not None:
+        _file = file
+    _last_scan = 0.0
+
+
+def reset() -> None:
+    """Clear one-shot state (tests)."""
+    _fired_tasks.clear()
+    _fired_teams.clear()
+
+
+def register_team(team: Any) -> None:
+    TEAMS.add(team)
+
+
+# ---------------------------------------------------------------------------
+# scan — called from ProgressQueue.progress() under `if watchdog.ENABLED:`
+# ---------------------------------------------------------------------------
+
+def check(queue: Any, now: Optional[float] = None) -> bool:
+    """Scan one progress queue + the team registry for stalls; fire a
+    dump for each newly-detected one. Returns True when a dump fired."""
+    global _last_scan
+    if now is None:
+        now = time.monotonic()
+    if now - _last_scan < _SCAN_PERIOD:
+        return False
+    _last_scan = now
+
+    stalled: List[Any] = []
+    for task in list(getattr(queue, "_q", ())):
+        if task.start_time and (now - task.start_time) > TIMEOUT and \
+                task.seq_num not in _fired_tasks:
+            _fired_tasks.add(task.seq_num)
+            stalled.append(task)
+
+    stalled_teams: List[Any] = []
+    for team in list(TEAMS):
+        state = getattr(team, "state", None)
+        if state is None or getattr(state, "name", "") in ("ACTIVE",
+                                                           "FAILED"):
+            continue
+        dwell = now - getattr(team, "state_since", now)
+        if dwell > TIMEOUT and (id(team), state.name) not in _fired_teams:
+            _fired_teams.add((id(team), state.name))
+            stalled_teams.append(team)
+
+    if not stalled and not stalled_teams:
+        return False
+    dump_state(queue, stalled, stalled_teams, now)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the dump
+# ---------------------------------------------------------------------------
+
+def _describe_task(task: Any, now: float) -> Dict[str, Any]:
+    describe = getattr(task, "obs_describe", None)
+    if describe is not None:
+        try:
+            return describe(now)
+        except Exception:  # noqa: BLE001 - diagnostics must never raise
+            pass
+    return {"task": type(task).__name__,
+            "seq": getattr(task, "seq_num", None),
+            "status": getattr(getattr(task, "status", None), "name", "?")}
+
+
+def _describe_team(team: Any, now: float) -> Dict[str, Any]:
+    state = getattr(team, "state", None)
+    d: Dict[str, Any] = {
+        "team_id": getattr(team, "id", None),
+        "rank": getattr(team, "rank", None),
+        "size": getattr(team, "size", None),
+        "state": getattr(state, "name", "?"),
+        "dwell_s": round(now - getattr(team, "state_since", now), 3),
+    }
+    if getattr(state, "name", "") == "CL_AGREE":
+        # the known silent-hang path: a peer that failed every CL create
+        # and never posted its agreement allgather (core/team.py
+        # _cl_agree_step) leaves everyone else parked exactly here
+        d["hint"] = ("stuck in CL_AGREE: a peer likely failed CL create "
+                     "and never posted the agreement allgather; its "
+                     "local CL set is the thing to inspect")
+    return d
+
+
+def dump_state(queue: Any, stalled: List[Any], stalled_teams: List[Any],
+               now: Optional[float] = None,
+               reason: str = "watchdog") -> Dict[str, Any]:
+    """Build + emit the diagnostic report (log ERROR + JSON line)."""
+    if now is None:
+        now = time.monotonic()
+    in_flight = [_describe_task(t, now)
+                 for t in list(getattr(queue, "_q", ()))]
+    report = {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "reason": reason,
+        "timeout_s": TIMEOUT,
+        "progress_queue_depth": len(getattr(queue, "_q", ())),
+        "stalled_tasks": [_describe_task(t, now) for t in stalled],
+        "in_flight_tasks": in_flight,
+        "teams": [_describe_team(t, now) for t in list(TEAMS)],
+        "stalled_teams": [_describe_team(t, now) for t in stalled_teams],
+    }
+    for t in report["stalled_tasks"]:
+        logger.error(
+            "WATCHDOG: task stalled > %.1fs: %s", TIMEOUT,
+            json.dumps(t, default=str))
+    for t in report["stalled_teams"]:
+        logger.error(
+            "WATCHDOG: team create stalled > %.1fs in %s: %s", TIMEOUT,
+            t.get("state"), json.dumps(t, default=str))
+    logger.error(
+        "WATCHDOG: state dump (%d in-flight, queue depth %d) -> %s",
+        len(in_flight), report["progress_queue_depth"], _file)
+    try:
+        with open(_file, "a") as fh:
+            fh.write(json.dumps(report, default=str) + "\n")
+    except OSError:
+        logger.exception("watchdog dump write failed")
+    return report

@@ -25,7 +25,9 @@ from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 from ..constants import EventType
+from ..obs import metrics
 from ..status import Status
+from ..utils import profiling
 from ..utils.config import SIZE_INF
 from ..utils.mathutils import div_round_up
 from .schedule import Schedule
@@ -191,6 +193,19 @@ class PipelinedSchedule(Schedule):
             st = self.frag_setup(self, frag, self.n_frags_started)
             if isinstance(st, Status) and st.is_error:
                 return st
+        if profiling.ENABLED:
+            # per-fragment begin; the matching E fires in child_completed.
+            # span id is the frag schedule's seq (window entries are
+            # reused, so B/E pairs alternate on the same id — exactly
+            # what accum pairing and chrome nesting expect)
+            profiling.span_begin("pipeline_frag", frag.seq_num,
+                                 parent=self.seq_num,
+                                 frag_num=self.n_frags_started,
+                                 n_frags_total=self.n_frags_total)
+        if metrics.ENABLED:
+            metrics.inc("frags_pipelined", component="schedule",
+                        coll=self.coll_name or "",
+                        alg=self.alg_name or "")
         self.next_frag_to_post = (self.next_frag_to_post + 1) % self.n_frags
         self.n_frags_started += 1
         self.n_frags_in_pipeline += 1
@@ -200,6 +215,9 @@ class PipelinedSchedule(Schedule):
         """ucc_schedule_pipelined_completed_handler (:54-123)."""
         if self.is_completed():
             return  # straggler frag after an error already completed us
+        if profiling.ENABLED:
+            profiling.span_end("pipeline_frag", frag.seq_num,
+                               status=frag.status.name)
         idx = self.frags.index(frag)
         self.n_completed += 1
         self.n_frags_in_pipeline -= 1
@@ -238,6 +256,15 @@ class PipelinedSchedule(Schedule):
             if isinstance(s, Status) and s.is_error:
                 st = s
         return st
+
+    def obs_describe(self, now=None) -> dict:
+        d = super().obs_describe(now)
+        d["n_frags_total"] = self.n_frags_total
+        d["n_frags_started"] = self.n_frags_started
+        d["n_frags_in_pipeline"] = self.n_frags_in_pipeline
+        d["children"] = [f.obs_describe(now) for f in self.frags
+                         if not f.is_completed()]
+        return d
 
 
 def _pipeline_dep_handler(parent: CollTask, event: EventType,

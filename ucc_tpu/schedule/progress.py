@@ -14,6 +14,7 @@ import time
 from collections import deque
 from typing import Callable, Deque, List
 
+from ..obs import metrics, watchdog
 from ..status import Status
 from .task import CollTask
 
@@ -60,6 +61,12 @@ class ProgressQueue:
             for fn in self._progress_fns:
                 fn()
         self._throttle = (self._throttle + 1) % self._throttle_period
+        if metrics.ENABLED:
+            metrics.inc("progress_iterations", component="schedule")
+        if watchdog.ENABLED:
+            # self-throttled to ~1 scan/s; fires one-shot state dumps
+            # for tasks IN_PROGRESS past the soft deadline
+            watchdog.check(self)
         if not self._q:
             return 0
         completed = 0

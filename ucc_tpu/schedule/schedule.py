@@ -86,3 +86,13 @@ class Schedule(CollTask):
             if isinstance(s, Status) and s.is_error:
                 st = s
         return st
+
+    def obs_describe(self, now=None) -> dict:
+        d = super().obs_describe(now)
+        d["n_tasks"] = self.n_tasks
+        d["n_completed"] = self.n_completed
+        # the incomplete children are where a stall actually lives: a
+        # dump of the schedule alone would hide the stuck TL round
+        d["children"] = [t.obs_describe(now) for t in self.tasks
+                         if not t.is_completed()]
+        return d

@@ -23,7 +23,9 @@ import time
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..constants import EventType
+from ..obs import metrics
 from ..status import Status
+from ..utils import profiling
 from ..utils.log import get_logger
 
 logger = get_logger("schedule")
@@ -72,6 +74,14 @@ class CollTask:
     Lifecycle mirrors the reference:
       init -> OPERATION_INITIALIZED -> post -> IN_PROGRESS -> ... -> OK
     """
+
+    #: observability labels — class attrs so instances pay nothing until
+    #: a layer stamps them (core dispatch sets coll/alg on the top-level
+    #: task, CL/HIER sets stage on sub-collectives)
+    coll_name: Optional[str] = None
+    alg_name: Optional[str] = None
+    obs_stage: Optional[str] = None
+    _span_open = False
 
     def __init__(self, team=None, args=None, flags_internal: bool = False):
         self.team = team
@@ -122,6 +132,19 @@ class CollTask:
             self.start_time = time.monotonic()
         self.status = Status.IN_PROGRESS
         self.super_status = Status.IN_PROGRESS
+        if profiling.ENABLED:
+            self._span_open = True
+            fields = {}
+            if self.coll_name:
+                fields["coll"] = self.coll_name
+            if self.alg_name:
+                fields["alg"] = self.alg_name
+            if self.obs_stage:
+                fields["stage"] = self.obs_stage
+            profiling.span_begin(
+                f"task_{type(self).__name__}", self.seq_num,
+                parent=self.schedule.seq_num if self.schedule is not None
+                else None, **fields)
         st = self.post_fn()
         if isinstance(st, Status) and st.is_error:
             self.status = st
@@ -187,6 +210,24 @@ class CollTask:
         # handlers, and the idempotence guard above must already see the
         # final state or the error cascade recurses forever
         self.super_status = st
+        if self._span_open:
+            # _span_open is only ever set under profiling.ENABLED; the
+            # end event closes the B emitted at post() so accum pairs and
+            # chrome nesting stay balanced even for error cascades
+            self._span_open = False
+            profiling.span_end(f"task_{type(self).__name__}", self.seq_num,
+                               status=st.name)
+        if metrics.ENABLED and self.coll_name:
+            alg = self.alg_name or ""
+            if st == Status.ERR_TIMED_OUT:
+                metrics.inc("coll_timed_out", component="core",
+                            coll=self.coll_name, alg=alg)
+            if st.is_error:
+                metrics.inc("coll_failed", component="core",
+                            coll=self.coll_name, alg=alg)
+            else:
+                metrics.inc("coll_completed", component="core",
+                            coll=self.coll_name, alg=alg)
         if st.is_error:
             if self.timeout and st == Status.ERR_TIMED_OUT:
                 logger.warning(
@@ -215,6 +256,30 @@ class CollTask:
 
     def check_timeout(self, now: float) -> bool:
         return bool(self.timeout) and (now - self.start_time) > self.timeout
+
+    # --------------------------------------------------------------- obs
+    def obs_describe(self, now: Optional[float] = None) -> dict:
+        """Diagnostic self-description for watchdog state dumps. Cold
+        path only — never called unless a dump is being built."""
+        if now is None:
+            now = time.monotonic()
+        d: dict = {"task": type(self).__name__, "seq": self.seq_num,
+                   "status": self.status.name}
+        if self.coll_name:
+            d["coll"] = self.coll_name
+        if self.alg_name:
+            d["alg"] = self.alg_name
+        if self.obs_stage:
+            d["stage"] = self.obs_stage
+        if self.start_time:
+            d["age_s"] = round(now - self.start_time, 3)
+        if self.timeout:
+            d["timeout_s"] = self.timeout
+        core = getattr(self.team, "core_team", self.team)
+        if core is not None:
+            d["team"] = getattr(core, "id", None)
+            d["rank"] = getattr(core, "rank", None)
+        return d
 
     def __repr__(self):
         return (f"<{type(self).__name__} seq={self.seq_num} "

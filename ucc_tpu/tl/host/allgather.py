@@ -218,18 +218,12 @@ class AllgatherLinearBatched(HostCollTask):
                 recvs.append(self.recv_nb(
                     peer, dst[peer * blk:(peer + 1) * blk], slot=131))
                 r_posted += 1
-            sends = [r for r in sends if not r.test()]
-            live = []
-            for r in recvs:
-                if not r.test():
-                    live.append(r)
-                elif getattr(r, "error", None):
-                    # same contract as HostCollTask.wait(): a delivered-
-                    # with-error recv (e.g. truncation) fails the coll
-                    raise UccError(Status.ERR_NO_MESSAGE,
-                                   f"allgather linear_batched recv "
-                                   f"failed: {r.error}")
-            recvs = live
+            # same contract as HostCollTask.wait() for BOTH directions: a
+            # completed-with-error send (e.g. a socket peer reset) must
+            # fail the collective, not vanish from the window — and it
+            # bumps the tl/host coll_errors metric on the way out
+            sends = self._drain_window(sends)
+            recvs = self._drain_window(recvs)
             if sends or recvs or s_posted < n_peers or r_posted < n_peers:
                 yield
 

@@ -23,9 +23,10 @@ HOST_ALG_FIELDS = [
                 "(FULL_HOST_ORDERED sbgp; reference RANKS_REORDERING)",
                 parse_bool),
     ConfigField("KN_RADIX", "0", "convenience override: a positive "
-                "value supersedes the barrier/reduce_scatter/bcast/"
-                "reduce/scatter/gather KN radixes (reference KN_RADIX, "
-                "tl_ucp_lib.c:30-37; allreduce keeps its own knob)",
+                "value supersedes the barrier/bcast/reduce KN radixes "
+                "(reference KN_RADIX, tl_ucp_lib.c:30-37; allreduce "
+                "keeps its own knob; this build's reduce_scatter/"
+                "scatter/gather trees are binomial, radix fixed at 2)",
                 parse_uint),
     ConfigField("ALLREDUCE_KN_RADIX", "0-inf:4",
                 "allreduce knomial radix per msg range", parse_mrange_uint),

@@ -27,6 +27,15 @@ from .knomial import clamp_radix, largest_pow
 from .task import HostCollTask
 
 
+#: SRG phase-2 slots. The scatter-reduce phase uses 172+rnd per round, so
+#: any fixed slot under 172+log_r(full) can collide with a deep tree —
+#: the old gather slot 190 aliased round 18's messages (190 = 172+18),
+#: mismatching buffers on teams deep enough to reach it. Phase-2 slots
+#: live at a base no round counter can reach.
+_SRG_GATHER_SLOT = 300
+_SRG_FORWARD_SLOT = 301
+
+
 def _part(lo: int, hi: int, r: int, t: int) -> Tuple[int, int]:
     """Balanced sub-segment t of [lo, hi) split r ways (pure — every
     group member computes identical bounds)."""
@@ -248,7 +257,8 @@ class ReduceSrgKnomial(_SraBase):
             gen = me // full
             yield from self.wait(self.send_nb(proxy, work, slot=170 * 100 + gen))
             if is_root:
-                yield from self.wait(self.recv_nb(proxy, work, slot=171))
+                yield from self.wait(self.recv_nb(proxy, work,
+                                                  slot=_SRG_FORWARD_SLOT))
             return
         yield from self._fold_extras(work, op, slot_base=170 * 100)
 
@@ -270,13 +280,15 @@ class ReduceSrgKnomial(_SraBase):
                     continue
                 plo, phi = _owned_segment(p, self.count, full, r)
                 if phi > plo:
-                    reqs.append(self.recv_nb(p, work[plo:phi], slot=190))
+                    reqs.append(self.recv_nb(p, work[plo:phi],
+                                             slot=_SRG_GATHER_SLOT))
             yield from self.wait(*reqs)
             if self.root >= full:           # forward to the extra root
                 yield from self.wait(self.send_nb(self.root, work,
-                                                  slot=171))
+                                                  slot=_SRG_FORWARD_SLOT))
         elif hi > lo:
-            yield from self.wait(self.send_nb(sink, work[lo:hi], slot=190))
+            yield from self.wait(self.send_nb(sink, work[lo:hi],
+                                              slot=_SRG_GATHER_SLOT))
 
 
 def _pipelined_init(init_args, team, knob: str, make_task, count: int,

@@ -19,8 +19,10 @@ from typing import Any, Iterable, List, Optional
 
 import numpy as np
 
+from ...obs import metrics, watchdog
 from ...schedule.task import CollTask
 from ...status import Status, UccError
+from ...utils import profiling
 from ...utils.ep_map import Subset
 from .transport import RecvReq, SendReq
 
@@ -88,26 +90,99 @@ class HostCollTask(CollTask):
             self.tag = self.tl_team.next_coll_tag()
 
     # ------------------------------------------------------------------
+    # observability (cold unless the matching env knob is set)
+    _obs_names_cache = None
+
+    def _obs_names(self):
+        """(collective, algorithm) metric labels, computed once."""
+        names = self._obs_names_cache
+        if names is None:
+            from ...constants import coll_type_str
+            coll = self.coll_name
+            if coll is None and self.args is not None:
+                coll = coll_type_str(self.args.coll_type)
+            names = self._obs_names_cache = (coll or "",
+                                             self.alg_name or
+                                             type(self).__name__)
+        return names
+
+    def _obs_track(self, kind: str, peer: int, slot: int, req) -> None:
+        """Remember an outstanding request so a watchdog dump can name
+        the stuck peers/slots. Bounded: completed entries are pruned
+        whenever the list grows past a window."""
+        reqs = self.__dict__.setdefault("_obs_reqs", [])
+        if len(reqs) > 256:
+            reqs[:] = [e for e in reqs if not e[3].test()]
+        reqs.append((kind, peer, slot, req))
+
+    def _obs_error(self, reason: str) -> None:
+        if metrics.ENABLED:
+            coll, alg = self._obs_names()
+            metrics.inc("coll_errors", component="tl/host", coll=coll,
+                        alg=alg)
+        raise UccError(Status.ERR_NO_MESSAGE, reason)
+
+    def obs_describe(self, now=None) -> dict:
+        d = super().obs_describe(now)
+        d["grank"] = self.grank
+        d["gsize"] = self.gsize
+        d["tag"] = str(self.tag)
+        reqs = self.__dict__.get("_obs_reqs")
+        if reqs:
+            reqs[:] = [e for e in reqs if not e[3].test()]
+            d["outstanding"] = [{"kind": k, "peer": p, "slot": s}
+                                for k, p, s, _ in reqs[:64]]
+            # algorithms encode their round in the slot (slot_base+rnd),
+            # so the live slot set IS the stuck round
+            d["round_slots"] = sorted({s for _, _, s, _ in reqs})
+        return d
+
+    # ------------------------------------------------------------------
     # p2p helpers (group-rank addressed)
     def send_nb(self, peer_grank: int, data: np.ndarray, slot: int = 0) -> SendReq:
-        return self.tl_team.send_nb(self.subset, peer_grank, self.tag, slot,
-                                    data)
+        req = self.tl_team.send_nb(self.subset, peer_grank, self.tag, slot,
+                                   data)
+        if profiling.ENABLED:
+            profiling.event("tl_send", "i", span=self.seq_num,
+                            peer=peer_grank, slot=slot, tag=str(self.tag),
+                            nbytes=int(data.nbytes))
+        if metrics.ENABLED:
+            coll, alg = self._obs_names()
+            metrics.inc("bytes_sent", int(data.nbytes),
+                        component="tl/host", coll=coll, alg=alg)
+            metrics.inc("msgs_sent", 1, component="tl/host", coll=coll,
+                        alg=alg)
+        if watchdog.ENABLED:
+            self._obs_track("send", peer_grank, slot, req)
+        return req
 
     def recv_nb(self, peer_grank: int, dst: np.ndarray, slot: int = 0) -> RecvReq:
-        return self.tl_team.recv_nb(self.subset, peer_grank, self.tag, slot,
-                                    dst)
+        req = self.tl_team.recv_nb(self.subset, peer_grank, self.tag, slot,
+                                   dst)
+        if profiling.ENABLED:
+            profiling.event("tl_recv", "i", span=self.seq_num,
+                            peer=peer_grank, slot=slot, tag=str(self.tag),
+                            nbytes=int(dst.nbytes))
+        if metrics.ENABLED:
+            coll, alg = self._obs_names()
+            metrics.inc("bytes_recvd", int(dst.nbytes),
+                        component="tl/host", coll=coll, alg=alg)
+            metrics.inc("msgs_recvd", 1, component="tl/host", coll=coll,
+                        alg=alg)
+        if watchdog.ENABLED:
+            self._obs_track("recv", peer_grank, slot, req)
+        return req
 
     def _drain_window(self, reqs):
         """Sliding-window helper for NUM_POSTS-bounded algorithms:
         filter completed requests, failing the collective on a
-        delivered-with-error recv exactly like wait()."""
+        delivered-with-error request exactly like wait()."""
         live = []
         for r in reqs:
             if not r.test():
                 live.append(r)
             elif getattr(r, "error", None):
-                raise UccError(Status.ERR_NO_MESSAGE,
-                               f"window request failed: {r.error}")
+                self._obs_error(f"window request failed: {r.error}")
         return live
 
     def _throttle(self, reqs, max_live):
@@ -129,7 +204,7 @@ class HostCollTask(CollTask):
         for r in reqs:
             err = getattr(r, "error", None)
             if err:
-                raise UccError(Status.ERR_NO_MESSAGE, err)
+                self._obs_error(err)
 
     def sendrecv(self, send_to: int, data: np.ndarray, recv_from: int,
                  dst: np.ndarray, slot: int = 0):

@@ -41,11 +41,14 @@ from .task import HostCollTask
 from .transport import Mailbox, TagKey
 
 
-#: knobs the global KN_RADIX convenience override applies to
-#: (tl_ucp_lib.c:30-37)
+#: knobs the global KN_RADIX convenience override applies to. The
+#: reference copies it into barrier/reduce_scatter/bcast/reduce/scatter/
+#: gather (tl_ucp_lib.c:30-37); here the set is trimmed to the knobs a
+#: radix can actually reach: this build's reduce_scatter/scatter/gather
+#: trees are binomial (radix-2 hardwired, knomial2.py), so listing them
+#: would advertise a knob with no effect.
 _KN_RADIX_GLOBAL = frozenset((
-    "barrier_kn_radix", "reduce_scatter_kn_radix", "bcast_kn_radix",
-    "reduce_kn_radix", "scatter_kn_radix", "gather_kn_radix"))
+    "barrier_kn_radix", "bcast_kn_radix", "reduce_kn_radix"))
 
 
 class HostTlTeam(TlTeamBase):

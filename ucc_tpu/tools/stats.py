@@ -1,0 +1,161 @@
+"""ucc_stats — pretty-print and diff UCC_STATS metric dumps.
+
+The stats-dump consumer (the reference pairs its stats counters with a
+``ucc_info``-style reader). ``obs.metrics`` appends one JSON snapshot
+per line to ``UCC_STATS_FILE``; this tool renders them:
+
+    ucc_stats dump.json                  # latest snapshot, pretty
+    ucc_stats dump.json --first          # earliest snapshot instead
+    ucc_stats a.json b.json              # diff: latest(a) -> latest(b)
+    ucc_stats dump.json --self-diff      # diff first -> last of one file
+
+Counter diffs print deltas; gauges print (old -> new); histograms print
+count/sum deltas. Exit status 1 on unreadable/empty input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def load_snapshots(path: str) -> List[Dict[str, Any]]:
+    snaps = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "counters" in rec:
+                snaps.append(rec)
+    return snaps
+
+
+def _fmt_key(k: str) -> str:
+    component, coll, alg = (k.split("|") + ["", "", ""])[:3]
+    parts = [p for p in (component, coll, alg) if p]
+    return "/".join(parts) if parts else "(total)"
+
+
+def _fmt_val(v: float) -> str:
+    if isinstance(v, float) and not v.is_integer():
+        return f"{v:.3f}"
+    return f"{int(v):,}"
+
+
+def _fmt_signed(v: float) -> str:
+    if isinstance(v, float) and not v.is_integer():
+        return f"{v:+.3f}"
+    return f"{int(v):+,}"
+
+
+def print_snapshot(snap: Dict[str, Any], out=None) -> None:
+    w = (out or sys.stdout).write
+    w(f"# pid {snap.get('pid')} uptime {snap.get('uptime_s')}s "
+      f"reason={snap.get('reason', '?')}\n")
+    for section in ("counters", "gauges"):
+        table = snap.get(section) or {}
+        if not table:
+            continue
+        w(f"\n[{section}]\n")
+        for name in sorted(table):
+            for k, v in sorted(table[name].items()):
+                w(f"  {name:<28} {_fmt_key(k):<40} {_fmt_val(v)}\n")
+    hists = snap.get("histograms") or {}
+    if hists:
+        w("\n[histograms]  (log2 buckets: b counts samples in "
+          "[2^(b-1), 2^b))\n")
+        for name in sorted(hists):
+            for k, slot in sorted(hists[name].items()):
+                count = slot.get("count", 0)
+                avg = (slot.get("sum", 0) / count) if count else 0
+                w(f"  {name:<28} {_fmt_key(k):<40} "
+                  f"count={count} avg={avg:.1f} max={slot.get('max', 0)}\n")
+                buckets = slot.get("buckets") or {}
+                if buckets:
+                    bs = " ".join(
+                        f"{b}:{c}" for b, c in
+                        sorted(buckets.items(), key=lambda kv: int(kv[0])))
+                    w(f"  {'':<28} {'':<40} {bs}\n")
+
+
+def diff_snapshots(old: Dict[str, Any], new: Dict[str, Any],
+                   out=None) -> None:
+    w = (out or sys.stdout).write
+    w(f"# diff: uptime {old.get('uptime_s')}s -> {new.get('uptime_s')}s\n")
+    for name in sorted(set(old.get("counters", {}))
+                       | set(new.get("counters", {}))):
+        o = old.get("counters", {}).get(name, {})
+        n = new.get("counters", {}).get(name, {})
+        for k in sorted(set(o) | set(n)):
+            d = n.get(k, 0) - o.get(k, 0)
+            if d:
+                w(f"  {name:<28} {_fmt_key(k):<40} {_fmt_signed(d)}\n")
+    for name in sorted(set(old.get("gauges", {})) | set(new.get("gauges", {}))):
+        o = old.get("gauges", {}).get(name, {})
+        n = new.get("gauges", {}).get(name, {})
+        for k in sorted(set(o) | set(n)):
+            if o.get(k) != n.get(k):
+                w(f"  {name:<28} {_fmt_key(k):<40} "
+                  f"{_fmt_val(o.get(k, 0))} -> {_fmt_val(n.get(k, 0))}\n")
+    for name in sorted(set(old.get("histograms", {}))
+                       | set(new.get("histograms", {}))):
+        o = old.get("histograms", {}).get(name, {})
+        n = new.get("histograms", {}).get(name, {})
+        for k in sorted(set(o) | set(n)):
+            oc = o.get(k, {}).get("count", 0)
+            nc = n.get(k, {}).get("count", 0)
+            if nc != oc:
+                osum = o.get(k, {}).get("sum", 0)
+                nsum = n.get(k, {}).get("sum", 0)
+                w(f"  {name:<28} {_fmt_key(k):<40} "
+                  f"{nc - oc:+} samples ({nsum - osum:+.1f})\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ucc_stats",
+        description="pretty-print / diff UCC_STATS JSON dumps")
+    ap.add_argument("files", nargs="+",
+                    help="one dump file (print) or two (diff latest of "
+                         "each)")
+    ap.add_argument("--first", action="store_true",
+                    help="use the earliest snapshot instead of the latest")
+    ap.add_argument("--self-diff", action="store_true",
+                    help="diff first -> last snapshot of a single file")
+    args = ap.parse_args(argv)
+
+    snapsets = []
+    for path in args.files:
+        try:
+            snaps = load_snapshots(path)
+        except OSError as e:
+            print(f"ucc_stats: {e}", file=sys.stderr)
+            return 1
+        if not snaps:
+            print(f"ucc_stats: no snapshots in {path}", file=sys.stderr)
+            return 1
+        snapsets.append(snaps)
+
+    try:
+        if len(snapsets) == 2:
+            diff_snapshots(snapsets[0][-1], snapsets[1][-1])
+        elif args.self_diff:
+            diff_snapshots(snapsets[0][0], snapsets[0][-1])
+        else:
+            print_snapshot(snapsets[0][0 if args.first else -1])
+    except BrokenPipeError:
+        # `ucc_stats dump | head` closes the pipe early — that is not an
+        # error worth a traceback
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

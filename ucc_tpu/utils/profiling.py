@@ -66,11 +66,37 @@ def event(name: str, phase: str = "i", **fields: Any) -> None:
 
 
 def request_new(coll: str, seq: int, **fields) -> None:
-    event(f"coll_{coll}", "B", seq=seq, **fields)
+    """Collective-request begin. ``seq`` doubles as the span id (task seq
+    nums are process-unique); pass ``parent=<span>`` to link nested
+    requests (schedule -> child task -> TL round)."""
+    event(f"coll_{coll}", "B", seq=seq, span=seq, **fields)
 
 
 def request_complete(coll: str, seq: int, **fields) -> None:
-    event(f"coll_{coll}", "E", seq=seq, **fields)
+    event(f"coll_{coll}", "E", seq=seq, span=seq, **fields)
+
+
+# ---------------------------------------------------------------------------
+# span API — the generalized request_new/complete used by the schedule and
+# TL layers. A span is a named B/E pair carrying a process-unique id (task
+# seq_num) and an optional parent span id, so a chrome://tracing load shows
+# the full dispatch -> schedule -> TL lifetime of one collective and the
+# parent links survive in accum-free JSON for offline tools.
+# ---------------------------------------------------------------------------
+
+def span_begin(name: str, span: int, parent: Optional[int] = None,
+               **fields: Any) -> None:
+    if not ENABLED:
+        return
+    if parent is not None:
+        fields["parent"] = parent
+    event(name, "B", span=span, **fields)
+
+
+def span_end(name: str, span: int, **fields: Any) -> None:
+    if not ENABLED:
+        return
+    event(name, "E", span=span, **fields)
 
 
 @atexit.register
