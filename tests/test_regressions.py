@@ -336,3 +336,178 @@ class TestSrgGatherSlots:
             np.testing.assert_allclose(dsts[root], 6.0)
         finally:
             job.cleanup()
+
+
+class TestWaitTimeoutCancels:
+    """core/coll.py: CollRequest.wait used to raise on deadline but
+    leave the task IN_PROGRESS in the progress queue — finalize then
+    raised forever and the posted ops were orphaned. wait now cancels
+    the task (ERR_TIMED_OUT) before raising, so finalize works and the
+    queue drains."""
+
+    def test_wait_timeout_leaves_finalizable_request(self):
+        job = UccJob(2)
+        try:
+            teams = job.create_team()
+            count = 8
+            dst = np.zeros(count, np.float64)
+            # only rank 0 posts: the collective can never complete
+            req = teams[0].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(np.ones(count), count, DataType.FLOAT64),
+                dst=BufferInfo(dst, count, DataType.FLOAT64),
+                op=ReductionOp.SUM))
+            req.post()
+            with pytest.raises(UccError) as ei:
+                req.wait(timeout=0.2)
+            assert ei.value.status == Status.ERR_TIMED_OUT
+            # the fix: task is terminal, finalize no longer raises
+            assert req.test() == Status.ERR_TIMED_OUT
+            req.finalize()
+            # the queue drains the cancelled task instead of spinning it
+            for _ in range(3):
+                job.contexts[0].progress()
+            assert len(job.contexts[0].progress_queue) == 0
+        finally:
+            job.cleanup()
+
+
+class TestProgressExceptionSurfaced:
+    """schedule/progress.py: a progress_fn crash was masked as a bare
+    ERR_NO_MESSAGE with no traceback. The queue now logs the exception
+    once with the task identity, keeps it on task.exc, and bumps
+    coll_errors."""
+
+    def test_exception_kept_on_task(self):
+        import logging
+        from ucc_tpu.obs import metrics
+        from ucc_tpu.schedule.progress import ProgressQueue
+        from ucc_tpu.schedule.task import CollTask
+
+        class _Boom(CollTask):
+            def __init__(self):
+                super().__init__()
+                self.calls = 0
+                self.coll_name = "allreduce"
+                self.alg_name = "boom"
+
+            def post_fn(self):
+                return Status.OK
+
+            def progress_fn(self):
+                self.calls += 1
+                if self.calls > 1:   # survive the enqueue-time pass
+                    raise RuntimeError("boom")
+
+        # the ucc root logger is propagate=False, so capture with our
+        # own handler instead of caplog
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        from ucc_tpu.utils.log import get_logger
+        sched_logger = get_logger("schedule")
+        cap = _Capture(level=logging.ERROR)
+        sched_logger.addHandler(cap)
+        metrics.reset()
+        metrics.enable()
+        try:
+            q = ProgressQueue()
+            t = _Boom()
+            t.progress_queue = q
+            t.post()
+            q.progress()
+            assert t.super_status == Status.ERR_NO_MESSAGE
+            assert isinstance(t.exc, RuntimeError)
+            assert "boom" in str(t.exc)
+            # logged once, naming the task
+            msgs = [r for r in records if "failing with" in r.getMessage()]
+            assert len(msgs) == 1
+            assert "_Boom" in msgs[0].getMessage()
+            snap = metrics.snapshot()
+            errs = snap["counters"].get("coll_errors", {})
+            assert sum(errs.values()) >= 1
+        finally:
+            sched_logger.removeHandler(cap)
+            metrics.disable()
+            metrics.reset()
+
+
+class TestStoreServerBootstrapDeadline:
+    """core/oob.py: _StoreServer waited for stragglers forever — one
+    crashed rank hung the whole job's bootstrap. After the bootstrap
+    deadline, registered clients now get ERR_TIMED_OUT naming the
+    absent ranks."""
+
+    def test_absent_ranks_named(self):
+        from ucc_tpu.core.oob import TcpStoreOob
+        import socket as pysock
+
+        probe = pysock.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        # rank 0 of a 3-rank job; ranks 1 and 2 never arrive
+        oob = TcpStoreOob(0, 3, port=port, timeout_s=5,
+                          bootstrap_timeout_s=0.5)
+        try:
+            req = oob.allgather(b"hello")
+            with pytest.raises(UccError) as ei:
+                req.wait()
+            assert ei.value.status == Status.ERR_TIMED_OUT
+            assert "[1, 2]" in str(ei.value)
+        finally:
+            oob.close()
+
+    def test_no_deadline_waits(self):
+        """bootstrap_timeout_s <= 0 preserves the wait-forever contract
+        (in-process servers constructed directly by older tests)."""
+        from ucc_tpu.core.oob import _StoreServer, _store_cookie
+        srv = _StoreServer(2, ("127.0.0.1", 0), _store_cookie("j", 2),
+                           bootstrap_timeout_s=0.0)
+        try:
+            import time as _t
+            _t.sleep(0.3)
+            assert srv.thread.is_alive()   # still patiently listening
+        finally:
+            srv.close()
+
+
+class TestPeerTimeoutTerminal:
+    """The no-hang invariant, minimal form: when one rank never posts,
+    every OTHER rank's collective must reach a terminal status within
+    its timeout — cancelled with posted ops unwound, not parked
+    IN_PROGRESS (the round-5 probe-log `hang` wall)."""
+
+    def test_peers_reach_terminal_status(self):
+        from ucc_tpu.constants import CollArgsFlags
+        n = 3
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            count = 8
+            dsts = [np.zeros(count, np.float64) for _ in range(n)]
+            # rank 2 never posts (simulated silent death)
+            reqs = [teams[r].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(np.ones(count), count, DataType.FLOAT64),
+                dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+                op=ReductionOp.SUM, flags=CollArgsFlags.TIMEOUT,
+                timeout=0.3)) for r in range(n - 1)]
+            for rq in reqs:
+                rq.post()
+            import time as _t
+            deadline = _t.monotonic() + 5.0
+            while _t.monotonic() < deadline:
+                for c in job.contexts:
+                    c.progress()
+                if all([rq.test() != Status.IN_PROGRESS for rq in reqs]):
+                    break
+            sts = [rq.test() for rq in reqs]
+            assert all(s == Status.ERR_TIMED_OUT for s in sts), sts
+            for rq in reqs:
+                rq.finalize()       # terminal => finalizable
+        finally:
+            job.cleanup()

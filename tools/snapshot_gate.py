@@ -20,11 +20,35 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WATCHDOG_FILE = "/tmp/ucc_gate_watchdog.json"
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tpu_probe import _watchdog_evidence  # noqa: E402 - shared parser
+
+
+def _watchdog_outcome(offset: int) -> str:
+    """Classify a failed/timed-out gate step from watchdog evidence
+    written after ``offset``: `timeout(coll=...)` when the armed
+    watchdog (UCC_WATCHDOG_ACTION=cancel) attributed the stall to named
+    collectives, bare `hang` otherwise (wedged below the collective
+    layer). Same taxonomy and parser as tools/tpu_probe.py."""
+    names, _ = _watchdog_evidence(offset, path=WATCHDOG_FILE)
+    if names:
+        return f"timeout(coll={','.join(sorted(set(names))[:4])})"
+    return "hang"
+
+
+def _wd_size() -> int:
+    try:
+        return os.path.getsize(WATCHDOG_FILE)
+    except OSError:
+        return 0
 
 
 def _run(title: str, argv, timeout: float, env=None) -> bool:
     print(f"[gate] {title} ...", flush=True)
     t0 = time.monotonic()
+    wd_offset = _wd_size()
     # own session + group kill on timeout: pytest spawns multiprocessing
     # workers that inherit the captured pipes — killing only pytest would
     # leave the pipe open and block the post-kill read forever, hanging
@@ -43,7 +67,8 @@ def _run(title: str, argv, timeout: float, env=None) -> bool:
             raise
         r = subprocess.CompletedProcess(argv, proc.returncode, out, err)
     except subprocess.TimeoutExpired:
-        print(f"[gate] {title}: TIMEOUT after {timeout:.0f}s", flush=True)
+        print(f"[gate] {title}: TIMEOUT after {timeout:.0f}s -> "
+              f"{_watchdog_outcome(wd_offset)}", flush=True)
         return False
     dt = time.monotonic() - t0
     tail = "\n".join((r.stdout or "").strip().splitlines()[-3:])
@@ -68,6 +93,17 @@ def main(argv=None) -> int:
         env["XLA_FLAGS"] = (flags +
                             " --xla_force_host_platform_device_count=8"
                             ).strip()
+    # Arm the watchdog escalation ladder in every gate child (ISSUE-2 CI
+    # satellite): a wedged step gets its stuck collectives cancelled and
+    # attributed (`timeout(coll=...)`) instead of a bare gate TIMEOUT.
+    # Soft/hard deadlines sized to land inside every step's own timeout
+    # (shortest full-gate step: dryrun at 1200s) — an escalation armed
+    # beyond the step kill would never run. No single collective in the
+    # gate legitimately runs 100s.
+    env.setdefault("UCC_WATCHDOG_TIMEOUT", "100")
+    env.setdefault("UCC_WATCHDOG_ACTION", "cancel")
+    env.setdefault("UCC_WATCHDOG_HARD_TIMEOUT", "200")
+    env.setdefault("UCC_WATCHDOG_FILE", WATCHDOG_FILE)
 
     ok = True
     if args.quick:

@@ -74,8 +74,18 @@ def run_sub(argv, timeout, env=None):
     # Arm the stall watchdog (ucc_tpu/obs/watchdog.py) in every child:
     # a wedged-chip round then leaves per-task state dumps (which
     # collective/algorithm/round/peers were in flight) in WATCHDOG_LOG
-    # instead of this log's bare `hang` lines.
+    # instead of this log's bare `hang` lines. ACTION=cancel escalates
+    # at the hard deadline: stuck collectives are cancelled with
+    # ERR_TIMED_OUT (posted ops unwound), so a wedged round exits as an
+    # attributed `timeout(coll=...)` instead of eating the probe's
+    # process-group kill.
     full_env.setdefault("UCC_WATCHDOG_TIMEOUT", "60")
+    full_env.setdefault("UCC_WATCHDOG_ACTION", "cancel")
+    # hard deadline must land BEFORE the probe's own process-group kill
+    # (default --timeout 90s) or the cancel rung could never run: dump
+    # at 60s, cancel at 80s, kill at 90s. Still clear of the 20-40s
+    # worst-case first-compile stall of a healthy real-chip collective.
+    full_env.setdefault("UCC_WATCHDOG_HARD_TIMEOUT", "80")
     full_env.setdefault("UCC_WATCHDOG_FILE", WATCHDOG_LOG)
     if env:
         full_env.update(env)
@@ -107,42 +117,63 @@ def _watchdog_size() -> int:
         return 0
 
 
-def _watchdog_tail(offset: int) -> str:
-    """Summary of the newest watchdog state dump written AFTER ``offset``
-    (the file size before this probe attempt) — turns a bare `hang` line
-    into 'hang (stalled: ...)' evidence. The offset guard matters: the
-    dump file is shared by every child and never truncated, so without
-    it a hang that produced no dump (e.g. wedged at the XLA layer) would
-    be blamed on a stale dump from an earlier round."""
+def _watchdog_evidence(offset: int, path: str = None):
+    """(stalled-collective names, summary) from the newest watchdog
+    state dump written AFTER ``offset`` (the file size before this probe
+    attempt) — the evidence that upgrades a bare `hang` into an
+    attributed `timeout(coll=...)`. The offset guard matters: the dump
+    file is shared by every child and never truncated, so without it a
+    hang that produced no dump (e.g. wedged at the XLA layer) would be
+    blamed on a stale dump from an earlier round. ``path`` defaults to
+    this probe's WATCHDOG_LOG; tools/snapshot_gate.py reuses the parser
+    against its own dump file."""
     try:
-        with open(WATCHDOG_LOG) as f:
+        with open(path or WATCHDOG_LOG) as f:
             f.seek(offset)
             last = None
             for line in f:
                 if line.strip():
                     last = line
             if not last:
-                return ""
+                return [], ""
         rep = json.loads(last)
         stalled = rep.get("stalled_tasks") or rep.get("stalled_teams") or []
         names = [f"{t.get('coll') or t.get('state')}/"
                  f"{t.get('alg') or t.get('task') or ''}" for t in stalled]
-        return (f"(watchdog: {len(stalled)} stalled, "
-                f"queue_depth={rep.get('progress_queue_depth')}, "
-                f"{','.join(names[:4])})")
+        return names, (f"(watchdog: {len(stalled)} stalled, "
+                       f"queue_depth={rep.get('progress_queue_depth')}, "
+                       f"{','.join(names[:4])})")
     except (OSError, ValueError):
-        return ""
+        return [], ""
+
+
+def classify(rc, out: str, wd_offset: int):
+    """Outcome taxonomy (ISSUE-2 CI satellite): `ok`, `error` (child
+    exited nonzero), `timeout(coll=...)` (child was killed or failed
+    but the watchdog attributed the stall to named collectives), and
+    bare `hang` only when there is genuinely no evidence — a wedge
+    below the collective layer."""
+    tail = out.strip().splitlines()[-1] if out.strip() else ""
+    if rc == 0 and "PROBE_OK" in out:
+        return "ok", tail
+    names, summary = _watchdog_evidence(wd_offset)
+    if rc is None:
+        if names:
+            return f"timeout(coll={','.join(sorted(set(names))[:4])})", \
+                summary
+        return "hang", summary
+    if names:
+        # armed UCC_WATCHDOG_ACTION=cancel: the child *exited* (nonzero)
+        # because stuck collectives were cancelled — attribute it
+        return f"timeout(coll={','.join(sorted(set(names))[:4])})", \
+            f"{summary} {tail[-160:]}"
+    return "error", tail[-200:]
 
 
 def probe_once(timeout: float):
     wd_offset = _watchdog_size()
     rc, out = run_sub([sys.executable, "-c", PROBE_SRC], timeout)
-    if rc is None:
-        return "hang", _watchdog_tail(wd_offset)
-    tail = out.strip().splitlines()[-1] if out.strip() else ""
-    if rc == 0 and "PROBE_OK" in out:
-        return "ok", tail
-    return "error", tail[-200:]
+    return classify(rc, out, wd_offset)
 
 
 STATE = os.path.join(REPO, "TPU_PROBE_STATE.json")

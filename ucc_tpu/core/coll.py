@@ -83,6 +83,16 @@ class _StubTask(CollTask):
         return Status.OK
 
 
+#: task failure statuses eligible for runtime score-map fallback: local
+#: resource/support failures. Timeouts and cancels are excluded (they
+#: imply peers were already engaged), as is INVALID_PARAM (a different
+#: algorithm won't fix the caller's arguments).
+_FALLBACK_ELIGIBLE = frozenset((Status.ERR_NOT_SUPPORTED,
+                                Status.ERR_NO_RESOURCE,
+                                Status.ERR_NO_MESSAGE,
+                                Status.ERR_NO_MEMORY))
+
+
 class CollRequest:
     """ucc_coll_req_h: post/test/finalize + persistent re-post."""
 
@@ -91,6 +101,10 @@ class CollRequest:
         self.team = team
         self.args = args
         self._posted = False
+        #: runtime fallback chain: (init_args, [remaining MsgRange]) set
+        #: by collective_init for plain (unwrapped, non-persistent) tasks
+        self._fallback = None
+        self._fb_used = False
         # hot-path caches: flag tests are enum __and__ calls and the
         # config read is a table lookup — both fixed after init
         self._persistent = args.is_persistent
@@ -159,13 +173,75 @@ class CollRequest:
         st = self.task.super_status
         if st == Status.OPERATION_INITIALIZED:
             return Status.OPERATION_INITIALIZED
+        if st.is_error and self._try_runtime_fallback():
+            return Status.IN_PROGRESS
         return st
+
+    def _try_runtime_fallback(self) -> bool:
+        """Runtime extension of the score-map fallback walk (score_map.c
+        walks candidates on ERR_NOT_SUPPORTED at INIT only): a posted
+        task that failed with a local resource error BEFORE committing
+        any data to the wire is re-initialized once on the next
+        candidate in the chain and re-posted, invisibly to the caller
+        (test() keeps returning IN_PROGRESS across the swap). Tasks that
+        already sent/received anything are NOT retried — peers may have
+        consumed fragments of the first attempt, and only a team-wide
+        restart can reconcile that."""
+        fb = self._fallback
+        task = self.task
+        if fb is None or self._fb_used or not self._posted or \
+                self._persistent or getattr(task, "data_committed", True) or \
+                task.super_status not in _FALLBACK_ELIGIBLE:
+            return False
+        if task.cb is not None or any(task.em.listeners) or \
+                task.triggered_task is not None:
+            # observers (user callback, EVENT subscribers, EE triggered
+            # proxies) already saw the first attempt's error completion —
+            # swapping in a fallback now would double-signal one
+            # collective (error then success). Same divert rule as the
+            # persistent fast re-post lane.
+            return False
+        init_args, remaining = fb
+        for cand in remaining:
+            if cand.init is None:
+                continue
+            try:
+                new_task = cand.init(init_args, cand.team)
+            except UccError:
+                continue
+            self._fb_used = True
+            new_task.coll_name = task.coll_name
+            new_task.alg_name = str(cand.alg_name or cand.team)
+            new_task.timeout = task.timeout
+            new_task.progress_queue = self.team.context.progress_queue
+            logger.warning(
+                "runtime fallback: %s alg %s failed (%s) before data "
+                "commit; retrying once on %s", task.coll_name,
+                task.alg_name, task.super_status.name, new_task.alg_name)
+            if metrics.ENABLED:
+                metrics.inc("coll_fallback_runtime", component="core",
+                            coll=new_task.coll_name or "",
+                            alg=new_task.alg_name or "")
+            try:
+                task.finalize()
+            except Exception:  # noqa: BLE001 - old task teardown is
+                # best-effort; the replacement is already wired in
+                pass
+            self.task = new_task
+            new_task.post()
+            return True
+        return False
 
     def wait(self, timeout: float = 60.0) -> Status:
         deadline = time.monotonic() + timeout
         while self.test() == Status.IN_PROGRESS:
             self.team.context.progress()
             if time.monotonic() > deadline:
+                # cancel, don't just raise: leaving the task IN_PROGRESS
+                # would orphan its posted ops in the progress queue and
+                # make the request un-finalizable (finalize raises on
+                # in-progress) — satellite fix, ISSUE 2
+                self.task.cancel(Status.ERR_TIMED_OUT)
                 raise UccError(Status.ERR_TIMED_OUT,
                                "CollRequest.wait timed out")
         return self.test()
@@ -257,7 +333,9 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
     init_args = InitArgs(args=args, team=team, mem_type=mem_type,
                          msgsize=msgsize)
     assert team.score_map is not None
-    task, chosen = team.score_map.init_coll(ct, mem_type, msgsize, init_args)
+    candidates = team.score_map.lookup(ct, mem_type, msgsize)
+    task, chosen = team.score_map.init_coll(ct, mem_type, msgsize, init_args,
+                                            candidates)
     # observability labels: metrics key the (collective, algorithm) pair
     # and the watchdog dump names both; stamped once at init, read only
     # on cold paths
@@ -275,7 +353,19 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
     _attach_user_opts(task, args)
     if profiling.ENABLED:
         _attach_profiling(task, ct)
-    return CollRequest(task, team, args)
+    req = CollRequest(task, team, args)
+    if task is inner and not args.is_persistent:
+        # retain the fallback-chain tail for RUNTIME fallback (see
+        # CollRequest._try_runtime_fallback). Wrapped (dt-check) and
+        # persistent tasks are excluded: the former's failure status is
+        # the schedule's, the latter's re-post lanes cache task identity.
+        try:
+            rest = candidates[candidates.index(chosen) + 1:]
+        except ValueError:
+            rest = []
+        if rest:
+            req._fallback = (init_args, rest)
+    return req
 
 
 def _maybe_wrap_dt_check(task: CollTask, args: CollArgs, team: Team,

@@ -51,6 +51,33 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
                 parse_string),
     ConfigField("WATCHDOG_FILE", "ucc_watchdog.json", "watchdog state-dump "
                 "file (JSON lines)", parse_string),
+    ConfigField("WATCHDOG_ACTION", "dump", "escalation ladder: dump = "
+                "diagnose only; cancel = also cancel tasks stuck past the "
+                "hard deadline with ERR_TIMED_OUT (unwinds posted transport "
+                "ops); abort = cancel EVERY in-flight task once one "
+                "crosses the hard deadline and fail stalled team creates",
+                parse_string),
+    ConfigField("WATCHDOG_HARD_TIMEOUT", "0", "hard deadline in seconds "
+                "for the cancel/abort watchdog actions (0 = 2x "
+                "WATCHDOG_TIMEOUT)", parse_string),
+    ConfigField("FAULT", "", "fault-injection spec (deterministic failure "
+                "drills): drop=P,delay=P:S,error=P,post_error=P,"
+                "kill=R[+R..] — probabilistic send drop/delay, send/recv "
+                "post errors, pre-wire task post errors, and simulated "
+                "dead ranks at the transport and task boundaries; empty = "
+                "off (zero cost)", parse_string),
+    ConfigField("FAULT_SEED", "0", "RNG seed for UCC_FAULT decisions: the "
+                "same seed + spec replays the same drill", parse_string),
+    ConfigField("OOB_CONNECT_BACKOFF_BASE", "0.05", "initial TCP-store OOB "
+                "connect retry backoff in seconds (exponential, full "
+                "jitter)", parse_string),
+    ConfigField("OOB_CONNECT_BACKOFF_MAX", "2.0", "TCP-store OOB connect "
+                "retry backoff cap in seconds", parse_string),
+    ConfigField("OOB_BOOTSTRAP_TIMEOUT", "120", "TCP-store OOB server-side "
+                "bootstrap deadline in seconds: after it, registered "
+                "ranks are failed with ERR_TIMED_OUT naming the absent "
+                "ranks instead of hanging the job (<=0 = wait forever)",
+                parse_string),
     ConfigField("TEAM_IDS_POOL_SIZE", "32", "team id pool size per context",
                 parse_uint),
     ConfigField("CHECK_ASYMMETRIC_DT", "n", "validate datatype consistency "

@@ -12,7 +12,9 @@ ordered-allgather contract over DCN.
 """
 from __future__ import annotations
 
+import os
 import pickle
+import random
 import selectors
 import socket
 import struct
@@ -21,10 +23,29 @@ import time
 from typing import Dict, List, Optional
 
 from ..api.types import OobColl, OobRequest
-from ..status import Status
+from ..status import Status, UccError
 from ..utils.log import get_logger
 
 logger = get_logger("oob")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+#: client connect backoff: exponential with full jitter, bounded by the
+#: caller's overall deadline. A thundering herd of restarted clients
+#: re-registering against a rebooted store server must not synchronize.
+CONNECT_BACKOFF_BASE = _env_float("UCC_OOB_CONNECT_BACKOFF_BASE", 0.05)
+CONNECT_BACKOFF_MAX = _env_float("UCC_OOB_CONNECT_BACKOFF_MAX", 2.0)
+#: server-side bootstrap deadline: how long the store server waits for
+#: ALL ranks to register before failing the registered ones with
+#: ERR_TIMED_OUT naming the absentees (0/negative = wait forever, the
+#: pre-PR-2 behavior)
+BOOTSTRAP_TIMEOUT = _env_float("UCC_OOB_BOOTSTRAP_TIMEOUT", 120.0)
 
 
 # ---------------------------------------------------------------------------
@@ -183,7 +204,8 @@ class TcpStoreOob(OobColl):
 
     def __init__(self, rank: int, size: int, host: str = "127.0.0.1",
                  port: int = 29999, key: str = "",
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0,
+                 bootstrap_timeout_s: Optional[float] = None):
         self.rank = rank
         self.size = size
         self.addr = (host, port)
@@ -191,8 +213,12 @@ class TcpStoreOob(OobColl):
         self._server: Optional[_StoreServer] = None
         self._sock: Optional[socket.socket] = None
         if rank == 0:
-            self._server = _StoreServer(size, (host, port), cookie)
+            self._server = _StoreServer(
+                size, (host, port), cookie,
+                bootstrap_timeout_s if bootstrap_timeout_s is not None
+                else BOOTSTRAP_TIMEOUT)
         deadline = time.monotonic() + timeout_s
+        backoff = CONNECT_BACKOFF_BASE
         while True:
             # per-attempt socket timeout capped to the REMAINING deadline
             # so a silent listener cannot stretch a small timeout_s to
@@ -227,7 +253,15 @@ class TcpStoreOob(OobColl):
                         self._server.close()
                         self._server = None
                     raise
-                time.sleep(0.05)
+                # exponential backoff + full jitter (bounded by the
+                # remaining deadline): every retry is a complete
+                # re-registration handshake, so a client outliving a
+                # store-server restart rejoins cleanly — but a herd of
+                # them must not arrive in lockstep
+                sleep = min(backoff, max(0.0,
+                                         deadline - time.monotonic()))
+                time.sleep(sleep * random.uniform(0.5, 1.0))
+                backoff = min(backoff * 2, CONNECT_BACKOFF_MAX)
 
     @property
     def oob_ep(self) -> int:
@@ -284,7 +318,17 @@ class _TcpOobRequest(OobRequest):
                 (ln,) = struct.unpack("!I", self._buf[:4])
                 self._need = 4 + ln
             if self._need is not None and len(self._buf) >= self._need:
-                self._result = pickle.loads(self._buf[4:self._need])
+                blob = pickle.loads(self._buf[4:self._need])
+                if isinstance(blob, dict) and "__ucc_oob_error__" in blob:
+                    # server-side bootstrap failure frame: convert the
+                    # would-be hang into a typed error naming the ranks
+                    # that never arrived
+                    raise UccError(
+                        Status.ERR_TIMED_OUT,
+                        f"OOB bootstrap failed: "
+                        f"{blob.get('__ucc_oob_error__')}; absent ranks "
+                        f"{blob.get('absent')}")
+                self._result = blob
                 return Status.OK
 
     @property
@@ -318,9 +362,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class _StoreServer:
-    def __init__(self, size: int, addr, cookie: bytes):
+    def __init__(self, size: int, addr, cookie: bytes,
+                 bootstrap_timeout_s: float = 0.0):
         self.size = size
         self.cookie = cookie
+        self.bootstrap_timeout_s = bootstrap_timeout_s
         self.lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.lsock.bind(addr)
@@ -351,11 +397,42 @@ class _StoreServer:
                 pass
             return None
 
+    def _bootstrap_fail(self, registered: set) -> None:
+        """Registered ranks must not starve behind ranks that will never
+        arrive: name the absentees in a typed error frame and close.
+        Without a deadline one crashed rank hangs the entire job's
+        bootstrap forever — the exact failure mode the ISSUE-2 store
+        server satellite targets."""
+        absent = sorted(set(range(self.size)) - registered)
+        logger.error(
+            "store server: bootstrap timed out after %.1fs with %d/%d "
+            "ranks registered; absent ranks: %s", self.bootstrap_timeout_s,
+            len(registered), self.size, absent)
+        blob = pickle.dumps({"__ucc_oob_error__": "bootstrap timed out",
+                             "absent": absent})
+        out = struct.pack("!I", len(blob)) + blob
+        for c in self.conns:
+            try:
+                c.sendall(out)
+            except OSError:
+                pass
+        self.close()
+
     def _run(self) -> None:
         try:
             registered: set = set()
+            deadline = (time.monotonic() + self.bootstrap_timeout_s
+                        if self.bootstrap_timeout_s > 0 else None)
+            if deadline is not None:
+                self.lsock.settimeout(0.25)
             while len(registered) < self.size:
-                c, _ = self.lsock.accept()
+                if deadline is not None and time.monotonic() > deadline:
+                    self._bootstrap_fail(registered)
+                    return
+                try:
+                    c, _ = self.lsock.accept()
+                except socket.timeout:
+                    continue
                 c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 rank = self._register(c)
                 if rank is None:

@@ -355,6 +355,24 @@ class Team:
                            "no CL survived team-wide agreement")
         return Status.OK
 
+    def fail(self, status: Status = Status.ERR_TIMED_OUT,
+             reason: str = "") -> None:
+        """Force the create state machine into FAILED (watchdog
+        escalation; a peer that will never arrive). The next
+        ``create_test`` returns *status* instead of IN_PROGRESS forever
+        — the bounded outcome the no-hang invariant requires. In-flight
+        service tasks are cancelled so they don't linger in the
+        progress queue."""
+        if self.state in (TeamState.ACTIVE, TeamState.FAILED):
+            return
+        logger.error("team create failed by escalation in state %s: %s",
+                     self.state.name, reason or status.name)
+        task = self._pending_task
+        if task is not None and not task.is_completed():
+            task.cancel(status)
+        self._failed_status = status
+        self.state = TeamState.FAILED
+
     def _build_score_map(self) -> None:
         """ucc_team_build_score_map (ucc_team.c:386-423)."""
         merged = CollScore()

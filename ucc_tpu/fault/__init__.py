@@ -1,0 +1,37 @@
+"""Fault-injection subsystem — deterministic failure drills.
+
+The fourth pillar of the fault-tolerance layer (PR 2): production
+collective stacks earn their no-hang guarantees by *injecting* failures
+continuously, not by waiting for the fabric to provide them (PAPERS.md
+"Collective Communication for 100k+ GPUs" runs timeout→abort→re-init
+drills as part of the runtime's own qualification). This package gives
+the TPU build the same muscle:
+
+- ``fault.inject`` — env-driven (``UCC_FAULT=spec``, seeded by
+  ``UCC_FAULT_SEED``) probabilistic drop / delay / error / rank-kill at
+  the transport boundary (tl/host send/recv) and the task boundary
+  (CollTask.post). Zero-cost when unset: hot paths guard with the
+  module-level ``inject.ENABLED`` boolean, the same trick as ``obs``.
+- ``fault.soak`` — the soak harness: runs the collective matrix under
+  injection and asserts the no-hang invariant (every rank reaches a
+  terminal status within the deadline, whatever was injected).
+
+Spec grammar (comma-separated)::
+
+    UCC_FAULT=drop=0.01,delay=0.05:0.003,error=0.02,post_error=0.01,kill=2
+    UCC_FAULT_SEED=7
+
+``drop=P``            drop a send with probability P (message lost)
+``delay=P:S``         delay a send's delivery by S seconds with prob P
+``error=P``           fail a send/recv post with ERR_NO_MESSAGE
+``post_error=P``      fail a task at post() before any wire traffic
+``kill=R[+R2..]``     simulate dead rank(s): ctx rank R drops every
+                      send and fails every task post
+
+Call sites import the owning module (``from ..fault import inject``) so
+runtime reconfiguration stays visible — a re-exported boolean would be a
+stale copy.
+"""
+from . import inject  # noqa: F401
+
+__all__ = ["inject"]

@@ -1,0 +1,257 @@
+"""Soak harness — the collective matrix under fault injection.
+
+Runs an in-process multi-rank job (thread OOB, the gtest UccJob shape)
+through ``iterations`` collectives drawn round-robin from the matrix
+while ``fault.inject`` drops / delays / errors / kills, and asserts the
+**no-hang invariant**: every rank's request reaches a terminal status
+within ``iter_deadline_s`` of posting, whatever was injected. Success
+of the *collective* is explicitly NOT asserted — a drilled fault is
+supposed to fail things; it is the *unbounded* outcome (a rank parked
+IN_PROGRESS forever, the round-5 probe-log wall of ``hang``) that is
+the bug.
+
+Per-collective timeouts (CollArgs TIMEOUT flag) are the first
+resolution rung: the progress queue cancels timed-out tasks, unwinding
+their posted transport ops. A team whose iteration faulted is
+re-created before the next one — cancellation is local, so the team's
+tag space is undefined afterwards (README "Fault tolerance"), exactly
+like the reference's abort→re-init contract.
+
+Used by ``tests/test_fault.py``; runnable standalone::
+
+    python -m ucc_tpu.fault.soak --ranks 4 --iterations 200 \
+        --spec 'drop=0.01,delay=0.05:0.003,error=0.02,post_error=0.01'
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import inject
+
+
+_DEFAULT_SPEC = "drop=0.01,delay=0.05:0.003,error=0.02,post_error=0.01"
+
+
+def _make_job(n: int):
+    """N contexts bootstrapped by a thread OOB; returns (contexts, libs)."""
+    import ucc_tpu
+    from ucc_tpu import Context, ContextParams, ThreadOobWorld
+    world = ThreadOobWorld(n)
+    libs = [ucc_tpu.init() for _ in range(n)]
+    ctxs: List = [None] * n
+    errs: List = []
+
+    def mk(r):
+        try:
+            ctxs[r] = Context(libs[r], ContextParams(oob=world.endpoint(r)))
+        except Exception as e:  # noqa: BLE001 - reported below
+            errs.append((r, e))
+
+    ths = [threading.Thread(target=mk, args=(r,)) for r in range(n)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=60)
+    if errs:
+        raise RuntimeError(f"soak context create failed: {errs}")
+    return ctxs
+
+
+def _make_team(ctxs, deadline_s: float = 30.0):
+    from ucc_tpu import Status, TeamParams, ThreadOobWorld, UccError
+    world = ThreadOobWorld(len(ctxs))
+    teams = [c.create_team_post(TeamParams(oob=world.endpoint(i)))
+             for i, c in enumerate(ctxs)]
+    deadline = time.monotonic() + deadline_s
+    while True:
+        sts = [t.create_test() for t in teams]
+        for c in ctxs:
+            c.progress()
+        if all(s == Status.OK for s in sts):
+            return teams
+        bad = [s for s in sts if s.is_error]
+        if bad:
+            raise UccError(bad[0], "soak team create failed")
+        if time.monotonic() > deadline:
+            raise TimeoutError("soak team create timed out")
+
+
+def _coll_args(coll: str, rank: int, n: int, count: int, bufs: Dict,
+               timeout_s: float):
+    from ucc_tpu import (BufferInfo, CollArgs, CollArgsFlags, CollType,
+                        DataType, ReductionOp)
+    flags = CollArgsFlags.TIMEOUT
+    if coll == "barrier":
+        return CollArgs(coll_type=CollType.BARRIER, flags=flags,
+                        timeout=timeout_s)
+    src = np.full(count, rank + 1.0, np.float64)
+    if coll == "allreduce":
+        dst = bufs.setdefault(rank, {}).setdefault(
+            "ar", np.zeros(count, np.float64))
+        return CollArgs(coll_type=CollType.ALLREDUCE,
+                        src=BufferInfo(src, count, DataType.FLOAT64),
+                        dst=BufferInfo(dst, count, DataType.FLOAT64),
+                        op=ReductionOp.SUM, flags=flags, timeout=timeout_s)
+    if coll == "bcast":
+        buf = bufs.setdefault(rank, {}).setdefault(
+            "bc", np.zeros(count, np.float64))
+        if rank == 0:
+            buf[:] = 42.0
+        return CollArgs(coll_type=CollType.BCAST,
+                        src=BufferInfo(buf, count, DataType.FLOAT64),
+                        root=0, flags=flags, timeout=timeout_s)
+    if coll == "reduce":
+        dst = bufs.setdefault(rank, {}).setdefault(
+            "rd", np.zeros(count, np.float64))
+        return CollArgs(coll_type=CollType.REDUCE,
+                        src=BufferInfo(src, count, DataType.FLOAT64),
+                        dst=BufferInfo(dst, count, DataType.FLOAT64),
+                        op=ReductionOp.SUM, root=0, flags=flags,
+                        timeout=timeout_s)
+    if coll == "allgather":
+        dst = bufs.setdefault(rank, {}).setdefault(
+            "ag", np.zeros(count * n, np.float64))
+        return CollArgs(coll_type=CollType.ALLGATHER,
+                        src=BufferInfo(src, count, DataType.FLOAT64),
+                        dst=BufferInfo(dst, count * n, DataType.FLOAT64),
+                        flags=flags, timeout=timeout_s)
+    if coll == "alltoall":
+        src_a = np.arange(count * n, dtype=np.float64) + rank
+        dst = bufs.setdefault(rank, {}).setdefault(
+            "a2a", np.zeros(count * n, np.float64))
+        return CollArgs(coll_type=CollType.ALLTOALL,
+                        src=BufferInfo(src_a, count * n, DataType.FLOAT64),
+                        dst=BufferInfo(dst, count * n, DataType.FLOAT64),
+                        flags=flags, timeout=timeout_s)
+    raise ValueError(f"unknown soak collective {coll!r}")
+
+
+DEFAULT_MATRIX = ("allreduce", "bcast", "allgather", "reduce", "alltoall",
+                  "barrier")
+
+
+def run_soak(n_ranks: int = 4, iterations: int = 200,
+             spec: str = _DEFAULT_SPEC, seed: int = 0,
+             coll_timeout_s: float = 0.5, iter_deadline_s: float = 10.0,
+             count: int = 64,
+             matrix=DEFAULT_MATRIX) -> Dict:
+    """Run the drill; returns a report dict:
+
+    ``iterations`` run, per-outcome ``outcomes`` counts (terminal
+    statuses by name), ``hangs`` (iterations where some rank was still
+    IN_PROGRESS at the deadline — MUST be empty), ``injected`` decision
+    counts, ``teams_recreated``.
+    """
+    from ucc_tpu import Status
+
+    inject.reset()
+    ctxs = _make_job(n_ranks)
+    teams = _make_team(ctxs)
+    report: Dict = {"iterations": 0, "outcomes": {}, "hangs": [],
+                    "teams_recreated": 0, "spec": spec, "seed": seed}
+    bufs: Dict = {}
+    inject.configure(spec, seed)
+    try:
+        for it in range(iterations):
+            coll = matrix[it % len(matrix)]
+            try:
+                reqs = [t.collective_init(
+                    _coll_args(coll, r, n_ranks, count, bufs,
+                               coll_timeout_s))
+                        for r, t in enumerate(teams)]
+                for rq in reqs:
+                    rq.post()
+            except Exception as e:  # noqa: BLE001 - init/post-time faults
+                # (post_error on a killed rank, fallback exhaustion) are
+                # a terminal outcome for the iteration, not a hang
+                key = f"init_error({type(e).__name__})"
+                report["outcomes"][key] = report["outcomes"].get(key, 0) + 1
+                report["iterations"] += 1
+                prev = inject.pause()
+                teams = _recreate(teams, ctxs, report)
+                inject.restore(prev)
+                continue
+            deadline = time.monotonic() + iter_deadline_s
+            while time.monotonic() < deadline:
+                for c in ctxs:
+                    c.progress()
+                if all(rq.test() != Status.IN_PROGRESS for rq in reqs):
+                    break
+            sts = [rq.test() for rq in reqs]
+            stuck = [r for r, s in enumerate(sts)
+                     if s == Status.IN_PROGRESS]
+            if stuck:
+                # invariant violation: record, then cancel so the soak
+                # itself can continue past the broken iteration
+                report["hangs"].append(
+                    {"iteration": it, "coll": coll, "ranks": stuck,
+                     "statuses": [s.name for s in sts]})
+                for r in stuck:
+                    reqs[r].task.cancel(Status.ERR_TIMED_OUT)
+            for s in sts:
+                report["outcomes"][s.name] = \
+                    report["outcomes"].get(s.name, 0) + 1
+            for rq in reqs:
+                try:
+                    rq.finalize()
+                except Exception:  # noqa: BLE001
+                    pass
+            report["iterations"] += 1
+            if any(s != Status.OK for s in sts):
+                # the faulted team's tag space is poisoned (peers may
+                # hold stale unexpected messages under tags a future
+                # collective will reuse) — re-create it, injection
+                # paused, mirroring abort→re-init
+                prev = inject.pause()
+                teams = _recreate(teams, ctxs, report)
+                inject.restore(prev)
+    finally:
+        report["injected"] = dict(inject.COUNTS)   # before reset zeroes it
+        inject.reset()
+        for t in teams:
+            try:
+                t.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+        for c in ctxs:
+            try:
+                c.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+    return report
+
+
+def _recreate(teams, ctxs, report):
+    for t in teams:
+        try:
+            t.destroy()
+        except Exception:  # noqa: BLE001
+            pass
+    report["teams_recreated"] += 1
+    return _make_team(ctxs)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(prog="python -m ucc_tpu.fault.soak")
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--iterations", type=int, default=200)
+    ap.add_argument("--spec", default=_DEFAULT_SPEC)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--coll-timeout", type=float, default=0.5)
+    ap.add_argument("--iter-deadline", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    report = run_soak(args.ranks, args.iterations, args.spec, args.seed,
+                      args.coll_timeout, args.iter_deadline)
+    print(json.dumps(report, indent=1))
+    return 1 if report["hangs"] else 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

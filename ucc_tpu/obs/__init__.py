@@ -10,7 +10,11 @@ exactly this telemetry + hang-diagnostics pairing):
 - span tracing    — lives in ``utils.profiling`` (span ids + parent
   links threaded through core -> schedule -> TL); ``UCC_PROFILE_MODE``.
 - ``obs.watchdog`` — stalled-task detector + one-shot diagnostic state
-  dumps; ``UCC_WATCHDOG_TIMEOUT``.
+  dumps; ``UCC_WATCHDOG_TIMEOUT``. With ``UCC_WATCHDOG_ACTION=cancel``
+  (or ``abort``) it escalates past diagnosis: tasks stuck beyond the
+  hard deadline are cancelled (ERR_TIMED_OUT, posted ops unwound) —
+  the detect→survive bridge of the fault-tolerance layer (PR 2; the
+  injection side lives in ``ucc_tpu.fault``).
 
 Every pillar is zero-cost when its env knob is unset: hot paths guard
 with module-level booleans (``metrics.ENABLED`` / ``watchdog.ENABLED``

@@ -15,6 +15,16 @@ core/team.py) — to the log at ERROR and as a JSON line appended to
 Zero-cost when off: the progress loop guards with ``watchdog.ENABLED``
 (a module-level boolean) before calling in, and even when on the scan
 itself is throttled to one per ``_SCAN_PERIOD`` seconds.
+
+PR 2 adds the escalation ladder (``UCC_WATCHDOG_ACTION``): ``dump``
+(default) only diagnoses; ``cancel`` additionally cancels any task
+still IN_PROGRESS past the HARD deadline (``UCC_WATCHDOG_HARD_TIMEOUT``,
+default 2x the soft one) with ERR_TIMED_OUT — unwinding its posted
+transport ops instead of orphaning them; ``abort`` cancels EVERY
+in-flight task once any one crosses the hard deadline, and fails
+stalled team creates, converting a wedged process into a bounded
+all-errors outcome (the Meta timeout→abort→re-init ladder's middle
+rungs; re-init is the caller's move).
 """
 from __future__ import annotations
 
@@ -24,6 +34,7 @@ import time
 import weakref
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ..status import Status
 from ..utils.log import get_logger
 
 logger = get_logger("obs")
@@ -34,6 +45,17 @@ except ValueError:
     TIMEOUT = 0.0
 ENABLED: bool = TIMEOUT > 0
 _file: str = os.environ.get("UCC_WATCHDOG_FILE", "ucc_watchdog.json")
+ACTION: str = os.environ.get("UCC_WATCHDOG_ACTION", "dump").strip().lower()
+if ACTION not in ("dump", "cancel", "abort"):
+    logger.warning("unknown UCC_WATCHDOG_ACTION %r; using 'dump'", ACTION)
+    ACTION = "dump"
+try:
+    HARD_TIMEOUT: float = float(
+        os.environ.get("UCC_WATCHDOG_HARD_TIMEOUT", "0") or 0)
+except ValueError:
+    HARD_TIMEOUT = 0.0
+if HARD_TIMEOUT <= 0:
+    HARD_TIMEOUT = 2 * TIMEOUT
 
 _SCAN_PERIOD = 1.0
 _last_scan = 0.0
@@ -47,13 +69,22 @@ _fired_teams: Set[Tuple[Any, str]] = set()
 TEAMS: "weakref.WeakSet" = weakref.WeakSet()
 
 
-def configure(timeout: float, file: Optional[str] = None) -> None:
+def configure(timeout: float, file: Optional[str] = None,
+              action: Optional[str] = None,
+              hard_timeout: Optional[float] = None) -> None:
     """Runtime enable/disable (tests and embedders; env read at import)."""
-    global TIMEOUT, ENABLED, _file, _last_scan
+    global TIMEOUT, ENABLED, _file, _last_scan, ACTION, HARD_TIMEOUT
     TIMEOUT = float(timeout)
     ENABLED = TIMEOUT > 0
     if file is not None:
         _file = file
+    if action is not None:
+        if action not in ("dump", "cancel", "abort"):
+            raise ValueError(f"watchdog action must be dump|cancel|abort, "
+                             f"got {action!r}")
+        ACTION = action
+    HARD_TIMEOUT = float(hard_timeout) if hard_timeout is not None \
+        else 2 * TIMEOUT
     _last_scan = 0.0
 
 
@@ -73,12 +104,24 @@ def register_team(team: Any) -> None:
 
 def check(queue: Any, now: Optional[float] = None) -> bool:
     """Scan one progress queue + the team registry for stalls; fire a
-    dump for each newly-detected one. Returns True when a dump fired."""
+    dump for each newly-detected one. Returns True when a dump fired.
+
+    The scan throttle is PER QUEUE: a process with several contexts
+    (in-process multi-rank jobs, the test harness shape) calls check
+    from every context's progress loop, and a single global stamp would
+    hand the one scan slot per second to whichever queue polls first —
+    starving the queue that actually holds the stuck task (found by the
+    PR-2 verify drive: escalation needs two scans of the right queue,
+    which a 4-context job delivered only every ~8s). The module-level
+    ``_last_scan`` survives as a test hook: zeroing it forces the next
+    check through regardless of the per-queue stamp."""
     global _last_scan
     if now is None:
         now = time.monotonic()
-    if now - _last_scan < _SCAN_PERIOD:
+    last_q = getattr(queue, "_wd_last_scan", 0.0)
+    if now - last_q < _SCAN_PERIOD and now - _last_scan < _SCAN_PERIOD:
         return False
+    queue._wd_last_scan = now
     _last_scan = now
 
     stalled: List[Any] = []
@@ -99,10 +142,66 @@ def check(queue: Any, now: Optional[float] = None) -> bool:
             _fired_teams.add((id(team), state.name))
             stalled_teams.append(team)
 
-    if not stalled and not stalled_teams:
-        return False
-    dump_state(queue, stalled, stalled_teams, now)
-    return True
+    fired = False
+    if stalled or stalled_teams:
+        dump_state(queue, stalled, stalled_teams, now)
+        fired = True
+    if ACTION != "dump":
+        fired = _escalate(queue, now) or fired
+    return fired
+
+
+def _escalate(queue: Any, now: float) -> bool:
+    """The cancel/abort rungs: tasks IN_PROGRESS past HARD_TIMEOUT are
+    cancelled (ERR_TIMED_OUT) — under ``abort``, one hard-stalled task
+    condemns every in-flight task, since a collective stack with one
+    wedged collective rarely has healthy neighbors (they share the
+    fabric and usually the team), and stalled team creates are failed
+    so ``create_test`` returns instead of spinning forever."""
+    q = list(getattr(queue, "_q", ()))
+    hard = [t for t in q
+            if not t.is_completed() and getattr(t, "start_time", 0)
+            and (now - t.start_time) > HARD_TIMEOUT]
+    acted = False
+    if ACTION == "abort":
+        # only the abort rung condemns team creates: an operator who
+        # opted into per-task cancel did not opt into failing a
+        # legitimately slow large-job bootstrap
+        for team in list(TEAMS):
+            state = getattr(team, "state", None)
+            if state is None or getattr(state, "name", "") in ("ACTIVE",
+                                                               "FAILED"):
+                continue
+            dwell = now - getattr(team, "state_since", now)
+            if dwell > HARD_TIMEOUT:
+                fail = getattr(team, "fail", None)
+                if fail is None:
+                    continue
+                try:
+                    fail(Status.ERR_TIMED_OUT,
+                         f"watchdog abort: create stalled {dwell:.1f}s "
+                         f"in {state.name}")
+                except Exception:  # noqa: BLE001
+                    logger.exception("watchdog team fail raised")
+                acted = True
+    if hard:
+        targets = [t for t in q if not t.is_completed()] \
+            if ACTION == "abort" else hard
+        for t in targets:
+            logger.error(
+                "WATCHDOG: %s: cancelling task %s seq %s (coll=%s alg=%s) "
+                "stuck > %.1fs", ACTION, type(t).__name__,
+                getattr(t, "seq_num", "?"), getattr(t, "coll_name", None),
+                getattr(t, "alg_name", None), HARD_TIMEOUT)
+            cancel = getattr(t, "cancel", None)
+            if cancel is None:
+                continue
+            try:
+                cancel(Status.ERR_TIMED_OUT)
+            except Exception:  # noqa: BLE001 - escalation must never kill
+                logger.exception("watchdog cancel raised")
+        acted = True
+    return acted
 
 
 # ---------------------------------------------------------------------------

@@ -249,6 +249,17 @@ class PipelinedSchedule(Schedule):
                 self.complete(st)
                 return
 
+    def cancel_fn(self) -> None:
+        """Cancel the live fragment window. Fragments not yet (re)posted
+        are OPERATION_INITIALIZED and cancel cleanly; in-flight ones
+        unwind their TL tasks. ``child_completed`` restarts nothing
+        afterwards because the first cancelled frag sets first_error,
+        which completes the pipeline."""
+        st = getattr(self, "_cancel_status", Status.ERR_CANCELED)
+        for frag in list(self.frags):
+            if not frag.is_completed():
+                frag.cancel(st)
+
     def finalize_fn(self) -> Status:
         st = Status.OK
         for frag in self.frags:

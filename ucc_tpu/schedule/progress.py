@@ -14,9 +14,13 @@ import time
 from collections import deque
 from typing import Callable, Deque, List
 
+from ..fault import inject as fault
 from ..obs import metrics, watchdog
 from ..status import Status
+from ..utils.log import get_logger
 from .task import CollTask
+
+logger = get_logger("schedule")
 
 
 class ProgressQueue:
@@ -65,8 +69,13 @@ class ProgressQueue:
             metrics.inc("progress_iterations", component="schedule")
         if watchdog.ENABLED:
             # self-throttled to ~1 scan/s; fires one-shot state dumps
-            # for tasks IN_PROGRESS past the soft deadline
+            # for tasks IN_PROGRESS past the soft deadline, and (with
+            # UCC_WATCHDOG_ACTION=cancel|abort) cancels tasks past the
+            # hard deadline
             watchdog.check(self)
+        if fault.ENABLED:
+            # release injected delayed deliveries that have come due
+            fault.progress()
         if not self._q:
             return 0
         completed = 0
@@ -78,13 +87,28 @@ class ProgressQueue:
                 completed += 1
                 continue
             if task.check_timeout(now):
-                task.complete(Status.ERR_TIMED_OUT)
+                # cancel, not complete: completing locally would orphan
+                # the task's posted sends/recvs (and its generator, mid-
+                # round) — exactly the round-5 dangling-op hang class
+                task.cancel(Status.ERR_TIMED_OUT)
                 completed += 1
                 continue
             try:
                 task.progress()
-            except Exception:  # noqa: BLE001 - a broken task must not kill
-                # an unrelated caller's progress loop; fail it instead
+            except Exception as e:  # noqa: BLE001 - a broken task must not
+                # kill an unrelated caller's progress loop; fail it instead.
+                # Keep the real exception on task.exc and log it once with
+                # the task's identity — ERR_NO_MESSAGE alone is undebuggable
+                task.exc = e
+                logger.exception(
+                    "progress: task %s seq %d (coll=%s alg=%s) raised; "
+                    "failing with ERR_NO_MESSAGE", type(task).__name__,
+                    task.seq_num, task.coll_name or "?",
+                    task.alg_name or "?")
+                if metrics.ENABLED:
+                    metrics.inc("coll_errors", component="schedule",
+                                coll=task.coll_name or "",
+                                alg=task.alg_name or "")
                 task.complete(Status.ERR_NO_MESSAGE)
                 completed += 1
                 continue

@@ -72,6 +72,16 @@ class Schedule(CollTask):
             self.status = self.first_error if self.first_error else Status.OK
             self.complete(self.status)
 
+    def cancel_fn(self) -> None:
+        """Cancel every incomplete child with the same status. Child
+        completions re-enter ``child_completed`` and may complete the
+        schedule mid-loop — ``cancel`` tolerates that (idempotent
+        complete), and first_error carries the identical status."""
+        st = getattr(self, "_cancel_status", Status.ERR_CANCELED)
+        for t in list(self.tasks):
+            if not t.is_completed():
+                t.cancel(st)
+
     def reset(self) -> None:
         super().reset()
         self.n_completed = 0

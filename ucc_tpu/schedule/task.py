@@ -23,6 +23,7 @@ import time
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..constants import EventType
+from ..fault import inject as fault
 from ..obs import metrics
 from ..status import Status
 from ..utils import profiling
@@ -82,6 +83,16 @@ class CollTask:
     alg_name: Optional[str] = None
     obs_stage: Optional[str] = None
     _span_open = False
+    #: exception that crashed the task (set by the progress queue when a
+    #: progress_fn escapes — the real traceback behind an ERR_NO_MESSAGE)
+    exc: Optional[BaseException] = None
+    #: has this task put data on the wire / into peer-visible state?
+    #: Conservative class default True: runtime score-map fallback may
+    #: only retry a failed task that PROVABLY committed nothing, so task
+    #: types that don't track the transition are never retried. Host TL
+    #: tasks flip an instance copy False at post and True on the first
+    #: send/recv (tl/host/task.py).
+    data_committed: bool = True
 
     def __init__(self, team=None, args=None, flags_internal: bool = False):
         self.team = team
@@ -114,6 +125,11 @@ class CollTask:
     def finalize_fn(self) -> Status:
         return Status.OK
 
+    def cancel_fn(self) -> None:
+        """Abort the underlying operation: close generators, drain/cancel
+        posted transport ops, stop launching new work. Must be idempotent
+        and best-effort — cancel() swallows anything it raises."""
+
     def triggered_post_setup(self) -> Status:
         return Status.OK
 
@@ -145,6 +161,12 @@ class CollTask:
                 f"task_{type(self).__name__}", self.seq_num,
                 parent=self.schedule.seq_num if self.schedule is not None
                 else None, **fields)
+        if fault.ENABLED:
+            bad = fault.post_inject(self)
+            if bad is not None:
+                self.status = bad
+                self.complete(bad)
+                return bad
         st = self.post_fn()
         if isinstance(st, Status) and st.is_error:
             self.status = st
@@ -168,10 +190,40 @@ class CollTask:
     def finalize(self) -> Status:
         return self.finalize_fn()
 
+    def cancel(self, status: Status = Status.ERR_CANCELED) -> None:
+        """Abort this task with a terminal *status* on THIS rank.
+
+        The missing half of the reference's timeout contract
+        (ucc_coll.c:409 stamps timeouts but nothing unwinds the op):
+        cancel runs the type's ``cancel_fn`` (close the algorithm
+        generator, cancel posted transport ops, cancel children for
+        schedules) and then completes, which fires the normal EVENT_ERROR
+        cascade — dependents, parent schedules, and user callbacks all
+        observe an ordinary error completion. Idempotent; never raises.
+
+        Cancellation is local: peers discover it through their own
+        timeouts/cancellations, and the team's tag space is undefined
+        afterwards — production flows re-create the team (the Meta
+        timeout→abort→re-init ladder; README "Fault tolerance")."""
+        if self.is_completed():
+            return
+        self._cancel_status = status   # schedules propagate it to children
+        try:
+            self.cancel_fn()
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            logger.exception("cancel_fn of %s seq %d raised",
+                             type(self).__name__, self.seq_num)
+        if metrics.ENABLED:
+            metrics.inc("coll_cancelled", component="core",
+                        coll=self.coll_name or "", alg=self.alg_name or "")
+        if not self.is_completed():  # cancel_fn may have completed us
+            self.complete(status)
+
     def reset(self) -> None:
         """Prepare for re-post (persistent collectives)."""
         self.status = Status.OPERATION_INITIALIZED
         self.super_status = Status.OPERATION_INITIALIZED
+        self.exc = None
         self.n_deps_satisfied = 0
         self.n_deps = self.n_deps_base
 

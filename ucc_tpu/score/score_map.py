@@ -38,10 +38,19 @@ class ScoreMap:
         return [r for r in lst if r.contains(msgsize) and r.score > 0]
 
     def init_coll(self, coll: CollType, mem: MemoryType, msgsize: int,
-                  init_args) -> Tuple[Any, MsgRange]:
+                  init_args,
+                  candidates: Optional[List[MsgRange]] = None
+                  ) -> Tuple[Any, MsgRange]:
         """ucc_coll_init (ucc_coll_score_map.c:114): try winner, walk
-        fallbacks on ERR_NOT_SUPPORTED. Returns (task, chosen_range)."""
-        candidates = self.lookup(coll, mem, msgsize)
+        fallbacks on ERR_NOT_SUPPORTED. Returns (task, chosen_range).
+
+        ``candidates`` lets the caller pre-compute (and keep) the lookup
+        — core dispatch does so to retain the tail of the chain for
+        RUNTIME fallback: a task that fails after init but before
+        committing data is retried once on the next candidate
+        (core/coll.py CollRequest)."""
+        if candidates is None:
+            candidates = self.lookup(coll, mem, msgsize)
         if not candidates:
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            f"no candidates for {coll_type_str(coll)}/"

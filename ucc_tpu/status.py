@@ -28,6 +28,7 @@ class Status(enum.IntEnum):
     ERR_NO_MESSAGE = -6
     ERR_NOT_FOUND = -7
     ERR_TIMED_OUT = -8
+    ERR_CANCELED = -9
     ERR_LAST = -100
 
     @property
@@ -50,6 +51,7 @@ _STATUS_STR = {
     Status.ERR_NO_MESSAGE: "No message available",
     Status.ERR_NOT_FOUND: "Not found",
     Status.ERR_TIMED_OUT: "Operation timed out",
+    Status.ERR_CANCELED: "Operation canceled",
 }
 
 

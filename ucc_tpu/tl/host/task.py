@@ -19,6 +19,7 @@ from typing import Any, Iterable, List, Optional
 
 import numpy as np
 
+from ...fault import inject as fault
 from ...obs import metrics, watchdog
 from ...schedule.task import CollTask
 from ...status import Status, UccError
@@ -41,6 +42,10 @@ class HostCollTask(CollTask):
         self.tag = tag if tag is not None else team.next_coll_tag()
         self._gen = None
         self._slot_counter = 0
+        # instance copy shadows the conservative class-True default (see
+        # CollTask.data_committed): a freshly-built host task has
+        # provably committed nothing
+        self.data_committed = False
 
     # ------------------------------------------------------------------
     def run(self):
@@ -49,6 +54,10 @@ class HostCollTask(CollTask):
         yield  # pragma: no cover
 
     def post_fn(self) -> Status:
+        # instance copy shadows the conservative class-True default: a
+        # host task KNOWS when it first touches the wire, so a failure
+        # before that point is provably retryable (runtime fallback)
+        self.data_committed = False
         self._gen = self.run()
         self._advance()
         return Status.OK
@@ -78,6 +87,34 @@ class HostCollTask(CollTask):
                 "collective algorithm %s raised", type(self).__name__)
             self.status = Status.ERR_NO_MESSAGE
             self._gen = None
+
+    def cancel_fn(self) -> None:
+        """Abort the algorithm: close the generator (GeneratorExit runs
+        its finally blocks / releases its locals mid-round) and cancel
+        every tracked outstanding transport op — posted recvs are
+        withdrawn from the mailbox so late peer sends cannot scribble
+        into reclaimed buffers, pending sends stop being waited on.
+        Tracking rides the ``_obs_reqs`` window the watchdog shares:
+        recvs are always tracked (they are the scribble hazard), sends
+        only when watchdog/fault is armed."""
+        gen, self._gen = self._gen, None
+        if gen is not None:
+            try:
+                gen.close()
+            except Exception:  # noqa: BLE001 - closing mid-yield can
+                # surface algorithm finally-block errors; cancel is
+                # best-effort teardown
+                pass
+        reqs = self.__dict__.get("_obs_reqs")
+        if reqs:
+            for _kind, _peer, _slot, req in reqs:
+                c = getattr(req, "cancel", None)
+                if c is not None:
+                    try:
+                        c()
+                    except Exception:  # noqa: BLE001
+                        pass
+            reqs.clear()
 
     def reset(self) -> None:
         super().reset()
@@ -140,6 +177,11 @@ class HostCollTask(CollTask):
     # ------------------------------------------------------------------
     # p2p helpers (group-rank addressed)
     def send_nb(self, peer_grank: int, data: np.ndarray, slot: int = 0) -> SendReq:
+        if fault.ENABLED:
+            req = self._fault_send(peer_grank, data, slot)
+            if req is not None:
+                return req
+        self.data_committed = True
         req = self.tl_team.send_nb(self.subset, peer_grank, self.tag, slot,
                                    data)
         if profiling.ENABLED:
@@ -152,13 +194,47 @@ class HostCollTask(CollTask):
                         component="tl/host", coll=coll, alg=alg)
             metrics.inc("msgs_sent", 1, component="tl/host", coll=coll,
                         alg=alg)
-        if watchdog.ENABLED:
+        if watchdog.ENABLED or fault.ENABLED:
             self._obs_track("send", peer_grank, slot, req)
         return req
 
+    def _fault_send(self, peer_grank: int, data: np.ndarray, slot: int):
+        """Transport-boundary injection (cold: only under fault.ENABLED).
+        Returns a substitute request, or None to send normally. The
+        error action fires BEFORE data_committed flips so a first-send
+        error is runtime-fallback-eligible, matching a real local
+        transport failure at the post boundary."""
+        act = fault.send_action(getattr(self.tl_team, "_my_ctx_rank", None))
+        if act is None:
+            return None
+        if act == "error":
+            self._obs_error("fault injected: send post failed")
+        if act == "drop":
+            # sender proceeds, message is lost: the receiver-side hang
+            # the cancellation ladder must bound
+            self.data_committed = True
+            return SendReq(done=True)
+        _, delay_s = act
+        self.data_committed = True
+        proxy = fault.DelayedSendReq()
+        payload = data.copy()   # sender may legally reuse its buffer
+
+        def _fire(task=self, peer=peer_grank, d=payload, s=slot, p=proxy):
+            if not p.cancelled:
+                p.real = task.tl_team.send_nb(task.subset, peer, task.tag,
+                                              s, d)
+        fault.defer(delay_s, _fire)
+        if watchdog.ENABLED or fault.ENABLED:
+            self._obs_track("send", peer_grank, slot, proxy)
+        return proxy
+
     def recv_nb(self, peer_grank: int, dst: np.ndarray, slot: int = 0) -> RecvReq:
+        if fault.ENABLED and fault.recv_action(
+                getattr(self.tl_team, "_my_ctx_rank", None)) == "error":
+            self._obs_error("fault injected: recv post failed")
         req = self.tl_team.recv_nb(self.subset, peer_grank, self.tag, slot,
                                    dst)
+        self.data_committed = True
         if profiling.ENABLED:
             profiling.event("tl_recv", "i", span=self.seq_num,
                             peer=peer_grank, slot=slot, tag=str(self.tag),
@@ -169,8 +245,13 @@ class HostCollTask(CollTask):
                         component="tl/host", coll=coll, alg=alg)
             metrics.inc("msgs_recvd", 1, component="tl/host", coll=coll,
                         alg=alg)
-        if watchdog.ENABLED:
-            self._obs_track("recv", peer_grank, slot, req)
+        # recvs are tracked UNCONDITIONALLY (one bounded append): they
+        # are what cancel_fn must withdraw from the mailbox — without
+        # this, a default-config timeout->cancel would leave posted
+        # recvs live and a late peer send could scribble into a buffer
+        # the caller reclaimed after finalize. Sends stay obs-gated: an
+        # abandoned SendReq cannot write anywhere.
+        self._obs_track("recv", peer_grank, slot, req)
         return req
 
     def _drain_window(self, reqs):

@@ -32,26 +32,58 @@ TagKey = Tuple[Any, int, int, int]
 
 
 class SendReq:
-    __slots__ = ("done",)
+    __slots__ = ("done", "cancelled")
 
     def __init__(self, done: bool = False):
         self.done = done
+        self.cancelled = False
 
     def test(self) -> bool:
         return self.done
 
+    def cancel(self) -> None:
+        """Give up on completion. The message itself cannot be unsent
+        (it may already sit in the peer's unexpected queue); the caller
+        just stops waiting on it."""
+        self.cancelled = True
+        self.done = True
+
 
 class RecvReq:
-    __slots__ = ("done", "dst", "nbytes", "error")
+    __slots__ = ("done", "dst", "nbytes", "error", "cancelled", "_mb")
 
     def __init__(self, dst: np.ndarray):
         self.done = False
         self.dst = dst
         self.nbytes = 0
         self.error = None   # str reason when the matched send misbehaved
+        self.cancelled = False
+        self._mb = None     # owning Mailbox (set at post; cancel sync)
 
     def test(self) -> bool:
         return self.done
+
+    def cancel(self) -> None:
+        """Withdraw a posted recv: the mailbox skips cancelled entries
+        at match time, so a LATE send can no longer scribble into a
+        buffer the cancelled collective's caller may have reclaimed.
+        Taken under the owning mailbox's lock — delivery happens inside
+        that lock too (``push``), so cancel-vs-match cannot interleave:
+        whichever wins the lock decides, and a req that was already
+        delivered stays delivered (the data landed before the caller
+        could reclaim anything)."""
+        mb = self._mb
+        if mb is None:
+            if not self.done:
+                self.error = self.error or "canceled"
+            self.cancelled = True
+            self.done = True
+            return
+        with mb.lock:
+            if not self.done:
+                self.error = self.error or "canceled"
+                self.done = True
+            self.cancelled = True
 
 
 class _PendingSend:
@@ -74,19 +106,27 @@ class Mailbox:
         self.posted: Dict[TagKey, deque] = {}
 
     def push(self, key: TagKey, ps: _PendingSend) -> None:
+        # delivery happens INSIDE the lock: RecvReq.cancel synchronizes
+        # on the same lock, so a recv cannot be cancelled (and its
+        # buffer reclaimed) between being matched and being written
         with self.lock:
+            req = None
             rq = self.posted.get(key)
-            if rq:
-                req = rq.popleft()
+            while rq:
+                cand = rq.popleft()
                 if not rq:
                     del self.posted[key]
-            else:
+                if not cand.cancelled:
+                    req = cand
+                    break
+            if req is None:
                 self.unexpected.setdefault(key, deque()).append(ps)
                 return
-        _deliver(req, ps)
+            _deliver(req, ps)
 
     def post_recv(self, key: TagKey, req: RecvReq) -> None:
         with self.lock:
+            req._mb = self
             uq = self.unexpected.get(key)
             if uq:
                 ps = uq.popleft()
@@ -95,7 +135,7 @@ class Mailbox:
             else:
                 self.posted.setdefault(key, deque()).append(req)
                 return
-        _deliver(req, ps)
+            _deliver(req, ps)
 
 
 def _deliver(req: RecvReq, ps: _PendingSend) -> None:
