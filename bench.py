@@ -137,6 +137,8 @@ def _measure_point(coll: str, count: int, ctxs, teams, devices, mesh,
     def raw_round():
         jax.block_until_ready(raw(garr))
 
+    from ucc_tpu.mc.pool import host_pool
+    point_start = host_pool().stats()
     srcs = [jax.device_put(jnp.ones((count,), jnp.float32), devices[r])
             for r in range(n)]
     argses, reqs = _persistent_reqs(coll, teams, ctxs, srcs, count, n)
@@ -159,6 +161,12 @@ def _measure_point(coll: str, count: int, ctxs, teams, devices, mesh,
     for _ in range(warmup):
         raw_round()
         one_round()
+    # memory behavior alongside busbw: pool misses that grow during the
+    # timed (steady-state) loop are per-iteration allocations the mpool
+    # failed to absorb — 0 is the healthy reading (ISSUE 3 satellite).
+    # All numbers are PER-POINT deltas (a --sweep record must not carry
+    # earlier points' cumulative hits in its hit_rate).
+    pool0 = host_pool().stats()
     raw_samples, ucc_samples = [], []
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -168,14 +176,23 @@ def _measure_point(coll: str, count: int, ctxs, teams, devices, mesh,
         t2 = time.perf_counter()
         raw_samples.append(t1 - t0)
         ucc_samples.append(t2 - t1)
+    pool1 = host_pool().stats()
     for rq in reqs:
         rq.finalize()
     raw_samples.sort()
     ucc_samples.sort()
     raw_time = raw_samples[len(raw_samples) // 2]
     ucc_time = ucc_samples[len(ucc_samples) // 2]
+    hits = pool1["hits"] - point_start["hits"]
+    misses = pool1["misses"] - point_start["misses"]
+    lookups = hits + misses
+    pool_stats = {
+        "hit": hits, "miss": misses,
+        "hit_rate": round(hits / lookups, 4) if lookups else 0.0,
+        "steady_state_allocs": pool1["misses"] - pool0["misses"],
+    }
     return (ucc_time, raw_time, _busbw(coll, nbytes, n, ucc_time),
-            _busbw(coll, nbytes, n, raw_time))
+            _busbw(coll, nbytes, n, raw_time), pool_stats)
 
 
 def main(sweep: bool = False) -> None:
@@ -202,8 +219,9 @@ def main(sweep: bool = False) -> None:
             if coll == "alltoall" and cnt % n:
                 cnt += n - cnt % n
             it = max(6, iters // (2 if cnt >= (1 << 20) else 1))
-            ut, rt, ub, rb = _measure_point(coll, cnt, ctxs, teams, devices,
-                                            mesh, it, warmup=4)
+            ut, rt, ub, rb, pool = _measure_point(coll, cnt, ctxs, teams,
+                                                  devices, mesh, it,
+                                                  warmup=4)
             # platform is recorded so consumers (tools/tpu_probe.py) can
             # tell a real-accelerator sweep from the CPU-mesh fallback
             plat = devices[0].platform
@@ -215,7 +233,8 @@ def main(sweep: bool = False) -> None:
                     "detail": {"n_chips": n, "msg_bytes": cnt * 4,
                                "platform": plat,
                                "ucc_lat_ms": round(ut * 1e3, 3),
-                               "raw_lat_ms": round(rt * 1e3, 3)}}
+                               "raw_lat_ms": round(rt * 1e3, 3),
+                               "mc_pool": pool}}
             else:
                 # 1 chip: busbw is identically 0 (the 2(n-1)/n factor) —
                 # the honest per-size number is e2e latency vs raw
@@ -226,11 +245,12 @@ def main(sweep: bool = False) -> None:
                     "vs_baseline": round(rt / ut, 4) if ut else 0.0,
                     "detail": {"n_chips": n, "msg_bytes": cnt * 4,
                                "platform": plat,
-                               "raw_lat_us": round(rt * 1e6, 2)}}
+                               "raw_lat_us": round(rt * 1e6, 2),
+                               "mc_pool": pool}}
             print(json.dumps(rec))
         return
 
-    ucc_time, raw_time, ucc_bw, raw_bw = _measure_point(
+    ucc_time, raw_time, ucc_bw, raw_bw, pool = _measure_point(
         "allreduce", count, ctxs, teams, devices, mesh, iters, warmup=5)
     nbytes = count * 4
 
@@ -248,6 +268,7 @@ def main(sweep: bool = False) -> None:
                 "ucc_lat_ms": round(ucc_time * 1e3, 3),
                 "raw_psum_lat_ms": round(raw_time * 1e3, 3),
                 "raw_busbw_GBps": round(raw_bw, 3),
+                "mc_pool": pool,
             },
         }
     else:
@@ -266,6 +287,7 @@ def main(sweep: bool = False) -> None:
                 "msg_bytes": nbytes,
                 "platform": devices[0].platform,
                 "raw_psum_lat_us": round(raw_time * 1e6, 2),
+                "mc_pool": pool,
                 "note": "single-chip: latency comparison (busbw undefined); "
                         "multi-chip busbw path activates when >1 device",
             },

@@ -247,7 +247,12 @@ class CollRequest:
         return self.test()
 
     def finalize(self) -> Status:
-        """ucc_collective_finalize (ucc_coll.c:460-508)."""
+        """ucc_collective_finalize (ucc_coll.c:460-508). Releases the
+        task's resources — for host TL tasks that includes returning
+        pool-leased scratch to the mc mpool (tl/host/task.py
+        finalize_fn), which is why persistent requests should be
+        finalized rather than dropped: a dropped task's lease is
+        reclaimed only by GC and its buffers never re-enter the pool."""
         if self.task.super_status == Status.IN_PROGRESS:
             raise UccError(Status.ERR_INVALID_PARAM,
                            "finalize of in-progress collective")

@@ -38,15 +38,45 @@ def _as_typed(buf: Any, count: int, nd: np.dtype) -> np.ndarray:
     return np.frombuffer(buf, dtype=nd, count=count)
 
 
+#: ops eligible for the allocation-free `out=` accumulate path
+_OUT_UFUNC = {ReductionOp.SUM: np.add,
+              ReductionOp.PROD: np.multiply,
+              ReductionOp.MAX: np.maximum,
+              ReductionOp.MIN: np.minimum}
+
+
 def reduce_arrays(srcs: Sequence[np.ndarray], op: ReductionOp,
-                  dt: DataType, alpha: Optional[float] = None) -> np.ndarray:
-    """Reduce a list of equally-shaped 1-D typed arrays."""
+                  dt: DataType, alpha: Optional[float] = None,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Reduce a list of equally-shaped 1-D typed arrays.
+
+    ``out`` (hot-path opt-in): the result lands in *out* (which may
+    alias ``srcs[0]``) and is returned. When the op is a plain
+    elementwise ufunc (SUM/PROD/MAX/MIN) and the dtype needs no
+    widening (not half/bfloat16), accumulation runs straight into *out*
+    with no temporary allocation; otherwise the allocating path runs
+    and copies back — so callers can pass ``out`` unconditionally.
+    """
     nd = dt_numpy(dt)
     is_float_like = np.issubdtype(nd, np.floating) or \
         nd.name == "bfloat16" or np.issubdtype(nd, np.complexfloating)
 
     if op in _LOC_OPS:
-        return _reduce_loc(srcs, op)
+        res = _reduce_loc(srcs, op)
+        if out is not None:
+            out[:] = res
+            return out
+        return res
+
+    if (out is not None and alpha is None and op in _OUT_UFUNC and
+            len(srcs) >= 2 and nd.type not in _HALF and
+            nd.name != "bfloat16" and out.dtype == nd and
+            all(s.dtype == nd for s in srcs)):
+        ufunc = _OUT_UFUNC[op]
+        ufunc(srcs[0], srcs[1], out=out)
+        for s in srcs[2:]:
+            ufunc(out, s, out=out)
+        return out
 
     compute = srcs
     if nd.type in _HALF or nd.name == "bfloat16":
@@ -84,7 +114,13 @@ def reduce_arrays(srcs: Sequence[np.ndarray], op: ReductionOp,
         acc = acc.astype(nd)
     if alpha is not None:
         acc = acc * alpha
-    return acc.astype(nd) if acc.dtype != nd else acc
+    res = acc.astype(nd) if acc.dtype != nd else acc
+    if out is not None and res is not out:
+        # contract: with out=, the result ALWAYS lands in out (callers
+        # need no conditional copy-back when the fast path didn't apply)
+        out[:] = res
+        return out
+    return res
 
 
 def _reduce_loc(srcs: Sequence[np.ndarray], op: ReductionOp) -> np.ndarray:

@@ -1,0 +1,5 @@
+"""Memory components: dispatch (base), host (cpu), device (tpu), and the
+host scratch mpool (pool) — importing the pool here registers its
+``UCC_MC_POOL_*`` config table for ``ucc_info -cf``."""
+from . import pool  # noqa: F401 - registers MC_POOL_CONFIG
+from .pool import HostMemPool, ScratchLease, host_pool  # noqa: F401

@@ -1,0 +1,244 @@
+"""Host scratch-buffer mpool — the hot-path memory component.
+
+Models the reference's ``ucc_mc_cpu`` mpool (mc/cpu/mc_cpu.c:23-38:
+``ucc_mc_cpu_config_table`` MPOOL_ELEM_SIZE / MPOOL_MAX_ELEMS backing
+``ucc_mpool_get``-served scratch for every TL): collective algorithms
+must not pay a fresh allocation on every post. Here the pool is
+size-classed — power-of-two buckets of raw ``uint8`` arrays kept on
+per-class free lists — and algorithms consume it through
+:class:`ScratchLease`, a per-task set of leased buffers keyed by call
+site that is returned to the pool when the task is finalized
+(task-lifetime return, the ``ucc_mpool_put`` at task cleanup).
+
+Why it matters: per-post ``np.empty`` + page-faulting fresh memory
+dominates small/medium collective latency on the host TLs, and a
+persistent collective (init once, post many) otherwise re-allocates
+identical scratch every single post. With the pool, a steady-state
+persistent loop performs ZERO allocations: the first post leases
+(misses), every later post reuses the same lease without touching the
+pool at all, and the lease outlives ``PipelinedSchedule`` fragment
+retargeting so one fragment scratch set serves the whole window.
+
+Knobs (``ucc_info -cf``; env wins over ``UCC_CONFIG_FILE``):
+
+- ``UCC_MC_POOL_ENABLE`` (y): pooling on/off — off means every lease is
+  a direct allocation (every ``get`` a miss). ``UCC_MC_POOL=n`` is an
+  accepted shorthand.
+- ``UCC_MC_POOL_MAX_ELEM_SIZE`` (64M): largest pooled bucket; bigger
+  requests allocate directly and are never cached.
+- ``UCC_MC_POOL_MAX_ELEMS`` (8): free-list cap per size class
+  (reference MPOOL_MAX_ELEMS).
+- ``UCC_MC_POOL_MAX_BYTES`` (256M): total cached-bytes cap across all
+  classes; returns beyond it are dropped to the allocator.
+
+Metrics: ``mc_pool_hit`` / ``mc_pool_miss`` counters and the
+``mc_pool_bytes`` cached-bytes gauge (component ``mc``) when
+``UCC_STATS`` is on; :meth:`HostMemPool.stats` exposes the same numbers
+unconditionally so benchmarks and allocation-regression tests need no
+stats file.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..obs import metrics
+from ..utils.config import (Config, ConfigField, ConfigTable, parse_bool,
+                            parse_memunits, parse_uint, register_table)
+
+MC_POOL_CONFIG = register_table(ConfigTable(
+    prefix="MC_POOL_", name="mc/pool", fields=[
+        ConfigField("ENABLE", "y", "size-classed scratch mpool for host "
+                    "collectives (reference ucc_mc_cpu mpool); off = every "
+                    "scratch lease is a direct allocation. UCC_MC_POOL=n "
+                    "is an accepted shorthand", parse_bool),
+        ConfigField("MAX_ELEM_SIZE", "64M", "largest pooled bucket; bigger "
+                    "requests bypass the pool (never cached)",
+                    parse_memunits),
+        ConfigField("MAX_ELEMS", "8", "free-list cap per size class "
+                    "(reference MPOOL_MAX_ELEMS)", parse_uint),
+        ConfigField("MAX_BYTES", "256M", "total cached-bytes cap across "
+                    "all size classes", parse_memunits),
+    ]))
+
+#: buckets never go below this (keeps the class table small and lets a
+#: tiny follow-up request reuse a prior tiny lease)
+_MIN_BUCKET = 64
+
+
+class HostMemPool:
+    """Size-classed free-list pool of raw ``uint8`` arrays.
+
+    ``get(nbytes)`` returns an array whose capacity is the smallest
+    power-of-two bucket >= nbytes; ``put`` must receive that same
+    array (not a view) and files it back on its class free list.
+    """
+
+    def __init__(self, enable: bool = True,
+                 max_elem_size: int = 64 << 20,
+                 max_elems: int = 8,
+                 max_bytes: int = 256 << 20):
+        self.enable = enable
+        self.max_elem_size = int(max_elem_size)
+        self.max_elems = int(max_elems)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._classes: Dict[int, List[np.ndarray]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.cached_bytes = 0
+        self.leased = 0          # live leases (get - put), diagnostic only
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _bucket(nbytes: int) -> int:
+        return max(_MIN_BUCKET, 1 << max(0, int(nbytes - 1).bit_length()))
+
+    def get(self, nbytes: int) -> np.ndarray:
+        nbytes = max(1, int(nbytes))
+        buf = None
+        hit = False
+        # admission is by BUCKET capacity, matching put(): a request whose
+        # bucket rounds past max_elem_size must go direct, or every lease
+        # in (bucket/2, max_elem_size] would miss forever (get would hand
+        # out a bucket put() refuses to cache)
+        cap = self._bucket(nbytes)
+        if self.enable and cap <= self.max_elem_size:
+            with self._lock:
+                lst = self._classes.get(cap)
+                if lst:
+                    buf = lst.pop()
+                    self.cached_bytes -= cap
+                    self.hits += 1
+                    hit = True
+                else:
+                    self.misses += 1
+                self.leased += 1
+            if buf is None:
+                buf = np.empty(cap, dtype=np.uint8)
+        else:
+            with self._lock:
+                self.misses += 1
+                self.leased += 1
+            buf = np.empty(nbytes, dtype=np.uint8)
+        if metrics.ENABLED:
+            metrics.inc("mc_pool_hit" if hit else "mc_pool_miss",
+                        component="mc")
+        return buf
+
+    def put(self, buf: np.ndarray) -> None:
+        cap = int(buf.nbytes)
+        with self._lock:
+            self.leased = max(0, self.leased - 1)
+            if (self.enable and cap <= self.max_elem_size and
+                    cap == self._bucket(cap)):
+                lst = self._classes.setdefault(cap, [])
+                if (len(lst) < self.max_elems and
+                        self.cached_bytes + cap <= self.max_bytes):
+                    lst.append(buf)
+                    self.cached_bytes += cap
+        if metrics.ENABLED:
+            metrics.gauge("mc_pool_bytes", self.cached_bytes, component="mc")
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "cached_bytes": self.cached_bytes,
+                    "cached_elems": sum(len(v)
+                                        for v in self._classes.values()),
+                    "leased": self.leased}
+
+    def trim(self) -> None:
+        """Drop every cached free-list element (tests / memory pressure)."""
+        with self._lock:
+            self._classes.clear()
+            self.cached_bytes = 0
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self.hits = 0
+            self.misses = 0
+
+
+class ScratchLease:
+    """A task's set of pool-leased scratch buffers, keyed by call site.
+
+    ``get(key, shape, dtype)`` returns a typed view of a leased buffer;
+    the same key on a later call (persistent re-post, pipelined fragment
+    restart) reuses the lease in place when its capacity still fits —
+    zero pool traffic, zero allocation. ``release()`` files every buffer
+    back to the pool (idempotent); the owning task calls it from
+    ``finalize_fn`` so lease lifetime == task lifetime.
+    """
+
+    __slots__ = ("_pool", "_bufs")
+
+    def __init__(self, pool: HostMemPool):
+        self._pool = pool
+        self._bufs: Dict[Any, np.ndarray] = {}
+
+    def get(self, key: Any, shape, dtype) -> np.ndarray:
+        nd = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+        if isinstance(shape, (int, np.integer)):
+            shape = (int(shape),)
+        count = 1
+        for s in shape:
+            count *= int(s)
+        nbytes = count * nd.itemsize
+        buf = self._bufs.get(key)
+        if buf is None or buf.nbytes < nbytes:
+            if buf is not None:
+                self._pool.put(buf)
+            buf = self._bufs[key] = self._pool.get(nbytes)
+        return buf[:nbytes].view(nd).reshape(shape)
+
+    def release(self) -> None:
+        bufs, self._bufs = self._bufs, {}
+        for buf in bufs.values():
+            self._pool.put(buf)
+
+    def __len__(self) -> int:
+        return len(self._bufs)
+
+
+# ---------------------------------------------------------------------------
+# process-global pool (the MC/CPU component instance)
+# ---------------------------------------------------------------------------
+
+_global_pool: Optional[HostMemPool] = None
+_global_lock = threading.Lock()
+
+
+def _pool_from_env() -> HostMemPool:
+    cfg = Config(MC_POOL_CONFIG)
+    enable = bool(cfg.enable)
+    shorthand = os.environ.get("UCC_MC_POOL", "").strip().lower()
+    if shorthand:
+        enable = shorthand not in ("0", "n", "no", "off", "false")
+    return HostMemPool(enable=enable,
+                       max_elem_size=cfg.max_elem_size,
+                       max_elems=cfg.max_elems,
+                       max_bytes=cfg.max_bytes)
+
+
+def host_pool() -> HostMemPool:
+    """The process-global host scratch pool (lazy, env-configured)."""
+    global _global_pool
+    pool = _global_pool
+    if pool is None:
+        with _global_lock:
+            pool = _global_pool
+            if pool is None:
+                pool = _global_pool = _pool_from_env()
+    return pool
+
+
+def reset_host_pool(pool: Optional[HostMemPool] = None) -> None:
+    """Swap/clear the global pool (tests; embedders with custom caps)."""
+    global _global_pool
+    with _global_lock:
+        _global_pool = pool

@@ -146,13 +146,19 @@ def reset() -> None:
 # runtime enable/disable (tests and embedders; env is read at import)
 # ---------------------------------------------------------------------------
 
-def enable(file: Optional[str] = None, interval: float = 0.0) -> None:
+def enable(file: Optional[str] = None,
+           interval: Optional[float] = None) -> None:
+    """Runtime enable (embedders/tests). Same contract as UCC_STATS=y:
+    the at-exit dump is armed (it self-guards on ENABLED, so a later
+    ``disable()`` suppresses it), and an env-configured interval is kept
+    unless explicitly overridden."""
     global ENABLED, _file, _interval
     ENABLED = True
     if file is not None:
         _file = file
-    _interval = interval
-    _start_background(dump_at_exit=False)
+    if interval is not None:
+        _interval = interval
+    _start_background()
 
 
 def disable() -> None:

@@ -106,7 +106,16 @@ def parse_pipeline_params(s: str) -> PipelineParams:
 
 class PipelinedSchedule(Schedule):
     """See module docstring. ``frag_init(sched, idx) -> Schedule`` builds a
-    window entry; ``frag_setup(sched, frag, frag_num)`` retargets it."""
+    window entry; ``frag_setup(sched, frag, frag_num)`` retargets it.
+
+    Memory: window entries are built ONCE and re-posted for every
+    fragment they serve, so a TL task's pool-leased scratch
+    (``HostCollTask.scratch``) survives retargeting — one fragment
+    scratch set serves the whole window instead of each fragment
+    allocating its own (fragments are near-equal splits, so the first
+    lease's capacity fits every later fragment). Leases return to the
+    mpool when this schedule is finalized (``finalize_fn`` -> frag ->
+    task)."""
 
     MAX_FRAGS = 4  # window size cap, ucc_schedule_pipelined.h:13
 

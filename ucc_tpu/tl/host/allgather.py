@@ -44,7 +44,7 @@ class AllgatherBruck(HostCollTask):
         blk = total // size
         nd = dt_numpy(args.dst.datatype)
         dst = binfo_typed(args.dst, total)
-        work = np.empty(total, dtype=nd)
+        work = self.scratch("work", total, nd)
         if args.is_inplace:
             work[0:blk] = dst[me * blk:(me + 1) * blk]
         else:
@@ -124,13 +124,17 @@ class AllgatherNeighbor(HostCollTask):
         if size == 1:
             return
         neighbor, sent, recv = self._schedule(size)
+        # every round moves at most 2 blocks per direction; one leased
+        # buffer pair serves all rounds
+        rbuf_all = self.scratch("rbuf", 2 * blk, dst.dtype)
         for i in range(size // 2):
             peer = neighbor(me, i)
             sblocks = sent[me][i]
             rblocks = recv[me][i]
-            sbuf = np.concatenate([bview(b) for b in sblocks]) \
-                if len(sblocks) > 1 else bview(sblocks[0])
-            rbuf = np.empty(len(rblocks) * blk, dtype=dst.dtype)
+            sbuf = self.pack("sbuf", [bview(b) for b in sblocks],
+                             dst.dtype) if len(sblocks) > 1 else \
+                bview(sblocks[0])
+            rbuf = rbuf_all[:len(rblocks) * blk]
             yield from self.sendrecv(peer, sbuf, peer, rbuf, slot=120 + i)
             for n_, b in enumerate(rblocks):
                 bview(b)[:] = rbuf[n_ * blk:(n_ + 1) * blk]
@@ -299,7 +303,7 @@ class _KnomialAllgatherBase(HostCollTask):
         n_extra = size - full
 
         my_cnt = counts[me]
-        my_src = np.empty(my_cnt, dtype=nd)
+        my_src = self.scratch("my_src", my_cnt, nd)
         if args.is_inplace:
             from ..base import binfo_v_block
             if hasattr(args.dst, "counts"):
@@ -322,7 +326,7 @@ class _KnomialAllgatherBase(HostCollTask):
                     for v in range(full)]
         v_offsets = list(np.cumsum([0] + v_counts))
         total_v = v_offsets[-1]
-        scratch = np.empty(total_v, dtype=nd)
+        scratch = self.scratch("vspace", total_v, nd)
 
         if is_extra:
             yield from self.wait(self.send_nb(proxy, my_src, slot=150))
@@ -333,7 +337,7 @@ class _KnomialAllgatherBase(HostCollTask):
         seg_lo = v_offsets[me]
         scratch[seg_lo:seg_lo + my_cnt] = my_src
         if me < n_extra:
-            ex = np.empty(counts[full + me], dtype=nd)
+            ex = self.scratch("extra", counts[full + me], nd)
             yield from self.wait(self.recv_nb(full + me, ex, slot=150))
             scratch[seg_lo + my_cnt:seg_lo + v_counts[me]] = ex
 

@@ -99,7 +99,9 @@ class AlltoallPairwise(HostCollTask):
         blk = total // size
         src = binfo_typed(args.src if not args.is_inplace else args.dst, total)
         if args.is_inplace:
-            src = src.copy()
+            staged = self.scratch("staged", total, src.dtype)
+            staged[:] = src
+            src = staged
         dst = binfo_typed(args.dst, total)
         dst[me * blk:(me + 1) * blk] = src[me * blk:(me + 1) * blk]
         reqs: List = []
@@ -135,14 +137,14 @@ class AlltoallBruck(HostCollTask):
         src = binfo_typed(args.src if not args.is_inplace else args.dst, total)
         dst = binfo_typed(args.dst, total)
         # phase 0: local rotation - work[i] = block for rank (me + i) % size
-        work = np.empty(total, dtype=nd)
+        work = self.scratch("work", total, nd)
         for i in range(size):
             peer = (me + i) % size
             work[i * blk:(i + 1) * blk] = src[peer * blk:(peer + 1) * blk]
         # phase 1: log2 rounds
         k = 1
         rnd = 0
-        tmp = np.empty(total, dtype=nd)
+        tmp = self.scratch("tmp", total, nd)
         while k < size:
             # blocks whose bit-k is set travel this round (any team size,
             # ceil(log2 N) rounds). Invariant: work[i] at rank r holds data
@@ -151,8 +153,9 @@ class AlltoallBruck(HostCollTask):
             idxs = [i for i in range(size) if (i // k) % 2 == 1]
             send_to = (me + k) % size
             recv_from = (me - k) % size
-            sbuf = np.concatenate([work[i * blk:(i + 1) * blk] for i in idxs]) \
-                if idxs else np.empty(0, dtype=nd)
+            sbuf = self.pack("sbuf",
+                             [work[i * blk:(i + 1) * blk] for i in idxs],
+                             nd)
             rbuf = tmp[:sbuf.size]
             yield from self.sendrecv(send_to, sbuf, recv_from, rbuf,
                                      slot=84 + rnd)
@@ -181,8 +184,10 @@ class AlltoallvPairwise(HostCollTask):
         srcv: BufferInfoV = args.src
         dstv: BufferInfoV = args.dst
         if args.is_inplace:
-            # in-place alltoallv: stage through a copy of dst
-            staged = binfo_typed(dstv).copy()
+            # in-place alltoallv: stage through a leased copy of dst
+            view = binfo_typed(dstv)
+            staged = self.scratch("staged", view.size, view.dtype)
+            staged[:] = view
 
             def sblock(p):
                 c = int(dstv.counts[p])
@@ -308,23 +313,22 @@ class AlltoallvHybrid(HostCollTask):
                     if (((t[1] - me) % size) >> k) & 1]
             pending = [t for t in pending
                        if not (((t[1] - me) % size) >> k) & 1]
-            meta = np.empty(1 + 3 * len(ship), dtype=np.int64)
+            meta = self.scratch("meta", 1 + 3 * len(ship), np.int64)
             meta[0] = len(ship)
-            payloads = []
             for i, (orig, dest, data) in enumerate(ship):
                 meta[1 + 3 * i:4 + 3 * i] = (orig, dest, data.size)
-                payloads.append(data)
-            payload = np.concatenate(payloads) if payloads else \
-                np.empty(0, dtype=nd)
+            payload = self.pack("payload", [d for _, _, d in ship], nd)
             # metadata first (bounded recv + nbytes), then exact payload
-            meta_recv = np.empty(1 + 3 * size * size, dtype=np.int64)
+            meta_recv = self.scratch("meta_recv", 1 + 3 * size * size,
+                                     np.int64)
             sreq_m = self.send_nb(to, meta, slot=241 + 2 * k)
             rreq_m = self.recv_nb(frm, meta_recv, slot=241 + 2 * k)
             sreq_p = self.send_nb(to, payload, slot=242 + 2 * k)
             yield from self.wait(sreq_m, rreq_m)
             m = int(meta_recv[0])
             in_total = int(sum(meta_recv[3 + 3 * i] for i in range(m)))
-            payload_in = np.empty(in_total, dtype=nd)
+            payload_in = self.scratch("payload_in", max(1, in_total),
+                                      nd)[:in_total]
             rreq_p = self.recv_nb(frm, payload_in, slot=242 + 2 * k)
             yield from self.wait(sreq_p, rreq_p)
             off = 0

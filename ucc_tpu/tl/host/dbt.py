@@ -16,8 +16,6 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
-import numpy as np
-
 from ...constants import ReductionOp, dt_numpy
 from ...ec.cpu import reduce_arrays
 from ..base import binfo_typed
@@ -149,7 +147,7 @@ class ReduceDbt(_DbtBase):
             if not args.is_inplace:
                 dst[:] = binfo_typed(args.src, self.count)
             recvs = []
-            scratch = np.empty(self.count, dtype=nd)
+            scratch = self.scratch("root", self.count, nd)
             for t, (rootv, _, _) in enumerate(self.trees):
                 lo, hi = self.halves[t]
                 if hi > lo and rootv is not None:
@@ -159,15 +157,17 @@ class ReduceDbt(_DbtBase):
             yield from self.wait(*[r for _, r in recvs])
             for t, _ in recvs:
                 lo, hi = self.halves[t]
-                dst[lo:hi] = reduce_arrays([dst[lo:hi], scratch[lo:hi]],
-                                           red_op, self.dt)
+                acc = dst[lo:hi]
+                reduce_arrays([acc, scratch[lo:hi]], red_op, self.dt,
+                              out=acc)
             if op == ReductionOp.AVG:
                 dst[:] = reduce_arrays([dst], ReductionOp.SUM, self.dt,
                                        alpha=1.0 / p)
             return
         v = self.v_of(me)
         src = binfo_typed(args.src, self.count)
-        acc = src.copy()
+        acc = self.scratch("acc", self.count, nd)
+        acc[:] = src
         # post BOTH trees' child receives up front so the two half-message
         # pipelines overlap (the point of DBT), then drain each as it lands
         pending = {}
@@ -176,8 +176,8 @@ class ReduceDbt(_DbtBase):
             if hi <= lo:
                 continue
             kids = children.get(v, [])
-            kid_buf = np.empty((len(kids), hi - lo), dtype=nd) if kids \
-                else None
+            kid_buf = self.scratch(("kids", t), (len(kids), hi - lo), nd) \
+                if kids else None
             reqs = [self.recv_nb(self.rank_of(c), kid_buf[i], slot=150 + t)
                     for i, c in enumerate(kids)]
             pending[t] = (reqs, kid_buf, kids)
@@ -190,10 +190,10 @@ class ReduceDbt(_DbtBase):
                 rootv, parent, _ = self.trees[t]
                 lo, hi = self.halves[t]
                 if kids:
-                    acc[lo:hi] = reduce_arrays(
-                        [acc[lo:hi]] + [kid_buf[i]
-                                        for i in range(len(kids))],
-                        red_op, self.dt)
+                    seg = acc[lo:hi]
+                    reduce_arrays(
+                        [seg] + [kid_buf[i] for i in range(len(kids))],
+                        red_op, self.dt, out=seg)
                 up = self.root if v == rootv else self.rank_of(parent[v])
                 yield from self.wait(self.send_nb(up, acc[lo:hi],
                                                   slot=150 + t))
@@ -241,10 +241,10 @@ class AllreduceDbt(_DbtBase):
             if me == 0:                       # virtual root
                 if rootv is not None:
                     tr = self.rank_of(rootv)
-                    buf = np.empty(hi - lo, dtype=nd)
+                    buf = self.scratch(("up", t), hi - lo, nd)
                     rreq = self.recv_nb(tr, buf, slot=slot_up)
                     yield from self.wait(rreq)
-                    half[:] = reduce_arrays([half, buf], red_op, self.dt)
+                    reduce_arrays([half, buf], red_op, self.dt, out=half)
                 if op == ReductionOp.AVG:
                     half[:] = reduce_arrays([half], ReductionOp.SUM,
                                             self.dt, alpha=1.0 / n)
@@ -256,12 +256,14 @@ class AllreduceDbt(_DbtBase):
             v = self.v_of(me)
             # up: accumulate children's halves, forward to parent/root
             kids = children.get(v, [])
-            bufs = [np.empty(hi - lo, dtype=nd) for _ in kids]
+            kid_rows = self.scratch(("kids", t), (len(kids), hi - lo), nd) \
+                if kids else None
+            bufs = [kid_rows[i] for i in range(len(kids))]
             rreqs = [self.recv_nb(self.rank_of(c), b, slot=slot_up)
                      for c, b in zip(kids, bufs)]
             yield from self.wait(*rreqs)
             if bufs:
-                half[:] = reduce_arrays([half] + bufs, red_op, self.dt)
+                reduce_arrays([half] + bufs, red_op, self.dt, out=half)
             up_to = 0 if v == rootv else self.rank_of(parent[v])
             sreq = self.send_nb(up_to, half, slot=slot_up)
             yield from self.wait(sreq)

@@ -102,17 +102,18 @@ class AllreduceKnomial(HostCollTask):
             return
         my_extras = list(range(me + full, size, full))
         if my_extras:
-            extra_buf = np.empty((len(my_extras), self.count), dtype=nd)
+            extra_buf = self.scratch("extra", (len(my_extras), self.count),
+                                     nd)
             reqs = [self.recv_nb(x, extra_buf[i], slot=1000 + x // full)
                     for i, x in enumerate(my_extras)]
             yield from self.wait(*reqs)
-            dst[:] = reduce_arrays([dst] + [extra_buf[i] for i in
-                                            range(len(my_extras))],
-                                   self.op_no_avg(), self.dt)
+            reduce_arrays([dst] + [extra_buf[i] for i in
+                                   range(len(my_extras))],
+                          self.op_no_avg(), self.dt, out=dst)
 
         # LOOP: radix-r exchange over the full-tree ranks
         n_rounds = int(round(math.log(full, r)))
-        scratch = np.empty((r - 1, self.count), dtype=nd)
+        scratch = self.scratch("loop", (r - 1, self.count), nd)
         dist = 1
         for rnd in range(n_rounds):
             span = dist * r
@@ -125,9 +126,8 @@ class AllreduceKnomial(HostCollTask):
                 reqs.append(self.recv_nb(p, scratch[i], slot=2 + rnd))
                 reqs.append(self.send_nb(p, dst, slot=2 + rnd))
             yield from self.wait(*reqs)
-            dst[:] = reduce_arrays([dst] + [scratch[i]
-                                            for i in range(r - 1)],
-                                   self.op_no_avg(), self.dt)
+            reduce_arrays([dst] + [scratch[i] for i in range(r - 1)],
+                          self.op_no_avg(), self.dt, out=dst)
             dist *= r
 
         if self.op == ReductionOp.AVG:
@@ -225,7 +225,8 @@ class ReduceKnomial(HostCollTask):
             if not args.is_inplace:
                 acc[:] = binfo_typed(args.src, self.count)
         else:
-            acc = binfo_typed(args.src, self.count).copy()
+            acc = self.scratch("acc", self.count, nd)
+            acc[:] = binfo_typed(args.src, self.count)
         if size == 1:
             if self.op == ReductionOp.AVG:
                 acc[:] = reduce_arrays([acc], ReductionOp.SUM, self.dt,
@@ -235,7 +236,7 @@ class ReduceKnomial(HostCollTask):
         v = (me - self.root) % size
         k = knomial_height(size, self.radix)
         r = self.radix
-        recv_buf = np.empty((r - 1, self.count), dtype=nd)
+        recv_buf = self.scratch("recv", (r - 1, self.count), nd)
         for i in range(k):
             dist = r ** i
             if v % (dist * r) == 0:
@@ -248,9 +249,9 @@ class ReduceKnomial(HostCollTask):
                                          slot=20 + i)
                             for n, c in enumerate(children)]
                     yield from self.wait(*reqs)
-                    acc[:] = reduce_arrays(
+                    reduce_arrays(
                         [acc] + [recv_buf[n] for n in range(len(children))],
-                        op, self.dt)
+                        op, self.dt, out=acc)
             elif v % dist == 0:
                 parent = v - ((v // dist) % r) * dist
                 yield from self.wait(
@@ -277,8 +278,8 @@ class BarrierKnomial(HostCollTask):
         size, me, r = self.gsize, self.grank, self.radix
         if size == 1:
             return
-        tok = _TOKEN.copy()
-        sink = np.empty(1, dtype=np.uint8)
+        tok = _TOKEN
+        sink = self.scratch("sink", 1, np.uint8)
         dist = 1
         rnd = 0
         while dist < size:
@@ -309,7 +310,7 @@ class FaninKnomial(ReduceKnomial):
             return
         v = (me - self.root) % size
         k = knomial_height(size, r)
-        sink = np.empty(1, dtype=np.uint8)
+        sink = self.scratch("sink", 1, np.uint8)
         for i in range(k):
             dist = r ** i
             if v % (dist * r) == 0:

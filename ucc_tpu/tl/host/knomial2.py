@@ -17,8 +17,6 @@ from __future__ import annotations
 
 from typing import List
 
-import numpy as np
-
 from ...constants import ReductionOp, dt_numpy
 from ...ec.cpu import reduce_arrays
 from ...status import Status, UccError
@@ -60,7 +58,7 @@ class BcastSagKnomial(HostCollTask):
             # receive my whole range from parent in one message
             nbytes_range = sum(block_count(count, size, i)
                                for i in range(v, reach))
-            rng = np.empty(nbytes_range, dtype=buf.dtype)
+            rng = self.scratch("rng", nbytes_range, buf.dtype)
             yield from self.wait(self.recv_nb((parent + root) % size, rng,
                                               slot=160))
             off = 0
@@ -80,7 +78,8 @@ class BcastSagKnomial(HostCollTask):
             if child < reach:
                 crange = (child, min(child + step, reach))
                 parts = [blk(i) for i in range(*crange)]
-                payload = np.concatenate(parts) if len(parts) > 1 else parts[0]
+                payload = self.pack("fwd", parts, buf.dtype) \
+                    if len(parts) > 1 else parts[0]
                 yield from self.wait(self.send_nb((child + root) % size,
                                                   payload, slot=160))
                 reach = child
@@ -114,12 +113,13 @@ class ReduceScatterKnomial(HostCollTask):
         dt = (args.src or args.dst).datatype
         nd = dt_numpy(dt)
         total = self.total
+        work = self.scratch("work", total, nd)
         if args.is_inplace:
-            work = binfo_typed(args.dst, total).copy()
+            work[:] = binfo_typed(args.dst, total)
             out = binfo_typed(args.dst, total)[me * (total // size):
                                                (me + 1) * (total // size)]
         else:
-            work = binfo_typed(args.src, total).copy()
+            work[:] = binfo_typed(args.src, total)
             out = binfo_typed(args.dst, total // size)
         if size == 1:
             res = work
@@ -129,7 +129,7 @@ class ReduceScatterKnomial(HostCollTask):
             return
         lo, hi = 0, total
         dist = size // 2
-        scratch = np.empty(total // 2, dtype=nd)
+        scratch = self.scratch("halving", total // 2, nd)
         rnd = 0
         while dist >= 1:
             partner = me ^ dist
@@ -140,7 +140,7 @@ class ReduceScatterKnomial(HostCollTask):
             yield from self.sendrecv(partner, work[give[0]:give[1]],
                                      partner, rview, slot=170 + rnd)
             seg = work[keep[0]:keep[1]]
-            seg[:] = reduce_arrays([seg, rview], red_op, dt)
+            reduce_arrays([seg, rview], red_op, dt, out=seg)
             lo, hi = keep
             dist //= 2
             rnd += 1
@@ -179,7 +179,7 @@ class GatherKnomial(HostCollTask):
         nd = dt_numpy((args.src or args.dst).datatype)
         v = (me - root) % size
         span = _binomial_span(v, size)
-        agg = np.empty(span * per, dtype=nd)
+        agg = self.scratch("agg", span * per, nd)
         if args.src is not None and args.src.buffer is not None:
             agg[:per] = binfo_typed(args.src, per)
         elif v == 0 and args.is_inplace:
@@ -218,7 +218,7 @@ class ScatterKnomial(HostCollTask):
         nd = dt_numpy((args.src or args.dst).datatype)
         v = (me - root) % size
         span = _binomial_span(v, size)
-        agg = np.empty(span * per, dtype=nd)
+        agg = self.scratch("agg", span * per, nd)
         if v == 0:
             src = binfo_typed(args.src, per * size)
             for i in range(size):

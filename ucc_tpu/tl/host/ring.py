@@ -90,16 +90,18 @@ class ReduceScatterRing(HostCollTask):
         size, me = self.gsize, self.grank
         op = args.op if args.op is not None else ReductionOp.SUM
         red_op = ReductionOp.SUM if op == ReductionOp.AVG else op
+        dt = (args.src or args.dst).datatype
+        nd = dt_numpy(dt)
         if args.is_inplace:
             total = int(args.dst.count)
-            work = binfo_typed(args.dst, total).copy()
+            work = self.scratch("work", total, nd)
+            work[:] = binfo_typed(args.dst, total)
             out_block = _blk_view(binfo_typed(args.dst, total), total, size, me)
         else:
             total = int(args.src.count)
-            work = binfo_typed(args.src, total).copy()
+            work = self.scratch("work", total, nd)
+            work[:] = binfo_typed(args.src, total)
             out_block = binfo_typed(args.dst, block_count(total, size, me))
-        dt = (args.src or args.dst).datatype
-        nd = dt_numpy(dt)
         if size == 1:
             res = work
             if op == ReductionOp.AVG:
@@ -109,7 +111,7 @@ class ReduceScatterRing(HostCollTask):
         right = (me + 1) % size
         left = (me - 1) % size
         max_blk = max(block_count(total, size, b) for b in range(size))
-        recv_buf = np.empty(max_blk, dtype=nd)
+        recv_buf = self.scratch("recv", max_blk, nd)
         for step in range(size - 1):
             sb = (me - 1 - step) % size
             rb = (me - 2 - step) % size
@@ -118,7 +120,7 @@ class ReduceScatterRing(HostCollTask):
             yield from self.sendrecv(right, sview, left, rview,
                                      slot=64 + step)
             acc = _blk_view(work, total, size, rb)
-            acc[:] = reduce_arrays([acc, rview], red_op, dt)
+            reduce_arrays([acc, rview], red_op, dt, out=acc)
         mine = _blk_view(work, total, size, me)
         if op == ReductionOp.AVG:
             mine = reduce_arrays([mine], ReductionOp.SUM, dt, alpha=1.0 / size)
@@ -142,15 +144,16 @@ class ReduceScattervRing(HostCollTask):
         else:
             displs = list(np.cumsum([0] + counts[:-1]))
         total = max(d + c for d, c in zip(displs, counts)) if counts else 0
-        if args.is_inplace:
-            work = binfo_typed(dstv, total).copy()
-            out_block = binfo_typed(dstv, counts[me], displs[me])
-        else:
-            work = binfo_typed(args.src, total).copy()
-            # non-inplace: dst holds only my block
-            out_block = binfo_typed(dstv, counts[me], 0)
         dt = (args.src or dstv).datatype
         nd = dt_numpy(dt)
+        work = self.scratch("work", max(1, total), nd)[:total]
+        if args.is_inplace:
+            work[:] = binfo_typed(dstv, total)
+            out_block = binfo_typed(dstv, counts[me], displs[me])
+        else:
+            work[:] = binfo_typed(args.src, total)
+            # non-inplace: dst holds only my block
+            out_block = binfo_typed(dstv, counts[me], 0)
 
         def blk(arr, b):
             return arr[displs[b]:displs[b] + counts[b]]
@@ -163,7 +166,7 @@ class ReduceScattervRing(HostCollTask):
             return
         right = (me + 1) % size
         left = (me - 1) % size
-        recv_buf = np.empty(max(counts) if counts else 0, dtype=nd)
+        recv_buf = self.scratch("recv", max(counts) if counts else 1, nd)
         for step in range(size - 1):
             sb = (me - 1 - step) % size
             rb = (me - 2 - step) % size
@@ -171,7 +174,7 @@ class ReduceScattervRing(HostCollTask):
             yield from self.sendrecv(right, blk(work, sb), left, rview,
                                      slot=66 + step)
             acc = blk(work, rb)
-            acc[:] = reduce_arrays([acc, rview], red_op, dt)
+            reduce_arrays([acc, rview], red_op, dt, out=acc)
         mine = blk(work, me)
         if op == ReductionOp.AVG:
             mine = reduce_arrays([mine], ReductionOp.SUM, dt, alpha=1.0 / size)
@@ -201,7 +204,7 @@ class AllreduceRing(_TopoOrderedRingTask):
         right = (me + 1) % size
         left = (me - 1) % size
         max_blk = max(block_count(total, size, b) for b in range(size))
-        recv_buf = np.empty(max_blk, dtype=nd)
+        recv_buf = self.scratch("recv", max_blk, nd)
         # phase 1: reduce-scatter
         for step in range(size - 1):
             sb = (me - 1 - step) % size
@@ -210,7 +213,7 @@ class AllreduceRing(_TopoOrderedRingTask):
             yield from self.sendrecv(right, _blk_view(dst, total, size, sb),
                                      left, rview, slot=70 + step)
             acc = _blk_view(dst, total, size, rb)
-            acc[:] = reduce_arrays([acc, rview], red_op, dt)
+            reduce_arrays([acc, rview], red_op, dt, out=acc)
         if op == ReductionOp.AVG:
             mine = _blk_view(dst, total, size, me)
             mine[:] = reduce_arrays([mine], ReductionOp.SUM, dt,
@@ -242,17 +245,19 @@ class ReduceScatterRingBidirectional(HostCollTask):
         size, me = self.gsize, self.grank
         op = args.op if args.op is not None else ReductionOp.SUM
         red_op = ReductionOp.SUM if op == ReductionOp.AVG else op
+        dt = (args.src or args.dst).datatype
+        nd = dt_numpy(dt)
         if args.is_inplace:
             total = int(args.dst.count)
-            work = binfo_typed(args.dst, total).copy()
+            work = self.scratch("work", total, nd)
+            work[:] = binfo_typed(args.dst, total)
             out_block = _blk_view(binfo_typed(args.dst, total), total, size,
                                   me)
         else:
             total = int(args.src.count)
-            work = binfo_typed(args.src, total).copy()
+            work = self.scratch("work", total, nd)
+            work[:] = binfo_typed(args.src, total)
             out_block = binfo_typed(args.dst, block_count(total, size, me))
-        dt = (args.src or args.dst).datatype
-        nd = dt_numpy(dt)
         if size == 1:
             res = work
             if op == ReductionOp.AVG:
@@ -270,8 +275,8 @@ class ReduceScatterRingBidirectional(HostCollTask):
         right = (me + 1) % size
         left = (me - 1) % size
         max_half = max(block_count(total, size, b) for b in range(size))
-        buf_a = np.empty(max_half, dtype=nd)
-        buf_b = np.empty(max_half, dtype=nd)
+        buf_a = self.scratch("buf_a", max_half, nd)
+        buf_b = self.scratch("buf_b", max_half, nd)
         for step in range(size - 1):
             # cw: block indices walk down (classic ring)
             sa = (me - 1 - step) % size
@@ -289,9 +294,9 @@ class ReduceScatterRingBidirectional(HostCollTask):
             ]
             yield from self.wait(*reqs)
             acc_a = sub(ra, 0)
-            acc_a[:] = reduce_arrays([acc_a, va], red_op, dt)
+            reduce_arrays([acc_a, va], red_op, dt, out=acc_a)
             acc_b = sub(rb, 1)
-            acc_b[:] = reduce_arrays([acc_b, vb], red_op, dt)
+            reduce_arrays([acc_b, vb], red_op, dt, out=acc_b)
         mine = _blk_view(work, total, size, me)
         if op == ReductionOp.AVG:
             mine = reduce_arrays([mine], ReductionOp.SUM, dt,

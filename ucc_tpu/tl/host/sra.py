@@ -19,8 +19,6 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-import numpy as np
-
 from ...constants import ReductionOp, dt_numpy
 from ...ec.cpu import reduce_arrays
 from .knomial import clamp_radix, largest_pow
@@ -68,17 +66,21 @@ class _SraBase(HostCollTask):
         size, me = self.gsize, self.grank
         full = self.full
         nd = work.dtype
+        n_extra = max(0, (size - 1 - me) // full)
+        if not n_extra:
+            return
+        bufs = self.scratch("fold", (n_extra, self.count), nd)
         gen = 1
         pending = []
         while gen * full + me < size:
-            buf = np.empty(self.count, dtype=nd)
+            buf = bufs[gen - 1]
             pending.append((buf, self.recv_nb(gen * full + me, buf,
                                               slot=slot_base + gen)))
             gen += 1
         if pending:
             yield from self.wait(*[rq for _, rq in pending])
-            work[:] = reduce_arrays([work] + [b for b, _ in pending],
-                                    op, self.dt)
+            reduce_arrays([work] + [b for b, _ in pending], op, self.dt,
+                          out=work)
 
     def _scatter_reduce(self, work, op, slot_base: int):
         """Radix-r recursive vector splitting; returns my (lo, hi)."""
@@ -86,7 +88,7 @@ class _SraBase(HostCollTask):
         lo, hi = 0, self.count
         # round-0 pieces are the largest: (r-1) peer copies of my part
         max_piece = (self.count + r - 1) // r + 1
-        scratch = np.empty((r - 1, max_piece), dtype=work.dtype)
+        scratch = self.scratch("sr", (r - 1, max_piece), work.dtype)
         dist = full // r
         rnd = 0
         while dist >= 1:
@@ -108,7 +110,7 @@ class _SraBase(HostCollTask):
             yield from self.wait(*reqs)
             seg = work[keep[0]:keep[1]]
             if keep[1] > keep[0]:
-                seg[:] = reduce_arrays([seg] + pieces, op, self.dt)
+                reduce_arrays([seg] + pieces, op, self.dt, out=seg)
             lo, hi = keep
             dist //= r
             rnd += 1
@@ -238,7 +240,7 @@ class ReduceSrgKnomial(_SraBase):
         elif is_root and args.is_inplace:
             work = binfo_typed(args.dst, self.count)
         else:
-            work = np.empty(self.count, dtype=nd)
+            work = self.scratch("work", self.count, nd)
             src_bi = args.dst if args.is_inplace else args.src
             work[:] = binfo_typed(src_bi, self.count)
 

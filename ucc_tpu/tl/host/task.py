@@ -31,6 +31,10 @@ from .transport import RecvReq, SendReq
 class HostCollTask(CollTask):
     """Base for all host-transport collective algorithms."""
 
+    #: conservative default for tasks built without post_fn (tests build
+    #: bare instances via object.__new__): take the instrumented path
+    _instr = True
+
     def __init__(self, init_args, team, subset: Optional[Subset] = None,
                  tag: Optional[int] = None):
         super().__init__(team=team, args=init_args.args if init_args else None)
@@ -42,6 +46,9 @@ class HostCollTask(CollTask):
         self.tag = tag if tag is not None else team.next_coll_tag()
         self._gen = None
         self._slot_counter = 0
+        #: group rank -> context rank, resolved once per peer (the two
+        #: ep-map evals per message were measurable hot-path overhead)
+        self._peer_ctx = {}
         # instance copy shadows the conservative class-True default (see
         # CollTask.data_committed): a freshly-built host task has
         # provably committed nothing
@@ -58,6 +65,13 @@ class HostCollTask(CollTask):
         # host task KNOWS when it first touches the wire, so a failure
         # before that point is provably retryable (runtime fallback)
         self.data_committed = False
+        # bind the per-message instrumentation ONCE per post: when every
+        # observability/fault subsystem is off, send_nb/recv_nb take a
+        # branch-free fast path instead of re-checking four module flags
+        # per message (subsystems enabled mid-collective take effect at
+        # the next post — acceptable for diagnostics)
+        self._instr = (metrics.ENABLED or profiling.ENABLED or
+                       watchdog.ENABLED or fault.ENABLED)
         self._gen = self.run()
         self._advance()
         return Status.OK
@@ -105,26 +119,96 @@ class HostCollTask(CollTask):
                 # surface algorithm finally-block errors; cancel is
                 # best-effort teardown
                 pass
+        self._cancel_tracked()
+
+    def _cancel_tracked(self, recv_only: bool = False) -> None:
+        """Cancel tracked outstanding requests (``_obs_reqs``) and clear
+        the window. ``recv_only`` limits it to still-posted recvs — the
+        finalize-time pool-recycle guard; ``cancel_fn`` cancels all."""
         reqs = self.__dict__.get("_obs_reqs")
-        if reqs:
-            for _kind, _peer, _slot, req in reqs:
-                c = getattr(req, "cancel", None)
-                if c is not None:
-                    try:
-                        c()
-                    except Exception:  # noqa: BLE001
-                        pass
-            reqs.clear()
+        if not reqs:
+            return
+        for kind, _peer, _slot, req in reqs:
+            if recv_only and (kind != "recv" or req.test()):
+                continue
+            c = getattr(req, "cancel", None)
+            if c is not None:
+                try:
+                    c()
+                except Exception:  # noqa: BLE001
+                    pass
+        reqs.clear()
 
     def reset(self) -> None:
+        # taint check MUST precede super().reset(), which clears the
+        # status fields it reads: an errored post may have parked
+        # zero-copy sends referencing the lease in peers' unexpected
+        # queues, so finalize must drop the buffers instead of recycling
+        # them through the pool (see finalize_fn)
+        if self.super_status.is_error or self.status.is_error:
+            self._lease_tainted = True
         super().reset()
         self._gen = None
         # persistent re-post uses a fresh team-wide tag (the reference bumps
         # task seq_num per post). Tuple tags (active-set / service) stay
         # fixed: they are outside the team seq space and per-key FIFO
-        # matching keeps successive posts ordered.
+        # matching keeps successive posts ordered. The scratch lease is
+        # deliberately NOT reset: re-posts reuse the same leased buffers
+        # (zero allocations in the steady state).
         if isinstance(self.tag, int):
             self.tag = self.tl_team.next_coll_tag()
+
+    # ------------------------------------------------------------------
+    # scratch leasing (mc mpool; task-lifetime return)
+    def scratch(self, key, shape, dtype) -> np.ndarray:
+        """Lease a typed scratch array from the host mpool, keyed by call
+        site. The same key on a later post (persistent re-post, pipelined
+        fragment restart) returns the SAME buffer when its capacity still
+        fits — replacing the per-post ``np.empty`` the host algorithms
+        used to pay. Returned views are only valid until ``finalize``.
+        """
+        lease = self.__dict__.get("_lease")
+        if lease is None:
+            from ...mc.pool import ScratchLease, host_pool
+            lease = self.__dict__["_lease"] = ScratchLease(host_pool())
+        return lease.get(key, shape, dtype)
+
+    def pack(self, key, parts, dtype) -> np.ndarray:
+        """Concatenate *parts* (1-D typed views) into leased scratch —
+        the allocation-free replacement for ``np.concatenate`` on send
+        payloads. Returns a view sized to the packed total."""
+        total = 0
+        for p in parts:
+            total += p.size
+        buf = self.scratch(key, max(1, total), dtype)[:total]
+        off = 0
+        for p in parts:
+            buf[off:off + p.size] = p
+            off += p.size
+        return buf
+
+    def finalize_fn(self) -> Status:
+        lease = self.__dict__.pop("_lease", None)
+        if lease is not None:
+            # withdraw any still-posted recvs BEFORE the lease returns to
+            # the pool: an errored/cancelled collective can leave recvs
+            # targeting leased scratch, and once the pool recycles those
+            # buffers into another task a late peer send would scribble
+            # into live foreign memory. Recvs are tracked unconditionally
+            # (_obs_reqs), so the withdrawal set is complete.
+            self._cancel_tracked(recv_only=True)
+            # a task that ever ended a post in error may have parked
+            # zero-copy rendezvous SENDS (copied=False views of leased
+            # scratch) in peers' unexpected queues — those cannot be
+            # withdrawn from here, so recycling the buffers through the
+            # pool would let a later collective's writes leak into a late
+            # peer recv. Drop the lease instead (GC reclaims it once the
+            # mailbox entries die); only a cleanly-completed task's
+            # scratch re-enters the pool.
+            if self.super_status == Status.OK and \
+                    not self.__dict__.get("_lease_tainted"):
+                lease.release()
+        return Status.OK
 
     # ------------------------------------------------------------------
     # observability (cold unless the matching env knob is set)
@@ -176,7 +260,27 @@ class HostCollTask(CollTask):
 
     # ------------------------------------------------------------------
     # p2p helpers (group-rank addressed)
+    def _ctx_of(self, peer_grank: int) -> int:
+        """Cached group-rank -> context-rank resolution (ep-map eval
+        chains are pure per team/subset, so one lookup per peer)."""
+        pc = self._peer_ctx
+        ctx = pc.get(peer_grank)
+        if ctx is None:
+            ctx = pc[peer_grank] = self.tl_team._peer_ctx_rank(
+                self.subset, peer_grank)
+        return ctx
+
     def send_nb(self, peer_grank: int, data: np.ndarray, slot: int = 0) -> SendReq:
+        if not self._instr:
+            # cold-hooks fast path: post_fn verified every per-message
+            # subsystem (metrics/profiling/watchdog/fault) is disabled
+            self.data_committed = True
+            return self.tl_team.send_nb_ctx(self._ctx_of(peer_grank),
+                                            self.tag, slot, data)
+        return self._send_nb_instr(peer_grank, data, slot)
+
+    def _send_nb_instr(self, peer_grank: int, data: np.ndarray,
+                       slot: int) -> SendReq:
         if fault.ENABLED:
             req = self._fault_send(peer_grank, data, slot)
             if req is not None:
@@ -229,6 +333,18 @@ class HostCollTask(CollTask):
         return proxy
 
     def recv_nb(self, peer_grank: int, dst: np.ndarray, slot: int = 0) -> RecvReq:
+        if not self._instr:
+            req = self.tl_team.recv_nb_ctx(self._ctx_of(peer_grank),
+                                           self.tag, slot, dst)
+            self.data_committed = True
+            # recvs stay tracked even on the cold path: cancel_fn must be
+            # able to withdraw them from the mailbox (see below)
+            self._obs_track("recv", peer_grank, slot, req)
+            return req
+        return self._recv_nb_instr(peer_grank, dst, slot)
+
+    def _recv_nb_instr(self, peer_grank: int, dst: np.ndarray,
+                       slot: int) -> RecvReq:
         if fault.ENABLED and fault.recv_action(
                 getattr(self.tl_team, "_my_ctx_rank", None)) == "error":
             self._obs_error("fault injected: recv post failed")

@@ -155,6 +155,20 @@ class HostTlTeam(TlTeamBase):
         peer_ctx = self._peer_ctx_rank(subset, peer_grank)
         return self.transport.recv_nb(self._key(coll_tag, slot, peer_ctx), dst)
 
+    # ctx-rank-addressed variants: the hot path (HostCollTask caches the
+    # grank->ctx resolution per peer) skips the two ep-map evals and the
+    # subset indirection every message otherwise pays
+    def send_nb_ctx(self, peer_ctx: int, coll_tag: int, slot: int,
+                    data: np.ndarray):
+        return self.comp_context.send_to(
+            peer_ctx, (self.team_key, coll_tag, slot, self._my_ctx_rank),
+            data)
+
+    def recv_nb_ctx(self, peer_ctx: int, coll_tag: int, slot: int,
+                    dst: np.ndarray):
+        return self.transport.recv_nb(
+            (self.team_key, coll_tag, slot, peer_ctx), dst)
+
     def _ag_large_alg(self) -> str:
         """Topology-aware large-message allgather default
         (ucc_tl_ucp_allgather_score_str_get, allgather.c:55-100): even

@@ -105,24 +105,60 @@ class Mailbox:
         #: key -> deque of RecvReq (posted receives)
         self.posted: Dict[TagKey, deque] = {}
 
+    def _match_posted_locked(self, key: TagKey) -> Optional[RecvReq]:
+        """Pop the first live (non-cancelled) posted recv for *key*.
+        Caller holds self.lock."""
+        rq = self.posted.get(key)
+        while rq:
+            cand = rq.popleft()
+            if not rq:
+                del self.posted[key]
+            if not cand.cancelled:
+                return cand
+        return None
+
     def push(self, key: TagKey, ps: _PendingSend) -> None:
         # delivery happens INSIDE the lock: RecvReq.cancel synchronizes
         # on the same lock, so a recv cannot be cancelled (and its
         # buffer reclaimed) between being matched and being written
         with self.lock:
-            req = None
-            rq = self.posted.get(key)
-            while rq:
-                cand = rq.popleft()
-                if not rq:
-                    del self.posted[key]
-                if not cand.cancelled:
-                    req = cand
-                    break
+            req = self._match_posted_locked(key)
             if req is None:
                 self.unexpected.setdefault(key, deque()).append(ps)
                 return
             _deliver(req, ps)
+
+    def send(self, key: TagKey, data_u8: np.ndarray,
+             eager_limit: int) -> Tuple[SendReq, str]:
+        """Copy-free matching fast path (sender side of ``push``): when a
+        matching recv is already posted, deliver STRAIGHT from the
+        sender's buffer into the posted dst — no eager staging copy at
+        any size, and the send completes immediately (the data has
+        landed, so the sender may reuse its buffer). Only an UNEXPECTED
+        message pays the classic eager copy (<= *eager_limit*) or parks
+        a zero-copy rendezvous view (larger). Returns the send request
+        plus how the message traveled: ``direct`` / ``eager`` /
+        ``rndv``. Same lock discipline as ``push`` — cancel-vs-match
+        cannot interleave. The eager staging copy runs under the lock
+        (the match outcome decides whether a copy is needed at all);
+        it is bounded by *eager_limit* (8K default), so the lock-held
+        window stays small — always-eager mode (limit=inf) trades that
+        for sender-buffer freedom, by explicit configuration."""
+        with self.lock:
+            req = self._match_posted_locked(key)
+            if req is not None:
+                ps = _PendingSend(data_u8, SendReq(), copied=False)
+                _deliver(req, ps)
+                return ps.req, "direct"
+            if data_u8.nbytes <= eager_limit:
+                ps = _PendingSend(data_u8.copy(), SendReq(done=True),
+                                  copied=True)
+                kind = "eager"
+            else:
+                ps = _PendingSend(data_u8, SendReq(), copied=False)
+                kind = "rndv"
+            self.unexpected.setdefault(key, deque()).append(ps)
+            return ps.req, kind
 
     def post_recv(self, key: TagKey, req: RecvReq) -> None:
         with self.lock:
@@ -162,17 +198,63 @@ def _deliver(req: RecvReq, ps: _PendingSend) -> None:
 _SHM_WORLD: Dict[str, "InProcTransport"] = {}
 _SHM_LOCK = threading.Lock()
 
+_DEFAULT_EAGER_LIMIT = 8192
+
+
+def _register_eager_knob():
+    """UCC_HOST_EAGER_LIMIT replaces the hardcoded eager threshold for
+    every host transport endpoint; registered so ucc_info -cf lists it.
+    Per-TL EAGER_THRESH (UCC_TL_SHM_EAGER_THRESH) still overrides when
+    set to a concrete size."""
+    from ...utils.config import (ConfigField, ConfigTable, parse_memunits,
+                                 register_table)
+    return register_table(ConfigTable(
+        prefix="HOST_", name="tl/host-transport", fields=[
+            ConfigField("EAGER_LIMIT", str(_DEFAULT_EAGER_LIMIT),
+                        "eager copy limit for host transports: unexpected "
+                        "sends at or under it are copied-and-completed, "
+                        "larger ones park a zero-copy rendezvous view; "
+                        "sends matching an already-posted recv are always "
+                        "delivered copy-free regardless of size",
+                        parse_memunits),
+        ]))
+
+
+_HOST_TRANSPORT_CONFIG = _register_eager_knob()
+
+
+def eager_limit_from_env() -> int:
+    """Resolve the process eager limit: UCC_HOST_EAGER_LIMIT (memunits,
+    env or UCC_CONFIG_FILE — standard precedence via the config table),
+    else the historical 8K default. ``inf`` means always-eager
+    (unbounded copy threshold, same meaning as the per-TL EAGER_THRESH);
+    only ``auto`` defers to the default."""
+    from ...utils.config import Config, SIZE_AUTO
+    try:
+        v = Config(_HOST_TRANSPORT_CONFIG).eager_limit
+        if v != SIZE_AUTO:
+            return int(v)          # SIZE_INF passes through: always-eager
+    except ValueError:
+        pass
+    return _DEFAULT_EAGER_LIMIT
+
 
 class InProcTransport:
     """One endpoint per core context. Uses the native C++ tag matcher
     (ucc_tpu.native) when built; pure-Python mailbox otherwise."""
 
-    EAGER_THRESHOLD = 8192
+    EAGER_THRESHOLD = _DEFAULT_EAGER_LIMIT
 
     def __init__(self, use_native: Optional[bool] = None,
                  default_native: bool = False):
         self.uid = uuid.uuid4().hex
         self.mailbox = Mailbox()
+        self.EAGER_THRESHOLD = eager_limit_from_env()
+        # data-path accounting (plain ints — cheap enough to keep on
+        # unconditionally; tests and bench read them directly)
+        self.n_direct = 0        # copy-free deliveries into posted recvs
+        self.n_eager = 0         # unexpected sends staged via eager copy
+        self.n_rndv = 0          # unexpected zero-copy rendezvous views
         self.native = None
         if use_native is None:
             import os
@@ -222,14 +304,18 @@ class InProcTransport:
             # matcher only (a mixed pair must not split send/recv across
             # python and native matchers)
             return peer.native.push_native(key, data)
-        data = data.reshape(-1).view(np.uint8)
-        if data.nbytes <= self.EAGER_THRESHOLD:
-            ps = _PendingSend(data.copy(), SendReq(), copied=True)
-            ps.req.done = True        # eager: sender buffer free immediately
+        # copy-free fast path: a send whose recv is already posted lands
+        # directly in the destination buffer — the eager staging copy is
+        # paid only for genuinely unexpected small messages
+        req, kind = peer.mailbox.send(key, data.reshape(-1).view(np.uint8),
+                                      self.EAGER_THRESHOLD)
+        if kind == "direct":
+            self.n_direct += 1
+        elif kind == "eager":
+            self.n_eager += 1
         else:
-            ps = _PendingSend(data, SendReq(), copied=False)
-        peer.mailbox.push(key, ps)
-        return ps.req
+            self.n_rndv += 1
+        return req
 
     def recv_nb(self, key: TagKey, dst: np.ndarray) -> RecvReq:
         if self.native is not None:

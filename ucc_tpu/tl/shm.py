@@ -26,8 +26,11 @@ from .host.transport import InProcTransport
 
 TL_SHM_CONFIG = register_table(ConfigTable(
     prefix="TL_SHM_", name="tl/shm", fields=HOST_ALG_FIELDS + [
-        ConfigField("EAGER_THRESH", "8k", "eager copy threshold; larger "
-                    "sends are zero-copy rendezvous", parse_memunits),
+        ConfigField("EAGER_THRESH", "auto", "eager copy threshold for "
+                    "UNEXPECTED sends; larger sends are zero-copy "
+                    "rendezvous (sends matching a posted recv are always "
+                    "copy-free). auto = defer to UCC_HOST_EAGER_LIMIT "
+                    "(default 8k)", parse_memunits),
     ]))
 
 
@@ -43,7 +46,9 @@ class TlShmContext(BaseContext):
         mt = core_context.lib.params.thread_mode == ThreadMode.MULTIPLE
         self.transport = InProcTransport(default_native=mt)
         if config is not None:
-            self.transport.EAGER_THRESHOLD = config.eager_thresh
+            from ..utils.config import SIZE_AUTO
+            if config.eager_thresh != SIZE_AUTO:
+                self.transport.EAGER_THRESHOLD = config.eager_thresh
         self.executor = EcCpu()
         self.peer_info: Dict[int, tuple] = {}
         self._mailboxes: Dict[int, object] = {}

@@ -57,6 +57,17 @@ OPS = {o.name.lower(): o for o in ReductionOp}
 DTS = {d.name.lower(): d for d in DataType}
 
 
+def lat_stats(lats) -> dict:
+    """avg/min/max plus p50/p99 (microseconds) from second-samples.
+    p99 is linearly interpolated (np.percentile default) — with few
+    iterations it converges to max, which is the honest reading."""
+    a = np.asarray(lats, dtype=np.float64) * 1e6
+    return {"avg_us": float(a.mean()), "min_us": float(a.min()),
+            "max_us": float(a.max()),
+            "p50_us": float(np.percentile(a, 50)),
+            "p99_us": float(np.percentile(a, 99))}
+
+
 def busbw_factor(coll: CollType, n: int) -> float:
     """Bus-bandwidth factors (ucc_pt_benchmark.cc bus bw computation)."""
     if n <= 1:
@@ -231,14 +242,16 @@ def run_op_bench(args) -> int:
             import jax
             jax.block_until_ready(task.array)
 
-    print(f"# ucc_perftest: {args.coll} {args.dtype}"
-          + (f" {args.op}" if args.coll != "memcpy" else "")
-          + f" mem={args.mem} nbufs={nbufs}")
-    hdr = f"{'count':>12} {'size':>10} {'time avg(us)':>14} " \
-          f"{'min(us)':>10} {'max(us)':>10}"
-    if args.full:
-        hdr += f" {'bw(GB/s)':>10}"
-    print(hdr)
+    if not args.json:
+        print(f"# ucc_perftest: {args.coll} {args.dtype}"
+              + (f" {args.op}" if args.coll != "memcpy" else "")
+              + f" mem={args.mem} nbufs={nbufs}")
+        hdr = f"{'count':>12} {'size':>10} {'time avg(us)':>14} " \
+              f"{'min(us)':>10} {'max(us)':>10} {'p50(us)':>10} " \
+              f"{'p99(us)':>10}"
+        if args.full:
+            hdr += f" {'bw(GB/s)':>10}"
+        print(hdr)
 
     size = max(parse_memunits(args.begin), esz)
     bmax = parse_memunits(args.end)
@@ -281,13 +294,25 @@ def run_op_bench(args) -> int:
             t1 = time.perf_counter()
             if i >= args.warmup:
                 lats.append(t1 - t0)
-        avg = sum(lats) / len(lats)
-        line = f"{count:>12} {memunits_str(nbytes):>10} " \
-               f"{avg * 1e6:>14.2f} {min(lats) * 1e6:>10.2f} " \
-               f"{max(lats) * 1e6:>10.2f}"
-        if args.full:
-            line += f" {factor * nbytes / avg / 1e9:>10.3f}"
-        print(line)
+        st = lat_stats(lats)
+        bw = factor * nbytes / (st["avg_us"] / 1e6) / 1e9
+        if args.json:
+            import json
+            rec = {"bench": "op", "op": args.coll, "dtype": args.dtype,
+                   "mem": args.mem, "nbufs": nbufs, "count": count,
+                   "size_bytes": nbytes,
+                   **{k: round(v, 3) for k, v in st.items()}}
+            if args.full:
+                rec["bw_GBps"] = round(bw, 3)
+            print(json.dumps(rec), flush=True)
+        else:
+            line = f"{count:>12} {memunits_str(nbytes):>10} " \
+                   f"{st['avg_us']:>14.2f} {st['min_us']:>10.2f} " \
+                   f"{st['max_us']:>10.2f} {st['p50_us']:>10.2f} " \
+                   f"{st['p99_us']:>10.2f}"
+            if args.full:
+                line += f" {bw:>10.3f}"
+            print(line)
         size *= 2
     return 0
 
@@ -494,6 +519,10 @@ def main(argv=None) -> int:
     p.add_argument("-i", "--inplace", action="store_true")
     p.add_argument("-F", "--full", action="store_true",
                    help="print bus bandwidth column")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON line per size (machine-readable: "
+                        "avg/min/max/p50/p99 us + busbw with -F) instead "
+                        "of the latency table")
     p.add_argument("-p", "--nprocs", type=int, default=0,
                    help="in-process ranks (default: one per device for tpu "
                         "mem, else 4)")
@@ -587,9 +616,10 @@ def main(argv=None) -> int:
         ranks = list(range(n))
         is_lead = True
 
-    if is_lead:
+    if is_lead and not args.json:
         hdr = f"{'count':>12} {'size':>10} {'time avg(us)':>14} " \
-              f"{'min(us)':>10} {'max(us)':>10}"
+              f"{'min(us)':>10} {'max(us)':>10} {'p50(us)':>10} " \
+              f"{'p99(us)':>10}"
         if args.full:
             hdr += f" {'bus bw(GB/s)':>14}"
         print(f"# ucc_perftest: {args.coll} {args.dtype} {args.op} "
@@ -673,13 +703,26 @@ def main(argv=None) -> int:
                     lats.append(dt_s)
         lats = np.array(lats)
         if is_lead:
-            avg = lats.mean() * 1e6
-            line = f"{count:>12} {memunits_str(size):>10} {avg:>14.2f} " \
-                   f"{lats.min() * 1e6:>10.2f} {lats.max() * 1e6:>10.2f}"
-            if args.full:
-                bw = busbw_factor(coll, n) * size / lats.mean() / 1e9
-                line += f" {bw:>14.3f}"
-            print(line, flush=True)
+            st = lat_stats(lats)
+            bw = busbw_factor(coll, n) * size / lats.mean() / 1e9
+            if args.json:
+                import json
+                rec = {"bench": "coll", "coll": args.coll,
+                       "dtype": args.dtype, "op": args.op, "mem": args.mem,
+                       "ranks": n, "count": count, "size_bytes": size,
+                       "iters": args.iters,
+                       **{k: round(v, 3) for k, v in st.items()}}
+                if args.full:
+                    rec["busbw_GBps"] = round(bw, 3)
+                print(json.dumps(rec), flush=True)
+            else:
+                line = f"{count:>12} {memunits_str(size):>10} " \
+                       f"{st['avg_us']:>14.2f} {st['min_us']:>10.2f} " \
+                       f"{st['max_us']:>10.2f} {st['p50_us']:>10.2f} " \
+                       f"{st['p99_us']:>10.2f}"
+                if args.full:
+                    line += f" {bw:>14.3f}"
+                print(line, flush=True)
         for ctx, h in os_unmap:
             ctx.mem_unmap(h)
         size *= 2
