@@ -1,0 +1,365 @@
+"""Rank-failure recovery (UCC_FT=shrink; ISSUE 4): liveness detection
+and attribution, fail-fast posts to dead ranks, fault-tolerant
+agreement, ULFM-style Team.shrink, epoch fencing (PR-3 lease-buffer
+interplay), and the half-created-team destroy regression."""
+import time
+
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType,
+                     RankFailedError, ReductionOp, Status, UccError)
+from ucc_tpu.fault import health, inject
+from ucc_tpu.obs import metrics
+from ucc_tpu.tl.host.transport import (Mailbox, RecvReq, SendReq,
+                                       _PendingSend)
+
+from harness import UccJob
+
+
+@pytest.fixture(autouse=True)
+def _clean_ft():
+    inject.reset()
+    health.reset()
+    yield
+    inject.reset()
+    health.reset()
+
+
+def _ft_on(interval=0.02, timeout=0.3):
+    health.configure("shrink", interval=interval, timeout=timeout)
+
+
+def _ar_args(rank, count=16):
+    dst = np.zeros(count, np.float64)
+    args = CollArgs(coll_type=CollType.ALLREDUCE,
+                    src=BufferInfo(np.full(count, rank + 1.0), count,
+                                   DataType.FLOAT64),
+                    dst=BufferInfo(dst, count, DataType.FLOAT64),
+                    op=ReductionOp.SUM)
+    return args, dst
+
+
+def _drive(ctxs, cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for c in ctxs:
+            c.progress()
+        if cond():
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# detection + attribution
+# ---------------------------------------------------------------------------
+
+class TestDetection:
+    def test_default_mode_is_cold(self):
+        assert health.MODE == "none"
+        assert not health.ENABLED
+        job = UccJob(2)
+        try:
+            assert job.contexts[0].health is None
+        finally:
+            job.cleanup()
+
+    def test_heartbeat_detects_killed_rank(self):
+        """A rank that stops beating (kill injection) is detected by
+        every survivor's registry within the heartbeat timeout, and
+        in-flight collectives depending on it are cancelled with
+        ERR_RANK_FAILED naming it."""
+        _ft_on()
+        job = UccJob(3)
+        try:
+            teams = job.create_team()
+            # post BEFORE the kill so detection (not fail-fast) must
+            # bound the in-flight collective
+            reqs = [t.collective_init(_ar_args(i)[0]) for i, t in
+                    enumerate(teams[:2])]
+            killed_ctx = job.contexts[2].rank
+            inject.configure(f"kill={killed_ctx}", seed=0)
+            for rq in reqs:
+                rq.post()
+            assert _drive(job.contexts, lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs), 10)
+            for rq in reqs:
+                assert rq.test() == Status.ERR_RANK_FAILED, rq.test()
+                assert killed_ctx in (rq.failed_ranks or [])
+            for r in (0, 1):
+                reg = job.contexts[r].health
+                assert reg is not None and reg.is_dead(killed_ctx)
+                assert reg.dead[killed_ctx]["source"] in (
+                    "heartbeat", "send", "inject")
+            for rq in reqs:
+                rq.finalize()
+        finally:
+            job.cleanup()
+
+    def test_fail_fast_post_to_dead_rank(self):
+        """Satellite: a post targeting a known-dead rank fails fast with
+        ERR_RANK_FAILED + attribution instead of black-holing until a
+        watchdog timeout — and counts in rank_failures_detected. Runs
+        with UCC_FT off: the kill drill alone must benefit."""
+        metrics.reset()
+        metrics.enable(file="/dev/null")
+        job = UccJob(3)
+        try:
+            teams = job.create_team()
+            killed_ctx = job.contexts[2].rank
+            inject.configure(f"kill={killed_ctx}", seed=0)
+            args, _ = _ar_args(0)
+            rq = teams[0].collective_init(args)
+            t0 = time.monotonic()
+            rq.post()
+            assert _drive(job.contexts, lambda:
+                          rq.test() != Status.IN_PROGRESS, 5)
+            assert time.monotonic() - t0 < 2.0   # fast, not watchdog-slow
+            assert rq.test() == Status.ERR_RANK_FAILED
+            assert killed_ctx in (rq.failed_ranks or [])
+            snap = metrics.snapshot()
+            hits = snap.get("counters", {}).get("rank_failures_detected", {})
+            assert hits and sum(hits.values()) >= 1
+            rq.finalize()
+        finally:
+            metrics.disable()
+            metrics.reset()
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# agreement
+# ---------------------------------------------------------------------------
+
+class TestAgreement:
+    def test_divergent_views_converge(self):
+        """Survivors entering agreement with DIFFERENT local views (one
+        detected the death, the others did not) converge on the union
+        and an identical epoch — the other ranks learn the dead set
+        mid-round and cancel their pending recv from the dead rank."""
+        from ucc_tpu.fault.agree import FtAgreement
+        _ft_on(timeout=10.0)   # heartbeats effectively off: views stay split
+        job = UccJob(4)
+        try:
+            teams = job.create_team()
+            tasks = {}
+            for r, local in ((0, {2}), (1, set()), (3, set())):
+                t = FtAgreement(teams[r].service_team, local, epoch=0,
+                                round_timeout_s=8.0)
+                t.progress_queue = job.contexts[r].progress_queue
+                tasks[r] = t
+                t.post()
+            assert _drive(job.contexts, lambda: all(
+                t.is_completed() for t in tasks.values()), 15)
+            views = {(frozenset(t.result_dead), t.result_epoch)
+                     for t in tasks.values()}
+            assert views == {(frozenset({2}), 1)}, views
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: kill -> detect -> agree -> shrink -> resume
+# ---------------------------------------------------------------------------
+
+class TestKillShrinkSoak:
+    def test_kill_shrink_resume(self):
+        """ISSUE-4 acceptance: with UCC_FAULT=kill and UCC_FT=shrink, a
+        4-rank matrix survives the kill — every survivor observes
+        ERR_RANK_FAILED naming the dead rank, all agree on the same
+        (dead set, epoch), Team.shrink completes, and >= 50 subsequent
+        collectives finish on the shrunk team with correct results and
+        zero ranks IN_PROGRESS."""
+        from ucc_tpu.fault.soak import run_kill_shrink_soak
+        report = run_kill_shrink_soak(n_ranks=4, kill_rank=2,
+                                      pre_iters=3, post_iters=54)
+        assert report["violations"] == [], report
+        assert report["post_iters"] >= 50
+        views = {(tuple(v["dead"]), v["epoch"])
+                 for v in report["agreed"].values()}
+        assert len(views) == 1
+        for v in report["detected"].values():
+            assert v["status"] == "ERR_RANK_FAILED"
+            assert report["killed"]["ctx_rank"] in v["ranks"]
+
+    def test_old_team_rejects_posts_after_shrink(self):
+        _ft_on()
+        job = UccJob(3)
+        try:
+            teams = job.create_team()
+            killed_ctx = job.contexts[2].rank
+            inject.configure(f"kill={killed_ctx}", seed=0)
+            # let the survivors detect the death first
+            assert _drive(job.contexts, lambda: all(
+                job.contexts[r].health.is_dead(killed_ctx)
+                for r in (0, 1)), 5)
+            shrinks = {r: teams[r].shrink_post() for r in (0, 1)}
+            assert _drive(job.contexts, lambda: all(
+                [s.test() != Status.IN_PROGRESS
+                 for s in shrinks.values()]), 15)
+            for s in shrinks.values():
+                assert s.test() == Status.OK
+                assert s.new_team.epoch == s.epoch
+            with pytest.raises(RankFailedError):
+                teams[0].collective_init(_ar_args(0)[0])
+            # the successor works
+            reqs = []
+            for g, s in enumerate(shrinks.values()):
+                args, dst = _ar_args(g)
+                rq = s.new_team.collective_init(args)
+                rq.post()
+                reqs.append((rq, dst))
+            assert _drive(job.contexts, lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq, _ in reqs), 10)
+            for rq, dst in reqs:
+                assert rq.test() == Status.OK
+                assert np.allclose(dst, 1.0 + 2.0)
+                rq.finalize()
+            for s in shrinks.values():
+                s.new_team.destroy()
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing (PR-3 lease-buffer interplay)
+# ---------------------------------------------------------------------------
+
+TEAM_KEY = (("unit",), "cl")
+
+
+class TestEpochFence:
+    def test_fence_purges_parked_stale_state(self):
+        """Fencing an epoch completes parked senders, errors stale
+        posted recvs, and discards late stale arrivals — so a parked
+        pre-shrink rndv send can no longer alias a buffer the pool
+        reissues (its mailbox entry dies at the fence)."""
+        mb = Mailbox()
+        old_key = (TEAM_KEY, 0, 7, 0, 1)
+        # a parked zero-copy rndv send (the PR-3 hazard shape) ...
+        lease_buf = np.arange(64, dtype=np.uint8)
+        ps = _PendingSend(lease_buf, SendReq(), copied=False)
+        mb.push(old_key, ps)
+        # ... and a stale posted recv
+        stale_dst = np.zeros(64, np.uint8)
+        stale_recv = RecvReq(stale_dst)
+        mb.post_recv((TEAM_KEY, 0, 8, 0, 1), stale_recv)
+        purged = mb.fence(TEAM_KEY, 1)
+        assert purged == 2
+        assert not mb.unexpected and not mb.posted
+        assert ps.req.done           # sender stops waiting
+        assert stale_recv.done and "fenced" in stale_recv.error
+
+    def test_stale_send_cannot_match_post_shrink_recv(self):
+        """Regression: a STALE pre-shrink send arriving after the fence
+        is discarded at the matching boundary — it can never land in a
+        recv posted under the new epoch (which would be a pool-reissued
+        lease buffer in the PR-3 steady state)."""
+        mb = Mailbox()
+        mb.fence(TEAM_KEY, 1)
+        new_dst = np.zeros(8, np.uint8)
+        new_recv = RecvReq(new_dst)
+        mb.post_recv((TEAM_KEY, 1, 1, 0, 0), new_recv)
+        # same (coll_tag, slot, src) but old epoch: must NOT match
+        sreq, kind = mb.send((TEAM_KEY, 0, 1, 0, 0),
+                             np.full(8, 0xAB, np.uint8), 8192)
+        assert kind == "fenced" and sreq.done
+        assert not new_recv.done
+        assert not new_dst.any()
+        # the new-epoch send still matches normally
+        sreq2, kind2 = mb.send((TEAM_KEY, 1, 1, 0, 0),
+                               np.full(8, 0xCD, np.uint8), 8192)
+        assert kind2 == "direct" and new_recv.done
+        assert (new_dst == 0xCD).all()
+        # posting a recv under the fenced epoch fails locally, loudly
+        late = RecvReq(np.zeros(4, np.uint8))
+        mb.post_recv((TEAM_KEY, 0, 2, 0, 0), late)
+        assert late.done and "fenced" in late.error
+
+    def test_shrink_fences_old_tl_teams(self):
+        """Integration: after Team.shrink, a late message keyed to the
+        OLD team's tag space is discarded by the survivor's transport
+        (n_fenced), not delivered."""
+        _ft_on()
+        job = UccJob(3)
+        try:
+            teams = job.create_team()
+            old_tl_keys = {r: teams[r]._tl_tag_spaces() for r in (0, 1)}
+            assert all(old_tl_keys.values())
+            killed_ctx = job.contexts[2].rank
+            inject.configure(f"kill={killed_ctx}", seed=0)
+            assert _drive(job.contexts, lambda: all(
+                job.contexts[r].health.is_dead(killed_ctx)
+                for r in (0, 1)), 5)
+            shrinks = {r: teams[r].shrink_post() for r in (0, 1)}
+            assert _drive(job.contexts, lambda: all(
+                [s.test() != Status.IN_PROGRESS
+                 for s in shrinks.values()]), 15)
+            assert all(s.test() == Status.OK for s in shrinks.values())
+            # replay a "delayed" pre-shrink send into survivor 1's
+            # mailbox under the old cl-scope key at the old epoch
+            tr1 = job.contexts[1].tl_contexts["shm"].obj.transport
+            tk = old_tl_keys[1][0][0]
+            before = tr1.n_fenced
+            tr0 = job.contexts[0].tl_contexts["shm"].obj
+            req = tr0.send_to(job.contexts[1].rank,
+                              (tk, teams[1].epoch, 999, 0,
+                               job.contexts[0].rank),
+                              np.ones(8, np.float64))
+            assert req.done                      # discarded, not parked
+            assert tr1.mailbox.fences            # fence installed
+            assert not any(k[0] == tk for k in tr1.mailbox.unexpected)
+            for s in shrinks.values():
+                s.new_team.destroy()
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# half-created team destroy (satellite regression)
+# ---------------------------------------------------------------------------
+
+class TestHalfCreatedTeamDestroy:
+    def test_destroy_after_mid_cl_create_failure(self, monkeypatch):
+        """Team.fail()/destroy() on a team stuck mid _cl_create_step
+        must tear down the already-created service team and the
+        partially-created CL team without raising — even when a
+        component's own destroy misbehaves."""
+        from ucc_tpu.cl.basic import ClBasicTeam
+        from ucc_tpu.core.team import TeamState
+
+        monkeypatch.setattr(ClBasicTeam, "create_test",
+                            lambda self: Status.IN_PROGRESS)
+        destroyed = []
+        orig_destroy = ClBasicTeam.destroy
+
+        def raising_destroy(self):
+            destroyed.append(self)
+            orig_destroy(self)
+            raise RuntimeError("component destroy bug")
+
+        monkeypatch.setattr(ClBasicTeam, "destroy", raising_destroy)
+        job = UccJob(2)
+        try:
+            from ucc_tpu import TeamParams, ThreadOobWorld
+            world = ThreadOobWorld(2)
+            teams = [job.contexts[r].create_team_post(
+                TeamParams(oob=world.endpoint(r))) for r in range(2)]
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                sts = [t.create_test() for t in teams]
+                for c in job.contexts:
+                    c.progress()
+                if all(t.state == TeamState.CL_CREATE for t in teams):
+                    break
+            assert all(t.state == TeamState.CL_CREATE for t in teams)
+            for t in teams:
+                t.fail(Status.ERR_TIMED_OUT, "test escalation")
+                assert t.create_test() == Status.ERR_TIMED_OUT
+            for t in teams:
+                t.destroy()          # must not raise
+                t.destroy()          # idempotent
+            assert destroyed          # the half-created CL team was torn down
+        finally:
+            job.cleanup()

@@ -23,15 +23,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WATCHDOG_FILE = "/tmp/ucc_gate_watchdog.json"
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from tpu_probe import _watchdog_evidence  # noqa: E402 - shared parser
+from tpu_probe import (_rank_failure_evidence,  # noqa: E402 - shared parser
+                       _watchdog_evidence)
 
 
 def _watchdog_outcome(offset: int) -> str:
     """Classify a failed/timed-out gate step from watchdog evidence
-    written after ``offset``: `timeout(coll=...)` when the armed
-    watchdog (UCC_WATCHDOG_ACTION=cancel) attributed the stall to named
+    written after ``offset``: `rank_failed(ranks=...)` when the liveness
+    layer attributed it to named dead ranks (most specific evidence),
+    `timeout(coll=...)` when the armed watchdog
+    (UCC_WATCHDOG_ACTION=cancel) attributed the stall to named
     collectives, bare `hang` otherwise (wedged below the collective
-    layer). Same taxonomy and parser as tools/tpu_probe.py."""
+    layer). Same taxonomy and parsers as tools/tpu_probe.py."""
+    failed, _src = _rank_failure_evidence(offset, path=WATCHDOG_FILE)
+    if failed:
+        return f"rank_failed(ranks={','.join(str(r) for r in failed)})"
     names, _ = _watchdog_evidence(offset, path=WATCHDOG_FILE)
     if names:
         return f"timeout(coll={','.join(sorted(set(names))[:4])})"
@@ -218,6 +224,13 @@ def main(argv=None) -> int:
                     "import __graft_entry__ as g; g.dryrun_multichip(8); "
                     "print('DRYRUN OK')"],
                    timeout=1200, env=env)
+        # the rank-failure recovery pipeline (detect -> agree -> shrink
+        # -> resume) must not silently rot: run the kill+shrink drill on
+        # every gate pass (ISSUE-4 CI satellite; tier-1-safe, not slow)
+        ok &= _run("kill+shrink soak",
+                   [sys.executable, "-m", "ucc_tpu.fault.soak",
+                    "--kill-shrink"],
+                   timeout=600, env=env)
         # warn-only: surfaces perf regressions in-PR without making the
         # gate flaky on a noisy shared box (ISSUE 3 CI satellite)
         _perf_smoke(env)

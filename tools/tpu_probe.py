@@ -132,8 +132,18 @@ def _watchdog_evidence(offset: int, path: str = None):
             f.seek(offset)
             last = None
             for line in f:
-                if line.strip():
-                    last = line
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("reason") == "rank_failed":
+                    # rank-failure evidence notes (fault/health.py) are
+                    # collected separately by _rank_failure_evidence;
+                    # they are not stall dumps
+                    continue
+                last = line
             if not last:
                 return [], ""
         rep = json.loads(last)
@@ -147,15 +157,47 @@ def _watchdog_evidence(offset: int, path: str = None):
         return [], ""
 
 
+def _rank_failure_evidence(offset: int, path: str = None):
+    """Failed ranks named by ``rank_failed`` evidence lines written after
+    ``offset`` (fault/health.py writes one per detection when the
+    watchdog is armed). The union across lines is the attributed dead
+    set — the third outcome class alongside hang/timeout/error."""
+    ranks = set()
+    source = ""
+    try:
+        with open(path or WATCHDOG_LOG) as f:
+            f.seek(offset)
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("reason") == "rank_failed":
+                    ranks.update(int(r) for r in
+                                 rec.get("failed_ranks") or ())
+                    source = rec.get("source") or source
+    except (OSError, ValueError):
+        pass
+    return sorted(ranks), source
+
+
 def classify(rc, out: str, wd_offset: int):
-    """Outcome taxonomy (ISSUE-2 CI satellite): `ok`, `error` (child
-    exited nonzero), `timeout(coll=...)` (child was killed or failed
-    but the watchdog attributed the stall to named collectives), and
+    """Outcome taxonomy (ISSUE-2 + ISSUE-4 CI satellites): `ok`, `error`
+    (child exited nonzero), `timeout(coll=...)` (child was killed or
+    failed but the watchdog attributed the stall to named collectives),
+    `rank_failed(ranks=...)` (the liveness layer attributed the failure
+    to named dead ranks — the most specific evidence, so it wins), and
     bare `hang` only when there is genuinely no evidence — a wedge
     below the collective layer."""
     tail = out.strip().splitlines()[-1] if out.strip() else ""
     if rc == 0 and "PROBE_OK" in out:
         return "ok", tail
+    failed, fsource = _rank_failure_evidence(wd_offset)
+    if failed:
+        return (f"rank_failed(ranks={','.join(str(r) for r in failed)})",
+                f"(source={fsource}) {tail[-160:]}")
     names, summary = _watchdog_evidence(wd_offset)
     if rc is None:
         if names:

@@ -33,7 +33,7 @@ Quick start (single process, UCC-style objects)::
 from .constants import (CollArgsFlags, CollArgsHints, CollSyncType, CollType,  # noqa: F401
                         DataType, EventType, GenericDataType, MemoryType,
                         ReductionOp, ThreadMode, coll_type_str, dt_size)
-from .status import Status, UccError, check  # noqa: F401
+from .status import RankFailedError, Status, UccError, check  # noqa: F401
 from .api.types import (ActiveSet, BufferInfo, BufferInfoV, CollArgs,  # noqa: F401
                         ContextAttr, ContextParams, ContextType, LibAttr,
                         LibParams, OobColl, OobRequest, TeamAttr, TeamParams)

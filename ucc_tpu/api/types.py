@@ -121,6 +121,7 @@ class TeamParams:
     team_size: Optional[int] = None
     ordered: bool = True                     # EP_RANGE contig / ordering flag
     id: Optional[int] = None                 # user-provided team id
+    epoch: int = 0                           # recovery epoch (Team.shrink)
 
 
 @dataclass

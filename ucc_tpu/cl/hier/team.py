@@ -52,6 +52,9 @@ class SbgpCoreTeamFacade:
                          tuple(int(self.ctx_map.eval(i))
                                for i in range(self.size)))
         self.id = core_team.id
+        # recovery epoch rides through to the unit TL teams' match keys
+        # so a shrunk parent's hier units are epoch-fenced consistently
+        self.epoch = getattr(core_team, "epoch", 0)
 
 
 class HierSbgp:

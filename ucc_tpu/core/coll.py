@@ -21,7 +21,7 @@ from ..mc.base import detect_mem_type
 from ..obs import metrics
 from ..schedule.schedule import Schedule
 from ..schedule.task import CollTask
-from ..status import Status, UccError
+from ..status import RankFailedError, Status, UccError
 from ..utils import profiling
 from ..utils.log import get_logger
 from .team import Team
@@ -118,6 +118,24 @@ class CollRequest:
     @property
     def status(self) -> Status:
         return self.task.super_status
+
+    @property
+    def failed_ranks(self):
+        """Attribution for an ERR_RANK_FAILED outcome: the failed ranks
+        (context ranks) this request's cancellation named, falling back
+        to the context health registry's view. None when no failure has
+        been attributed."""
+        fr = getattr(self.task, "failed_ranks", None)
+        if fr:
+            return sorted(int(r) for r in fr)
+        # registry fallback ONLY for a rank-failure outcome: a healthy
+        # request on an unaffected team must report None even when some
+        # other team's rank is known dead
+        if self.task.super_status == Status.ERR_RANK_FAILED:
+            reg = getattr(self.team.context, "health", None)
+            if reg is not None and reg.dead:
+                return sorted(reg.dead_set())
+        return None
 
     def post(self) -> Status:
         """ucc_collective_post (ucc_coll.c:375)."""
@@ -294,6 +312,12 @@ def _is_zero_size(args: CollArgs) -> bool:
 
 def collective_init(args: CollArgs, team: Team) -> CollRequest:
     """ucc_collective_init (ucc_coll.c:172)."""
+    if team._shrunk:
+        # the old epoch's tag space is fenced; collectives must move to
+        # the successor team ShrinkRequest.new_team returned
+        raise RankFailedError(
+            f"team {team.id} was shrunk after rank failure; post on the "
+            "successor team")
     if team.score_map is None:
         raise UccError(Status.ERR_INVALID_PARAM, "team is not active")
     ct = args.coll_type

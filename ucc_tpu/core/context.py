@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional
 
 from ..api.types import ContextParams
 from ..constants import ThreadMode
+from ..fault import health as ft_health
 from ..schedule.progress import ProgressQueue, ProgressQueueMT
 from ..status import Status, UccError
 from ..topo.proc_info import ProcInfo, local_proc_info
@@ -79,6 +80,16 @@ class Context:
         else:
             self.progress_queue = ProgressQueue()
 
+        # process-unique context identity: mem-map segment addressing AND
+        # (UCC_FT=shrink) the heartbeat-board key peers watch for liveness
+        import uuid as _uuid
+        self._ctx_uid = _uuid.uuid4().hex
+        self.health = None
+        if ft_health.ENABLED:
+            self.health = ft_health.HealthRegistry(self)
+            # the progress queue drives beats/polls (fault/health.check)
+            self.progress_queue._ft_health = self.health
+
         # TL contexts first, then CLs (ucc_context.c:758-817)
         self.tl_contexts: Dict[str, TlContextHandle] = {}
         for name, tl_lib in lib.tl_libs.items():
@@ -96,6 +107,7 @@ class Context:
         if oob is not None:
             payload = {
                 "proc": self.proc_info,
+                "uid": self._ctx_uid,   # heartbeat-board key (fault/health)
                 "tl": {name: h.obj.pack_address()
                        for name, h in self.tl_contexts.items()},
             }
@@ -109,6 +121,11 @@ class Context:
                 h.obj.unpack_addresses(
                     {r: a["tl"].get(name, b"")
                      for r, a in enumerate(self.addr_storage)})
+            if self.health is not None:
+                self.health.set_peers(
+                    {r: a.get("uid", "")
+                     for r, a in enumerate(self.addr_storage)})
+                self.health.beat()
         else:
             self.addr_storage = [{"proc": self.proc_info, "tl": {}}]
             self.topo = ContextTopo([self.proc_info])
@@ -118,8 +135,6 @@ class Context:
             h.obj.create_epilog()
 
         self._team_id_counter = 1
-        import uuid as _uuid
-        self._ctx_uid = _uuid.uuid4().hex
         self._mem_maps = {}
         # itertools.count: next() is atomic under the GIL, so concurrent
         # mem_map calls in ThreadMode.MULTIPLE never mint duplicate ids

@@ -68,6 +68,21 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
                 "off (zero cost)", parse_string),
     ConfigField("FAULT_SEED", "0", "RNG seed for UCC_FAULT decisions: the "
                 "same seed + spec replays the same drill", parse_string),
+    ConfigField("FT", "none", "rank-failure recovery mode: none = failures "
+                "are bounded but terminal (PR-2 behavior; zero cost); "
+                "shrink = peer liveness + failure agreement + ULFM-style "
+                "Team.shrink — survivors observe ERR_RANK_FAILED naming "
+                "the dead ranks, agree on the failed set and recovery "
+                "epoch, and rebuild the team without them (old-epoch "
+                "traffic is fenced at the transport)", parse_string),
+    ConfigField("HEARTBEAT_INTERVAL", "0.05", "seconds between liveness "
+                "heartbeats published from each context's progress loop "
+                "(UCC_FT=shrink only)", parse_string),
+    ConfigField("HEARTBEAT_TIMEOUT", "2.0", "seconds without a peer "
+                "heartbeat before the peer is declared failed and "
+                "in-flight collectives depending on it are cancelled "
+                "with ERR_RANK_FAILED (UCC_FT=shrink only)",
+                parse_string),
     ConfigField("OOB_CONNECT_BACKOFF_BASE", "0.05", "initial TCP-store OOB "
                 "connect retry backoff in seconds (exponential, full "
                 "jitter)", parse_string),

@@ -181,6 +181,130 @@ class _SubsetOobRequest(OobRequest):
         return [full[r] for r in self.ranks]
 
 
+class TransportOob(OobColl):
+    """OOB allgather over a TL transport among SURVIVING context ranks —
+    the fault-tolerant replacement for :class:`SubsetOob` when the parent
+    team has dead members. SubsetOob's contract (every allgather rides a
+    full parent-OOB round, so every parent member must participate) is
+    unsatisfiable once a rank is dead: its contribution never arrives and
+    the round wedges forever. TransportOob sidesteps the parent OOB
+    entirely: members exchange blobs point-to-point through the (still
+    live) transport endpoints, under a dedicated ``("ftoob", ...)`` tag
+    space keyed by the recovery epoch, so a shrunken team can bootstrap
+    using only survivors.
+
+    Ordered-allgather contract preserved: calls must be issued in the
+    same order on every member (exactly the UCC OOB requirement), each
+    call consuming one round number.
+    """
+
+    def __init__(self, comp_context, transport, member_ctx_ranks, my_ctx,
+                 space_key, epoch: int):
+        self.comp_context = comp_context
+        self.transport = transport
+        self.members = [int(r) for r in member_ctx_ranks]
+        if int(my_ctx) not in self.members:
+            raise ValueError("TransportOob endpoint not in member set")
+        self.my_ctx = int(my_ctx)
+        self.my = self.members.index(self.my_ctx)
+        #: tag-space root: distinct from every team's (core_key, scope)
+        #: key, fence-compatible shape (epoch at key[1])
+        self.team_key = ("ftoob", space_key)
+        self.epoch = int(epoch)
+        self._round = 0
+
+    @property
+    def oob_ep(self) -> int:
+        return self.my
+
+    @property
+    def n_oob_eps(self) -> int:
+        return len(self.members)
+
+    def _key(self, round_idx: int, phase: int, src_ctx: int):
+        return (self.team_key, self.epoch, round_idx, phase, src_ctx)
+
+    def allgather(self, data: bytes) -> OobRequest:
+        r = self._round
+        self._round += 1
+        return _TransportOobRequest(self, r, bytes(data))
+
+
+class _TransportOobRequest(OobRequest):
+    """Two-phase (sizes, then payloads) linear exchange; genuinely
+    nonblocking — ``test`` only polls transport requests."""
+
+    def __init__(self, oob: TransportOob, round_idx: int, data: bytes):
+        import numpy as np
+        self.oob = oob
+        self.round_idx = round_idx
+        self.data = data
+        self._np = np
+        peers = [p for p in range(oob.n_oob_eps) if p != oob.my]
+        my_sz = np.array([len(data)], dtype=np.int64)
+        self._szbufs = {p: np.zeros(1, dtype=np.int64) for p in peers}
+        self._szreqs = {}
+        self._pay_bufs = {}
+        self._payreqs = {}
+        self._result: Optional[List[bytes]] = None
+        for p in peers:
+            self._szreqs[p] = oob.transport.recv_nb(
+                oob._key(round_idx, 0, oob.members[p]), self._szbufs[p])
+        for p in peers:
+            oob.comp_context.send_to(
+                oob.members[p], oob._key(round_idx, 0, oob.my_ctx), my_sz)
+
+    def test(self) -> Status:
+        if self._result is not None:
+            return Status.OK
+        oob = self.oob
+        np = self._np
+        oob.transport.progress()
+        for p, rq in list(self._szreqs.items()):
+            if not rq.test():
+                continue
+            if getattr(rq, "error", None):
+                raise UccError(Status.ERR_NO_MESSAGE,
+                               f"ft OOB size recv from member {p} failed: "
+                               f"{rq.error}")
+            del self._szreqs[p]
+            # post the payload recv as soon as the size is known; send my
+            # payload to this peer (per-key FIFO keeps phases ordered)
+            buf = np.zeros(max(1, int(self._szbufs[p][0])), dtype=np.uint8)
+            self._pay_bufs[p] = buf
+            self._payreqs[p] = oob.transport.recv_nb(
+                oob._key(self.round_idx, 1, oob.members[p]), buf)
+            oob.comp_context.send_to(
+                oob.members[p], oob._key(self.round_idx, 1, oob.my_ctx),
+                np.frombuffer(self.data, dtype=np.uint8) if self.data
+                else np.zeros(1, dtype=np.uint8))
+        if self._szreqs:
+            return Status.IN_PROGRESS
+        for p, rq in list(self._payreqs.items()):
+            if not rq.test():
+                return Status.IN_PROGRESS
+            if getattr(rq, "error", None):
+                raise UccError(Status.ERR_NO_MESSAGE,
+                               f"ft OOB payload recv from member {p} "
+                               f"failed: {rq.error}")
+        out: List[bytes] = []
+        for p in range(oob.n_oob_eps):
+            if p == oob.my:
+                out.append(self.data)
+            else:
+                n = int(self._szbufs[p][0])
+                out.append(self._pay_bufs[p][:n].tobytes())
+        self._result = out
+        return Status.OK
+
+    @property
+    def result(self) -> List[bytes]:
+        while self.test() == Status.IN_PROGRESS:
+            time.sleep(0)
+        assert self._result is not None
+        return self._result
+
+
 # ---------------------------------------------------------------------------
 # TCP store OOB (multi-process DCN bootstrap)
 # ---------------------------------------------------------------------------

@@ -54,6 +54,12 @@ class TeamState(enum.IntEnum):
 class Team:
     """ucc_team_h. Construct via Context.create_team_post()."""
 
+    #: flipped by Team.shrink once survivors have agreed and fenced: the
+    #: old epoch's tag space is dead, so new collectives must move to the
+    #: successor team
+    _shrunk = False
+    _destroyed = False
+
     def __init__(self, context: Context, params: Optional[TeamParams] = None):
         self.context = context
         self.params = params or TeamParams()
@@ -82,6 +88,9 @@ class Team:
         self.ctx_map: Optional[EpMap] = None
         self.team_key: Any = None
         self.id: Optional[int] = p.id
+        #: recovery epoch: 0 for normal teams, bumped by Team.shrink and
+        #: stamped into every host-transport match key (epoch fencing)
+        self.epoch: int = int(getattr(p, "epoch", 0) or 0)
         self.state = TeamState.ADDR_EXCHANGE
         # the watchdog enumerates live teams so a create-time hang names
         # its state-machine position (WeakSet; no lifetime extension)
@@ -397,19 +406,69 @@ class Team:
         return collective_init(args, self)
 
     def destroy(self) -> Status:
-        for cl_team in self.cl_teams:
-            cl_team.destroy()
+        """Release the team's component teams. Must be safe on a
+        HALF-CREATED team — a failure mid ``_cl_create_step`` leaves a
+        partially-built CL team in ``_cl_current`` and possibly an
+        in-flight service task — so every teardown step is individually
+        guarded and the already-created service/CL teams are torn down
+        even when one of them misbehaves. Idempotent."""
+        if self._destroyed:
+            return Status.OK
+        self._destroyed = True
+        task, self._pending_task = self._pending_task, None
+        if task is not None and not task.is_completed():
+            task.cancel(Status.ERR_CANCELED)   # never raises (contract)
+        cur, self._cl_current = self._cl_current, None
+        teams = ([cur] if cur is not None else []) + list(self.cl_teams)
+        self.cl_teams = []
+        for cl_team in teams:
+            try:
+                cl_team.destroy()
+            except Exception:  # noqa: BLE001 - teardown must reach the rest
+                logger.exception("CL team destroy raised (half-created "
+                                 "team teardown continues)")
         if self.service_team is not None:
-            self.service_team.destroy()
+            try:
+                self.service_team.destroy()
+            except Exception:  # noqa: BLE001
+                logger.exception("service team destroy raised")
         return Status.OK
 
     @classmethod
-    def create_from_parent(cls, parent: "Team",
-                           ranks: List[int]) -> Optional["Team"]:
+    def create_from_parent(cls, parent: "Team", ranks: List[int],
+                           dead: Optional[List[int]] = None,
+                           epoch: Optional[int] = None) -> Optional["Team"]:
         """ucc_team_create_from_parent (ucc.h:1656): split by explicit
-        parent-team ranks. ALL parent ranks must call this (reference
+        parent-team ranks.
+
+        Without *dead*: ALL parent ranks must call this (reference
         semantics: every rank passes include/exclude); non-members
-        contribute a dummy OOB round and get None back."""
+        contribute a dummy OOB round and get None back.
+
+        With *dead* (team ranks that can never participate again —
+        the Team.shrink rebuild): the SubsetOob contract is
+        unsatisfiable, since every subset round rides a full parent-OOB
+        round the dead ranks will never contribute to. The rebuild
+        instead bootstraps over the parent's service-team transport
+        among survivors only (:class:`~.oob.TransportOob`), keyed by the
+        recovery *epoch*; dead ranks and non-member survivors simply
+        don't participate."""
+        if dead:
+            if parent.rank in dead or parent.rank not in ranks:
+                return None
+            svc = parent.service_team
+            if svc is None or getattr(svc, "transport", None) is None:
+                raise UccError(
+                    Status.ERR_NOT_SUPPORTED,
+                    "fault-tolerant split requires a transport-backed "
+                    "service team")
+            from .oob import TransportOob
+            ep = int(epoch) if epoch is not None else parent.epoch + 1
+            survivor_ctx = [int(parent.ctx_map.eval(r)) for r in ranks]
+            ft_oob = TransportOob(svc.comp_context, svc.transport,
+                                  survivor_ctx, parent.context.rank,
+                                  ("shrink", parent.team_key, ep), ep)
+            return Team(parent.context, TeamParams(oob=ft_oob, epoch=ep))
         from .oob import SubsetOob
         if parent.oob is None:
             raise UccError(Status.ERR_INVALID_PARAM,
@@ -419,3 +478,196 @@ class Team:
             return None
         sub_oob = SubsetOob(parent.oob, ranks)
         return Team(parent.context, TeamParams(oob=sub_oob))
+
+    # ------------------------------------------------------------------
+    # rank-failure recovery (UCC_FT=shrink): detect -> agree -> shrink
+    def _cancel_in_flight(self, status: Status,
+                          failed_ctx_ranks: List[int]) -> int:
+        """Cancel every queued task riding THIS team with *status*,
+        stamping ``task.failed_ranks`` (CONTEXT ranks) for attribution.
+        Recovery traffic (``_ft_exempt``) is spared. Reuses PR 2
+        cancellation, so posted recvs are withdrawn from the mailbox and
+        PR 3 scratch leases are tainted (dropped at finalize, not
+        recycled)."""
+        from ..fault.health import cancel_queued_tasks
+        failed = set(failed_ctx_ranks)
+
+        def failed_for(task):
+            core = getattr(task.team, "core_team", task.team)
+            return failed if core is self else None
+
+        return cancel_queued_tasks(self.context.progress_queue,
+                                   failed_for, status)
+
+    def _tl_tag_spaces(self):
+        """(team_key, transport) pairs for every host TL team hanging off
+        this team — the tag spaces an epoch fence must cover. Walks the
+        service team plus CL teams (cl/basic's tl_teams, cl/hier's
+        per-sbgp units) duck-typed, so new CL shapes are covered as long
+        as they expose ``tl_teams``/``sbgps``."""
+        spaces = []
+
+        def visit(t):
+            if t is None:
+                return
+            tk = getattr(t, "team_key", None)
+            tr = getattr(t, "transport", None)
+            if tk is not None and tr is not None and \
+                    hasattr(tr, "fence"):
+                spaces.append((tk, tr))
+            for sub in getattr(t, "tl_teams", ()) or ():
+                visit(sub)
+            for sub in getattr(t, "_pending", ()) or ():
+                visit(sub)
+            sbgps = getattr(t, "sbgps", None)
+            if sbgps:
+                for sub in sbgps.values():
+                    visit(sub)
+
+        visit(self.service_team)
+        for cl in self.cl_teams:
+            visit(cl)
+        return spaces
+
+    def _fence(self, min_epoch: int) -> int:
+        """Epoch-fence every tag space of this team on the LOCAL receive
+        side: parked stale messages are purged (their senders' reqs
+        completed, posted recvs errored) and late arrivals are discarded
+        at the matching boundary — the guard that keeps a stale
+        pre-shrink send out of a pool-reissued lease buffer."""
+        purged = 0
+        for team_key, transport in self._tl_tag_spaces():
+            purged += transport.fence(team_key, min_epoch)
+        return purged
+
+    def shrink_post(self, dead_hint: Optional[List[int]] = None
+                    ) -> "ShrinkRequest":
+        """Post a nonblocking ULFM-style shrink: agree with the other
+        survivors on the failed-rank set and recovery epoch, fence the
+        old epoch's tag space, and rebuild a successor team excluding
+        the dead ranks. Every SURVIVING rank must call this (dead ranks
+        obviously don't). Drive with ``ShrinkRequest.test()`` +
+        ``context.progress()``; on OK, ``req.new_team`` is the ACTIVE
+        successor and this team only accepts ``destroy()``."""
+        return ShrinkRequest(self, dead_hint)
+
+    def shrink(self, dead_hint: Optional[List[int]] = None,
+               timeout: float = 60.0) -> "Team":
+        """Blocking convenience over :meth:`shrink_post`. Only usable
+        when other survivors progress concurrently (threads/processes);
+        cooperative single-thread drivers must use shrink_post."""
+        req = self.shrink_post(dead_hint)
+        deadline = time.monotonic() + timeout
+        while req.test() == Status.IN_PROGRESS:
+            self.context.progress()
+            if time.monotonic() > deadline:
+                raise UccError(Status.ERR_TIMED_OUT, "team shrink timed out")
+        st = req.test()
+        if st.is_error:
+            raise UccError(st, "team shrink failed")
+        assert req.new_team is not None
+        return req.new_team
+
+
+class ShrinkRequest:
+    """Nonblocking team-shrink state machine: CANCEL (at post) -> AGREE
+    -> FENCE -> REBUILD -> OK. On success ``new_team`` is the ACTIVE
+    successor, ``failed_ranks`` the agreed dead set (parent-team ranks),
+    and ``epoch`` the successor's recovery epoch — identical on every
+    survivor by construction (fault/agree.py)."""
+
+    def __init__(self, team: Team, dead_hint: Optional[List[int]] = None):
+        if team.state != TeamState.ACTIVE:
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           "shrink of a non-active team")
+        if team.size <= 1 or team.service_team is None or \
+                getattr(team.service_team, "transport", None) is None:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "shrink requires a transport-backed service "
+                           "team over 2+ ranks")
+        self.team = team
+        self.status = Status.IN_PROGRESS
+        self.new_team: Optional[Team] = None
+        self.failed_ranks: Optional[List[int]] = None
+        self.epoch: Optional[int] = None
+        ctx = team.context
+        # local dead view: health attribution (ctx ranks) + caller hint
+        # (team ranks); the agreement reconciles divergent views
+        local_dead = {int(r) for r in (dead_hint or ())}
+        reg = getattr(ctx, "health", None)
+        if reg is not None:
+            dead_ctx = reg.dead_set()
+            for i in range(team.size):
+                if int(team.ctx_map.eval(i)) in dead_ctx:
+                    local_dead.add(i)
+        local_dead.discard(team.rank)
+        # bound everything already in flight on the dying team NOW —
+        # callers polling those requests see ERR_RANK_FAILED, attributed
+        # (in ctx ranks, the failed_ranks contract everywhere else)
+        team._cancel_in_flight(
+            Status.ERR_RANK_FAILED,
+            [int(team.ctx_map.eval(i)) for i in sorted(local_dead)])
+        from ..fault.agree import FtAgreement
+        self._agree = FtAgreement(team.service_team, local_dead, team.epoch)
+        self._agree.progress_queue = ctx.progress_queue
+        self._agree.post()
+        self._state = "agree"
+
+    def test(self) -> Status:
+        if self.status != Status.IN_PROGRESS:
+            return self.status
+        try:
+            return self._step()
+        except UccError as e:
+            logger.error("team shrink failed: %s", e)
+            self.status = e.status
+            return self.status
+
+    def _step(self) -> Status:
+        team = self.team
+        if self._state == "agree":
+            a = self._agree
+            if not a.is_completed():
+                return Status.IN_PROGRESS
+            if a.super_status.is_error:
+                self.status = a.super_status
+                return self.status
+            dead = a.result_dead or set()
+            self.epoch = a.result_epoch
+            self.failed_ranks = sorted(dead)
+            # attribution: agreed-dead ranks this rank had not detected
+            # locally become known to its health registry, so later posts
+            # targeting them fail fast on every team
+            reg = getattr(team.context, "health", None)
+            if reg is not None:
+                for tr in dead:
+                    reg.report_failure(int(team.ctx_map.eval(tr)),
+                                       "agreement",
+                                       f"agreed dead in team {team.id} "
+                                       f"shrink to epoch {self.epoch}")
+            survivors = [i for i in range(team.size) if i not in dead]
+            # the old epoch's tag space is now dead: fence it (purges
+            # parked stale sends/recvs, discards late arrivals) and stop
+            # accepting new collectives on the old team
+            team._shrunk = True
+            team._fence(self.epoch)
+            if metrics.ENABLED:
+                metrics.inc("team_shrinks", component="core")
+            logger.warning(
+                "team %s shrinking: dead ranks %s, %d survivors, "
+                "epoch %d", team.id, self.failed_ranks, len(survivors),
+                self.epoch)
+            self.new_team = Team.create_from_parent(
+                team, survivors, dead=sorted(dead), epoch=self.epoch)
+            self._state = "rebuild"
+        if self._state == "rebuild":
+            assert self.new_team is not None
+            st = self.new_team.create_test()
+            if st == Status.IN_PROGRESS:
+                return st
+            if st.is_error:
+                self.status = st
+                return st
+            self._state = "done"
+            self.status = Status.OK
+        return self.status

@@ -28,10 +28,22 @@ Spec grammar (comma-separated)::
 ``kill=R[+R2..]``     simulate dead rank(s): ctx rank R drops every
                       send and fails every task post
 
+PR 4 adds the recovery half — failures stop being merely *bounded* and
+become *survivable* (detect → attribute → agree → shrink → resume):
+
+- ``fault.health`` — peer liveness under ``UCC_FT=shrink``: heartbeat
+  board + per-context ``HealthRegistry`` converging on a named
+  failed-rank set from heartbeats, transport fail-fast evidence,
+  watchdog escalation, and kill injection; cancels in-flight work on
+  dead-rank teams with ``ERR_RANK_FAILED``.
+- ``fault.agree`` — fault-tolerant agreement over the service team:
+  survivors converge on the same (failed set, recovery epoch) while
+  routing around dead members; feeds ``Team.shrink``.
+
 Call sites import the owning module (``from ..fault import inject``) so
 runtime reconfiguration stays visible — a re-exported boolean would be a
 stale copy.
 """
-from . import inject  # noqa: F401
+from . import health, inject  # noqa: F401
 
-__all__ = ["inject"]
+__all__ = ["health", "inject"]

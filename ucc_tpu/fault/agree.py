@@ -1,0 +1,173 @@
+"""Fault-tolerant agreement — survivors converge on (failed set, epoch).
+
+The *agree* step of the recovery pipeline (detect → attribute → agree →
+shrink → resume): before a team can shrink, every surviving rank must
+adopt the SAME failed-rank set and recovery epoch, or the rebuilt teams
+diverge in membership and deadlock their first collective — the exact
+failure class PR 1's ``_cl_agree_step`` empty-set fix closed for CL
+creation. Unlike that step's OOB allgather, this one must run while some
+members are DEAD, so it routes around them: a simplified, ULFM-agreement-
+shaped protocol over the service team's transport.
+
+Protocol (rounds in lockstep, slot = round):
+
+1. Each participant sends its current view ``(dead set, epoch)`` to
+   every rank it believes alive, and posts recvs from the same set.
+2. Arriving views are unioned in; a peer that becomes known-dead
+   mid-round (named by another view, fail-fast ERR_RANK_FAILED on the
+   post, or round-deadline expiry) has its pending recv cancelled and
+   joins the dead set.
+3. A round where every received view equals the sender's own view
+   terminates the protocol. Termination is symmetric: if any rank
+   observes all-equal(S), every survivor sent S that round, so every
+   survivor observes all-equal(S) and stops at the same round. A
+   non-terminal round grows someone's set, and sets are bounded by the
+   team size, so the protocol converges in <= size+2 rounds absent new
+   failures.
+4. The agreed epoch is ``max(all exchanged epochs) + 1`` — identical
+   everywhere because the exchanged views are identical.
+
+Known limitation (documented, not hidden): a rank that dies *between* a
+peer's termination and another peer's round-deadline can make the
+late peer suspect the already-terminated one. Full ULFM agreement
+(ERA) layers a coordinator to close this; here the round deadline is
+sized well above the heartbeat timeout so detection almost always
+precedes agreement, and a mis-suspected survivor is excluded (shrunk
+away), never deadlocked — the bounded-outcome invariant holds.
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterable, Optional, Set
+
+import numpy as np
+
+from ..status import RankFailedError, Status, UccError
+from ..tl.host.task import HostCollTask
+from ..utils.log import get_logger
+from . import health
+
+logger = get_logger("fault")
+
+#: slot base for agreement rounds: far above any algorithm's round slots
+#: (they top out in the hundreds) so a tuple-tagged agreement can never
+#: collide with service-collective traffic on the same team
+_AGREE_SLOT_BASE = 7000
+
+
+class FtAgreement(HostCollTask):
+    """Agreement task posted on the (old) team's service TL team by every
+    survivor. On success, ``result_dead`` holds the agreed failed set in
+    TEAM ranks and ``result_epoch`` the agreed next epoch."""
+
+    coll_name = "ft_agree"
+    alg_name = "flood"
+
+    #: recovery traffic must not be cancelled by the health scan for
+    #: depending on a team with dead members — routing around them is
+    #: its entire job
+    _ft_exempt = True
+
+    def __init__(self, service_team, local_dead: Iterable[int],
+                 epoch: int, round_timeout_s: float = 0.0):
+        super().__init__(None, service_team)
+        self.local_dead: Set[int] = {int(r) for r in local_dead}
+        self.base_epoch = int(epoch)
+        # the round deadline is the last-resort failure detector for
+        # peers dying mid-agreement; default: comfortably above the
+        # heartbeat timeout so ordinary detection wins
+        self.round_timeout_s = round_timeout_s or max(
+            1.0, 4 * health.HEARTBEAT_TIMEOUT)
+        self.tag = ("ftagree", self.base_epoch)
+        self.result_dead: Optional[Set[int]] = None
+        self.result_epoch: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    def _pack(self, dead: Set[int], epoch: int) -> np.ndarray:
+        buf = np.full(self.gsize + 2, -1, dtype=np.int64)
+        buf[0] = len(dead)
+        buf[1] = epoch
+        for i, r in enumerate(sorted(dead)):
+            buf[2 + i] = r
+        return buf
+
+    @staticmethod
+    def _unpack(buf: np.ndarray):
+        n = int(buf[0])
+        return {int(r) for r in buf[2:2 + n]}, int(buf[1])
+
+    def run(self):
+        size, me = self.gsize, self.grank
+        my: Set[int] = set(self.local_dead)
+        my.discard(me)
+        epoch = self.base_epoch
+        for rnd in range(size + 2):
+            sent = frozenset(my)
+            alive = [p for p in range(size) if p != me and p not in my]
+            if not alive:
+                break   # sole survivor: my view is the agreement
+            payload = self._pack(my, epoch)
+            rbufs = {}
+            rreqs = {}
+            for p in list(alive):
+                try:
+                    rbufs[p] = np.full(size + 2, -1, dtype=np.int64)
+                    rreqs[p] = self.recv_nb(p, rbufs[p],
+                                            slot=_AGREE_SLOT_BASE + rnd)
+                    self.send_nb(p, payload, slot=_AGREE_SLOT_BASE + rnd)
+                except RankFailedError:
+                    # fail-fast attribution fired between the alive
+                    # computation and the post: adopt it (in TEAM ranks —
+                    # the exception carries ctx ranks) and route on
+                    my.add(p)
+                    req = rreqs.pop(p, None)
+                    if req is not None:
+                        req.cancel()
+                    rbufs.pop(p, None)
+            got = {}
+            deadline = time.monotonic() + self.round_timeout_s
+            while rreqs:
+                yield
+                for p, rq in list(rreqs.items()):
+                    if p in my:
+                        # named dead by an arrived view mid-round
+                        rq.cancel()
+                        del rreqs[p]
+                        continue
+                    if not rq.test():
+                        continue
+                    del rreqs[p]
+                    if getattr(rq, "error", None):
+                        my.add(p)   # errored delivery = failed peer
+                        continue
+                    peer_dead, peer_epoch = self._unpack(rbufs[p])
+                    got[p] = peer_dead
+                    epoch = max(epoch, peer_epoch)
+                    my |= peer_dead
+                    my.discard(me)
+                if rreqs and time.monotonic() > deadline:
+                    # last-resort detector: unresponsive peers are
+                    # suspected dead (see module docstring limitation)
+                    for p, rq in list(rreqs.items()):
+                        logger.warning(
+                            "ft agreement round %d: rank %d unresponsive "
+                            "past %.1fs; suspecting it failed", rnd, p,
+                            self.round_timeout_s)
+                        my.add(p)
+                        rq.cancel()
+                        del rreqs[p]
+            if my == sent and all(v == sent for p, v in got.items()
+                                  if p not in my):
+                self.result_dead = set(my)
+                self.result_epoch = epoch + 1
+                logger.info(
+                    "ft agreement converged in %d round(s): dead=%s "
+                    "epoch=%d", rnd + 1, sorted(my), self.result_epoch)
+                return
+        if len(my) >= size - 1:
+            # everyone else is (believed) dead; trivially agreed
+            self.result_dead = set(my)
+            self.result_epoch = epoch + 1
+            return
+        raise UccError(Status.ERR_TIMED_OUT,
+                       "ft agreement did not converge")

@@ -235,6 +235,195 @@ def _recreate(teams, ctxs, report):
     return _make_team(ctxs)
 
 
+# ---------------------------------------------------------------------------
+# kill + shrink scenario (UCC_FT=shrink acceptance drill)
+# ---------------------------------------------------------------------------
+
+def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
+                         pre_iters: int = 6, post_iters: int = 60,
+                         hb_interval: float = 0.02,
+                         hb_timeout: float = 0.3,
+                         iter_deadline_s: float = 15.0,
+                         count: int = 64,
+                         matrix=DEFAULT_MATRIX) -> Dict:
+    """The full recovery pipeline under drill: run the matrix healthy,
+    kill one rank mid-run (``UCC_FAULT=kill``), assert every survivor
+    observes ``ERR_RANK_FAILED`` naming it, shrink, then complete
+    *post_iters* more matrix collectives on the shrunk team — with zero
+    ranks left IN_PROGRESS anywhere (the no-hang invariant, upgraded to
+    a *resume* guarantee).
+
+    Returns a report dict; ``report["violations"]`` MUST be empty.
+    """
+    from ucc_tpu import Status
+    from . import health
+
+    inject.reset()
+    prev_mode, prev_int, prev_to = (health.MODE, health.HEARTBEAT_INTERVAL,
+                                    health.HEARTBEAT_TIMEOUT)
+    health.configure("shrink", interval=hb_interval, timeout=hb_timeout)
+    ctxs = _make_job(n_ranks)
+    teams = _make_team(ctxs)
+    report: Dict = {"pre_iters": 0, "post_iters": 0, "violations": [],
+                    "outcomes": {}, "detected": {}, "agreed": {}}
+    bufs: Dict = {}
+    new_teams = None
+    try:
+        # -- healthy warm-up ------------------------------------------
+        for it in range(pre_iters):
+            coll = matrix[it % len(matrix)]
+            _drive_iter(ctxs, teams, coll, n_ranks, count, bufs,
+                        iter_deadline_s, report, "pre", range(n_ranks))
+            report["pre_iters"] += 1
+
+        # -- kill one rank --------------------------------------------
+        killed_ctx = ctxs[kill_rank].rank
+        inject.configure(f"kill={killed_ctx}", seed=0)
+        survivors = [r for r in range(n_ranks) if r != kill_rank]
+        report["killed"] = {"team_rank": kill_rank, "ctx_rank": killed_ctx}
+
+        # post one matrix iteration across the kill: survivors must
+        # reach ERR_RANK_FAILED naming the dead rank (fail-fast or
+        # health-cancel), nobody may park IN_PROGRESS
+        reqs = {}
+        for r in survivors:
+            try:
+                reqs[r] = teams[r].collective_init(
+                    _coll_args("allreduce", r, n_ranks, count, bufs, 0.0))
+                reqs[r].post()
+            except Exception as e:  # noqa: BLE001
+                report["violations"].append(
+                    f"survivor {r} post raised {type(e).__name__}: {e}")
+        deadline = time.monotonic() + iter_deadline_s
+        while time.monotonic() < deadline:
+            for c in ctxs:
+                c.progress()
+            if all(rq.test() != Status.IN_PROGRESS for rq in reqs.values()):
+                break
+        for r, rq in reqs.items():
+            st = rq.test()
+            named = rq.failed_ranks or []
+            report["detected"][r] = {"status": st.name, "ranks": named}
+            if st == Status.IN_PROGRESS:
+                report["violations"].append(
+                    f"survivor {r} still IN_PROGRESS after kill")
+                rq.task.cancel(Status.ERR_TIMED_OUT)
+            elif st != Status.ERR_RANK_FAILED:
+                report["violations"].append(
+                    f"survivor {r} saw {st.name}, not ERR_RANK_FAILED")
+            elif killed_ctx not in named:
+                report["violations"].append(
+                    f"survivor {r} attribution {named} misses ctx rank "
+                    f"{killed_ctx}")
+            try:
+                rq.finalize()
+            except Exception:  # noqa: BLE001
+                pass
+
+        # -- agree + shrink -------------------------------------------
+        shrinks = {r: teams[r].shrink_post() for r in survivors}
+        deadline = time.monotonic() + iter_deadline_s
+        while time.monotonic() < deadline:
+            for c in ctxs:
+                c.progress()
+            # NOTE: every request must be polled each pass (list, not a
+            # short-circuiting all()): ShrinkRequest.test() is what
+            # drives the rebuild's OOB rounds, like create_test
+            sts = [s.test() for s in shrinks.values()]
+            if all(st != Status.IN_PROGRESS for st in sts):
+                break
+        for r, s in shrinks.items():
+            st = s.test()
+            report["agreed"][r] = {"status": st.name,
+                                   "dead": s.failed_ranks,
+                                   "epoch": s.epoch}
+            if st != Status.OK:
+                report["violations"].append(
+                    f"survivor {r} shrink failed: {st.name}")
+        views = {(tuple(v["dead"] or ()), v["epoch"])
+                 for v in report["agreed"].values()}
+        if len(views) > 1:
+            report["violations"].append(
+                f"survivors diverged on (dead set, epoch): {views}")
+        if not report["violations"]:
+            new_teams = [shrinks[r].new_team for r in survivors]
+
+        # -- resume on the shrunk team --------------------------------
+        if new_teams:
+            nbufs: Dict = {}
+            nn = len(survivors)
+            for it in range(post_iters):
+                coll = matrix[it % len(matrix)]
+                _drive_iter([ctxs[r] for r in survivors], new_teams, coll,
+                            nn, count, nbufs, iter_deadline_s, report,
+                            "post", survivors, check=True)
+                report["post_iters"] += 1
+    finally:
+        report["injected"] = dict(inject.COUNTS)
+        inject.reset()
+        health.configure(prev_mode, interval=prev_int, timeout=prev_to)
+        for t in list(teams) + list(new_teams or ()):
+            try:
+                t.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+        for c in ctxs:
+            try:
+                c.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+    return report
+
+
+def _drive_iter(ctxs, teams, coll, n, count, bufs, deadline_s, report,
+                phase, rank_labels, check=False):
+    """Post one matrix collective on every team member, drive to
+    terminal, record outcomes; flags hangs and (optionally) failures as
+    violations."""
+    import numpy as np
+    from ucc_tpu import Status
+    reqs = [t.collective_init(_coll_args(coll, r, n, count, bufs, 0.0))
+            for r, t in enumerate(teams)]
+    for rq in reqs:
+        rq.post()
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        for c in ctxs:
+            c.progress()
+        if all(rq.test() != Status.IN_PROGRESS for rq in reqs):
+            break
+    sts = [rq.test() for rq in reqs]
+    for s in sts:
+        key = f"{phase}:{s.name}"
+        report["outcomes"][key] = report["outcomes"].get(key, 0) + 1
+    stuck = [r for r, s in zip(rank_labels, sts) if s == Status.IN_PROGRESS]
+    if stuck:
+        report["violations"].append(
+            f"{phase} iter {coll}: ranks {stuck} IN_PROGRESS past deadline")
+        for r, rq in zip(rank_labels, reqs):
+            if rq.test() == Status.IN_PROGRESS:
+                rq.task.cancel(Status.ERR_TIMED_OUT)
+    elif check:
+        bad = [r for r, s in zip(rank_labels, sts) if s != Status.OK]
+        if bad:
+            report["violations"].append(
+                f"{phase} iter {coll}: ranks {bad} failed "
+                f"({[s.name for s in sts]})")
+        elif coll == "allreduce":
+            expected = sum(g + 1.0 for g in range(n))
+            for g in range(n):
+                got = bufs[g]["ar"]
+                if not np.allclose(got, expected):
+                    report["violations"].append(
+                        f"{phase} iter {coll}: rank {g} wrong result "
+                        f"{got[0]} != {expected}")
+    for rq in reqs:
+        try:
+            rq.finalize()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def main(argv=None) -> int:
     import argparse
     import json
@@ -245,7 +434,17 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--coll-timeout", type=float, default=0.5)
     ap.add_argument("--iter-deadline", type=float, default=10.0)
+    ap.add_argument("--kill-shrink", action="store_true",
+                    help="run the kill+shrink recovery drill instead of "
+                    "the probabilistic soak (UCC_FT=shrink pipeline)")
+    ap.add_argument("--kill-rank", type=int, default=2)
+    ap.add_argument("--post-iters", type=int, default=60)
     args = ap.parse_args(argv)
+    if args.kill_shrink:
+        report = run_kill_shrink_soak(args.ranks, args.kill_rank,
+                                      post_iters=args.post_iters)
+        print(json.dumps(report, indent=1))
+        return 1 if report["violations"] else 0
     report = run_soak(args.ranks, args.iterations, args.spec, args.seed,
                       args.coll_timeout, args.iter_deadline)
     print(json.dumps(report, indent=1))

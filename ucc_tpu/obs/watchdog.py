@@ -98,6 +98,24 @@ def register_team(team: Any) -> None:
     TEAMS.add(team)
 
 
+def note_rank_failure(ranks, source: str = "", detail: str = "") -> None:
+    """Append a ``rank_failed`` evidence line to the watchdog file
+    (called by fault/health on detection). Only when the watchdog is
+    armed — CI harnesses (tools/tpu_probe.py, tools/snapshot_gate.py)
+    always arm it, and parse this line to classify a run
+    ``rank_failed(ranks=...)`` instead of ``hang``/``timeout``."""
+    if not ENABLED:
+        return
+    rec = {"ts": time.time(), "pid": os.getpid(), "reason": "rank_failed",
+           "failed_ranks": sorted(int(r) for r in ranks),
+           "source": source, "detail": detail}
+    try:
+        with open(_file, "a") as fh:
+            fh.write(json.dumps(rec, default=str) + "\n")
+    except OSError:
+        logger.exception("watchdog rank-failure note write failed")
+
+
 # ---------------------------------------------------------------------------
 # scan — called from ProgressQueue.progress() under `if watchdog.ENABLED:`
 # ---------------------------------------------------------------------------
@@ -187,6 +205,17 @@ def _escalate(queue: Any, now: float) -> bool:
     if hard:
         targets = [t for t in q if not t.is_completed()] \
             if ACTION == "abort" else hard
+        # failure attribution (UCC_FT=shrink): before cancelling, report
+        # each hard-stalled task's outstanding recv peers to the health
+        # registry as suspects — a suspect whose heartbeat is also stale
+        # is confirmed failed, feeding the shrink pipeline
+        reg = getattr(queue, "_ft_health", None)
+        if reg is not None:
+            for t in hard:
+                try:
+                    reg.suspect_task_peers(t, now)
+                except Exception:  # noqa: BLE001 - attribution best-effort
+                    pass
         for t in targets:
             logger.error(
                 "WATCHDOG: %s: cancelling task %s seq %s (coll=%s alg=%s) "

@@ -14,6 +14,7 @@ import time
 from collections import deque
 from typing import Callable, Deque, List
 
+from ..fault import health
 from ..fault import inject as fault
 from ..obs import metrics, watchdog
 from ..status import Status
@@ -76,6 +77,10 @@ class ProgressQueue:
         if fault.ENABLED:
             # release injected delayed deliveries that have come due
             fault.progress()
+        if health.ENABLED:
+            # UCC_FT=shrink: heartbeat + peer-liveness scan; cancels
+            # tasks depending on failed ranks with ERR_RANK_FAILED
+            health.check(self)
         if not self._q:
             return 0
         completed = 0

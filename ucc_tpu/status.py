@@ -29,6 +29,7 @@ class Status(enum.IntEnum):
     ERR_NOT_FOUND = -7
     ERR_TIMED_OUT = -8
     ERR_CANCELED = -9
+    ERR_RANK_FAILED = -10      # a team member died (see RankFailedError)
     ERR_LAST = -100
 
     @property
@@ -52,6 +53,7 @@ _STATUS_STR = {
     Status.ERR_NOT_FOUND: "Not found",
     Status.ERR_TIMED_OUT: "Operation timed out",
     Status.ERR_CANCELED: "Operation canceled",
+    Status.ERR_RANK_FAILED: "A team member rank has failed",
 }
 
 
@@ -61,6 +63,20 @@ class UccError(Exception):
     def __init__(self, status: Status, msg: str = ""):
         self.status = Status(status)
         super().__init__(f"{self.status.name}: {msg}" if msg else self.status.name)
+
+
+class RankFailedError(UccError):
+    """ERR_RANK_FAILED carrying the failed-rank set (context ranks unless
+    the raiser documents otherwise) — the ULFM UCC_ERR_PROC_FAILED analog.
+    Callers recover by agreeing on the failed set and shrinking the team
+    (``Team.shrink``)."""
+
+    def __init__(self, msg: str = "", ranks=()):
+        self.ranks = frozenset(int(r) for r in ranks)
+        detail = msg or "rank failure"
+        if self.ranks:
+            detail = f"{detail} (ranks {sorted(self.ranks)})"
+        super().__init__(Status.ERR_RANK_FAILED, detail)
 
 
 def check(status, msg: str = ""):

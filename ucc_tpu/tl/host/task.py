@@ -19,10 +19,11 @@ from typing import Any, Iterable, List, Optional
 
 import numpy as np
 
+from ...fault import health as ft
 from ...fault import inject as fault
 from ...obs import metrics, watchdog
 from ...schedule.task import CollTask
-from ...status import Status, UccError
+from ...status import RankFailedError, Status, UccError
 from ...utils import profiling
 from ...utils.ep_map import Subset
 from .transport import RecvReq, SendReq
@@ -71,7 +72,7 @@ class HostCollTask(CollTask):
         # per message (subsystems enabled mid-collective take effect at
         # the next post — acceptable for diagnostics)
         self._instr = (metrics.ENABLED or profiling.ENABLED or
-                       watchdog.ENABLED or fault.ENABLED)
+                       watchdog.ENABLED or fault.ENABLED or ft.ENABLED)
         self._gen = self.run()
         self._advance()
         return Status.OK
@@ -279,8 +280,37 @@ class HostCollTask(CollTask):
                                             self.tag, slot, data)
         return self._send_nb_instr(peer_grank, data, slot)
 
+    def _health_registry(self):
+        core = getattr(self.tl_team, "core_team", None)
+        ctx = getattr(core, "context", None)
+        return getattr(ctx, "health", None)
+
+    def _check_peer_alive(self, peer_grank: int) -> None:
+        """Fail-fast for posts targeting a known-dead rank: without this
+        a send TO a killed rank silently black-holes (delivered into a
+        mailbox nobody drains) and the peer side waits out the full
+        watchdog timeout. Raises ERR_RANK_FAILED with attribution; the
+        detection is counted once per rank in ``rank_failures_detected``.
+        """
+        ctx = self._ctx_of(peer_grank)
+        reg = self._health_registry()
+        if fault.ENABLED and fault.killed(ctx):
+            source = "inject"
+        elif reg is not None and reg.is_dead(ctx):
+            source = reg.dead.get(ctx, {}).get("source", "health")
+        else:
+            return
+        ft.note_dead_target(ctx, reg, "send",
+                            "post targeted a known-dead rank")
+        self.failed_ranks = sorted(
+            (reg.dead_set() if reg is not None else set()) | {ctx})
+        raise RankFailedError(
+            f"post targets failed ctx rank {ctx} ({source})", ranks={ctx})
+
     def _send_nb_instr(self, peer_grank: int, data: np.ndarray,
                        slot: int) -> SendReq:
+        if ft.ENABLED or (fault.ENABLED and fault.SPEC.kill):
+            self._check_peer_alive(peer_grank)
         if fault.ENABLED:
             req = self._fault_send(peer_grank, data, slot)
             if req is not None:
@@ -345,6 +375,10 @@ class HostCollTask(CollTask):
 
     def _recv_nb_instr(self, peer_grank: int, dst: np.ndarray,
                        slot: int) -> RecvReq:
+        if ft.ENABLED or (fault.ENABLED and fault.SPEC.kill):
+            # a recv FROM a dead rank can never complete: same fail-fast
+            # + attribution as the send side
+            self._check_peer_alive(peer_grank)
         if fault.ENABLED and fault.recv_action(
                 getattr(self.tl_team, "_my_ctx_rank", None)) == "error":
             self._obs_error("fault injected: recv post failed")

@@ -64,6 +64,11 @@ class HostTlTeam(TlTeamBase):
         self.ctx_map: EpMap = core_team.ctx_map or EpMap.full(core_team.size)
         self._coll_tag = 0
         self._my_ctx_rank = core_team.context.rank
+        #: recovery epoch, stamped into every match key: a team rebuilt
+        #: after a rank-failure shrink gets a higher epoch, and survivors
+        #: fence the old one so stale pre-shrink sends are discarded
+        #: instead of matching a post-shrink recv (transport.Mailbox)
+        self.team_epoch = int(getattr(core_team, "epoch", 0))
 
     # ------------------------------------------------------------------
     def full_subset(self) -> Subset:
@@ -139,7 +144,8 @@ class HostTlTeam(TlTeamBase):
 
     # -- p2p by group rank ---------------------------------------------
     def _key(self, coll_tag: int, slot: int, src_ctx_rank: int) -> TagKey:
-        return (self.team_key, coll_tag, slot, src_ctx_rank)
+        return (self.team_key, self.team_epoch, coll_tag, slot,
+                src_ctx_rank)
 
     def _peer_ctx_rank(self, subset: Subset, grank: int) -> int:
         return self.ctx_map.eval(subset.map.eval(grank))
@@ -161,13 +167,13 @@ class HostTlTeam(TlTeamBase):
     def send_nb_ctx(self, peer_ctx: int, coll_tag: int, slot: int,
                     data: np.ndarray):
         return self.comp_context.send_to(
-            peer_ctx, (self.team_key, coll_tag, slot, self._my_ctx_rank),
-            data)
+            peer_ctx, (self.team_key, self.team_epoch, coll_tag, slot,
+                       self._my_ctx_rank), data)
 
     def recv_nb_ctx(self, peer_ctx: int, coll_tag: int, slot: int,
                     dst: np.ndarray):
         return self.transport.recv_nb(
-            (self.team_key, coll_tag, slot, peer_ctx), dst)
+            (self.team_key, self.team_epoch, coll_tag, slot, peer_ctx), dst)
 
     def _ag_large_alg(self) -> str:
         """Topology-aware large-message allgather default

@@ -27,8 +27,13 @@ import numpy as np
 
 from ...status import Status
 
-#: matching key: (team_key, coll_tag, slot, src_uid)
-TagKey = Tuple[Any, int, int, int]
+#: matching key: (team_key, epoch, coll_tag, slot, src_uid). The epoch
+#: field is the team's recovery epoch (0 for every team that never
+#: shrank): after a rank-failure shrink the survivors fence the old
+#: (team_key, epoch) space so a stale pre-shrink send can never match a
+#: post-shrink recv — without it, a late message from the dead team
+#: could scribble into a pool-reissued lease buffer (see Mailbox.fence).
+TagKey = Tuple[Any, int, int, int, int]
 
 
 class SendReq:
@@ -104,6 +109,46 @@ class Mailbox:
         self.unexpected: Dict[TagKey, deque] = {}
         #: key -> deque of RecvReq (posted receives)
         self.posted: Dict[TagKey, deque] = {}
+        #: epoch fences: team_key -> minimum accepted epoch. Empty (the
+        #: default, and always under UCC_FT=none) costs one falsy dict
+        #: test per message; once a team shrinks, messages keyed to an
+        #: older epoch of a fenced team_key are DISCARDED at the matching
+        #: boundary instead of parked or delivered.
+        self.fences: Dict[Any, int] = {}
+
+    def _is_fenced(self, key: TagKey) -> bool:
+        """Caller holds self.lock and has checked ``self.fences`` truthy.
+        Non-team keys (one-sided replies etc.) never collide with a
+        team_key, so the epoch comparison only runs for fenced teams."""
+        f = self.fences.get(key[0])
+        return f is not None and key[1] < f
+
+    def fence(self, team_key, min_epoch: int) -> int:
+        """Fence every epoch of *team_key* below *min_epoch*: record the
+        floor for future arrivals and purge already-parked state — posted
+        recvs error out as "fenced" (their buffers may be reclaimed by
+        the caller), unexpected sends are dropped and their send reqs
+        completed (the sender must stop waiting; the data is gone with
+        the old epoch). Returns the number of purged entries."""
+        purged = 0
+        with self.lock:
+            cur = self.fences.get(team_key)
+            if cur is None or min_epoch > cur:
+                self.fences[team_key] = min_epoch
+            for key in [k for k in self.posted
+                        if k[0] == team_key and k[1] < min_epoch]:
+                for req in self.posted.pop(key):
+                    if not req.done:
+                        req.error = req.error or "fenced: stale team epoch"
+                        req.done = True
+                    req.cancelled = True
+                    purged += 1
+            for key in [k for k in self.unexpected
+                        if k[0] == team_key and k[1] < min_epoch]:
+                for ps in self.unexpected.pop(key):
+                    ps.req.done = True
+                    purged += 1
+        return purged
 
     def _match_posted_locked(self, key: TagKey) -> Optional[RecvReq]:
         """Pop the first live (non-cancelled) posted recv for *key*.
@@ -122,6 +167,9 @@ class Mailbox:
         # on the same lock, so a recv cannot be cancelled (and its
         # buffer reclaimed) between being matched and being written
         with self.lock:
+            if self.fences and self._is_fenced(key):
+                ps.req.done = True   # discarded: stale-epoch delivery
+                return
             req = self._match_posted_locked(key)
             if req is None:
                 self.unexpected.setdefault(key, deque()).append(ps)
@@ -145,6 +193,10 @@ class Mailbox:
         window stays small — always-eager mode (limit=inf) trades that
         for sender-buffer freedom, by explicit configuration."""
         with self.lock:
+            if self.fences and self._is_fenced(key):
+                # stale-epoch send: complete-and-discard so the sender
+                # proceeds (its team is gone; nothing will ever recv this)
+                return SendReq(done=True), "fenced"
             req = self._match_posted_locked(key)
             if req is not None:
                 ps = _PendingSend(data_u8, SendReq(), copied=False)
@@ -163,6 +215,13 @@ class Mailbox:
     def post_recv(self, key: TagKey, req: RecvReq) -> None:
         with self.lock:
             req._mb = self
+            if self.fences and self._is_fenced(key):
+                # posting into a fenced epoch is a stale-team bug on the
+                # LOCAL side; fail the recv rather than park it forever
+                req.error = "fenced: stale team epoch"
+                req.cancelled = True
+                req.done = True
+                return
             uq = self.unexpected.get(key)
             if uq:
                 ps = uq.popleft()
@@ -255,6 +314,7 @@ class InProcTransport:
         self.n_direct = 0        # copy-free deliveries into posted recvs
         self.n_eager = 0         # unexpected sends staged via eager copy
         self.n_rndv = 0          # unexpected zero-copy rendezvous views
+        self.n_fenced = 0        # stale-epoch sends discarded at the fence
         self.native = None
         if use_native is None:
             import os
@@ -313,6 +373,8 @@ class InProcTransport:
             self.n_direct += 1
         elif kind == "eager":
             self.n_eager += 1
+        elif kind == "fenced":
+            self.n_fenced += 1
         else:
             self.n_rndv += 1
         return req
@@ -324,6 +386,19 @@ class InProcTransport:
         req = RecvReq(dst.reshape(-1).view(np.uint8))
         self.mailbox.post_recv(key, req)
         return req
+
+    def fence(self, team_key, min_epoch: int) -> int:
+        """Epoch-fence *team_key* on this endpoint's receive side (see
+        Mailbox.fence). The native matcher has no fence support — teams
+        running rank-failure recovery keep the python matcher (documented
+        FT limitation); the warning makes a silent mismatch loud."""
+        if self.native is not None:
+            from ...utils.log import get_logger
+            get_logger("tl_shm").warning(
+                "epoch fence requested on a native-matcher endpoint; "
+                "stale-epoch messages in the native mailbox are NOT "
+                "purged (UCC_FT=shrink requires the python matcher)")
+        return self.mailbox.fence(team_key, min_epoch)
 
     def progress(self) -> None:
         pass  # delivery happens inline at send/recv

@@ -289,6 +289,12 @@ class SocketTransport:
         self.mailbox.post_recv(key, req)
         return req
 
+    def fence(self, team_key, min_epoch: int) -> int:
+        """Epoch-fence this endpoint's receive side: in-flight frames of
+        the fenced epoch are discarded by Mailbox.push on arrival (the
+        reader thread funnels every frame through it)."""
+        return self.mailbox.fence(team_key, min_epoch)
+
     # -- one-sided initiator side --------------------------------------
     def _reply_key(self) -> tuple:
         with self._lock:
