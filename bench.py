@@ -142,6 +142,10 @@ def _measure_point(coll: str, count: int, ctxs, teams, devices, mesh,
     srcs = [jax.device_put(jnp.ones((count,), jnp.float32), devices[r])
             for r in range(n)]
     argses, reqs = _persistent_reqs(coll, teams, ctxs, srcs, count, n)
+    # which algorithm the score map selected for this point (ISSUE 5
+    # satellite): read back from the dispatched task so BENCH_r*.json
+    # trajectories can attribute busbw changes to selection changes
+    alg = str(getattr(reqs[0].task, "alg_name", "") or "")
 
     def one_round():
         for rq in reqs:
@@ -192,7 +196,7 @@ def _measure_point(coll: str, count: int, ctxs, teams, devices, mesh,
         "steady_state_allocs": pool1["misses"] - pool0["misses"],
     }
     return (ucc_time, raw_time, _busbw(coll, nbytes, n, ucc_time),
-            _busbw(coll, nbytes, n, raw_time), pool_stats)
+            _busbw(coll, nbytes, n, raw_time), pool_stats, alg)
 
 
 def main(sweep: bool = False) -> None:
@@ -219,9 +223,9 @@ def main(sweep: bool = False) -> None:
             if coll == "alltoall" and cnt % n:
                 cnt += n - cnt % n
             it = max(6, iters // (2 if cnt >= (1 << 20) else 1))
-            ut, rt, ub, rb, pool = _measure_point(coll, cnt, ctxs, teams,
-                                                  devices, mesh, it,
-                                                  warmup=4)
+            ut, rt, ub, rb, pool, alg = _measure_point(coll, cnt, ctxs,
+                                                       teams, devices,
+                                                       mesh, it, warmup=4)
             # platform is recorded so consumers (tools/tpu_probe.py) can
             # tell a real-accelerator sweep from the CPU-mesh fallback
             plat = devices[0].platform
@@ -231,7 +235,7 @@ def main(sweep: bool = False) -> None:
                     "unit": "GB/s/chip",
                     "vs_baseline": round(ub / rb, 4) if rb else 0.0,
                     "detail": {"n_chips": n, "msg_bytes": cnt * 4,
-                               "platform": plat,
+                               "platform": plat, "alg": alg,
                                "ucc_lat_ms": round(ut * 1e3, 3),
                                "raw_lat_ms": round(rt * 1e3, 3),
                                "mc_pool": pool}}
@@ -244,13 +248,13 @@ def main(sweep: bool = False) -> None:
                     "value": round(ut * 1e6, 2), "unit": "us (full stack)",
                     "vs_baseline": round(rt / ut, 4) if ut else 0.0,
                     "detail": {"n_chips": n, "msg_bytes": cnt * 4,
-                               "platform": plat,
+                               "platform": plat, "alg": alg,
                                "raw_lat_us": round(rt * 1e6, 2),
                                "mc_pool": pool}}
             print(json.dumps(rec))
         return
 
-    ucc_time, raw_time, ucc_bw, raw_bw, pool = _measure_point(
+    ucc_time, raw_time, ucc_bw, raw_bw, pool, alg = _measure_point(
         "allreduce", count, ctxs, teams, devices, mesh, iters, warmup=5)
     nbytes = count * 4
 
@@ -265,6 +269,7 @@ def main(sweep: bool = False) -> None:
                 "n_chips": n,
                 "msg_bytes": nbytes,
                 "platform": devices[0].platform,
+                "alg": alg,
                 "ucc_lat_ms": round(ucc_time * 1e3, 3),
                 "raw_psum_lat_ms": round(raw_time * 1e3, 3),
                 "raw_busbw_GBps": round(raw_bw, 3),
@@ -286,6 +291,7 @@ def main(sweep: bool = False) -> None:
                 "n_chips": n,
                 "msg_bytes": nbytes,
                 "platform": devices[0].platform,
+                "alg": alg,
                 "raw_psum_lat_us": round(raw_time * 1e6, 2),
                 "mc_pool": pool,
                 "note": "single-chip: latency comparison (busbw undefined); "

@@ -149,6 +149,168 @@ class TestUpdateFromStr:
         assert "allreduce/host" in info and "knomial:10" in info
 
 
+class TestDeterministicTieBreak:
+    """ISSUE 5 satellite: equal-score candidates must order by content
+    (score desc, then alg name, then component, then registration), not
+    construction history — a cross-rank divergence in that order makes
+    ranks pick different algorithms for one collective and deadlocks."""
+
+    def _map_with_insertion(self, names):
+        s = CollScore()
+        for nm in names:
+            s.add_range(CollType.ALLREDUCE, MemoryType.HOST, 0, SIZE_INF,
+                        10, mkinit(nm), "tl_x", nm)
+        return ScoreMap(s)
+
+    def test_equal_score_orders_by_name_not_insertion(self):
+        m1 = self._map_with_insertion(["zeta", "alpha"])
+        m2 = self._map_with_insertion(["alpha", "zeta"])
+        l1 = [c.alg_name for c in
+              m1.lookup(CollType.ALLREDUCE, MemoryType.HOST, 100)]
+        l2 = [c.alg_name for c in
+              m2.lookup(CollType.ALLREDUCE, MemoryType.HOST, 100)]
+        assert l1 == l2 == ["alpha", "zeta"]
+
+    def test_two_equal_score_ranges_regression(self):
+        # the satellite's regression shape: two candidates carrying two
+        # equal-score ranges each, inserted in opposite orders — every
+        # lookup point must agree on the full candidate order
+        def build(order):
+            s = CollScore()
+            for nm in order:
+                s.add_range(CollType.BCAST, MemoryType.HOST, 0, 4096, 7,
+                            mkinit(nm), "tl_x", nm)
+                s.add_range(CollType.BCAST, MemoryType.HOST, 4096,
+                            SIZE_INF, 7, mkinit(nm), "tl_x", nm)
+            return ScoreMap(s)
+
+        a = build(["ring", "knomial"])
+        b = build(["knomial", "ring"])
+        for msg in (128, 1 << 20):
+            la = [c.alg_name for c in
+                  a.lookup(CollType.BCAST, MemoryType.HOST, msg)]
+            lb = [c.alg_name for c in
+                  b.lookup(CollType.BCAST, MemoryType.HOST, msg)]
+            assert la == lb == ["knomial", "ring"]
+
+    def test_score_still_dominates_name(self):
+        s = CollScore()
+        s.add_range(CollType.ALLREDUCE, MemoryType.HOST, 0, SIZE_INF, 5,
+                    mkinit("alpha"), "tl_x", "alpha")
+        s.add_range(CollType.ALLREDUCE, MemoryType.HOST, 0, SIZE_INF, 50,
+                    mkinit("zeta"), "tl_x", "zeta")
+        m = ScoreMap(s)
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST,
+                        10)[0].alg_name == "zeta"
+
+
+class TestTuneDslEdges:
+    """ISSUE 5 satellite: parse_tune_str / update_from_str edge cases."""
+
+    def _score(self):
+        s = CollScore()
+        s.add_range(CollType.ALLREDUCE, MemoryType.HOST, 0, SIZE_INF, 10,
+                    mkinit("kn"), "tl_x", "knomial")
+        return s
+
+    def test_overlapping_updates_split_at_boundaries(self):
+        s = self._score()
+        assert s.update_from_str("allreduce:0-8k:20") == Status.OK
+        assert s.update_from_str("allreduce:4k-16k:30") == Status.OK
+        m = ScoreMap(s)
+
+        def score_at(msg):
+            return m.lookup(CollType.ALLREDUCE, MemoryType.HOST, msg)[0].score
+
+        assert score_at(2 << 10) == 20       # [0,4k) keeps first overlay
+        assert score_at(6 << 10) == 30       # [4k,8k) split by second
+        assert score_at(12 << 10) == 30      # [8k,16k)
+        assert score_at(1 << 20) == 10       # untouched tail
+
+    def test_multiple_ranges_one_section(self):
+        s = self._score()
+        assert s.update_from_str("allreduce:0-1k:4k-8k:99") == Status.OK
+        m = ScoreMap(s)
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 512)[0].score == 99
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 2048)[0].score == 10
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 6144)[0].score == 99
+
+    def test_inf_forces_over_higher_default(self):
+        s = self._score()
+        s.add_range(CollType.ALLREDUCE, MemoryType.HOST, 0, SIZE_INF, 90,
+                    mkinit("ring"), "tl_x", "ring")
+
+        def resolver(coll, alg):
+            return mkinit("kn2") if alg == "knomial" else None
+
+        assert s.update_from_str("allreduce:0-4k:@knomial:inf",
+                                 resolver) == Status.OK
+        m = ScoreMap(s)
+        lo = m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 100)
+        assert lo[0].score == SCORE_MAX
+        task, _ = m.init_coll(CollType.ALLREDUCE, MemoryType.HOST, 100, "a")
+        assert task[0] == "kn2"
+        hi = m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 1 << 20)
+        assert hi[0].alg_name == "ring"      # outside the forced window
+
+    def test_score_zero_disables_subrange_only(self):
+        s = self._score()
+        assert s.update_from_str("allreduce:4k-inf:0") == Status.OK
+        m = ScoreMap(s)
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 100) != []
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 1 << 20) == []
+
+    @pytest.mark.parametrize("bad", [
+        "allreduce:@a:@b",            # duplicate alg token
+        "allreduce:-5",               # negative score
+        "allreduce:4k-x1",            # unparseable range bound
+        "allreduce:not a token",      # garbage
+    ])
+    def test_malformed_tokens_raise_and_error(self, bad):
+        with pytest.raises(ValueError):
+            parse_tune_str(bad)
+        s = self._score()
+        assert s.update_from_str(bad) == Status.ERR_INVALID_PARAM
+
+    def test_empty_sections_are_skipped(self):
+        assert parse_tune_str("##  #") == []
+
+
+class TestProvenance:
+    """ISSUE 5 satellite: print_info marks every range with why it won
+    (default | tune-str | learned), surfaced via team logs/ucc_info -s."""
+
+    def test_origins_tracked_and_printed(self):
+        s = CollScore()
+        s.add_range(CollType.ALLREDUCE, MemoryType.HOST, 0, SIZE_INF, 10,
+                    mkinit("kn"), "tl_x", "knomial")
+        s.add_range(CollType.ALLREDUCE, MemoryType.HOST, 0, SIZE_INF, 5,
+                    mkinit("ring"), "tl_x", "ring")
+        assert s.update_from_str("allreduce:0-4k:20") == Status.OK
+        m = ScoreMap(s)
+        assert m.apply_learned(CollType.ALLREDUCE, MemoryType.HOST,
+                               4096, 1 << 20, "ring")
+        info = m.print_info("t0")
+        assert "(default)" in info
+        assert "(tune-str)" in info
+        assert "(learned)" in info
+        # and the learned promotion actually wins inside its window only
+        win = m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 64 << 10)[0]
+        assert win.alg_name == "ring" and win.origin == "learned"
+        out = m.lookup(CollType.ALLREDUCE, MemoryType.HOST, 2 << 20)[0]
+        assert out.alg_name == "knomial"
+
+    def test_apply_learned_unknown_alg_is_noop(self):
+        s = CollScore()
+        s.add_range(CollType.ALLREDUCE, MemoryType.HOST, 0, SIZE_INF, 10,
+                    mkinit("kn"), "tl_x", "knomial")
+        m = ScoreMap(s)
+        assert not m.apply_learned(CollType.ALLREDUCE, MemoryType.HOST,
+                                   0, 4096, "no_such_alg")
+        assert m.lookup(CollType.ALLREDUCE, MemoryType.HOST,
+                        100)[0].origin == "default"
+
+
 class TestTopologyAwareAllgatherDefault:
     """The large-message allgather winner is topology-dependent, like
     the reference's dynamic score string (allgather.c:55-100)."""

@@ -184,6 +184,67 @@ def _perf_smoke(env) -> None:
           f"in {dt:.0f}s -> {verdict}", flush=True)
 
 
+def _tuner_smoke(env) -> None:
+    """WARN-ONLY autotuner probe (ISSUE 5 CI satellite, same warn-only
+    harness as the PR-3 perf smoke): `ucc_tune --gate-smoke` sweeps one
+    allreduce point, round-trips the winners through the tuning cache,
+    and reports tuned vs default latency. Warn when the tuned selection
+    is slower than the static default beyond the tolerance band
+    (UCC_GATE_TUNER_TOL, default 25%) or the learned selection failed to
+    engage. Skip with UCC_GATE_TUNER=0."""
+    import json
+    if os.environ.get("UCC_GATE_TUNER", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] tuner smoke: skipped (UCC_GATE_TUNER=0)", flush=True)
+        return
+    try:
+        tol = float(os.environ.get("UCC_GATE_TUNER_TOL", "0.25"))
+    except ValueError:
+        tol = 0.25
+    print("[gate] tuner smoke (warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    # same de-instrumentation as the perf smoke: watchdog/fault/stats
+    # would bias both sides of the comparison onto the slow hook path
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE",
+                                      "UCC_TUNER"))}
+    try:
+        r = subprocess.run([sys.executable, "-m", "ucc_tpu.tools.tune",
+                            "--gate-smoke"], cwd=REPO, env=smoke_env,
+                           capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        print("[gate] WARN: tuner smoke timed out (not a gate failure)",
+              flush=True)
+        return
+    rec = None
+    for ln in (r.stdout or "").splitlines():
+        if ln.startswith("{"):
+            try:
+                cand = json.loads(ln)
+            except ValueError:
+                continue
+            if cand.get("metric") == "tuner_gate_smoke":
+                rec = cand
+    dt = time.monotonic() - t0
+    if rec is None or rec.get("error"):
+        why = (rec or {}).get("error") or f"rc={r.returncode}, no record"
+        print(f"[gate] WARN: tuner smoke — {why} in {dt:.0f}s "
+              f"(not a gate failure)", flush=True)
+        return
+    tuned = float(rec.get("tuned_us") or 0.0)
+    default = float(rec.get("default_us") or 0.0)
+    ceil = default * (1.0 + tol)
+    verdict = "OK"
+    if not rec.get("learned_selection"):
+        verdict = "WARN: learned selection did not engage"
+    elif default and tuned > ceil:
+        verdict = f"WARN: tuned slower than default + {tol:.0%} tolerance"
+    print(f"[gate] tuner smoke: tuned {tuned:.1f}us vs default "
+          f"{default:.1f}us (winner {rec.get('winner')}, ceiling "
+          f"{ceil:.1f}us) in {dt:.0f}s -> {verdict}", flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -234,6 +295,9 @@ def main(argv=None) -> int:
         # warn-only: surfaces perf regressions in-PR without making the
         # gate flaky on a noisy shared box (ISSUE 3 CI satellite)
         _perf_smoke(env)
+        # warn-only: tuned allreduce >= default - tolerance through the
+        # offline sweep -> cache -> reload round trip (ISSUE 5 satellite)
+        _tuner_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

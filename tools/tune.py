@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Offline autotuner sweep (repo-root entry).
+
+Thin shim over the packaged CLI — the implementation lives in
+ucc_tpu/tools/tune.py (installed as the `ucc_tune` console script).
+Sweeps every registered score-map candidate over a msg-size grid on a
+live team and writes the topology-keyed tuning cache that
+UCC_TUNER=offline|online loads at team activation.
+
+    python tools/tune.py -p 4 -c allreduce -b 8 -e 1M
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ucc_tpu.tools.tune import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,11 +96,19 @@ _FALLBACK_ELIGIBLE = frozenset((Status.ERR_NOT_SUPPORTED,
 class CollRequest:
     """ucc_coll_req_h: post/test/finalize + persistent re-post."""
 
+    #: autotuner probe lane (score/tuner.py): while a (coll, mem,
+    #: size-bucket) key is still exploring, ``_bind_tuner`` shadows the
+    #: class ``post`` with ``_tuner_post`` as an INSTANCE attribute —
+    #: the PR-3 ``_instr`` binding pattern, so UCC_TUNER=off adds no
+    #: per-post branch to this hot path
+    _tuner = None
+
     def __init__(self, task: CollTask, team: Team, args: CollArgs):
         self.task = task
         self.team = team
         self.args = args
         self._posted = False
+        self._finalized = False
         #: runtime fallback chain: (init_args, [remaining MsgRange]) set
         #: by collective_init for plain (unwrapped, non-persistent) tasks
         self._fallback = None
@@ -186,6 +194,148 @@ class CollRequest:
         except Exception:  # noqa: BLE001 - opt-in probe must never break post
             self._fast = False
         return self._fast
+
+    # ------------------------------------------------------------------
+    # autotuner probe lane (UCC_TUNER=online; score/tuner.py)
+    def _bind_tuner(self, tuner, key, init_args, candidates,
+                    chosen) -> None:
+        self._tuner = tuner
+        self._tuner_key = key
+        self._tuner_ia = init_args
+        self._tuner_cands = candidates
+        self._tuner_cur = chosen
+        self._tuner_user_cb = self.task.cb   # restore target on unbind
+        self._tuner_wrapped_cb = None
+        self.post = self._tuner_post         # shadow the class method
+
+    def _tuner_unbind(self) -> None:
+        if self._tuner_wrapped_cb is not None and \
+                self.task.cb is self._tuner_wrapped_cb:
+            self.task.cb = self._tuner_user_cb
+        self._tuner_wrapped_cb = None
+        self._tuner = None
+        self.__dict__.pop("post", None)      # back to the class post
+
+    def _tuner_swap_task(self, cand, new_task) -> None:
+        old = self.task
+        try:
+            old.finalize()
+        except Exception:  # noqa: BLE001 - probe teardown is best-effort
+            pass
+        new_task.coll_name = old.coll_name
+        new_task.alg_name = str(cand.alg_name or cand.team)
+        new_task.timeout = old.timeout
+        _attach_user_opts(new_task, self.args)
+        if profiling.ENABLED:
+            _attach_profiling(new_task, self.args.coll_type)
+        self.task = new_task
+        self._tuner_cur = cand
+        self._tuner_user_cb = new_task.cb
+        self._tuner_wrapped_cb = None
+
+    def _tuner_swap_to_winner(self, winner) -> None:
+        """Re-init the frozen winner under a persistent request so later
+        re-posts run it without another collective_init. An init failure
+        propagates: every peer switches to the team-agreed winner at
+        this same post, so a rank that cannot run it must fail loudly —
+        silently keeping a different algorithm would deadlock the team.
+        """
+        from ..score.tuner import cand_label
+        if cand_label(self._tuner_cur) == winner:
+            return
+        for cand in self._tuner_cands:
+            if cand.init is None or cand_label(cand) != winner:
+                continue
+            new_task = cand.init(self._tuner_ia, cand.team)
+            self._tuner_swap_task(cand, new_task)
+            return
+
+    def _tuner_post(self) -> Status:
+        """Exploration-round post: deterministic candidate rotation with
+        post->completion timing, until the rank-0 decision freezes the
+        key and the request drops back to the plain post path."""
+        from ..score.tuner import cand_label
+        task = self.task
+        st = task.super_status
+        if self._posted and st == Status.IN_PROGRESS:
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           "collective re-posted while in progress")
+        if self._posted and not self._persistent:
+            # same user-error contract as the class post(); silently
+            # re-running would also consume an exploration slot on this
+            # rank only and desync the lockstep per-key counters
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           "re-post of non-persistent collective")
+        if task.triggered_task is not None:
+            # EE-dispatched request: the EE installed observers on THIS
+            # task, so keep the plain lifecycle (EE use is symmetric
+            # across ranks, so leaving without consuming a rotation
+            # slot cannot desynchronize the counters)
+            self._tuner_unbind()
+            return self.post()
+        tuner = self._tuner
+        key = self._tuner_key
+        frozen, winner = tuner.poll(key)
+        if frozen:
+            if winner is not None:
+                self._tuner_swap_to_winner(winner)
+            self._tuner_unbind()
+            return self.post()
+        if not tuner.claim(key, self):
+            # another un-finalized request drives this key (overlapped
+            # posts): the key just froze to static defaults — leave the
+            # probe lane without consuming a rotation slot
+            self._tuner_unbind()
+            return self.post()
+        new_task = None
+        chosen = None
+        for cand in tuner.explore_order(key, self._tuner_cands):
+            if cand is self._tuner_cur:
+                new_task, chosen = task, cand
+                break
+            try:
+                new_task = cand.init(self._tuner_ia, cand.team)
+            except UccError as e:
+                if e.status != Status.ERR_NOT_SUPPORTED:
+                    # only NOT_SUPPORTED is symmetric across ranks (a
+                    # pure function of the args, like init_coll's
+                    # fallback walk). A rank-local transient failure
+                    # must surface, not silently shift this rank's
+                    # deterministic rotation off its peers'
+                    raise
+                tuner.record_unsupported(key, cand)
+                continue
+            chosen = cand
+            break
+        if new_task is None:
+            # nothing explorable survived init: leave the probe lane
+            self._tuner_unbind()
+            return self.post()
+        if new_task is not task:
+            self._tuner_swap_task(chosen, new_task)
+        elif self._posted:
+            new_task.reset()
+        self._posted = True
+        new_task.progress_queue = self.team.context.progress_queue
+        if metrics.ENABLED:
+            metrics.inc("coll_posted", component="core",
+                        coll=new_task.coll_name or "",
+                        alg=new_task.alg_name or "")
+        if self._trace:
+            logger.info("coll post (tuner explore): %s alg %s team %s "
+                        "seq %d", new_task.coll_name, new_task.alg_name,
+                        self.team.id, new_task.seq_num)
+        label = cand_label(chosen)
+        t0 = time.perf_counter()
+        user_cb = self._tuner_user_cb
+
+        def cb(t, s, _t0=t0):
+            tuner.record(key, label, time.perf_counter() - _t0, s)
+            if user_cb is not None:
+                user_cb(t, s)
+        new_task.cb = cb
+        self._tuner_wrapped_cb = cb
+        return new_task.post()
 
     def test(self) -> Status:
         st = self.task.super_status
@@ -274,6 +424,10 @@ class CollRequest:
         if self.task.super_status == Status.IN_PROGRESS:
             raise UccError(Status.ERR_INVALID_PARAM,
                            "finalize of in-progress collective")
+        # program-order marker the autotuner's per-key claim() reads: a
+        # finalized request can no longer post, so a successor request on
+        # the same key is sequential, not overlapped
+        self._finalized = True
         return self.task.finalize()
 
 
@@ -383,7 +537,19 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
     if profiling.ENABLED:
         _attach_profiling(task, ct)
     req = CollRequest(task, team, args)
-    if task is inner and not args.is_persistent:
+    tuner = team.tuner
+    if tuner is not None and task is inner and args.active_set is None \
+            and tuner.wants(ct, mem_type, msgsize, candidates):
+        # autotuner probe lane (UCC_TUNER=online, score/tuner.py): the
+        # first UCC_TUNER_SAMPLES posts of this (coll, mem, size-bucket)
+        # rotate through the candidates, then freeze the rank-0 winner.
+        # Bound only for plain (unwrapped) tasks — like the fallback
+        # retention below, a dt-check schedule's identity is not the
+        # algorithm's. Mutually exclusive with runtime fallback: the
+        # probe lane owns task identity while bound.
+        req._bind_tuner(tuner, tuner.key_for(ct, mem_type, msgsize),
+                        init_args, candidates, chosen)
+    elif task is inner and not args.is_persistent:
         # retain the fallback-chain tail for RUNTIME fallback (see
         # CollRequest._try_runtime_fallback). Wrapped (dt-check) and
         # persistent tasks are excluded: the former's failure status is

@@ -13,8 +13,8 @@ from ..api.types import LibAttr, LibParams
 from ..constants import COLL_TYPE_ALL, CollType, ThreadMode
 from ..status import Status, UccError
 from ..utils.config import (Config, ConfigField, ConfigTable, parse_bool,
-                            parse_list, parse_string, parse_uint,
-                            register_table)
+                            parse_enum, parse_list, parse_string,
+                            parse_uint, register_table)
 from ..utils.log import get_logger
 from .components import (CL_REGISTRY, TL_REGISTRY, available_cls,
                          available_tls, discover_components, get_cl, get_tl)
@@ -95,6 +95,24 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
                 parse_string),
     ConfigField("TEAM_IDS_POOL_SIZE", "32", "team id pool size per context",
                 parse_uint),
+    ConfigField("TUNER", "off", "measurement-driven algorithm autotuner: "
+                "off = static score map only (zero cost, no new dispatch "
+                "branches); offline = load the topology-keyed tuning "
+                "cache (written by the ucc_tune CLI / perftest --sweep "
+                "compilations / earlier online runs) at team activation; "
+                "online = additionally explore live candidates during "
+                "the first TUNER_SAMPLES posts per (coll, mem, "
+                "size-bucket), freeze the rank-0 winner team-wide over "
+                "the service team, and persist it to the cache",
+                parse_enum(("off", "offline", "online"))),
+    ConfigField("TUNER_SAMPLES", "8", "online exploration budget: tuned "
+                "posts per (coll, mem, size-bucket) before every rank "
+                "posts the decision bcast and freezes rank 0's measured "
+                "winner", parse_uint),
+    ConfigField("TUNER_CACHE", "", "tuning-cache file (JSON keyed by the "
+                "topology signature: team size, node layout, TL set, "
+                "thread mode); empty = ~/.cache/ucc_tpu/tune.json",
+                parse_string),
     ConfigField("CHECK_ASYMMETRIC_DT", "n", "validate datatype consistency "
                 "for gather(v)/scatter(v) via a service allreduce before "
                 "the collective (off by default for performance, matching "

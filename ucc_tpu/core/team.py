@@ -49,6 +49,12 @@ class TeamState(enum.IntEnum):
     CL_AGREE = 4
     ACTIVE = 5
     FAILED = 6
+    #: autotuner cache sync (UCC_TUNER=offline|online, multi-rank teams):
+    #: rank 0 bcasts its tuning-cache view so every rank compiles the
+    #: SAME learned entries — per-rank cache reads would diverge across
+    #: nodes that don't share the cache file. Skipped (no round) when
+    #: the tuner is off.
+    TUNER_SYNC = 7
 
 
 class Team:
@@ -59,6 +65,10 @@ class Team:
     #: successor team
     _shrunk = False
     _destroyed = False
+    #: online autotuner (score/tuner.py OnlineTuner), attached at
+    #: activation when UCC_TUNER=online; None (class attr, zero cost)
+    #: otherwise — core dispatch checks it once per collective INIT
+    tuner = None
 
     def __init__(self, context: Context, params: Optional[TeamParams] = None):
         self.context = context
@@ -214,6 +224,36 @@ class Team:
             assert self.context.topo is not None and self.ctx_map is not None
             self.topo = TeamTopo(self.context.topo, self.ctx_map, self.rank)
             self._build_score_map()
+            # autotuner cache sync (rank-0-authoritative; see TUNER_SYNC
+            # doc). activation_begin returns None (no round) when the
+            # tuner is off — zero cost on the default path. Tuning must
+            # never fail team creation.
+            from ..score.tuner import activation_begin
+            try:
+                self._pending_task = activation_begin(self)
+            except Exception:  # noqa: BLE001
+                logger.exception("tuner cache-sync post failed; team %s "
+                                 "continues untuned", self.id)
+                self._pending_task = None
+            self.state = TeamState.TUNER_SYNC
+
+        if self.state == TeamState.TUNER_SYNC:
+            task = self._pending_task
+            if task is not None and not task.is_completed():
+                return Status.IN_PROGRESS
+            self._pending_task = None
+            from ..score.tuner import activation_end
+            try:
+                activation_end(self, task)
+            except Exception:  # noqa: BLE001 - tuned is better, untuned ok
+                logger.exception("tuner activation failed; team %s "
+                                 "continues with the static score map",
+                                 self.id)
+            if self.context.lib.config.coll_trace:
+                # dumped here, not in _build_score_map, so learned rows
+                # show with their (learned) provenance
+                logger.info("%s", self.score_map.print_info(
+                    f"team {self.id} size {self.size}"))
             self.state = TeamState.ACTIVE
 
         if self.state == TeamState.ACTIVE:
@@ -388,9 +428,8 @@ class Team:
         for cl_team in self.cl_teams:
             merged = merged.merge(cl_team.get_scores())
         self.score_map = ScoreMap(merged)
-        if self.context.lib.config.coll_trace:
-            logger.info("%s", self.score_map.print_info(
-                f"team {self.id} size {self.size}"))
+        # (the score dump and the autotuner cache application happen in
+        # the TUNER_SYNC step, after rank 0's cache view was synced)
 
     # ------------------------------------------------------------------
     def get_attr(self) -> TeamAttr:

@@ -46,6 +46,11 @@ class MsgRange:
     init: Optional[Callable] = None   # algorithm init fn
     team: Any = None                  # owning component team (TL/CL)
     alg_name: str = ""
+    #: provenance of this range's (score, alg): "default" = component
+    #: alg-table defaults, "tune-str" = a UCC_*_TUNE overlay touched it,
+    #: "learned" = the autotuner promoted it from measurements. Shown in
+    #: the score dump so team logs say WHY an algorithm was chosen.
+    origin: str = "default"
 
     def contains(self, msgsize: int) -> bool:
         return self.start <= msgsize < self.end or \
@@ -161,9 +166,11 @@ class CollScore:
             mid = replace(r, start=lo, end=hi)
             if score is not None:
                 mid.score = score
+                mid.origin = "tune-str"
             if new_init is not None:
                 mid.init = new_init
                 mid.alg_name = alg or ""
+                mid.origin = "tune-str"
             out.append(mid)
             if hi < r.end:
                 out.append(replace(r, start=hi))

@@ -10,6 +10,7 @@ is preserved as ``print_info()``.
 """
 from __future__ import annotations
 
+from dataclasses import replace
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..constants import CollType, MemoryType, coll_type_str
@@ -19,14 +20,37 @@ from .score import CollScore, MsgRange, SCORE_MAX
 
 logger = get_logger("score")
 
+#: score the autotuner promotes a measured winner to: above every default
+#: and every finite tune-str score, but below SCORE_MAX so an explicit
+#: user `...:inf` force still outranks a learned decision
+LEARNED_SCORE = SCORE_MAX - 1
+
+
+def comp_name(r: MsgRange) -> str:
+    """Serving-component label of a range (the CL/TL the reference prints
+    per score-map entry)."""
+    return getattr(r.team, "NAME", None) or \
+        (getattr(r.team, "name", "") or "?")
+
+
+def _cand_order(lst: List[MsgRange]) -> List[MsgRange]:
+    """Deterministic candidate order: (score desc, alg name, component,
+    registration order). Score alone left equal-score candidates to
+    list/merge ordering — any cross-rank divergence there makes ranks
+    pick different algorithms for the same collective and deadlocks the
+    team, so ties break on content, not construction history."""
+    return [r for _, r in sorted(
+        enumerate(lst),
+        key=lambda p: (-p[1].score, p[1].alg_name or "", comp_name(p[1]),
+                       p[0]))]
+
 
 class ScoreMap:
     def __init__(self, score: CollScore):
         self._score = score
-        # candidates pre-sorted by score desc per (coll, mem)
+        # candidates pre-sorted per (coll, mem); see _cand_order
         self._sorted = {
-            key: sorted(lst, key=lambda r: -r.score)
-            for key, lst in score.ranges.items()
+            key: _cand_order(lst) for key, lst in score.ranges.items()
         }
 
     def lookup(self, coll: CollType, mem: MemoryType,
@@ -78,6 +102,51 @@ class ScoreMap:
     def supported_colls(self) -> List[Tuple[CollType, MemoryType]]:
         return sorted(self._sorted.keys())
 
+    # ------------------------------------------------------------------
+    # autotuner recompile-in-place (score/tuner.py)
+    def apply_learned(self, coll: CollType, mem: MemoryType, start: int,
+                      end: int, alg: str, comp: Optional[str] = None,
+                      score: int = LEARNED_SCORE) -> bool:
+        """Promote the measured winner *alg* (optionally pinned to the
+        serving component *comp*) to *score* over [start, end), splitting
+        its existing ranges at the boundaries — the tuner's "recompile
+        the ScoreMap in place" step. Other candidates keep their default
+        scores and remain the fallback chain. Returns False when no
+        range of that algorithm overlaps the window (e.g. a cache entry
+        learned on a build with a different algorithm set)."""
+        if start >= end:
+            return False
+        key = (coll, mem)
+        lst = self._score.ranges.get(key)
+        if not lst:
+            return False
+        out: List[MsgRange] = []
+        hit = False
+        for r in lst:
+            if r.alg_name != alg or r.init is None or \
+                    (comp is not None and comp_name(r) != comp) or \
+                    not r.overlaps(start, end):
+                out.append(r)
+                continue
+            lo = max(r.start, start)
+            hi = min(r.end, end)
+            if r.start < lo:
+                out.append(replace(r, end=lo))
+            mid = replace(r, start=lo, end=hi)
+            mid.score = score
+            mid.origin = "learned"
+            out.append(mid)
+            if hi < r.end:
+                out.append(replace(r, start=hi))
+            hit = True
+        if hit:
+            self._score.ranges[key] = out
+            self._recompile(key)
+        return hit
+
+    def _recompile(self, key: Tuple[CollType, MemoryType]) -> None:
+        self._sorted[key] = _cand_order(self._score.ranges.get(key, []))
+
     def print_info(self, team_name: str = "team") -> str:
         """Score-map dump like the reference team-create log
         (ucc_team.c:480-488, docs/user_guide.md:330+): every row names
@@ -86,6 +155,11 @@ class ScoreMap:
         — without attribution the fallback chain read ambiguously, e.g.
         `sliding_window:1 [0..inf] sliding_window:1` for the shm and
         socket instances of the same algorithm (round-3 verdict weak #5).
+
+        Each entry also carries its PROVENANCE — ``(default)``,
+        ``(tune-str)`` or ``(learned)`` — so UCC_COLL_TRACE/team logs and
+        ``ucc_info -s`` show why an algorithm was chosen, not just that
+        it was.
         """
         from ..utils.config import memunits_str
         lines = [f"ucc_tpu score map for {team_name}:"]
@@ -94,17 +168,17 @@ class ScoreMap:
             seen = set()
             for r in lst:
                 score = "inf" if r.score >= SCORE_MAX else str(r.score)
-                comp = getattr(r.team, "NAME", None) or \
-                    (getattr(r.team, "name", "") or "?")
+                comp = comp_name(r)
                 name = r.alg_name or comp
-                key = (comp, name, r.start, r.end, r.score)
+                origin = r.origin or "default"
+                key = (comp, name, r.start, r.end, r.score, origin)
                 if key in seen:
                     continue
                 seen.add(key)
                 label = comp if name == comp else f"{comp}/{name}"
                 segs.append(
                     f"[{memunits_str(r.start)}..{memunits_str(r.end)}]"
-                    f" {label}:{score}")
+                    f" {label}:{score} ({origin})")
             lines.append(f"  {coll_type_str(c)}/{m.name.lower():10s} "
                          + " ".join(segs))
         return "\n".join(lines)

@@ -274,7 +274,12 @@ class HostTlTeam(TlTeamBase):
                 spec(3, "onesided", AlltoallOnesided, sel="0-inf:1"),
             ],
             CollType.ALLTOALLV: [
-                spec(0, "pairwise", AlltoallvPairwise),
+                # pairwise keeps an explicit one-point edge: ties now
+                # break on alg NAME (deterministic cross-rank order,
+                # score_map._cand_order) and "hybrid" sorts before
+                # "pairwise" — without the edge the default would flip
+                spec(0, "pairwise", AlltoallvPairwise,
+                     sel=f"0-inf:{S + 1}"),
                 spec(1, "hybrid", AlltoallvHybrid),
                 # TUNE-selected; SHMEM-style target-relative dst
                 # displacements (alltoallv_onesided.c convention)

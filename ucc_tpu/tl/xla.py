@@ -1247,7 +1247,13 @@ class TlXlaTeam(TlTeamBase):
             CollType.ALLGATHER, CollType.ALLGATHERV, CollType.GATHER,
             CollType.GATHERV, CollType.ALLTOALL, CollType.REDUCE_SCATTER,
             CollType.REDUCE_SCATTERV, CollType.SCATTER)}
-        table[CollType.ALLREDUCE].append(spec(1, "ring", alg="ring"))
+        # the ring variant is an alternative, not the default: one point
+        # below "xla" so the deterministic tie-break (score desc, then
+        # alg NAME — score_map._cand_order) cannot flip the default to
+        # "ring" by name order; still TUNE-selectable
+        table[CollType.ALLREDUCE].append(
+            spec(1, "ring", alg="ring",
+                 select=f"0-inf:{TlXla.DEFAULT_SCORE - 1}"))
         shared = getattr(self, "shared", None)
         all_local = shared is None or \
             shared.n_local == getattr(self, "size", 0)

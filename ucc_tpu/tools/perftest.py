@@ -317,6 +317,47 @@ def run_op_bench(args) -> int:
     return 0
 
 
+def run_sweep_mode(args, job, coll, dt, op, mem, bmin, bmax, n,
+                   devices) -> int:
+    """--sweep: msg-size x algorithm sweep. Every score-map candidate of
+    (coll, mem) is force-selected per size and timed; one JSON line per
+    (size, algorithm) in the autotuner's measurement-file format, so
+    offline tuning data can come from perftest runs too::
+
+        ucc_perftest -c allreduce --sweep -p 4 > sweep.jsonl
+        ucc_tune --from sweep.jsonl -p 4
+    """
+    import json
+
+    from ..api.types import coll_args_msgsize
+    from ..score.tuner import (cand_label, measure_candidate,
+                               measurement_record, sweep_candidates)
+    esz = dt_size(dt)
+    size = max(bmin, esz)
+    while size <= bmax:
+        count = max(1, size // esz)
+        if coll == CollType.ALLTOALLV:
+            global _TRAFFIC_MATRIX
+            _TRAFFIC_MATRIX = gen_traffic_matrix(args.matrix or "uniform",
+                                                 n, count, args.seed)
+        argses = [make_args(coll, r, n, count, dt, op, mem, False,
+                            args.root, True, devices) for r in range(n)]
+        msgsize = coll_args_msgsize(argses[0], n, 0)
+        cands = sweep_candidates(job.teams[0], coll, mem, msgsize)
+        for idx in range(len(cands)):
+            comp, alg = cand_label(cands[idx])
+            lats = measure_candidate(job.teams, job.contexts, argses, coll,
+                                     mem, msgsize, idx, args.iters,
+                                     args.warmup)
+            if lats is None:
+                continue    # candidate refused these args / failed / hung
+            print(json.dumps(measurement_record(
+                args.coll, mem, n, (comp, alg), size, count, args.iters,
+                lat_stats(lats))), flush=True)
+        size *= 2
+    return 0
+
+
 def _wait_reqs(job, reqs) -> None:
     from ucc_tpu import Status as _St
     while any(rq.test() == _St.IN_PROGRESS for rq in reqs):
@@ -402,32 +443,59 @@ def attach_onesided(job, argses, coll, ranks, n):
 class InProcJob:
     persistent_capable = True
 
-    def __init__(self, n: int):
+    def __init__(self, n: int, lib_overrides: Optional[dict] = None,
+                 create_timeout: float = 120.0):
         self.n = n
         world = ThreadOobWorld(n)
-        self.libs = [ucc_tpu.init() for _ in range(n)]
+        self.libs = [ucc_tpu.init(**(lib_overrides or {}))
+                     for _ in range(n)]
         self.contexts: List[Optional[Context]] = [None] * n
+        errs: List[Exception] = []
 
         def mk(r):
-            self.contexts[r] = Context(self.libs[r],
-                                       ContextParams(oob=world.endpoint(r)))
+            try:
+                self.contexts[r] = Context(
+                    self.libs[r], ContextParams(oob=world.endpoint(r)))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
 
         ths = [threading.Thread(target=mk, args=(r,)) for r in range(n)]
         for t in ths:
             t.start()
         for t in ths:
-            t.join()
+            t.join(timeout=create_timeout)
+        if errs:
+            raise errs[0]
+        if any(c is None for c in self.contexts):
+            # a create thread is still wedged (e.g. a stuck TL probe):
+            # report the timeout instead of crashing on the None later
+            raise SystemExit("context create timed out")
         tw = ThreadOobWorld(n)
         self.teams = [c.create_team_post(TeamParams(oob=tw.endpoint(i)))
                       for i, c in enumerate(self.contexts)]
+        deadline = time.monotonic() + create_timeout
         while True:
             sts = [t.create_test() for t in self.teams]
             if all(s == Status.OK for s in sts):
                 break
-            if any(s.is_error for s in sts):
+            if any(s.is_error for s in sts) or \
+                    time.monotonic() > deadline:
                 raise SystemExit("team create failed")
             for c in self.contexts:
                 c.progress()
+
+    def destroy(self) -> None:
+        self.destroy_ees()
+        for t in self.teams:
+            try:
+                t.destroy()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+        for c in self.contexts:
+            try:
+                c.destroy()
+            except Exception:  # noqa: BLE001
+                pass
 
     def init_reqs(self, argses):
         return [self.teams[r].collective_init(argses[r])
@@ -523,6 +591,12 @@ def main(argv=None) -> int:
                    help="one JSON line per size (machine-readable: "
                         "avg/min/max/p50/p99 us + busbw with -F) instead "
                         "of the latency table")
+    p.add_argument("--sweep", action="store_true",
+                   help="msg-size x algorithm sweep: force every "
+                        "score-map candidate per size and emit one JSON "
+                        "measurement line per (size, algorithm) — the "
+                        "ucc_tune offline-tuning input format (compile "
+                        "with `ucc_tune --from FILE`); in-process only")
     p.add_argument("-p", "--nprocs", type=int, default=0,
                    help="in-process ranks (default: one per device for tpu "
                         "mem, else 4)")
@@ -615,6 +689,17 @@ def main(argv=None) -> int:
         job = InProcJob(n)
         ranks = list(range(n))
         is_lead = True
+
+    if args.sweep:
+        if args.store:
+            raise SystemExit("perftest: --sweep requires in-process mode "
+                             "(each candidate is force-selected by score-"
+                             "map index on every rank)")
+        if args.onesided or args.streaming or args.triggered:
+            raise SystemExit("perftest: --sweep is incompatible with "
+                             "-O/-S/-T")
+        return run_sweep_mode(args, job, coll, dt, op, mem, bmin, bmax, n,
+                              devices)
 
     if is_lead and not args.json:
         hdr = f"{'count':>12} {'size':>10} {'time avg(us)':>14} " \

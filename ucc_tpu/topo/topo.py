@@ -113,6 +113,18 @@ class TeamTopo:
         # NUMA/SOCKET flavors: single-socket hosts assumed on TPU pods
         return Sbgp(t, SbgpStatus.NOT_EXISTS)
 
+    def node_layout(self) -> tuple:
+        """Per-node member counts of THIS team, sorted — the node-shape
+        component of the autotuner's topology signature
+        (score/tuner.topo_signature): a tuning decision learned on a
+        (2,2) split must not be replayed onto a (1,3) one even though
+        both are 4 ranks over 2 nodes."""
+        by_host: Dict[int, int] = {}
+        for r in range(self.team_size):
+            h = self._proc(r).host_hash
+            by_host[h] = by_host.get(h, 0) + 1
+        return tuple(sorted(by_host.values()))
+
     @property
     def n_nodes(self) -> int:
         hosts = {self._proc(r).host_hash for r in range(self.team_size)}
