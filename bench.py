@@ -199,8 +199,82 @@ def _measure_point(coll: str, count: int, ctxs, teams, devices, mesh,
             _busbw(coll, nbytes, n, raw_time), pool_stats, alg)
 
 
-def main(sweep: bool = False) -> None:
+def _enable_quant() -> str:
+    """--quant: arm UCC_QUANT (default int8) BEFORE lib/context creation
+    and pin the device path to the quantized program (it registers below
+    the exact default, tuner-promoted on real fabrics — the bench mode
+    exists to measure it explicitly). Returns the mode."""
+    import os
+    mode = os.environ.get("UCC_QUANT", "").strip().lower()
+    if mode not in ("int8", "fp8"):
+        mode = "int8"
+    os.environ["UCC_QUANT"] = mode
+    os.environ.setdefault("UCC_TL_XLA_TUNE",
+                          f"allreduce:@q{mode}#allgather:@q{mode}")
+    return mode
+
+
+def _quant_detail(teams, ctxs, devices, count: int, busbw: float) -> dict:
+    """detail.quant for a bench record: the shared quant.verify record
+    (same shape ucc_perftest --quant emits and the gate smoke reads)
+    filled from one random-data verification round on device buffers
+    (the timed loop runs ones, which int8 encodes exactly)."""
+    import jax
+    import jax.numpy as jnp
+
+    import numpy as np
+    from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType,
+                         MemoryType, ReductionOp, Status)
+    from ucc_tpu import quant as _q
+    from ucc_tpu.quant.verify import (MeasuredBytes, base_detail,
+                                      error_stats)
+
+    n = len(teams)
+    params = _q.params_for(teams[0], CollType.ALLREDUCE)
+    if params is None:
+        return {"mode": "off"}
+    d = base_detail(params, CollType.ALLREDUCE, count, 4, busbw, n)
+    rng = np.random.default_rng(9)
+    hosts = [((rng.random(count).astype(np.float32)) - 0.5) * 4
+             for _ in range(n)]
+    srcs = [jax.device_put(jnp.asarray(hosts[r]), devices[r])
+            for r in range(n)]
+    argses = [CollArgs(
+        coll_type=CollType.ALLREDUCE,
+        src=BufferInfo(srcs[r], count, DataType.FLOAT32,
+                       mem_type=MemoryType.TPU),
+        dst=BufferInfo(None, count, DataType.FLOAT32,
+                       mem_type=MemoryType.TPU),
+        op=ReductionOp.SUM) for r in range(n)]
+    with MeasuredBytes() as mb:
+        reqs = [teams[r].collective_init(argses[r]) for r in range(n)]
+        d["alg"] = str(getattr(reqs[0].task, "alg_name", "") or "")
+        for rq in reqs:
+            rq.post()
+        while any(rq.test() == Status.IN_PROGRESS for rq in reqs):
+            for c in ctxs:
+                c.progress()
+    exact = np.sum(np.stack(hosts).astype(np.float64), axis=0)
+    d.update(error_stats(exact, [np.asarray(a.dst.buffer)
+                                 for a in argses], params.budget))
+    if mb.total > 0:            # 0 = device path, not host-instrumented
+        d["measured_wire_bytes_total"] = int(mb.total)
+    for rq in reqs:
+        rq.finalize()
+    return d
+
+
+def main(sweep: bool = False, quant: bool = False) -> None:
     _force_cpu_if_requested()
+    import os
+    if quant:
+        _enable_quant()
+    # detail.quant rides every allreduce record whenever a precision is
+    # armed — bare UCC_QUANT=int8 records the registered-but-not-forced
+    # state (selection stays honest per fabric; --quant pins the
+    # quantized program to measure it explicitly)
+    quant = quant or os.environ.get("UCC_QUANT", "").strip().lower() in \
+        ("int8", "fp8")
     import jax
 
     devices = jax.devices()
@@ -251,6 +325,9 @@ def main(sweep: bool = False) -> None:
                                "platform": plat, "alg": alg,
                                "raw_lat_us": round(rt * 1e6, 2),
                                "mc_pool": pool}}
+            if quant and coll == "allreduce" and n > 1:
+                rec["detail"]["quant"] = _quant_detail(teams, ctxs,
+                                                       devices, cnt, ub)
             print(json.dumps(rec))
         return
 
@@ -276,6 +353,9 @@ def main(sweep: bool = False) -> None:
                 "mc_pool": pool,
             },
         }
+        if quant:
+            result["detail"]["quant"] = _quant_detail(teams, ctxs, devices,
+                                                      count, ucc_bw)
     else:
         # single chip: a 1-rank allreduce is semantically a no-op, so bus
         # bandwidth is undefined; the honest hardware measurement is the
@@ -310,12 +390,13 @@ def _run_guarded() -> None:
     import sys
 
     sweep = "--sweep" in sys.argv
+    quant = "--quant" in sys.argv
     if os.environ.get("UCC_BENCH_CHILD"):
-        main(sweep=sweep)
+        main(sweep=sweep, quant=quant)
         return
     env = dict(os.environ, UCC_BENCH_CHILD="1")
     args = [sys.executable, os.path.abspath(__file__)] + \
-        (["--sweep"] if sweep else [])
+        (["--sweep"] if sweep else []) + (["--quant"] if quant else [])
     # UCC_BENCH_TIMEOUT overrides the accelerator-child budget (the
     # probe's real-chip sweep capture compiles ~10 fresh programs and
     # needs more than the driver default); UCC_BENCH_NO_FALLBACK=1

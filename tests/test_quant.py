@@ -1,0 +1,574 @@
+"""Quantized collectives (ISSUE 6): block-scaled int8/fp8 codecs, the
+host/xla quantized algorithm variants, error-budget eligibility, the
+widened ``reduce_arrays(out=)`` accumulate path, and the fault/cancel
+interactions (no-hang under injection, lease hygiene on cancellation).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+from ucc_tpu import (BufferInfo, CollArgs, CollArgsFlags, CollType,
+                     DataType, ReductionOp, Status)
+from ucc_tpu.constants import dt_from_numpy
+from ucc_tpu.ec.cpu import reduce_arrays
+from ucc_tpu.mc.pool import HostMemPool, reset_host_pool
+from ucc_tpu.quant import (CODECS, admits, default_budget, get_codec,
+                           n_blocks, predicted_error, wire_count,
+                           wire_ratio)
+
+from harness import UccJob
+
+BF16 = np.dtype(ml_dtypes.bfloat16)
+
+
+# ---------------------------------------------------------------------------
+# codec units
+# ---------------------------------------------------------------------------
+
+class TestCodec:
+    @pytest.mark.parametrize("name", ["int8", "fp8"])
+    @pytest.mark.parametrize("count", [1, 7, 256, 1000, 65536])
+    @pytest.mark.parametrize("block", [8, 64, 256])
+    def test_roundtrip_error_bound(self, name, count, block):
+        c = get_codec(name)
+        rng = np.random.default_rng(count * block)
+        x = ((rng.random(count).astype(np.float32)) - 0.5) * 10
+        wire = np.zeros(wire_count(count, block), np.uint8)
+        c.encode(x, wire, block)
+        out = np.empty(count, np.float32)
+        c.decode(wire, count, block, out)
+        # per-element error bounded by half_step of the BLOCK absmax
+        nb = n_blocks(count, block)
+        for b in range(nb):
+            seg = slice(b * block, min((b + 1) * block, count))
+            amax = np.max(np.abs(x[seg]))
+            err = np.max(np.abs(x[seg] - out[seg]))
+            assert err <= c.half_step * amax * 1.02 + 1e-12
+
+    @pytest.mark.parametrize("name", ["int8", "fp8"])
+    def test_bf16_payload(self, name):
+        c = get_codec(name)
+        count = 3000
+        x = ((np.random.default_rng(0).random(count)
+              .astype(np.float32)) - 0.5).astype(BF16)
+        wire = np.zeros(wire_count(count, 128), np.uint8)
+        c.encode(x, wire, 128)
+        out = np.empty(count, BF16)
+        c.decode(wire, count, 128, out)
+        xf = x.astype(np.float32)
+        err = np.max(np.abs(xf - out.astype(np.float32)))
+        # half-step + one bf16 rounding on each side
+        assert err <= (c.half_step + 2 ** -7) * np.max(np.abs(xf)) * 1.05
+
+    def test_zero_block_exact(self):
+        c = get_codec("int8")
+        x = np.zeros(512, np.float32)
+        x[300] = 2.5
+        wire = np.zeros(wire_count(512, 256), np.uint8)
+        c.encode(x, wire, 256)
+        out = np.empty(512, np.float32)
+        c.decode(wire, 512, 256, out)
+        assert np.all(out[:256] == 0.0)          # all-zero block exact
+        assert abs(out[300] - 2.5) <= c.half_step * 2.5 * 1.02
+
+    def test_stochastic_rounding_bounded_and_unbiased(self):
+        c = get_codec("int8")
+        count, block = 4096, 256
+        x = np.full(count, 0.3, np.float32)
+        x[::7] = 1.0                              # pin the block absmax
+        wire = np.zeros(wire_count(count, block), np.uint8)
+        rng = np.random.default_rng(3)
+        sums = np.zeros(count, np.float64)
+        out = np.empty(count, np.float32)
+        for _ in range(64):
+            c.encode(x, wire, block, stochastic=True, rng=rng)
+            c.decode(wire, count, block, out)
+            assert np.max(np.abs(x - out)) <= 2 * c.half_step * 1.02
+            sums += out
+        # the MEAN of stochastic roundings converges on the true value
+        mean_err = np.max(np.abs(sums / 64 - x))
+        assert mean_err < c.half_step
+
+    def test_stochastic_absmax_never_wraps(self):
+        """Regression: with a non-exactly-representable absmax,
+        x*(qmax/amax) can sit ~2 ulps past 127; floor(t + u) then
+        crosses 128 and the int8 cast would WRAP it to -128 — a
+        sign-flipped absmax element. The encoder must clamp."""
+        c = get_codec("int8")
+        count, block = 4096, 256
+        # this amax makes amax * (127/amax) = 127.00000763 in f32 — the
+        # 2-ulp overshoot the clamp exists for
+        amax = 0.16527634859085083
+        x = np.full(count, amax, np.float32)
+        x[1::2] = -amax
+        wire = np.zeros(wire_count(count, block), np.uint8)
+        out = np.empty(count, np.float32)
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            c.encode(x, wire, block, stochastic=True, rng=rng)
+            c.decode(wire, count, block, out)
+            # a wrap would show as a ~2*amax error on a +amax element
+            assert np.max(np.abs(x - out)) <= \
+                2 * c.half_step * amax * 1.05
+
+    def test_wire_count_and_ratio(self):
+        assert wire_count(256, 256) == 256 + 4
+        assert wire_count(257, 256) == 257 + 8
+        # f32 payload: ~4x reduction (+ scale overhead)
+        assert 0.25 <= wire_ratio(65536, 4, 256) < 0.26
+
+    def test_predicted_error_ordering(self):
+        c = CODECS["int8"]
+        # allgather (single round trip) < direct allreduce < ring
+        ag = predicted_error(c, CollType.ALLGATHER, 8)
+        ar = predicted_error(c, CollType.ALLREDUCE, 8, "direct")
+        ring = predicted_error(c, CollType.ALLREDUCE, 8, "ring")
+        assert ag < ar < ring
+
+
+# ---------------------------------------------------------------------------
+# reduce_arrays(out=) mixed-dtype accumulate (satellite fix)
+# ---------------------------------------------------------------------------
+
+class TestReduceArraysWidenedOut:
+    def test_f32_accumulate_of_bf16_payload_keeps_f32_precision(self):
+        """Dequantize+reduce accumulates a bf16 payload in f32 scratch:
+        the result must keep full f32 precision, not silently round-trip
+        through bf16 (which would quantize partial sums)."""
+        # values whose sum is NOT representable in bf16 (needs >8 bits)
+        a = np.array([1.0, 1.0], np.float32)
+        b = np.array([0.001953125, 0.001953125], np.float32)  # 2^-9
+        out = np.zeros(2, np.float32)
+        res = reduce_arrays([a, b], ReductionOp.SUM, DataType.BFLOAT16,
+                            out=out)
+        assert res is out
+        expect = np.float32(1.0 + 0.001953125)
+        assert out[0] == expect          # bf16 would have dropped 2^-9
+        bf_rounded = np.float32(np.array([expect], BF16)[0])
+        assert out[0] != bf_rounded or expect == bf_rounded
+
+    def test_slow_path_targets_out_dtype(self):
+        # AVG (alpha path) with f32 buffers under a bf16 dt: lands in
+        # out's dtype at full precision
+        a = np.array([1.0, 3.0], np.float32)
+        b = np.array([0.001953125, 0.0], np.float32)
+        out = np.zeros(2, np.float32)
+        reduce_arrays([a, b], ReductionOp.AVG, DataType.BFLOAT16,
+                      alpha=0.5, out=out)
+        assert out[0] == np.float32((1.0 + 0.001953125) * 0.5)
+
+    def test_same_dtype_fast_path_unchanged(self):
+        a = np.arange(8, dtype=np.float64)
+        b = np.ones(8, np.float64)
+        out = np.empty(8, np.float64)
+        res = reduce_arrays([a, b], ReductionOp.SUM, DataType.FLOAT64,
+                            out=out)
+        assert res is out
+        np.testing.assert_array_equal(out, a + b)
+
+
+# ---------------------------------------------------------------------------
+# quantized collectives through the full stack
+# ---------------------------------------------------------------------------
+
+QUANT_COUNT = 32 << 10        # 128KiB f32 -> quant wins the >=64k range
+
+
+def _random_srcs(n, count, dtype=np.float32, seed=1):
+    rng = np.random.default_rng(seed)
+    return [(((rng.random(count).astype(np.float32)) - 0.5) * 4)
+            .astype(dtype) for _ in range(n)]
+
+
+def _run_allreduce(job, teams, srcs, dsts, op=ReductionOp.SUM,
+                   inplace=False):
+    n = len(teams)
+    count = srcs[0].size
+    dt = dt_from_numpy(srcs[0].dtype)
+
+    def mk(i):
+        if inplace:
+            bi = BufferInfo(dsts[i], count, dt)
+            return CollArgs(coll_type=CollType.ALLREDUCE, src=bi, dst=bi,
+                            op=op, flags=CollArgsFlags.IN_PLACE)
+        return CollArgs(coll_type=CollType.ALLREDUCE,
+                        src=BufferInfo(srcs[i], count, dt),
+                        dst=BufferInfo(dsts[i], count, dt), op=op)
+    reqs = job.run_coll(teams, mk)
+    alg = reqs[0].task.alg_name
+    for rq in reqs:
+        rq.finalize()
+    return alg
+
+
+class TestQuantAllreduce:
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_int8_within_budget_across_team_sizes(self, n):
+        job = UccJob(n, lib_overrides={"QUANT": "int8"})
+        try:
+            teams = job.create_team()
+            srcs = _random_srcs(n, QUANT_COUNT)
+            dsts = [np.zeros(QUANT_COUNT, np.float32) for _ in range(n)]
+            alg = _run_allreduce(job, teams, srcs, dsts)
+            assert alg == "qint8_sra", alg
+            exact = np.sum(np.stack(srcs).astype(np.float64), axis=0)
+            peak = np.max(np.abs(exact))
+            budget = default_budget("int8")
+            for d in dsts:
+                assert np.max(np.abs(d - exact)) / peak <= budget
+            # every rank holds the SAME dequantized bits
+            for d in dsts[1:]:
+                np.testing.assert_array_equal(dsts[0], d)
+        finally:
+            job.cleanup()
+
+    def test_ring_variant_and_avg(self, monkeypatch):
+        monkeypatch.setenv("UCC_TL_SHM_TUNE", "allreduce:@qint8_ring:inf")
+        n = 4
+        job = UccJob(n, lib_overrides={"QUANT": "int8"})
+        try:
+            teams = job.create_team()
+            srcs = _random_srcs(n, QUANT_COUNT, seed=2)
+            dsts = [np.zeros(QUANT_COUNT, np.float32) for _ in range(n)]
+            alg = _run_allreduce(job, teams, srcs, dsts,
+                                 op=ReductionOp.AVG)
+            assert alg == "qint8_ring", alg
+            exact = np.mean(np.stack(srcs).astype(np.float64), axis=0)
+            peak = np.max(np.abs(exact))
+            bound = predicted_error(CODECS["int8"], CollType.ALLREDUCE,
+                                    n, "ring")
+            for d in dsts:
+                assert np.max(np.abs(d - exact)) / peak <= bound
+        finally:
+            job.cleanup()
+
+    def test_fp8_and_inplace(self):
+        n = 4
+        job = UccJob(n, lib_overrides={"QUANT": "fp8"})
+        try:
+            teams = job.create_team()
+            srcs = _random_srcs(n, QUANT_COUNT, seed=3)
+            dsts = [s.copy() for s in srcs]          # in-place
+            alg = _run_allreduce(job, teams, srcs, dsts, inplace=True)
+            assert alg == "qfp8_sra", alg
+            exact = np.sum(np.stack(srcs).astype(np.float64), axis=0)
+            peak = np.max(np.abs(exact))
+            budget = default_budget("fp8")
+            for d in dsts:
+                assert np.max(np.abs(d - exact)) / peak <= budget
+        finally:
+            job.cleanup()
+
+    def test_bf16_payload_accumulates_in_f32(self):
+        n = 4
+        job = UccJob(n, lib_overrides={"QUANT": "int8"})
+        try:
+            teams = job.create_team()
+            srcs = _random_srcs(n, QUANT_COUNT, dtype=BF16, seed=4)
+            dsts = [np.zeros(QUANT_COUNT, BF16) for _ in range(n)]
+            alg = _run_allreduce(job, teams, srcs, dsts)
+            assert alg == "qint8_sra", alg
+            exact = np.sum(np.stack([s.astype(np.float64) for s in srcs]),
+                           axis=0)
+            peak = np.max(np.abs(exact))
+            # int8 budget + bf16 output rounding
+            bound = default_budget("int8") + 2 ** -7
+            for d in dsts:
+                err = np.max(np.abs(d.astype(np.float64) - exact))
+                assert err / peak <= bound
+        finally:
+            job.cleanup()
+
+    def test_small_messages_stay_exact(self):
+        """The quantized default only wins the >=64k range; small
+        messages keep the exact latency algorithms."""
+        n = 4
+        job = UccJob(n, lib_overrides={"QUANT": "int8"})
+        try:
+            teams = job.create_team()
+            srcs = _random_srcs(n, 64)
+            dsts = [np.zeros(64, np.float32) for _ in range(n)]
+            alg = _run_allreduce(job, teams, srcs, dsts)
+            assert not alg.startswith("q"), alg
+            exact = np.sum(np.stack(srcs), axis=0)
+            np.testing.assert_allclose(dsts[0], exact, rtol=1e-5)
+        finally:
+            job.cleanup()
+
+
+class TestQuantAllgather:
+    def test_int8_allgather_roundtrip(self):
+        n = 4
+        per = QUANT_COUNT // n
+        job = UccJob(n, lib_overrides={"QUANT": "int8"})
+        try:
+            teams = job.create_team()
+            srcs = _random_srcs(n, per, seed=5)
+            dsts = [np.zeros(per * n, np.float32) for _ in range(n)]
+
+            def mk(i):
+                return CollArgs(
+                    coll_type=CollType.ALLGATHER,
+                    src=BufferInfo(srcs[i], per, DataType.FLOAT32),
+                    dst=BufferInfo(dsts[i], per * n, DataType.FLOAT32))
+            reqs = job.run_coll(teams, mk)
+            assert reqs[0].task.alg_name == "qint8_linear"
+            for rq in reqs:
+                rq.finalize()
+            exact = np.concatenate(srcs)
+            c = CODECS["int8"]
+            for r, d in enumerate(dsts):
+                for p in range(n):
+                    seg = d[p * per:(p + 1) * per]
+                    if p == r:
+                        np.testing.assert_array_equal(seg, srcs[p])
+                    else:
+                        amax = np.max(np.abs(srcs[p]))
+                        assert np.max(np.abs(
+                            seg - exact[p * per:(p + 1) * per])) <= \
+                            c.half_step * amax * 1.02
+        finally:
+            job.cleanup()
+
+
+class TestEligibility:
+    def test_error_budget_rejection_falls_back_to_exact(self):
+        n = 4
+        job = UccJob(n, lib_overrides={"QUANT": "int8",
+                                       "QUANT_ERROR_BUDGET": "1e-6"})
+        try:
+            teams = job.create_team()
+            srcs = _random_srcs(n, QUANT_COUNT)
+            dsts = [np.zeros(QUANT_COUNT, np.float32) for _ in range(n)]
+            alg = _run_allreduce(job, teams, srcs, dsts)
+            assert not alg.startswith("q"), alg
+            exact = np.sum(np.stack(srcs), axis=0)
+            np.testing.assert_allclose(dsts[0], exact, rtol=1e-5,
+                                       atol=1e-5)
+        finally:
+            job.cleanup()
+
+    def test_unsupported_op_and_dtype_fall_back(self):
+        n = 2
+        job = UccJob(n, lib_overrides={"QUANT": "int8"})
+        try:
+            teams = job.create_team()
+            # PROD is not quantizable -> exact algorithm serves it
+            srcs = _random_srcs(n, QUANT_COUNT)
+            dsts = [np.zeros(QUANT_COUNT, np.float32) for _ in range(n)]
+            alg = _run_allreduce(job, teams, srcs, dsts,
+                                 op=ReductionOp.PROD)
+            assert not alg.startswith("q"), alg
+            # int payloads are not quantizable either
+            isrcs = [np.ones(QUANT_COUNT, np.int32) for _ in range(n)]
+            idsts = [np.zeros(QUANT_COUNT, np.int32) for _ in range(n)]
+            alg = _run_allreduce(job, teams, isrcs, idsts)
+            assert not alg.startswith("q"), alg
+            np.testing.assert_array_equal(idsts[0],
+                                          np.full(QUANT_COUNT, n))
+        finally:
+            job.cleanup()
+
+    def test_off_leaves_candidate_lists_unchanged(self):
+        from ucc_tpu.constants import MemoryType
+        job = UccJob(2)
+        try:
+            teams = job.create_team()
+            for msgsize in (256, 1 << 20):
+                cands = teams[0].score_map.lookup(
+                    CollType.ALLREDUCE, MemoryType.HOST, msgsize)
+                assert all(not (c.alg_name or "").startswith("q")
+                           for c in cands)
+                assert all(not c.precision for c in cands)
+        finally:
+            job.cleanup()
+
+    def test_per_collective_override(self):
+        n = 2
+        job = UccJob(n, lib_overrides={"QUANT": "int8",
+                                       "QUANT_ALLREDUCE": "off"})
+        try:
+            teams = job.create_team()
+            srcs = _random_srcs(n, QUANT_COUNT)
+            dsts = [np.zeros(QUANT_COUNT, np.float32) for _ in range(n)]
+            alg = _run_allreduce(job, teams, srcs, dsts)
+            assert not alg.startswith("q"), alg          # overridden off
+            from ucc_tpu.constants import MemoryType
+            ag = teams[0].score_map.lookup(CollType.ALLGATHER,
+                                           MemoryType.HOST, 1 << 20)
+            assert any((c.alg_name or "").startswith("qint8")
+                       for c in ag)                      # inherited on
+        finally:
+            job.cleanup()
+
+    def test_score_dump_marks_precision(self):
+        job = UccJob(2, lib_overrides={"QUANT": "int8"})
+        try:
+            teams = job.create_team()
+            dump = teams[0].score_map.print_info("t")
+            assert "qint8_sra" in dump
+            assert "(default,int8)" in dump
+        finally:
+            job.cleanup()
+
+    def test_admits_predicate(self):
+        from ucc_tpu.quant import QuantParams
+        qp = QuantParams(codec=CODECS["int8"], block=256, budget=0.01,
+                         stochastic=False)
+        assert admits(qp, CollType.ALLGATHER, 64)       # single roundtrip
+        assert not admits(qp, CollType.ALLREDUCE, 64)   # (n+1)*h > 0.01
+
+
+# ---------------------------------------------------------------------------
+# xla TL quantized path
+# ---------------------------------------------------------------------------
+
+class TestQuantXla:
+    def test_qint8_allreduce_and_allgather(self, monkeypatch):
+        import jax
+        monkeypatch.setenv("UCC_TL_XLA_TUNE",
+                           "allreduce:@qint8#allgather:@qint8")
+        from ucc_tpu.constants import MemoryType
+        n, count = 4, 1000          # non-block-divisible: padding path
+        devs = jax.devices()
+        job = UccJob(n, lib_overrides={"QUANT": "int8"})
+        try:
+            teams = job.create_team()
+            hosts = _random_srcs(n, count, seed=6)
+            srcs = [jax.device_put(hosts[i], devs[i]) for i in range(n)]
+
+            def mk(i):
+                return CollArgs(
+                    coll_type=CollType.ALLREDUCE,
+                    src=BufferInfo(srcs[i], count, DataType.FLOAT32,
+                                   mem_type=MemoryType.TPU),
+                    dst=BufferInfo(None, count, DataType.FLOAT32,
+                                   mem_type=MemoryType.TPU),
+                    op=ReductionOp.SUM)
+            reqs = job.run_coll(teams, mk)
+            assert reqs[0].task.alg_name == "qint8"
+            exact = np.sum(np.stack(hosts).astype(np.float64), axis=0)
+            peak = np.max(np.abs(exact))
+            for rq in reqs:
+                got = np.asarray(rq.args.dst.buffer)
+                assert got.size == count
+                assert np.max(np.abs(got - exact)) / peak <= \
+                    default_budget("int8")
+                rq.finalize()
+
+            def mkag(i):
+                return CollArgs(
+                    coll_type=CollType.ALLGATHER,
+                    src=BufferInfo(srcs[i], count, DataType.FLOAT32,
+                                   mem_type=MemoryType.TPU),
+                    dst=BufferInfo(None, count * n, DataType.FLOAT32,
+                                   mem_type=MemoryType.TPU))
+            reqs = job.run_coll(teams, mkag)
+            assert reqs[0].task.alg_name == "qint8"
+            exact = np.concatenate(hosts)
+            for rq in reqs:
+                got = np.asarray(rq.args.dst.buffer)
+                assert got.size == count * n
+                assert np.max(np.abs(got - exact)) <= \
+                    CODECS["int8"].half_step * 4 * 1.02
+                rq.finalize()
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# fault injection + cancellation
+# ---------------------------------------------------------------------------
+
+class TestQuantFaults:
+    def test_soak_no_hang_under_injection(self, monkeypatch):
+        """UCC_FAULT + UCC_QUANT: the no-hang invariant holds with the
+        quantized variants selected (every rank reaches a terminal
+        status every iteration)."""
+        from ucc_tpu.fault.soak import run_soak
+        monkeypatch.setenv("UCC_QUANT", "int8")
+        report = run_soak(n_ranks=4, iterations=24,
+                          spec="drop=0.02,error=0.02", seed=11,
+                          coll_timeout_s=0.5, iter_deadline_s=10.0,
+                          count=32 << 10,
+                          matrix=("allreduce", "allgather"))
+        assert report["hangs"] == [], report["hangs"]
+        assert report["iterations"] == 24
+
+    def test_cancel_mid_collective_drops_lease(self):
+        """Cancelling a quantized collective withdraws its posted recvs
+        and the tainted lease is DROPPED at finalize (wire scratch never
+        re-enters the pool where a late peer send could scribble)."""
+        n = 2
+        job = UccJob(n, lib_overrides={"QUANT": "int8"})
+        try:
+            teams = job.create_team()
+            pool = HostMemPool()
+            reset_host_pool(pool)
+            count = QUANT_COUNT
+            src = np.ones(count, np.float32)
+            dst = np.zeros(count, np.float32)
+            # only rank 0 posts: its recvs can never match
+            req = teams[0].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(src, count, DataType.FLOAT32),
+                dst=BufferInfo(dst, count, DataType.FLOAT32),
+                op=ReductionOp.SUM))
+            assert req.task.alg_name == "qint8_sra"
+            req.post()
+            for _ in range(10):
+                job.contexts[0].progress()
+            assert req.test() == Status.IN_PROGRESS
+            assert pool.stats()["leased"] > 0     # wire scratch leased
+            req.task.cancel()
+            assert req.test() == Status.ERR_CANCELED
+            req.finalize()
+            st = pool.stats()
+            assert st["cached_elems"] == 0, \
+                "tainted quant lease was recycled into the pool"
+        finally:
+            reset_host_pool(None)
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# tuner integration
+# ---------------------------------------------------------------------------
+
+class TestQuantTunerIntegration:
+    def test_compile_measurements_carries_precision(self):
+        from ucc_tpu.score.tuner import compile_measurements
+        recs = [
+            {"coll": "allreduce", "mem": "host", "size_bytes": 65536,
+             "alg": "qint8_sra", "comp": "shm", "p50_us": 10.0,
+             "precision": "int8"},
+            {"coll": "allreduce", "mem": "host", "size_bytes": 65536,
+             "alg": "sra_knomial", "comp": "shm", "p50_us": 20.0},
+        ]
+        entries = compile_measurements(recs)
+        assert len(entries) == 1
+        assert entries[0]["alg"] == "qint8_sra"
+        assert entries[0]["precision"] == "int8"
+
+    def test_learned_quant_range_shows_precision_tag(self):
+        """apply_learned on a quantized candidate keeps the precision in
+        the provenance column — the `ucc_info -s` satellite."""
+        from ucc_tpu.constants import MemoryType
+        job = UccJob(2, lib_overrides={"QUANT": "int8"})
+        try:
+            teams = job.create_team()
+            sm = teams[0].score_map
+            ok = sm.apply_learned(CollType.ALLREDUCE, MemoryType.HOST,
+                                  1 << 16, 1 << 20, "qint8_sra")
+            assert ok
+            dump = sm.print_info("t")
+            assert "(learned,int8)" in dump
+            cands = sm.lookup(CollType.ALLREDUCE, MemoryType.HOST,
+                              1 << 18)
+            assert cands[0].alg_name == "qint8_sra"
+            assert cands[0].origin == "learned"
+            assert cands[0].precision == "int8"
+        finally:
+            job.cleanup()

@@ -245,6 +245,91 @@ def _tuner_smoke(env) -> None:
           f"{ceil:.1f}us) in {dt:.0f}s -> {verdict}", flush=True)
 
 
+def _quant_smoke(env) -> None:
+    """WARN-ONLY quantized-collectives probe (ISSUE 6 CI satellite,
+    same harness as the perf/tuner smokes): run the 4-rank 256KiB
+    allreduce point over the wire-bound host path (socket TL — the DCN
+    stand-in where wire bytes dominate; the in-process shm 'wire' is a
+    memcpy) with UCC_QUANT=int8 and without, then check that the int8
+    point (a) beats exact on wire bytes, (b) stays inside the error
+    budget, and (c) reports its busbw speedup over the exact path.
+    Skip with UCC_GATE_QUANT=0."""
+    import json
+    if os.environ.get("UCC_GATE_QUANT", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] quant smoke: skipped (UCC_GATE_QUANT=0)", flush=True)
+        return
+    print("[gate] quant smoke (warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    base_env = {k: v for k, v in env.items()
+                if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                     "UCC_STATS", "UCC_PROFILE",
+                                     "UCC_QUANT"))}
+    base_env["UCC_TLS"] = "socket,self"
+    argv = [sys.executable, "-m", "ucc_tpu.tools.perftest",
+            "-c", "allreduce", "-m", "host", "-p", "4",
+            "-b", "256K", "-e", "256K", "-n", "8", "-w", "2",
+            "--json", "-F"]
+
+    def run_point(quant: bool):
+        e = dict(base_env)
+        av = list(argv)
+        if quant:
+            e["UCC_QUANT"] = "int8"
+            av.append("--quant")
+        try:
+            r = subprocess.run(av, cwd=REPO, env=e, capture_output=True,
+                               text=True, timeout=300)
+        except subprocess.TimeoutExpired:
+            return None
+        for ln in (r.stdout or "").splitlines():
+            if ln.startswith("{"):
+                try:
+                    return json.loads(ln)
+                except ValueError:
+                    continue
+        return None
+
+    q = run_point(True)
+    e = run_point(False)
+    dt = time.monotonic() - t0
+    if not q or not e:
+        print(f"[gate] WARN: quant smoke produced no record in {dt:.0f}s "
+              f"(not a gate failure)", flush=True)
+        return
+    qd = (q.get("detail") or {}).get("quant") or {}
+    problems = []
+    if not str(qd.get("alg", "")).startswith("qint8"):
+        problems.append(f"quantized alg not selected (got "
+                        f"{qd.get('alg')})")
+    # MEASURED transport bytes (the verification round's bytes_sent
+    # delta) vs the minimum any exact algorithm must move — both
+    # sides real, so a regression that stops compressing the actual
+    # wire traffic fails this even if selection still looks right
+    measured = qd.get("measured_wire_bytes_total")
+    floor = qd.get("exact_wire_floor_bytes_total")
+    if not measured or not floor:
+        problems.append("no measured wire bytes in the quant record")
+    elif measured >= floor:
+        problems.append(f"measured wire bytes {measured} do not beat "
+                        f"the exact floor {floor}")
+    if not qd.get("within_budget"):
+        problems.append(f"max_rel_err {qd.get('max_rel_err')} outside "
+                        f"budget {qd.get('error_budget')}")
+    q_bw = float(q.get("busbw_GBps") or 0.0)
+    e_bw = float(e.get("busbw_GBps") or 0.0)
+    ratio = q_bw / e_bw if e_bw else 0.0
+    if e_bw and ratio < 1.0:
+        problems.append(f"quant busbw below exact ({ratio:.2f}x)")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] quant smoke: int8 {q_bw:.3f} vs exact {e_bw:.3f} "
+          f"GB/s ({ratio:.2f}x), measured wire {measured}B vs exact "
+          f"floor {floor}B (static ratio {qd.get('wire_ratio')}), "
+          f"max_rel_err {qd.get('max_rel_err')} (budget "
+          f"{qd.get('error_budget')}) in {dt:.0f}s -> {verdict}",
+          flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -298,6 +383,9 @@ def main(argv=None) -> int:
         # warn-only: tuned allreduce >= default - tolerance through the
         # offline sweep -> cache -> reload round trip (ISSUE 5 satellite)
         _tuner_smoke(env)
+        # warn-only: int8 allreduce beats exact on wire bytes and stays
+        # inside the error budget on the wire-bound host path (ISSUE 6)
+        _quant_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

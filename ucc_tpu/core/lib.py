@@ -113,6 +113,32 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
                 "topology signature: team size, node layout, TL set, "
                 "thread mode); empty = ~/.cache/ucc_tpu/tune.json",
                 parse_string),
+    ConfigField("QUANT", "off", "block-scaled wire precision for eligible "
+                "collectives (allreduce/allgather, float32/bfloat16 "
+                "payloads): off = exact only (zero cost, candidate lists "
+                "unchanged); int8/fp8 = register quantized algorithm "
+                "variants in the score maps — 2-4x fewer wire bytes for a "
+                "bounded block-relative rounding error; the autotuner "
+                "explores them like any other candidate",
+                parse_enum(("off", "int8", "fp8"))),
+    ConfigField("QUANT_ALLREDUCE", "", "per-collective precision override "
+                "for allreduce (off|int8|fp8; empty = inherit UCC_QUANT)",
+                parse_string),
+    ConfigField("QUANT_ALLGATHER", "", "per-collective precision override "
+                "for allgather (off|int8|fp8; empty = inherit UCC_QUANT)",
+                parse_string),
+    ConfigField("QUANT_BLOCK", "256", "elements per absmax scale block of "
+                "the quantized wire format (smaller = tighter error, more "
+                "scale overhead: 4B per block)", parse_uint),
+    ConfigField("QUANT_ERROR_BUDGET", "auto", "max tolerated relative "
+                "error (fraction of the per-block absmax) for quantized "
+                "candidates; candidates whose predicted worst-case error "
+                "exceeds it fall back to exact algorithms. auto = admit "
+                "the selected precision (int8: 0.1, fp8: 1.0); an "
+                "explicit float gates strictly", parse_string),
+    ConfigField("QUANT_STOCHASTIC", "n", "stochastic rounding in the int8 "
+                "encoder (unbiased under repeated accumulation, slightly "
+                "higher per-element error)", parse_bool),
     ConfigField("CHECK_ASYMMETRIC_DT", "n", "validate datatype consistency "
                 "for gather(v)/scatter(v) via a service allreduce before "
                 "the collective (off by default for performance, matching "

@@ -69,9 +69,13 @@ def reduce_arrays(srcs: Sequence[np.ndarray], op: ReductionOp,
         return res
 
     if (out is not None and alpha is None and op in _OUT_UFUNC and
-            len(srcs) >= 2 and nd.type not in _HALF and
-            nd.name != "bfloat16" and out.dtype == nd and
-            all(s.dtype == nd for s in srcs)):
+            len(srcs) >= 2 and out.dtype.type not in _HALF and
+            out.dtype.name != "bfloat16" and
+            all(s.dtype == out.dtype for s in srcs)):
+        # accumulate in the buffers' COMMON dtype — which may be a WIDER
+        # accumulation dtype than dt (a bf16 payload reduced in f32
+        # scratch, the quantized-collective dequant+accumulate path):
+        # the result must stay in that dtype, not round-trip through nd
         ufunc = _OUT_UFUNC[op]
         ufunc(srcs[0], srcs[1], out=out)
         for s in srcs[2:]:
@@ -114,13 +118,17 @@ def reduce_arrays(srcs: Sequence[np.ndarray], op: ReductionOp,
         acc = acc.astype(nd)
     if alpha is not None:
         acc = acc * alpha
-    res = acc.astype(nd) if acc.dtype != nd else acc
-    if out is not None and res is not out:
+    if out is not None:
         # contract: with out=, the result ALWAYS lands in out (callers
-        # need no conditional copy-back when the fast path didn't apply)
-        out[:] = res
+        # need no conditional copy-back when the fast path didn't
+        # apply). The cast targets OUT's dtype: an out wider than nd
+        # (f32 scratch accumulating a bf16 payload) keeps full
+        # precision instead of silently round-tripping through nd
+        if acc is not out:
+            out[:] = acc if acc.dtype == out.dtype else \
+                acc.astype(out.dtype)
         return out
-    return res
+    return acc.astype(nd) if acc.dtype != nd else acc
 
 
 def _reduce_loc(srcs: Sequence[np.ndarray], op: ReductionOp) -> np.ndarray:

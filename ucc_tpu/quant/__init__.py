@@ -1,0 +1,175 @@
+"""Quantized (block-scaled low-precision) collectives — policy layer.
+
+This package makes wire-compressed collectives FIRST-CLASS algorithm
+candidates rather than a special-cased mode (the GC3 framing from
+PAPERS.md): when ``UCC_QUANT`` selects a precision, the host and xla TLs
+register quantized algorithm variants in their score maps with a
+precision tag, the PR-5 tuner explores them like any other candidate,
+and an error budget gates their eligibility per collective. With
+``UCC_QUANT=off`` (the default) nothing is registered: the candidate
+lists, the dispatch hot path, and the tuner rotation are byte-identical
+to a build without this package.
+
+Knobs (global table, ``ucc_info -cf``):
+
+- ``UCC_QUANT=off|int8|fp8`` — wire precision for eligible collectives.
+- ``UCC_QUANT_ALLREDUCE`` / ``UCC_QUANT_ALLGATHER`` — per-collective
+  override (same values; ``off`` disables just that collective, empty
+  inherits ``UCC_QUANT``).
+- ``UCC_QUANT_BLOCK`` (256) — elements per absmax scale block.
+- ``UCC_QUANT_ERROR_BUDGET`` (auto) — max tolerated relative error
+  (fraction of the per-block absmax). Quantized candidates whose
+  predicted worst-case error exceeds the budget are rejected at init
+  (ERR_NOT_SUPPORTED) and the score-map fallback walk lands on an exact
+  algorithm. ``auto`` admits the precision the user explicitly selected
+  (int8: 0.1, fp8: 1.0); an explicit float gates strictly.
+- ``UCC_QUANT_STOCHASTIC`` (n) — stochastic rounding for the int8
+  encoder (unbiased accumulation across repeated reductions).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from ..constants import CollType, DataType
+from .codec import CODECS, BlockCodec, get_codec, n_blocks, wire_count
+
+__all__ = ["QuantParams", "coll_mode", "params_for", "admits",
+           "predicted_error", "default_budget", "wire_ratio",
+           "CODECS", "BlockCodec", "get_codec", "wire_count", "n_blocks",
+           "QUANT_COLLS", "QUANT_DTS"]
+
+_MODES = ("int8", "fp8")
+
+#: collectives served by quantized variants, and the payload dtypes the
+#: codecs accept (block-absmax scaling needs a float payload)
+QUANT_COLLS = (CollType.ALLREDUCE, CollType.ALLGATHER)
+QUANT_DTS = (DataType.FLOAT32, DataType.BFLOAT16)
+
+_COLL_FIELD = {CollType.ALLREDUCE: "quant_allreduce",
+               CollType.ALLGATHER: "quant_allgather"}
+_COLL_ENV = {CollType.ALLREDUCE: "UCC_QUANT_ALLREDUCE",
+             CollType.ALLGATHER: "UCC_QUANT_ALLGATHER"}
+
+#: auto error budgets: selecting a precision is itself the opt-in to its
+#: error class, so auto admits it at realistic team sizes; an explicit
+#: numeric budget gates strictly (the rejection-falls-back-to-exact path)
+_AUTO_BUDGET = {"int8": 0.1, "fp8": 1.0}
+
+
+@dataclass(frozen=True)
+class QuantParams:
+    """Resolved quantization policy for one (team, collective)."""
+
+    codec: BlockCodec
+    block: int
+    budget: float
+    stochastic: bool
+
+    @property
+    def mode(self) -> str:
+        return self.codec.name
+
+
+def _lib_config(team):
+    """The owning lib's global Config, or None for introspection stubs
+    (``ucc_info -A`` reads alg tables off a bare team)."""
+    try:
+        return team.core_team.context.lib.config
+    except AttributeError:
+        return None
+
+
+def _cfg_str(cfg, field: str, env: str, default: str = "") -> str:
+    if cfg is not None:
+        try:
+            return str(cfg.get(field) or "").strip().lower()
+        except KeyError:
+            pass
+    return os.environ.get(env, default).strip().lower()
+
+
+def coll_mode(team, coll: CollType) -> Optional[str]:
+    """The wire precision serving *coll* on *team*'s build, or None.
+    Read once per team create (alg-table construction) — never on the
+    dispatch path, so UCC_QUANT=off stays zero-cost."""
+    if coll not in _COLL_FIELD:
+        return None
+    cfg = _lib_config(team)
+    mode = _cfg_str(cfg, "quant", "UCC_QUANT")
+    override = _cfg_str(cfg, _COLL_FIELD[coll], _COLL_ENV[coll])
+    if override:
+        mode = override
+    return mode if mode in _MODES else None
+
+
+def default_budget(mode: str) -> float:
+    return _AUTO_BUDGET[mode]
+
+
+def params_for(team, coll: CollType) -> Optional[QuantParams]:
+    """Full quantization policy for (team, coll); None when off."""
+    mode = coll_mode(team, coll)
+    if mode is None:
+        return None
+    cfg = _lib_config(team)
+    block = 256
+    budget_s = "auto"
+    stochastic = False
+    if cfg is not None:
+        try:
+            block = int(cfg.get("quant_block"))
+            budget_s = str(cfg.get("quant_error_budget")).strip().lower()
+            stochastic = bool(cfg.get("quant_stochastic"))
+        except KeyError:
+            pass
+    else:
+        block = int(os.environ.get("UCC_QUANT_BLOCK", "256") or 256)
+        budget_s = os.environ.get("UCC_QUANT_ERROR_BUDGET",
+                                  "auto").strip().lower()
+        stochastic = os.environ.get("UCC_QUANT_STOCHASTIC", "n") \
+            .strip().lower() in ("y", "yes", "1", "true", "on")
+    block = max(8, block)
+    if budget_s in ("", "auto"):
+        budget = default_budget(mode)
+    else:
+        try:
+            budget = float(budget_s)
+        except ValueError:
+            budget = default_budget(mode)
+    return QuantParams(codec=get_codec(mode), block=block, budget=budget,
+                       stochastic=stochastic)
+
+
+def predicted_error(codec: BlockCodec, coll: CollType, team_size: int,
+                    variant: str = "direct") -> float:
+    """Worst-case relative error (fraction of per-block absmax) of a
+    quantized collective — the eligibility predictor the budget gates.
+
+    direct allreduce: every contribution quantized once + the reduced
+    result quantized once -> (n + 1) half-steps. ring allreduce:
+    partial sums re-quantized at each of the n-1 hops on top of the
+    incoming decode error -> ~2n half-steps. allgather: a single
+    round trip per block regardless of n.
+    """
+    h = codec.half_step
+    n = max(1, int(team_size))
+    if coll == CollType.ALLGATHER:
+        return h
+    if variant == "ring":
+        return 2.0 * n * h
+    return (n + 1.0) * h
+
+
+def admits(params: QuantParams, coll: CollType, team_size: int,
+           variant: str = "direct") -> bool:
+    """Does the caller's error budget admit this quantized candidate?"""
+    return predicted_error(params.codec, coll, team_size,
+                           variant) <= params.budget
+
+
+def wire_ratio(count: int, elem_size: int, block: int) -> float:
+    """wire bytes / logical bytes for a count-element payload."""
+    logical = count * elem_size
+    return wire_count(count, block) / logical if logical else 1.0

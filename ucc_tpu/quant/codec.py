@@ -1,0 +1,242 @@
+"""Block-scaled low-precision codecs for quantized collectives.
+
+EQuARX-style (PAPERS.md) wire compression: a float32/bfloat16 payload is
+split into fixed-size blocks, each block carries one float32 absmax
+scale, and the elements travel as int8 or fp8-e4m3 — 2-4x fewer wire
+bytes in exchange for a bounded, block-relative rounding error. The
+codecs are pure array transforms (encode into / decode from
+caller-provided buffers) so the host algorithms can run them over
+mc-pool scratch leases and keep the steady state zero-alloc.
+
+Wire layout of an encoded vector of ``count`` elements at block size
+``B`` (``nb = ceil(count / B)`` blocks)::
+
+    [ nb * 4 bytes : float32 per-block scales ][ count bytes : q elems ]
+
+Both sides derive the layout from (count, B) alone — no header — so the
+block size must agree across the team (it is config-driven, like every
+other algorithm knob).
+
+Error model (used for the eligibility gate, quant/__init__.admits):
+one quantize/dequantize round trip perturbs an element by at most
+``half_step`` of its block's absmax (int8: 1/254 ~ 0.4%; fp8-e4m3:
+2^-4 = 6.25% — fp8's error is relative to each element, the absmax
+bound is the conservative envelope). Reductions compound it: the
+direct (radix-n) allreduce pays one input quantization per contribution
+plus one output quantization, the ring variant re-quantizes partial
+sums every hop.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import ml_dtypes
+import numpy as np
+
+__all__ = ["BlockCodec", "CODECS", "get_codec", "wire_count", "n_blocks"]
+
+_F8 = np.dtype(ml_dtypes.float8_e4m3fn)
+_BF16 = np.dtype(ml_dtypes.bfloat16)
+
+
+def n_blocks(count: int, block: int) -> int:
+    return (int(count) + block - 1) // block
+
+
+def wire_count(count: int, block: int) -> int:
+    """Wire bytes for ``count`` encoded elements (scales + 1B/elem)."""
+    return int(count) + 4 * n_blocks(count, block)
+
+
+#: per-thread float32 work buffers, grown monotonically and reused: the
+#: encode/decode hot loops must not page-fault fresh temporaries on every
+#: call (the same rationale as the mc pool, kept internal because these
+#: are pure compute scratch with no transport lifetime)
+_TLS = threading.local()
+
+
+def _tmp(slot: int, n: int, dtype=np.float32) -> np.ndarray:
+    bufs = getattr(_TLS, "bufs", None)
+    if bufs is None:
+        bufs = _TLS.bufs = {}
+    buf = bufs.get(slot)
+    if buf is None or buf.size < n or buf.dtype != dtype:
+        buf = bufs[slot] = np.empty(n, dtype)
+    return buf[:n]
+
+
+def _tmp_f32(slot: int, n: int) -> np.ndarray:
+    return _tmp(slot, n, np.float32)
+
+
+def _as_f32(x: np.ndarray, slot: int = 1) -> np.ndarray:
+    """float32 compute view of a payload; bf16 widens into the reusable
+    thread-local work buffer (one cast pass, no fresh allocation)."""
+    if x.dtype == np.float32:
+        return x
+    t = _tmp_f32(slot, x.size)
+    t[:] = x
+    return t
+
+
+#: fp8 cast tables (built lazily, once per process): ml_dtypes' scalar
+#: cast loops (and this numpy build's f32->f16 cast) are far too slow
+#: for the wire hot path, so fp8 encode rounds each float32's UPPER 16
+#: BITS (+0x8000 with carry = round-to-nearest on the truncated value,
+#: safe for the finite, range-bounded scaled inputs) and gathers the f8
+#: byte from a 64K-entry table keyed on them; decode is a 256-entry
+#: f8-byte -> f32 gather. The 16-bit truncation double-rounds, adding
+#: at most 2^-9 relative — noise against fp8's 2^-4 half-step.
+_f8_tables: Dict[str, np.ndarray] = {}
+
+
+def _f8_from_f32hi_lut() -> np.ndarray:
+    lut = _f8_tables.get("enc")
+    if lut is None:
+        hi = (np.arange(1 << 16, dtype=np.uint32) << np.uint32(16))
+        with np.errstate(invalid="ignore"):       # inf/nan table rows
+            lut = _f8_tables["enc"] = \
+                hi.view(np.float32).astype(_F8).view(np.uint8)
+    return lut
+
+
+def _f8_to_f32_lut() -> np.ndarray:
+    lut = _f8_tables.get("dec")
+    if lut is None:
+        lut = _f8_tables["dec"] = \
+            np.arange(256, dtype=np.uint8).view(_F8).astype(np.float32)
+    return lut
+
+
+class BlockCodec:
+    """One precision's encode/decode pair.
+
+    ``qmax`` is the largest representable magnitude after scaling;
+    ``half_step`` the worst-case round-trip error of one element,
+    relative to its block's absmax.
+    """
+
+    def __init__(self, name: str, qdtype: np.dtype, qmax: float,
+                 half_step: float):
+        self.name = name
+        self.qdtype = np.dtype(qdtype)
+        self.qmax = float(qmax)
+        self.half_step = float(half_step)
+
+    def __repr__(self):
+        return f"BlockCodec({self.name})"
+
+    # ------------------------------------------------------------------
+    def _split_wire(self, wire: np.ndarray, count: int, block: int):
+        nb = n_blocks(count, block)
+        scales = wire[:4 * nb].view(np.float32)
+        q = wire[4 * nb:4 * nb + count].view(self.qdtype)
+        return scales, q
+
+    def encode(self, src: np.ndarray, wire: np.ndarray, block: int,
+               stochastic: bool = False,
+               rng: Optional[np.random.Generator] = None) -> None:
+        """Encode ``src`` (1-D float32/bfloat16) into ``wire`` (uint8,
+        >= wire_count(src.size, block) bytes)."""
+        count = src.size
+        scales, q = self._split_wire(wire, count, block)
+        x = _as_f32(src)
+        m = (count // block) * block
+
+        def one(xs: np.ndarray, sc_out: np.ndarray, q_out: np.ndarray,
+                blk: int) -> None:
+            x2 = xs.reshape(-1, blk)
+            t = _tmp_f32(0, xs.size).reshape(-1, blk)
+            np.abs(x2, out=t)
+            amax = t.max(axis=1)
+            # a zero block keeps scale 1 so 0 encodes to 0 exactly
+            nz = amax > 0.0
+            sc_out[:] = np.where(nz, amax / self.qmax, 1.0)
+            inv = np.where(nz, self.qmax / np.where(nz, amax, 1.0), 1.0)
+            np.multiply(x2, inv[:, None], out=t)
+            # |t| <= qmax by construction (inv is the exact reciprocal of
+            # the stored scale up to one rounding), so no clip pass:
+            # round-to-nearest cannot push a value past the code range
+            if self.qdtype == np.int8:
+                if stochastic and rng is not None:
+                    np.add(t, rng.random(t.shape, dtype=np.float32),
+                           out=t)
+                    np.floor(t, out=t)
+                    # the no-clip argument below holds for round-to-
+                    # nearest ONLY: here t can sit ~2 ulps past +/-127
+                    # (inv is not exactly 1/scale) and floor(t + u)
+                    # crosses 128 with small-but-real probability — the
+                    # int8 cast would WRAP that to -128, a sign-flipped
+                    # absmax element. One clip pass on the (cold-ish)
+                    # stochastic path buys the hard bound.
+                    np.clip(t, -127.0, 127.0, out=t)
+                else:
+                    np.rint(t, out=t)
+                q_out.reshape(-1, blk)[:] = t  # dtype-cast on assignment
+            else:
+                # fp8 via the f32-upper-bits table (_f8_from_f32hi_lut)
+                v = t.reshape(-1).view(np.uint32)
+                u = _tmp(3, v.size, np.uint32)
+                np.add(v, np.uint32(0x8000), out=u)
+                np.right_shift(u, np.uint32(16), out=u)
+                np.take(_f8_from_f32hi_lut(), u,
+                        out=q_out.view(np.uint8).reshape(-1))
+
+        if m:
+            one(x[:m], scales[:m // block], q[:m], block)
+        if m < count:                      # tail block (count % block)
+            one(x[m:], scales[m // block:], q[m:], count - m)
+
+    def decode(self, wire: np.ndarray, count: int, block: int,
+               out: np.ndarray) -> None:
+        """Decode ``count`` elements from ``wire`` into ``out`` (any
+        float dtype; values are computed in float32 and cast on
+        assignment)."""
+        scales, q = self._split_wire(wire, count, block)
+        m = (count // block) * block
+
+        def one(q_in: np.ndarray, sc: np.ndarray, dst: np.ndarray,
+                blk: int) -> None:
+            if self.qdtype == np.int8:
+                q2 = q_in.reshape(-1, blk)
+            else:
+                # fp8 via the 256-entry byte -> f32 gather
+                t8 = _tmp_f32(2, q_in.size)
+                np.take(_f8_to_f32_lut(),
+                        q_in.view(np.uint8).reshape(-1), out=t8)
+                q2 = t8.reshape(-1, blk)
+            d2 = dst.reshape(-1, blk)
+            if dst.dtype == np.float32:
+                np.multiply(q2, sc[:, None], out=d2)
+                return
+            t = _tmp_f32(0, q_in.size).reshape(-1, blk)
+            np.multiply(q2, sc[:, None], out=t)
+            d2[:] = t
+
+        if m:
+            one(q[:m], scales[:m // block], out[:m], block)
+        if m < count:
+            one(q[m:], scales[m // block:], out[m:], count - m)
+
+    # ------------------------------------------------------------------
+    def roundtrip_max_err(self, src: np.ndarray, wire: np.ndarray,
+                          block: int) -> float:
+        """max |src - decode(wire)| — the observability probe behind the
+        ``quant_max_abs_err`` gauge (cold path: callers guard on
+        metrics.ENABLED)."""
+        tmp = np.empty(src.size, np.float32)
+        self.decode(wire, src.size, block, tmp)
+        return float(np.max(np.abs(_as_f32(src) - tmp))) if src.size else 0.0
+
+
+#: int8: symmetric round-to-nearest over [-127, 127]; fp8-e4m3: scaled
+#: dtype cast (3 mantissa bits -> half-ulp 2^-4)
+CODECS: Dict[str, BlockCodec] = {
+    "int8": BlockCodec("int8", np.dtype(np.int8), 127.0, 0.5 / 127.0),
+    "fp8": BlockCodec("fp8", _F8, 448.0, 2.0 ** -4),
+}
+
+
+def get_codec(name: str) -> BlockCodec:
+    return CODECS[name]

@@ -1,0 +1,103 @@
+"""In-jit block-scaled quantized collectives for the xla TL.
+
+The device-path half of the quantization tentpole: inside the compiled
+shard_map program the local shard is quantized block-scaled (the same
+absmax-per-block format as the host codec, minus the byte-packing — XLA
+moves typed arrays), exchanged via dtype-cast ``lax.all_gather`` at 1
+byte/element, then dequantized and reduced locally in float32. The wire
+legs (the all_gather) carry int8/fp8 + one f32 scale per block instead
+of the full-precision payload.
+
+Wire accounting — the allgather structure's cut SHRINKS with team
+size: (n-1)*count bytes inbound per rank versus psum's
+2*(n-1)/n*count*4, i.e. 2x at n=4, break-even near n=8, and MORE
+bytes than exact beyond that. This variant is for small teams (the
+hier CL's node-leader sbgp over DCN is the intended shape); a
+reduce-scatter-structured quantized program (O(count) wire independent
+of n, like the host q*_sra variant) is the follow-up for large flat
+device teams.
+
+These are ordinary score-map candidates on the xla TL (tl/xla.py
+alg_table, gated on UCC_QUANT) — registered one point below the exact
+default so the PR-5 tuner (or an explicit TUNE string) selects them
+where the wire cut wins on the actual fabric and team shape; on the
+virtual CPU mesh the "wire" is memcpy and the exact program usually
+keeps the range.
+"""
+from __future__ import annotations
+
+from ..constants import ReductionOp
+
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def _qdtype(mode: str):
+    import jax.numpy as jnp
+    return jnp.int8 if mode == "int8" else jnp.float8_e4m3fn
+
+
+def _block_quantize(xf, mode: str, block: int):
+    """(count,) f32 -> ((nb, block) quantized, (nb,) f32 scales).
+    count must be a multiple of block (the program builder pads)."""
+    import jax.numpy as jnp
+    x2 = xf.reshape(-1, block)
+    amax = jnp.max(jnp.abs(x2), axis=1)
+    scale = jnp.where(amax > 0.0, amax / _QMAX[mode], 1.0)
+    scaled = x2 / scale[:, None]
+    if mode == "int8":
+        q = jnp.clip(jnp.round(scaled), -127.0, 127.0).astype(jnp.int8)
+    else:
+        q = jnp.clip(scaled, -448.0, 448.0).astype(_qdtype(mode))
+    return q, scale.astype(jnp.float32)
+
+
+def _block_dequantize(q, scale):
+    """((..., nb, block), (..., nb)) -> (..., nb, block) f32."""
+    import jax.numpy as jnp
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def quant_allreduce(x, op: ReductionOp, mode: str, block: int,
+                    axis_name: str = "r"):
+    """x: (1, padded) shard (padded % block == 0). Quantize-once
+    allgather-based allreduce: every rank receives each contribution
+    quantized (1B/elem + scales on the wire), dequantizes and
+    accumulates in f32 — the direct host variant's error model, (n+1)
+    half-steps worst case."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    orig = x.dtype
+    xf = x[0].astype(jnp.float32)
+    q, scale = _block_quantize(xf, mode, block)
+    gq = lax.all_gather(q, axis_name)            # (n, nb, block)
+    gs = lax.all_gather(scale, axis_name)        # (n, nb)
+    red = jnp.sum(_block_dequantize(gq, gs), axis=0)
+    if op == ReductionOp.AVG:
+        red = red / lax.psum(1, axis_name)
+    # re-quantize the result so every rank applies the identical
+    # rounding — bitwise cross-rank agreement, like the host variants
+    rq, rs = _block_quantize(red.reshape(-1), mode, block)
+    out = _block_dequantize(rq, rs).reshape(-1)
+    return out.astype(orig)[None, :]
+
+
+def quant_allgather(x, mode: str, block: int, count: int,
+                    axis_name: str = "r"):
+    """x: (1, padded) shard -> (1, n*count) replicated gather of the
+    dequantized contributions (single round-trip error per block).
+    ``count`` is the true per-rank element count — the block padding is
+    sliced off each row so the output is packed like the exact
+    allgather."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    orig = x.dtype
+    xf = x[0].astype(jnp.float32)
+    q, scale = _block_quantize(xf, mode, block)
+    gq = lax.all_gather(q, axis_name)            # (n, nb, block)
+    gs = lax.all_gather(scale, axis_name)
+    rows = _block_dequantize(gq, gs)             # (n, nb, block)
+    n = rows.shape[0]
+    out = rows.reshape(n, -1)[:, :count].reshape(-1)
+    return out.astype(orig)[None, :]

@@ -51,6 +51,10 @@ class MsgRange:
     #: "learned" = the autotuner promoted it from measurements. Shown in
     #: the score dump so team logs say WHY an algorithm was chosen.
     origin: str = "default"
+    #: wire-precision tag of quantized algorithm variants ("int8"/"fp8";
+    #: empty = exact). Preserved across tune-str/learned splits so the
+    #: score dump marks quantized (incl. learned-quantized) ranges.
+    precision: str = ""
 
     def contains(self, msgsize: int) -> bool:
         return self.start <= msgsize < self.end or \
@@ -79,12 +83,13 @@ class CollScore:
     # ------------------------------------------------------------------
     def add_range(self, coll: CollType, mem: MemoryType, start: int, end: int,
                   score: int, init: Optional[Callable] = None, team: Any = None,
-                  alg_name: str = "") -> Status:
+                  alg_name: str = "", precision: str = "") -> Status:
         """ucc_coll_score_add_range (ucc_coll_score.h:73)."""
         if start >= end or score < 0:
             return Status.ERR_INVALID_PARAM
         self.ranges.setdefault((coll, mem), []).append(
-            MsgRange(start, end, score, init, team, alg_name))
+            MsgRange(start, end, score, init, team, alg_name,
+                     precision=precision))
         return Status.OK
 
     def merge(self, other: "CollScore") -> "CollScore":
@@ -171,6 +176,10 @@ class CollScore:
                 mid.init = new_init
                 mid.alg_name = alg or ""
                 mid.origin = "tune-str"
+                # the resolver only hands back an init fn; a swapped-in
+                # algorithm's precision is unknown here — drop the old
+                # range's tag rather than mislabel the new algorithm
+                mid.precision = ""
             out.append(mid)
             if hi < r.end:
                 out.append(replace(r, start=hi))

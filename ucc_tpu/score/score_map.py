@@ -171,6 +171,12 @@ class ScoreMap:
                 comp = comp_name(r)
                 name = r.alg_name or comp
                 origin = r.origin or "default"
+                # quantized ranges carry their wire-precision tag next to
+                # the provenance — "(learned,int8)" says a LEARNED range
+                # runs the int8 variant, so tuned quantized windows are
+                # auditable from `ucc_info -s` alone
+                if r.precision:
+                    origin = f"{origin},{r.precision}"
                 key = (comp, name, r.start, r.end, r.score, origin)
                 if key in seen:
                     continue

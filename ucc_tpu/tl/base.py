@@ -109,6 +109,10 @@ class AlgSpec:
     #: default selection ranges "0-4k:score,4k-inf:score" (None -> whole
     #: range at the TL default score)
     default_select: Optional[str] = None
+    #: wire-precision tag for quantized variants ("int8"/"fp8"; empty =
+    #: exact). Carried into every MsgRange so score dumps and learned
+    #: tuning ranges name the precision, not just the algorithm.
+    precision: str = ""
 
 
 def load_coll_plugins(tl_name: str):
@@ -172,10 +176,12 @@ def build_scores(team: BaseTeam, default_score: int,
                         lo, hi = rng.split("-", 1)
                         score.add_range(coll, mt, parse_memunits(lo),
                                         parse_memunits(hi), int(sc),
-                                        spec.init, team, spec.name)
+                                        spec.init, team, spec.name,
+                                        precision=spec.precision)
                 else:
                     score.add_range(coll, mt, 0, SIZE_INF, default_score,
-                                    spec.init, team, spec.name)
+                                    spec.init, team, spec.name,
+                                    precision=spec.precision)
     if tune_env:
         tune = os.environ.get(tune_env, "")
         if tune:

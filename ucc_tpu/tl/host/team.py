@@ -32,6 +32,8 @@ from .knomial2 import (BcastSagKnomial, GatherKnomial, ReduceScatterKnomial,
                        ScatterKnomial)
 from .onesided import (AllreduceSlidingWindow, AlltoallOnesided,
                        AlltoallvOnesided)
+from .quantized import (AllgatherQuant, AllreduceQuantRing,
+                        AllreduceQuantSra)
 from .ring import (AllgatherRing, AllgathervRing, AllreduceRing,
                    ReduceScatterRing, ReduceScatterRingBidirectional,
                    ReduceScattervRing)
@@ -203,16 +205,16 @@ class HostTlTeam(TlTeamBase):
             if self._ag_large_alg() == "ring" else (S + 3, S + 5)
         a2a_switch = 129 * tsize
 
-        def spec(i, name, cls, sel=None, **kw):
+        def spec(i, name, cls, sel=None, precision="", **kw):
             def init(ia, team, _cls=cls, _kw=kw):
                 if ia.args.active_set is not None:
                     # active-set subset execution (bcast only, enforced by
                     # core dispatch ucc_coll.c:210-214)
                     return self.coll_init_active_set(ia)
                 return _cls(ia, self, **_kw)
-            return AlgSpec(i, name, init, sel)
+            return AlgSpec(i, name, init, sel, precision)
 
-        return {
+        table = {
             CollType.ALLREDUCE: [
                 # latency alg for small, bandwidth algs for large
                 # (default select mirrors tl_ucp allreduce.h:24-25)
@@ -338,6 +340,30 @@ class HostTlTeam(TlTeamBase):
                 spec(0, "linear", ScatterLinear),
             ],
         }
+        # quantized variants (ucc_tpu/quant, EQuARX-style block-scaled
+        # wire compression): registered as ORDINARY candidates — with a
+        # precision tag, tuner-explorable, TUNE-addressable by name —
+        # only when UCC_QUANT selects a precision, so the off path keeps
+        # a byte-identical candidate list and zero new dispatch work.
+        # When on, the quantized default wins the bandwidth-bound >=64K
+        # range (wire bytes shrink 2-4x); the exact algorithms remain the
+        # fallback chain (and take over when the error budget rejects
+        # quantization at init).
+        from ...quant import coll_mode
+        q_ar = coll_mode(self, CollType.ALLREDUCE)
+        if q_ar:
+            table[CollType.ALLREDUCE] += [
+                spec(5, f"q{q_ar}_sra", AllreduceQuantSra,
+                     sel=f"0-64k:1,64k-inf:{S + 6}", precision=q_ar),
+                spec(6, f"q{q_ar}_ring", AllreduceQuantRing,
+                     sel=f"0-64k:1,64k-inf:{S + 4}", precision=q_ar),
+            ]
+        q_ag = coll_mode(self, CollType.ALLGATHER)
+        if q_ag:
+            table[CollType.ALLGATHER].append(
+                spec(7, f"q{q_ag}_linear", AllgatherQuant,
+                     sel=f"0-64k:1,64k-inf:{S + 6}", precision=q_ag))
+        return table
 
     def get_scores(self) -> CollScore:
         return build_scores(self, self.TL_CLS.DEFAULT_SCORE, self.alg_table(),

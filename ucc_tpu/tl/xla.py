@@ -662,6 +662,32 @@ class XlaCollTask(CollTask):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            "tl/xla scatterv requires the counts vector on "
                            "the root's src BufferInfoV")
+        self._qblock = 0
+        if alg.startswith("q"):
+            # quantized dtype-cast variant (ucc_tpu/quant): the wire legs
+            # carry int8/fp8 + per-block scales inside the compiled
+            # program. Same eligibility contract as the host variants —
+            # float payload, SUM/AVG, and the error budget must admit
+            # the precision — with NOT_SUPPORTED walking the fallback
+            # chain back to the exact program.
+            from .. import quant as _quant
+            qp = _quant.params_for(team, self.coll)
+            if qp is None or f"q{qp.mode}" != alg:
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               "quantized xla variant disabled (UCC_QUANT)")
+            if (args.src or args.dst).datatype not in _quant.QUANT_DTS:
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               "quantized xla variant needs a float payload")
+            if self.coll == CollType.ALLREDUCE:
+                qop = args.op if args.op is not None else ReductionOp.SUM
+                if qop not in (ReductionOp.SUM, ReductionOp.AVG):
+                    raise UccError(Status.ERR_NOT_SUPPORTED,
+                                   "quantized xla allreduce supports "
+                                   "SUM/AVG")
+            if not _quant.admits(qp, self.coll, team.size, "direct"):
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               "error budget rejects quantized xla variant")
+            self._qblock = qp.block
         if self.coll == CollType.SCATTER and args.src is not None and \
                 args.src.buffer is not None and \
                 int(args.src.count) % team.size != 0:
@@ -751,11 +777,16 @@ class XlaCollTask(CollTask):
         count = self.src_count()
         key = (self.coll, args.op, self.np_dtype.str, count, self.alg,
                int(args.root) if args.is_rooted else 0, self._vkey())
+        if self._qblock:
+            # quantized programs additionally key on the scale-block
+            # size (exact algs keep the historical 7-tuple shape)
+            key += (self._qblock,)
         cached = shared.programs.get(key)
         if cached is not None:
             return cached
         program, padded = _build_xla_program(
-            shared.mesh, n, self.coll, args, self.np_dtype, count, self.alg)
+            shared.mesh, n, self.coll, args, self.np_dtype, count, self.alg,
+            qblock=self._qblock)
         shared.programs[key] = (program, padded)
         return program, padded
 
@@ -1105,7 +1136,7 @@ class XlaCollTask(CollTask):
 # ---------------------------------------------------------------------------
 
 def _build_xla_program(mesh, n: int, coll: CollType, args, nd, count: int,
-                       alg: str):
+                       alg: str, qblock: int = 0):
     """Build + jit the shard_map program for one (coll, shape) instance.
     Returns (callable, padded_per_rank_count)."""
     import jax
@@ -1127,6 +1158,9 @@ def _build_xla_program(mesh, n: int, coll: CollType, args, nd, count: int,
         rem = padded % n
         if rem:
             padded += n - rem
+    elif alg.startswith("q") and qblock:
+        # quantized programs reshape the shard into absmax blocks
+        padded += (-padded) % qblock
 
     vcounts = None
     for bi in (args.src, args.dst):
@@ -1135,6 +1169,9 @@ def _build_xla_program(mesh, n: int, coll: CollType, args, nd, count: int,
 
     def body_2d(x):       # x: (1, padded) shard-local
         if coll == CollType.ALLREDUCE:
+            if alg.startswith("q") and qblock:
+                from ..quant.xla_ops import quant_allreduce
+                return quant_allreduce(x, op, alg[1:], qblock)
             if alg == "ring" and op in (ReductionOp.SUM, ReductionOp.AVG):
                 return ops.allreduce_ring(x, op)
             return ops.allreduce(x, op)
@@ -1146,6 +1183,9 @@ def _build_xla_program(mesh, n: int, coll: CollType, args, nd, count: int,
                 coll == CollType.FANOUT:
             return ops.barrier()
         if coll == CollType.ALLGATHER or coll == CollType.GATHER:
+            if alg.startswith("q") and qblock and coll == CollType.ALLGATHER:
+                from ..quant.xla_ops import quant_allgather
+                return quant_allgather(x, alg[1:], qblock, count)
             return ops.allgather(x)
         if coll == CollType.ALLGATHERV or coll == CollType.GATHERV:
             g = ops.allgather(x)            # (1, n*padded)
@@ -1236,10 +1276,11 @@ class TlXlaTeam(TlTeamBase):
 
     # ------------------------------------------------------------------
     def alg_table(self) -> Dict[CollType, List[AlgSpec]]:
-        def spec(i, name, select=None, **kw):
+        def spec(i, name, select=None, precision="", **kw):
             def init(ia, team, _kw=kw):
                 return XlaCollTask(ia, self, **_kw)
-            return AlgSpec(i, name, init, default_select=select)
+            return AlgSpec(i, name, init, default_select=select,
+                           precision=precision)
 
         table = {ct: [spec(0, "xla")] for ct in (
             CollType.ALLREDUCE, CollType.REDUCE, CollType.BCAST,
@@ -1266,6 +1307,23 @@ class TlXlaTeam(TlTeamBase):
             # which needs every rank's device addressable (same locality
             # requirement as a2av's counts-matrix assembly)
             table[CollType.SCATTERV] = [spec(0, "xla")]
+        # quantized dtype-cast variants (ucc_tpu/quant): registered one
+        # point BELOW the exact default — on real fabrics the tuner (or a
+        # TUNE string) promotes them where the 2-4x wire cut beats the
+        # in-program quantize/dequantize; on the virtual CPU mesh the
+        # "wire" is memcpy, so defaulting to them would be dishonest.
+        # Absent with UCC_QUANT=off: candidate lists stay byte-identical.
+        from ..quant import coll_mode as _quant_mode
+        q_ar = _quant_mode(self, CollType.ALLREDUCE)
+        if q_ar:
+            table[CollType.ALLREDUCE].append(
+                spec(3, f"q{q_ar}", alg=f"q{q_ar}", precision=q_ar,
+                     select=f"0-inf:{TlXla.DEFAULT_SCORE - 2}"))
+        q_ag = _quant_mode(self, CollType.ALLGATHER)
+        if q_ag:
+            table[CollType.ALLGATHER].append(
+                spec(1, f"q{q_ag}", alg=f"q{q_ag}", precision=q_ag,
+                     select=f"0-inf:{TlXla.DEFAULT_SCORE - 2}"))
         thr = self._short_msg_max()
         if thr > 0 and all_local and shared is not None:
             # latency algorithm for short messages: host-staged eager

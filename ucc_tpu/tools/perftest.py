@@ -353,9 +353,91 @@ def run_sweep_mode(args, job, coll, dt, op, mem, bmin, bmax, n,
                 continue    # candidate refused these args / failed / hung
             print(json.dumps(measurement_record(
                 args.coll, mem, n, (comp, alg), size, count, args.iters,
-                lat_stats(lats))), flush=True)
+                lat_stats(lats), precision=cands[idx].precision)),
+                flush=True)
         size *= 2
     return 0
+
+
+# ---------------------------------------------------------------------------
+# --quant mode: wire-vs-logical busbw + measured error (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+def _quant_verify(job, coll, n, count, dt, mem, devices, budget, seed=5):
+    """One verification round on RANDOM data (the timed loops run ones,
+    which int8 encodes exactly): returns (selected alg, error-stats
+    dict, measured wire bytes). The round runs under
+    ``quant.verify.MeasuredBytes`` so the reported wire bytes are the
+    transport's actual ``bytes_sent``, not a formula. In-process jobs
+    only."""
+    from ucc_tpu.constants import dt_numpy as _dtn
+    from ucc_tpu.quant.verify import MeasuredBytes, error_stats
+    nd = _dtn(dt)
+    rng = np.random.default_rng(seed)
+    hosts = [(((rng.random(count).astype(np.float32)) - 0.5) * 4)
+             .astype(nd) for _ in range(n)]
+
+    def buf(r, arr):
+        cnt = arr.size
+        if mem == MemoryType.TPU:
+            import jax
+            a = jax.device_put(arr, devices[r] if devices else None)
+            return BufferInfo(a, cnt, dt, mem_type=MemoryType.TPU)
+        return BufferInfo(arr.copy(), cnt, dt, mem_type=MemoryType.HOST)
+
+    def out(cnt):
+        if mem == MemoryType.TPU:
+            return BufferInfo(None, cnt, dt, mem_type=MemoryType.TPU)
+        return BufferInfo(np.zeros(cnt, nd), cnt, dt,
+                          mem_type=MemoryType.HOST)
+
+    if coll == CollType.ALLREDUCE:
+        argses = [CollArgs(coll_type=coll, op=ReductionOp.SUM,
+                           src=buf(r, hosts[r]), dst=out(count))
+                  for r in range(n)]
+        exact = np.sum(np.stack([h.astype(np.float64) for h in hosts]),
+                       axis=0)
+    else:                                   # ALLGATHER
+        argses = [CollArgs(coll_type=coll, src=buf(r, hosts[r]),
+                           dst=out(count * n)) for r in range(n)]
+        exact = np.concatenate([h.astype(np.float64) for h in hosts])
+    with MeasuredBytes() as mb:
+        reqs = job.init_reqs(argses)
+        alg = str(getattr(reqs[0].task, "alg_name", "") or "")
+        job.post_and_wait(reqs)
+    stats = error_stats(exact, [a.dst.buffer for a in argses], budget)
+    for rq in reqs:
+        try:
+            rq.finalize()
+        except Exception:  # noqa: BLE001 - verification teardown
+            pass
+    return alg, stats, mb.total
+
+
+def _quant_detail(job, coll, n, count, dt, mem, devices, bw):
+    """The ``detail.quant`` record: effective (wire) vs logical busbw
+    plus the measured error and measured wire bytes of one random-data
+    round (record shape shared with bench.py via quant.verify)."""
+    from ucc_tpu import quant as _q
+    from ucc_tpu.quant.verify import base_detail
+    params = _q.params_for(job.teams[0] if hasattr(job, "teams")
+                           else job.team, coll)
+    if params is None or coll not in _q.QUANT_COLLS:
+        d = {"mode": params.mode if params else "off"}
+        d["note"] = "collective not served by quantized variants"
+        return d
+    d = base_detail(params, coll, count, dt_size(dt), bw, n)
+    try:
+        alg, stats, wire_total = _quant_verify(job, coll, n, count, dt,
+                                               mem, devices,
+                                               params.budget)
+        d["alg"] = alg
+        d.update(stats)
+        if wire_total > 0:      # 0 = path not transport-instrumented
+            d["measured_wire_bytes_total"] = int(wire_total)
+    except Exception as e:  # noqa: BLE001 - verification must not kill
+        d["verify_error"] = str(e)
+    return d
 
 
 def _wait_reqs(job, reqs) -> None:
@@ -597,6 +679,15 @@ def main(argv=None) -> int:
                         "measurement line per (size, algorithm) — the "
                         "ucc_tune offline-tuning input format (compile "
                         "with `ucc_tune --from FILE`); in-process only")
+    p.add_argument("--quant", nargs="?", const="env", default="",
+                   choices=["env", "int8", "fp8"],
+                   help="quantized mode (in-process only): report "
+                        "effective (wire) vs logical busbw and the "
+                        "measured max-abs/rel error of a random-data "
+                        "round per point (detail.quant with --json). An "
+                        "explicit int8/fp8 value sets UCC_QUANT for this "
+                        "run; bare --quant uses the ambient UCC_QUANT "
+                        "(defaulting to int8)")
     p.add_argument("-p", "--nprocs", type=int, default=0,
                    help="in-process ranks (default: one per device for tpu "
                         "mem, else 4)")
@@ -638,6 +729,17 @@ def main(argv=None) -> int:
 
     if args.coll in OP_BENCHES:
         return run_op_bench(args)
+
+    if args.quant:
+        # set the precision BEFORE lib/context creation: the quantized
+        # candidates register at team create from the lib config
+        import os as _os
+        if args.quant in ("int8", "fp8"):
+            _os.environ["UCC_QUANT"] = args.quant
+        elif not _os.environ.get("UCC_QUANT"):
+            _os.environ["UCC_QUANT"] = "int8"
+        if args.store:
+            raise SystemExit("perftest: --quant requires in-process mode")
 
     global _TRAFFIC_MATRIX
     coll = COLLS[args.coll]
@@ -790,6 +892,10 @@ def main(argv=None) -> int:
         if is_lead:
             st = lat_stats(lats)
             bw = busbw_factor(coll, n) * size / lats.mean() / 1e9
+            qd = None
+            if args.quant:
+                qd = _quant_detail(job, coll, n, count, dt, mem, devices,
+                                   bw)
             if args.json:
                 import json
                 rec = {"bench": "coll", "coll": args.coll,
@@ -799,6 +905,8 @@ def main(argv=None) -> int:
                        **{k: round(v, 3) for k, v in st.items()}}
                 if args.full:
                     rec["busbw_GBps"] = round(bw, 3)
+                if qd is not None:
+                    rec["detail"] = {"quant": qd}
                 print(json.dumps(rec), flush=True)
             else:
                 line = f"{count:>12} {memunits_str(size):>10} " \
@@ -808,6 +916,12 @@ def main(argv=None) -> int:
                 if args.full:
                     line += f" {bw:>14.3f}"
                 print(line, flush=True)
+                if qd is not None and "wire_ratio" in qd:
+                    print(f"#   quant[{qd['mode']}] alg={qd.get('alg', '?')}"
+                          f" wire_ratio={qd['wire_ratio']}"
+                          f" busbw_wire={qd.get('busbw_wire_GBps', 0)}GB/s"
+                          f" max_rel_err={qd.get('max_rel_err', '?')}"
+                          f" (budget {qd['error_budget']})", flush=True)
         for ctx, h in os_unmap:
             ctx.mem_unmap(h)
         size *= 2

@@ -114,7 +114,8 @@ def run_sweep(job: _Job, colls: List[str], sizes: List[int], iters: int,
                     continue
                 st = lat_stats(lats)
                 records.append(measurement_record(
-                    cname, mem, n, (comp, alg), size, count, iters, st))
+                    cname, mem, n, (comp, alg), size, count, iters, st,
+                    precision=cands[idx].precision))
                 if verbose:
                     print(f"# {cname:>12} {memunits_str(size):>8} "
                           f"{comp}/{alg:<20} p50 {st['p50_us']:>10.2f}us",
@@ -248,7 +249,21 @@ def main(argv=None) -> int:
                    help="warn-only CI probe: one-point sweep + cache "
                         "round-trip, prints a tuned-vs-default JSON "
                         "record, always exits 0")
+    p.add_argument("--quant", nargs="?", const="env", default="",
+                   choices=["env", "int8", "fp8"],
+                   help="include quantized candidates in the sweep: sets "
+                        "UCC_QUANT for the probe jobs (bare --quant keeps "
+                        "the ambient value, defaulting to int8). With "
+                        "UCC_QUANT already exported, quantized candidates "
+                        "are swept automatically — this flag just makes "
+                        "the opt-in explicit per run")
     args = p.parse_args(argv)
+
+    if args.quant:
+        if args.quant in ("int8", "fp8"):
+            os.environ["UCC_QUANT"] = args.quant
+        elif not os.environ.get("UCC_QUANT"):
+            os.environ["UCC_QUANT"] = "int8"
 
     from ucc_tpu.utils.jaxshim import ensure_live_backend
     ensure_live_backend(virtual_cpu_devices=max(args.nprocs, 4))
