@@ -1,19 +1,57 @@
-// ucc_tpu native runtime core.
+// ucc_tpu native runtime core — v2.
 //
 // The host-side hot paths of the framework, in C++ (the role the reference's
 // C core plays for its progress engine and UCX's matching engine plays for
 // tl/ucp — SURVEY §2.5, tl_ucp_sendrecv.h):
 //
-//   * tagged-message mailbox: unexpected-message queues + posted-receive
-//     matching with per-mailbox sharded locks. Matched receives copy
-//     payloads directly into the destination buffer (single memcpy).
-//   * bounded MPMC queue (the ucc_lock_free_queue.h analog,
-//     /root/reference/src/utils/ucc_lock_free_queue.h) for multi-threaded
-//     producers/consumers of task handles.
+//   * tagged-message mailbox with FULL parity to the Python
+//     tl/host/transport.Mailbox contract:
+//       - copy-free delivery: a push that finds a matching posted recv
+//         memcpys sender -> dst directly under the shard lock (no owned
+//         staging vector); unexpected sends take the classic eager copy
+//         (<= eager_limit) or park a zero-copy rendezvous pointer whose
+//         buffer the Python caller keeps alive.
+//       - fixed-width binary tag keys: three packed u64 words
+//         (team_id<<32|epoch, coll_tag, slot<<32|src) — hashing is a few
+//         word multiplies, no serialized Python keys.
+//       - epoch fences (ucc_mailbox_fence): parked stale entries are
+//         purged and LATE stale arrivals are discarded at the match
+//         boundary, so UCC_FT=shrink runs on the native matcher.
+//       - cancelled-entry skip (ucc_req_cancel): withdrawn recvs are
+//         skipped at match time under the same shard lock that delivers,
+//         so cancel-vs-match cannot interleave (PR-2 recv withdrawal and
+//         the PR-3/PR-4 lease-taint invariants hold natively).
+//       - truncation contract: a send larger than the recv capacity is
+//         clamped and flagged; the sender's total size is kept for the
+//         error text (cf. UCS_ERR_MESSAGE_TRUNCATED).
+//   * GIL-free completion polling: request state is published into a
+//     flat "pub" array of u64 words (gen<<32 | nbytes<<3 | state) that
+//     the Python side maps once and reads directly — the poll path costs
+//     a memory load, not an ffi call. ucc_req_test_many batch-polls N
+//     requests in one call for callers without the mapping.
+//   * request table: generation-counted slots in on-demand chunks. Send
+//     requests are freed AT DELIVERY (a bumped generation reads as
+//     complete), recv requests by their owner at completion, and
+//     ucc_mailbox_purge reclaims everything else at endpoint teardown —
+//     abandoned requests no longer leak until mailbox destroy.
+//   * bounded MPMC queue (the ucc_lock_free_queue.h analog) for
+//     multi-threaded producers/consumers of task handles.
 //
-// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
-// Handle-based API: requests are uint64 ids; Python polls test() — the same
-// nonblocking contract the Python mailbox implements.
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image);
+// ucc_abi_version() lets the loader reject a stale build instead of
+// symbol-probing. Handle-based API: requests are u64 ids packed as
+// (generation<<20 | slot index).
+
+#ifdef UCC_TPU_PY_EXT
+// Python.h must precede every other include (it defines feature-test
+// macros). The extension build (ucc_tpu_core_ext.so, -DUCC_TPU_EXT_THIN)
+// compiles ONLY the METH_FASTCALL wrappers around the two per-message
+// hot calls and links against libucc_tpu_core.so — ctypes argument
+// marshalling was the largest single cost on the single-threaded path.
+// The plain-C build stays the ctypes fallback; both speak the same ABI
+// version.
+#include <Python.h>
+#endif
 
 #include <atomic>
 #include <cstdint>
@@ -21,191 +59,524 @@
 #include <deque>
 #include <memory>
 #include <mutex>
-#include <string>
 #include <unordered_map>
 #include <vector>
 
 namespace {
+// visible to BOTH artifacts: the loader's ABI gate compares the ext's
+// compiled-in value (py_abi_version) against the core's ucc_abi_version()
+constexpr uint64_t kAbiVersion = 2;
+}  // namespace
 
-struct Request {
-    std::atomic<int> done{0};
-    size_t nbytes = 0;
-    int truncated = 0;   // recv side: matched send exceeded dst capacity
-    // send side: owned payload when unexpected; recv side: dst pointer
-    std::vector<uint8_t> owned;
-    void* dst = nullptr;
-    size_t dst_cap = 0;
-};
+// The thin extension build (-DUCC_TPU_EXT_THIN) compiles ONLY the CPython
+// module at the bottom and links against libucc_tpu_core.so, so exactly
+// one copy of the matcher code (and its struct layouts) exists in the
+// process by construction.
+#ifndef UCC_TPU_EXT_THIN
 
-struct PendingSend {
-    uint64_t req_id;
-};
+namespace {
 
-struct PendingRecv {
-    uint64_t req_id;
-};
-
+constexpr uint32_t kSlotBits = 20;
+constexpr uint32_t kMaxSlots = 1u << kSlotBits;      // 1M live requests
+constexpr uint32_t kIdxMask = kMaxSlots - 1;
+constexpr uint32_t kChunkBits = 12;
+constexpr uint32_t kChunkSize = 1u << kChunkBits;
+constexpr uint32_t kMaxChunks = kMaxSlots >> kChunkBits;
 constexpr int kShards = 16;
+
+// pub word: (gen << 32) | (min(nbytes, kNbMax) << 3) | state. nbytes
+// saturates at kNbMax (512MB-1); saturated readers fall back to
+// ucc_req_nbytes.
+constexpr uint64_t kNbMax = (1ull << 29) - 1;
+
+enum State : uint32_t {
+    kPending = 0,
+    kOk = 1,
+    kTruncated = 2,   // matched send exceeded dst capacity (clamped)
+    kFenced = 3,      // stale team epoch at the match boundary
+    kCanceled = 4,    // withdrawn by ucc_req_cancel
+};
+
+// push() return kinds, packed into the low 3 bits of the return word
+// (rndv additionally carries the send request id in the high bits)
+enum Kind : uint32_t {
+    kKindDirect = 0,
+    kKindEager = 1,
+    kKindRndv = 2,
+    kKindFenced = 3,
+};
+
+struct Key {
+    uint64_t a, b, c;   // team_id<<32|epoch, coll_tag, slot<<32|src
+    bool operator==(const Key& o) const {
+        return a == o.a && b == o.b && c == o.c;
+    }
+};
+
+struct KeyHash {
+    size_t operator()(const Key& k) const {
+        uint64_t h = k.a * 0x9E3779B97F4A7C15ull;
+        h ^= k.b + 0x9E3779B97F4A7C15ull + (h << 6) + (h >> 2);
+        h *= 0xBF58476D1CE4E5B9ull;
+        h ^= k.c + (h << 6) + (h >> 2);
+        return static_cast<size_t>(h ^ (h >> 31));
+    }
+};
+
+struct Slot {
+    std::atomic<uint32_t> gen{0};   // odd = live; bumped on alloc AND free
+    uint32_t shard = 0;             // recv: shard index (for cancel)
+    uint64_t nbytes = 0;            // recv: delivered bytes
+    uint64_t sent = 0;              // recv: matched send's TOTAL bytes
+    void* dst = nullptr;            // recv destination
+    uint64_t cap = 0;               // recv capacity
+};
+
+// parked unexpected send (the _PendingSend analog)
+struct Unexp {
+    std::vector<uint8_t> owned;     // eager staging copy (empty for rndv)
+    const void* ptr = nullptr;      // rndv payload (caller keeps it alive)
+    uint64_t len = 0;
+    uint64_t sreq = 0;              // rndv send request id (0 = eager)
+};
 
 struct Shard {
     std::mutex mu;
-    std::unordered_map<std::string, std::deque<uint64_t>> unexpected;
-    std::unordered_map<std::string, std::deque<uint64_t>> posted;
+    std::unordered_map<Key, std::deque<Unexp>, KeyHash> unexpected;
+    std::unordered_map<Key, std::deque<uint64_t>, KeyHash> posted;
+    // team_id -> minimum accepted epoch. Kept PER SHARD and read/written
+    // only under this shard's mu, so the fence-vs-push race needs no
+    // extra lock on the hot path: whichever takes the shard lock second
+    // sees the other's effect (the Python Mailbox gets the same property
+    // from its single lock). Empty (the UCC_FT=none steady state) costs
+    // one branch per message.
+    std::unordered_map<uint32_t, uint32_t> fences;
 };
 
 struct Mailbox {
     Shard shards[kShards];
-    std::mutex req_mu;
-    std::unordered_map<uint64_t, Request*> requests;
-    std::atomic<uint64_t> next_id{1};
 
-    uint64_t new_request(Request** out) {
-        auto* r = new Request();
-        uint64_t id = next_id.fetch_add(1, std::memory_order_relaxed);
-        {
-            std::lock_guard<std::mutex> g(req_mu);
-            requests[id] = r;
+    // request table: chunked slots + flat pub array (Python maps pub once)
+    std::atomic<Slot*> chunks[kMaxChunks];
+    std::atomic<uint64_t>* pub;
+    std::mutex alloc_mu;
+    std::vector<uint32_t> free_list;
+    uint32_t next_slot = 0;
+
+    Mailbox() {
+        for (auto& c : chunks) c.store(nullptr, std::memory_order_relaxed);
+        // default-init: trivial ctors, so the 8MB stays untouched virtual
+        // memory until slots are actually allocated
+        pub = new std::atomic<uint64_t>[kMaxSlots];
+    }
+
+    ~Mailbox() {
+        for (auto& c : chunks) delete[] c.load(std::memory_order_relaxed);
+        delete[] pub;
+    }
+
+    Shard& shard_for(const Key& k, uint32_t* idx_out) {
+        uint32_t i = static_cast<uint32_t>(KeyHash{}(k) % kShards);
+        *idx_out = i;
+        return shards[i];
+    }
+
+    Slot* slot_of(uint32_t idx) {
+        if (idx >= kMaxSlots) return nullptr;
+        Slot* c = chunks[idx >> kChunkBits].load(std::memory_order_acquire);
+        return c ? &c[idx & (kChunkSize - 1)] : nullptr;
+    }
+
+    // Allocate a live slot; returns the request id (0 on exhaustion).
+    uint64_t alloc(Slot** out) {
+        std::lock_guard<std::mutex> g(alloc_mu);
+        uint32_t idx;
+        if (!free_list.empty()) {
+            idx = free_list.back();
+            free_list.pop_back();
+        } else {
+            if (next_slot >= kMaxSlots) return 0;
+            idx = next_slot++;
+            uint32_t ch = idx >> kChunkBits;
+            if (chunks[ch].load(std::memory_order_relaxed) == nullptr)
+                chunks[ch].store(new Slot[kChunkSize],
+                                 std::memory_order_release);
         }
-        *out = r;
-        return id;
+        Slot* s = slot_of(idx);
+        uint32_t gen = s->gen.load(std::memory_order_relaxed) + 1;  // odd
+        s->gen.store(gen, std::memory_order_relaxed);
+        s->shard = 0;
+        s->nbytes = 0;
+        s->sent = 0;
+        s->dst = nullptr;
+        s->cap = 0;
+        pub[idx].store(static_cast<uint64_t>(gen) << 32,
+                       std::memory_order_release);
+        *out = s;
+        return (static_cast<uint64_t>(gen) << kSlotBits) | idx;
     }
 
-    Request* get(uint64_t id) {
-        std::lock_guard<std::mutex> g(req_mu);
-        auto it = requests.find(id);
-        return it == requests.end() ? nullptr : it->second;
+    // Validated free: no-op unless *rid* still names the live generation,
+    // so owner-free, delivery-free and purge can race without double-free.
+    void free_rid(uint64_t rid) {
+        uint32_t idx = static_cast<uint32_t>(rid & kIdxMask);
+        uint32_t gen = static_cast<uint32_t>(rid >> kSlotBits);
+        std::lock_guard<std::mutex> g(alloc_mu);
+        Slot* s = slot_of(idx);
+        if (s == nullptr || s->gen.load(std::memory_order_relaxed) != gen)
+            return;
+        uint32_t ng = gen + 1;   // even: free; readers of the old rid see
+        s->gen.store(ng, std::memory_order_relaxed);   // "freed == done"
+        pub[idx].store(static_cast<uint64_t>(ng) << 32,
+                       std::memory_order_release);
+        free_list.push_back(idx);
     }
 
-    void drop(uint64_t id) {
-        Request* r = nullptr;
-        {
-            std::lock_guard<std::mutex> g(req_mu);
-            auto it = requests.find(id);
-            if (it == requests.end()) return;
-            r = it->second;
-            requests.erase(it);
-        }
-        delete r;
+    // Live-and-pending check for a parked recv id (cancel/fence/free skip).
+    Slot* live_pending(uint64_t rid) {
+        uint32_t idx = static_cast<uint32_t>(rid & kIdxMask);
+        Slot* s = slot_of(idx);
+        if (s == nullptr) return nullptr;
+        uint64_t v = pub[idx].load(std::memory_order_acquire);
+        if ((v >> 32) != (rid >> kSlotBits) || (v & 7u) != 0) return nullptr;
+        return s;
     }
 
-    Shard& shard_for(const std::string& key) {
-        size_t h = std::hash<std::string>{}(key);
-        return shards[h % kShards];
+    void publish(uint64_t rid, uint64_t nbytes, uint32_t state) {
+        uint32_t idx = static_cast<uint32_t>(rid & kIdxMask);
+        uint64_t nb = nbytes > kNbMax ? kNbMax : nbytes;
+        pub[idx].store(((rid >> kSlotBits) << 32) | (nb << 3) | state,
+                       std::memory_order_release);
+    }
+
+    bool is_fenced(Shard& sh, const Key& k) {
+        auto it = sh.fences.find(static_cast<uint32_t>(k.a >> 32));
+        return it != sh.fences.end() &&
+               static_cast<uint32_t>(k.a) < it->second;
     }
 };
 
-void deliver(Request* send_req, Request* recv_req) {
-    size_t n = send_req->nbytes < recv_req->dst_cap ? send_req->nbytes
-                                                    : recv_req->dst_cap;
-    if (n && recv_req->dst) {
-        std::memcpy(recv_req->dst, send_req->owned.data(), n);
-    }
-    recv_req->nbytes = n;
-    recv_req->truncated = send_req->nbytes > recv_req->dst_cap ? 1 : 0;
-    recv_req->done.store(1, std::memory_order_release);
-    send_req->done.store(1, std::memory_order_release);
+// poll word relative to *rid*: 0 = pending; else (nbytes<<3)|state, with
+// a freed/reused slot reading as plain done-OK (only non-owners — rndv
+// senders, whose requests are freed at delivery — ever observe that).
+uint64_t poll_rid(Mailbox* mb, uint64_t rid) {
+    uint32_t idx = static_cast<uint32_t>(rid & kIdxMask);
+    if (idx >= kMaxSlots) return kOk;
+    uint64_t v = mb->pub[idx].load(std::memory_order_acquire);
+    if ((v >> 32) != (rid >> kSlotBits)) return kOk;   // freed == complete
+    return v & 0xFFFFFFFFull;
 }
+
+// Destroyed mailboxes are PARKED here and recycled by the next create,
+// never deleted: a Python thread that loaded the mailbox pointer (or its
+// mapped pub array) just before a concurrent destroy may still poll it,
+// and the generation bumps done by the destroy-time purge make every
+// such stale poll read "freed == complete" instead of touching freed
+// heap. Memory cost is bounded by the high-water mark of live mailboxes
+// (one per endpoint), and the pub array is lazily-paged virtual memory.
+std::mutex g_park_mu;
+std::vector<Mailbox*> g_parked;
 
 }  // namespace
 
 extern "C" {
 
-void* ucc_mailbox_create() { return new Mailbox(); }
+uint64_t ucc_abi_version() { return kAbiVersion; }
+
+uint64_t ucc_mailbox_purge(void* mbp);
+
+void* ucc_mailbox_create() {
+    Mailbox* mb = nullptr;
+    {
+        std::lock_guard<std::mutex> g(g_park_mu);
+        if (!g_parked.empty()) {
+            mb = g_parked.back();
+            g_parked.pop_back();
+        }
+    }
+    if (mb != nullptr) {
+        // purge AGAIN at pop: a push that raced the destroy may have
+        // parked a message in the already-purged parked mailbox; drop
+        // it before the new owner can post a recv. Generations carry
+        // over, so old-life rids keep reading as mismatched/complete.
+        ucc_mailbox_purge(mb);
+        return mb;
+    }
+    return new Mailbox();
+}
 
 void ucc_mailbox_destroy(void* mbp) {
     auto* mb = static_cast<Mailbox*>(mbp);
-    {
-        // free requests under the lock, then release it BEFORE deleting
-        // the mailbox (unlocking a destroyed mutex is UB)
-        std::lock_guard<std::mutex> g(mb->req_mu);
-        for (auto& kv : mb->requests) delete kv.second;
-        mb->requests.clear();
-    }
-    delete mb;
+    ucc_mailbox_purge(mb);   // drop parked state, bump every live gen
+    std::lock_guard<std::mutex> g(g_park_mu);
+    g_parked.push_back(mb);
 }
 
-// Push a message: copies data (eager). Returns the send request id
-// (already complete — the copy decouples the sender's buffer).
-uint64_t ucc_mailbox_push(void* mbp, const char* key, size_t keylen,
-                          const void* data, size_t len) {
-    auto* mb = static_cast<Mailbox*>(mbp);
-    std::string k(key, keylen);
-    Request* sreq = nullptr;
-    uint64_t sid = mb->new_request(&sreq);
-    sreq->owned.assign(static_cast<const uint8_t*>(data),
-                       static_cast<const uint8_t*>(data) + len);
-    sreq->nbytes = len;
-
-    Shard& sh = mb->shard_for(k);
-    uint64_t rid = 0;
-    {
-        std::lock_guard<std::mutex> g(sh.mu);
-        auto it = sh.posted.find(k);
-        if (it != sh.posted.end() && !it->second.empty()) {
-            rid = it->second.front();
-            it->second.pop_front();
-            if (it->second.empty()) sh.posted.erase(it);
-        } else {
-            sh.unexpected[k].push_back(sid);
-            return sid;  // parked as unexpected; send complete after copy
-        }
-    }
-    Request* rreq = mb->get(rid);
-    if (rreq) deliver(sreq, rreq);
-    sreq->done.store(1, std::memory_order_release);
-    return sid;
+// Base of the completion-publication array (kMaxSlots u64 words); stays
+// readable after ucc_mailbox_destroy (the mailbox is parked, not freed),
+// so a racing poller sees bumped generations, never unmapped memory.
+void* ucc_mailbox_pub_base(void* mbp) {
+    return static_cast<void*>(static_cast<Mailbox*>(mbp)->pub);
 }
 
-// Post a receive into dst (capacity cap bytes). Returns request id.
-uint64_t ucc_mailbox_post_recv(void* mbp, const char* key, size_t keylen,
-                               void* dst, size_t cap) {
+// Push a message. Returns (send_rid << 3) | kind:
+//   direct — delivered copy-free into an already-posted recv (complete);
+//   eager  — unexpected, <= eager_limit: staged copy, send complete;
+//   rndv   — unexpected, parked zero-copy: the caller must keep *data*
+//            alive until the returned send request completes;
+//   fenced — stale team epoch: discarded, send complete.
+// Only rndv carries a nonzero request id.
+uint64_t ucc_mailbox_push(void* mbp, uint64_t a, uint64_t b, uint64_t c,
+                          const void* data, uint64_t len,
+                          uint64_t eager_limit) {
     auto* mb = static_cast<Mailbox*>(mbp);
-    std::string k(key, keylen);
-    Request* rreq = nullptr;
-    uint64_t rid = mb->new_request(&rreq);
-    rreq->dst = dst;
-    rreq->dst_cap = cap;
-
-    Shard& sh = mb->shard_for(k);
-    uint64_t sid = 0;
-    {
-        std::lock_guard<std::mutex> g(sh.mu);
-        auto it = sh.unexpected.find(k);
-        if (it != sh.unexpected.end() && !it->second.empty()) {
-            sid = it->second.front();
-            it->second.pop_front();
-            if (it->second.empty()) sh.unexpected.erase(it);
-        } else {
-            sh.posted[k].push_back(rid);
-            return rid;
+    Key k{a, b, c};
+    uint32_t shard_idx;
+    Shard& sh = mb->shard_for(k, &shard_idx);
+    std::lock_guard<std::mutex> g(sh.mu);
+    if (!sh.fences.empty() && mb->is_fenced(sh, k)) return kKindFenced;
+    auto it = sh.posted.find(k);
+    if (it != sh.posted.end()) {
+        auto& dq = it->second;
+        uint64_t rid = 0;
+        Slot* s = nullptr;
+        while (!dq.empty()) {
+            rid = dq.front();
+            dq.pop_front();
+            s = mb->live_pending(rid);   // cancelled-entry skip
+            if (s != nullptr) break;
+        }
+        if (dq.empty()) sh.posted.erase(it);
+        if (s != nullptr) {
+            // copy-free delivery: sender buffer -> posted dst, under the
+            // shard lock (cancel takes the same lock, so a recv cannot be
+            // withdrawn between being matched and being written)
+            uint64_t n = len < s->cap ? len : s->cap;
+            if (n) std::memcpy(s->dst, data, n);
+            s->nbytes = n;
+            s->sent = len;
+            mb->publish(rid, n, len > s->cap ? kTruncated : kOk);
+            return kKindDirect;
         }
     }
-    Request* sreq = mb->get(sid);
-    if (sreq) deliver(sreq, rreq);
+    Slot* ss = nullptr;
+    // slot-space exhaustion (1M live requests) degrades rndv to an eager
+    // copy rather than failing — correctness over the rndv optimization
+    uint64_t sid = len <= eager_limit ? 0 : mb->alloc(&ss);
+    if (sid == 0) {
+        Unexp u;
+        u.len = len;
+        if (len)
+            u.owned.assign(static_cast<const uint8_t*>(data),
+                           static_cast<const uint8_t*>(data) + len);
+        sh.unexpected[k].push_back(std::move(u));
+        return kKindEager;
+    }
+    ss->shard = shard_idx;
+    Unexp u;
+    u.ptr = data;
+    u.len = len;
+    u.sreq = sid;
+    sh.unexpected[k].push_back(std::move(u));
+    return (sid << 3) | kKindRndv;
+}
+
+// Post a receive into dst (capacity cap bytes). Returns the request id
+// (0 on slot exhaustion). A post into a fenced epoch completes
+// immediately with the fenced state (local stale-team bug, surfaced).
+uint64_t ucc_mailbox_post_recv(void* mbp, uint64_t a, uint64_t b,
+                               uint64_t c, void* dst, uint64_t cap) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    Slot* s = nullptr;
+    uint64_t rid = mb->alloc(&s);
+    if (rid == 0) return 0;
+    Key k{a, b, c};
+    uint32_t shard_idx;
+    Shard& sh = mb->shard_for(k, &shard_idx);
+    s->dst = dst;
+    s->cap = cap;
+    s->shard = shard_idx;
+    std::lock_guard<std::mutex> g(sh.mu);
+    if (!sh.fences.empty() && mb->is_fenced(sh, k)) {
+        mb->publish(rid, 0, kFenced);
+        return rid;
+    }
+    auto it = sh.unexpected.find(k);
+    if (it != sh.unexpected.end() && !it->second.empty()) {
+        Unexp u = std::move(it->second.front());
+        it->second.pop_front();
+        if (it->second.empty()) sh.unexpected.erase(it);
+        uint64_t n = u.len < cap ? u.len : cap;
+        if (n)
+            std::memcpy(dst, u.ptr != nullptr ? u.ptr : u.owned.data(), n);
+        s->nbytes = n;
+        s->sent = u.len;
+        mb->publish(rid, n, u.len > cap ? kTruncated : kOk);
+        // send requests are freed AT DELIVERY: the bumped generation
+        // reads as complete on the sender's side, and the C-side Request
+        // no longer outlives its message (the v1 leak)
+        if (u.sreq) mb->free_rid(u.sreq);
+        return rid;
+    }
+    sh.posted[k].push_back(rid);
     return rid;
 }
 
-int ucc_req_test(void* mbp, uint64_t id) {
+// Fence every epoch of *team_id* below *min_epoch*: record the per-shard
+// floor for future arrivals and purge already-parked state — posted
+// recvs complete as fenced (their buffers may be reclaimed), unexpected
+// sends are dropped and their rndv send requests freed (the sender must
+// stop waiting; the data is gone with the old epoch). Returns the number
+// of purged entries.
+uint64_t ucc_mailbox_fence(void* mbp, uint64_t team_id, uint64_t min_epoch) {
     auto* mb = static_cast<Mailbox*>(mbp);
-    Request* r = mb->get(id);
-    if (!r) return 1;  // freed == complete
-    return r->done.load(std::memory_order_acquire) ? 1 : 0;
+    uint32_t team = static_cast<uint32_t>(team_id);
+    uint32_t epoch = static_cast<uint32_t>(min_epoch);
+    uint64_t purged = 0;
+    for (int i = 0; i < kShards; ++i) {
+        Shard& sh = mb->shards[i];
+        std::lock_guard<std::mutex> g(sh.mu);
+        uint32_t& floor = sh.fences[team];
+        if (epoch > floor) floor = epoch;
+        for (auto it = sh.posted.begin(); it != sh.posted.end();) {
+            const Key& k = it->first;
+            if (static_cast<uint32_t>(k.a >> 32) == team &&
+                static_cast<uint32_t>(k.a) < epoch) {
+                for (uint64_t rid : it->second) {
+                    if (mb->live_pending(rid)) mb->publish(rid, 0, kFenced);
+                    ++purged;
+                }
+                it = sh.posted.erase(it);
+            } else {
+                ++it;
+            }
+        }
+        for (auto it = sh.unexpected.begin(); it != sh.unexpected.end();) {
+            const Key& k = it->first;
+            if (static_cast<uint32_t>(k.a >> 32) == team &&
+                static_cast<uint32_t>(k.a) < epoch) {
+                for (Unexp& u : it->second) {
+                    if (u.sreq) mb->free_rid(u.sreq);
+                    ++purged;
+                }
+                it = sh.unexpected.erase(it);
+            } else {
+                ++it;
+            }
+        }
+    }
+    return purged;
 }
 
-uint64_t ucc_req_nbytes(void* mbp, uint64_t id) {
+// Endpoint-teardown reclamation: drop all parked state and free every
+// live request slot (abandoned requests otherwise leak until destroy).
+// Callers must be past the point of posting on this mailbox; outstanding
+// Python-side requests read the bumped generations as complete.
+uint64_t ucc_mailbox_purge(void* mbp) {
     auto* mb = static_cast<Mailbox*>(mbp);
-    Request* r = mb->get(id);
-    return r ? r->nbytes : 0;
+    uint64_t n = 0;
+    for (int i = 0; i < kShards; ++i) {
+        Shard& sh = mb->shards[i];
+        std::lock_guard<std::mutex> g(sh.mu);
+        for (auto& kv : sh.unexpected)
+            for (Unexp& u : kv.second) {
+                if (u.sreq) mb->free_rid(u.sreq);
+                ++n;
+            }
+        sh.unexpected.clear();
+        // posted recvs are NOT counted here: each holds a live request
+        // slot that the sweep below frees (and counts) exactly once
+        sh.posted.clear();
+        sh.fences.clear();
+    }
+    std::lock_guard<std::mutex> g(mb->alloc_mu);
+    for (uint32_t idx = 0; idx < mb->next_slot; ++idx) {
+        Slot* s = mb->slot_of(idx);
+        if (s == nullptr) continue;
+        uint32_t gen = s->gen.load(std::memory_order_relaxed);
+        if (gen & 1u) {
+            s->gen.store(gen + 1, std::memory_order_relaxed);
+            mb->pub[idx].store(static_cast<uint64_t>(gen + 1) << 32,
+                               std::memory_order_release);
+            mb->free_list.push_back(idx);
+            ++n;
+        }
+    }
+    return n;
 }
 
-int ucc_req_truncated(void* mbp, uint64_t id) {
-    auto* mb = static_cast<Mailbox*>(mbp);
-    Request* r = mb->get(id);
-    return r ? r->truncated : 0;
+// Poll one request: 0 = pending, else (nbytes<<3)|state — the same word
+// the mapped pub array yields, for callers without the mapping.
+uint64_t ucc_req_poll(void* mbp, uint64_t rid) {
+    return poll_rid(static_cast<Mailbox*>(mbp), rid);
 }
 
-void ucc_req_free(void* mbp, uint64_t id) {
-    static_cast<Mailbox*>(mbp)->drop(id);
+// Batch-poll: fills out[i] with the poll word for rids[i]; returns how
+// many are complete. One ffi call for a whole progress-loop pass.
+uint64_t ucc_req_test_many(void* mbp, uint64_t n, const uint64_t* rids,
+                           uint64_t* out) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    uint64_t done = 0;
+    for (uint64_t i = 0; i < n; ++i) {
+        out[i] = poll_rid(mb, rids[i]);
+        if (out[i] != 0) ++done;
+    }
+    return done;
+}
+
+uint64_t ucc_req_nbytes(void* mbp, uint64_t rid) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    uint32_t idx = static_cast<uint32_t>(rid & kIdxMask);
+    Slot* s = mb->slot_of(idx);
+    if (s == nullptr ||
+        s->gen.load(std::memory_order_acquire) !=
+            static_cast<uint32_t>(rid >> kSlotBits))
+        return 0;
+    return s->nbytes;
+}
+
+// Total bytes of the send matched to this recv (truncation error text).
+uint64_t ucc_req_sent_nbytes(void* mbp, uint64_t rid) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    uint32_t idx = static_cast<uint32_t>(rid & kIdxMask);
+    Slot* s = mb->slot_of(idx);
+    if (s == nullptr ||
+        s->gen.load(std::memory_order_acquire) !=
+            static_cast<uint32_t>(rid >> kSlotBits))
+        return 0;
+    return s->sent;
+}
+
+// Withdraw a posted recv: the mailbox skips cancelled entries at match
+// time. Taken under the owning shard's lock — delivery happens inside
+// that lock too, so cancel-vs-match cannot interleave: whichever wins
+// the lock decides, and a request that was already delivered stays
+// delivered. Returns 1 when cancelled here, 0 when already complete.
+int ucc_req_cancel(void* mbp, uint64_t rid) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    uint32_t idx = static_cast<uint32_t>(rid & kIdxMask);
+    uint32_t gen = static_cast<uint32_t>(rid >> kSlotBits);
+    Slot* s = mb->slot_of(idx);
+    if (s == nullptr || s->gen.load(std::memory_order_acquire) != gen)
+        return 0;
+    uint32_t shard = s->shard;
+    // if the slot was freed+reused between the reads above and the lock,
+    // we may hold the wrong shard's lock — the generation recheck below
+    // rejects that case before any state transition
+    std::lock_guard<std::mutex> g(mb->shards[shard].mu);
+    uint64_t v = mb->pub[idx].load(std::memory_order_acquire);
+    if ((v >> 32) != gen || (v & 7u) != 0) return 0;
+    mb->publish(rid, 0, kCanceled);
+    return 1;
+}
+
+void ucc_req_free(void* mbp, uint64_t rid) {
+    static_cast<Mailbox*>(mbp)->free_rid(rid);
+}
+
+void ucc_req_free_many(void* mbp, uint64_t n, const uint64_t* rids) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    for (uint64_t i = 0; i < n; ++i) mb->free_rid(rids[i]);
 }
 
 // ---------------------------------------------------------------------------
@@ -281,3 +652,119 @@ int ucc_mpmc_pop(void* qp, uint64_t* out) {
 }
 
 }  // extern "C"
+
+#else  // UCC_TPU_EXT_THIN
+
+// thin wrapper build: the matcher lives ONLY in libucc_tpu_core.so
+// (DT_NEEDED + $ORIGIN rpath resolve to the same loaded object ctypes
+// opened) — declare the two hot-path entry points this module forwards to
+extern "C" {
+uint64_t ucc_mailbox_push(void* mbp, uint64_t a, uint64_t b, uint64_t c,
+                          const void* data, uint64_t len,
+                          uint64_t eager_limit);
+uint64_t ucc_mailbox_post_recv(void* mbp, uint64_t a, uint64_t b,
+                               uint64_t c, void* dst, uint64_t cap);
+}
+
+#endif  // UCC_TPU_EXT_THIN
+
+// ---------------------------------------------------------------------------
+// optional CPython extension wrappers (built as ucc_tpu_core_ext.so when a
+// Python.h is available): METH_FASTCALL entry points for the per-message
+// hot calls, taking the buffer straight from the ndarray's buffer protocol
+// (no ctypes marshalling, no .ctypes.data property construction) and
+// releasing the GIL around the matcher work.
+// ---------------------------------------------------------------------------
+
+#ifdef UCC_TPU_PY_EXT
+
+namespace {
+
+int u64_args(PyObject* const* args, uint64_t* out, int n) {
+    for (int i = 0; i < n; ++i) {
+        out[i] = PyLong_AsUnsignedLongLong(args[i]);
+        if (out[i] == (uint64_t)-1 && PyErr_Occurred()) return -1;
+    }
+    return 0;
+}
+
+// push(mb, a, b, c, buf, eager_limit) -> (send_rid << 3) | kind
+PyObject* py_push(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
+    if (nargs != 6) {
+        PyErr_SetString(PyExc_TypeError, "push expects 6 arguments");
+        return nullptr;
+    }
+    uint64_t w[4];
+    if (u64_args(args, w, 4) != 0) return nullptr;
+    uint64_t eager = PyLong_AsUnsignedLongLong(args[5]);
+    if (eager == (uint64_t)-1 && PyErr_Occurred()) return nullptr;
+    Py_buffer view;
+    if (PyObject_GetBuffer(args[4], &view, PyBUF_C_CONTIGUOUS) != 0)
+        return nullptr;
+    uint64_t ret;
+    Py_BEGIN_ALLOW_THREADS
+    ret = ucc_mailbox_push(reinterpret_cast<void*>(
+                               static_cast<uintptr_t>(w[0])),
+                           w[1], w[2], w[3], view.buf,
+                           static_cast<uint64_t>(view.len), eager);
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&view);
+    return PyLong_FromUnsignedLongLong(ret);
+}
+
+// post_recv(mb, a, b, c, buf) -> rid
+PyObject* py_post_recv(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
+    if (nargs != 5) {
+        PyErr_SetString(PyExc_TypeError, "post_recv expects 5 arguments");
+        return nullptr;
+    }
+    uint64_t w[4];
+    if (u64_args(args, w, 4) != 0) return nullptr;
+    Py_buffer view;
+    if (PyObject_GetBuffer(args[4], &view,
+                           PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE) != 0)
+        return nullptr;
+    uint64_t rid;
+    Py_BEGIN_ALLOW_THREADS
+    rid = ucc_mailbox_post_recv(reinterpret_cast<void*>(
+                                    static_cast<uintptr_t>(w[0])),
+                                w[1], w[2], w[3], view.buf,
+                                static_cast<uint64_t>(view.len));
+    Py_END_ALLOW_THREADS
+    // the C side holds a raw pointer until delivery/cancel/purge; the
+    // PYTHON side pins the ndarray (dst_keepalive), matching the ctypes
+    // path, so releasing the view here is safe
+    PyBuffer_Release(&view);
+    return PyLong_FromUnsignedLongLong(rid);
+}
+
+PyObject* py_abi_version(PyObject*, PyObject*) {
+    // the ext's OWN compiled-in version, not a forward to the core: the
+    // loader's gate must reject a wrapper built against a different ABI
+    return PyLong_FromUnsignedLongLong(kAbiVersion);
+}
+
+PyMethodDef kExtMethods[] = {
+    {"push", reinterpret_cast<PyCFunction>(
+                 reinterpret_cast<void*>(py_push)),
+     METH_FASTCALL, "push(mb, a, b, c, buf, eager_limit) -> packed kind"},
+    {"post_recv", reinterpret_cast<PyCFunction>(
+                      reinterpret_cast<void*>(py_post_recv)),
+     METH_FASTCALL, "post_recv(mb, a, b, c, buf) -> request id"},
+    {"abi_version", py_abi_version, METH_NOARGS,
+     "native core ABI version"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef kExtModule = {
+    PyModuleDef_HEAD_INIT, "ucc_tpu_core_ext",
+    "fastcall wrappers for the ucc_tpu native core hot path",
+    -1, kExtMethods,
+    nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_ucc_tpu_core_ext(void) {
+    return PyModule_Create(&kExtModule);
+}
+
+#endif  // UCC_TPU_PY_EXT

@@ -1,13 +1,17 @@
-"""Native C++ runtime core tests: mailbox matching semantics (matches
-the python Mailbox contract), MPMC queue, and the full collective suite
-running over the native matcher (UCC_TL_SHM_NATIVE=y)."""
+"""Native C++ runtime core v2 tests: full parity with the python
+Mailbox contract (copy-free delivery in both match orders, eager/rndv
+split, truncation text, cancel-skip, epoch fences), the
+request-lifecycle fixes (free-at-delivery, purge), the MPMC queue, the
+collective suite over the native matcher, and the UCC_FT=shrink
+kill->shrink drill with the native matcher forced on. Skips cleanly
+when no toolchain built the core."""
 import os
 
 import numpy as np
 import pytest
 
 from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType, ReductionOp)
-from ucc_tpu.native import available
+from ucc_tpu.native import ABI_VERSION, available, get_lib
 
 from harness import UccJob
 
@@ -15,52 +19,451 @@ pytestmark = pytest.mark.skipif(not available(),
                                 reason="native core not built")
 
 
-class TestNativeMailbox:
-    def test_recv_then_send(self):
-        from ucc_tpu.native import NativeMailbox
-        mb = NativeMailbox()
-        dst = np.zeros(16, np.float32)
-        r = mb.post_recv_native(("t", 1, 0, 7), dst)
-        assert not r.test()
-        s = mb.push_native(("t", 1, 0, 7), np.arange(16, dtype=np.float32))
-        assert s.test() and r.test()
-        np.testing.assert_array_equal(dst, np.arange(16, dtype=np.float32))
-        mb.destroy()
+def _key(tag, epoch=0, slot=0, src=0, team="t"):
+    """Canonical 5-field TagKey shape (team_key, epoch, coll_tag, slot,
+    src) — what the host TL actually sends."""
+    return (team, epoch, tag, slot, src)
 
-    def test_unexpected_message_queue(self):
+
+class TestNativeAbi:
+    def test_abi_version_symbol(self):
+        lib = get_lib()
+        assert int(lib.ucc_abi_version()) == ABI_VERSION
+
+    def test_no_symbol_probing_fallbacks(self):
+        # v1 kept a `ucc_req_truncated = None` fallback for stale .so
+        # files; the versioned loader must never hand out a half-bound lib
+        lib = get_lib()
+        for sym in ("ucc_mailbox_push", "ucc_mailbox_post_recv",
+                    "ucc_mailbox_fence", "ucc_mailbox_purge",
+                    "ucc_req_poll", "ucc_req_test_many", "ucc_req_cancel",
+                    "ucc_req_free_many", "ucc_req_sent_nbytes"):
+            assert getattr(lib, sym, None) is not None
+
+
+class TestNativeMailbox:
+    def test_recv_then_send_direct(self):
         from ucc_tpu.native import NativeMailbox
         mb = NativeMailbox()
-        # two sends queue before any recv; FIFO per key
-        mb.push_native(("k",), np.full(4, 1.0, np.float32))
-        mb.push_native(("k",), np.full(4, 2.0, np.float32))
-        d1 = np.zeros(4, np.float32)
-        d2 = np.zeros(4, np.float32)
-        r1 = mb.post_recv_native(("k",), d1)
-        r2 = mb.post_recv_native(("k",), d2)
-        assert r1.test() and r2.test()
-        assert d1[0] == 1.0 and d2[0] == 2.0
-        mb.destroy()
+        try:
+            dst = np.zeros(16, np.float32)
+            r = mb.post_recv_native(_key(1), dst)
+            assert not r.test()
+            s, kind = mb.push_native(_key(1),
+                                     np.arange(16, dtype=np.float32))
+            # copy-free fast path: matched a posted recv, delivered
+            # straight into dst, send complete inside the call
+            assert kind == "direct"
+            assert s.test() and r.test()
+            np.testing.assert_array_equal(
+                dst, np.arange(16, dtype=np.float32))
+            assert r.nbytes == 64
+        finally:
+            mb.destroy()
+
+    def test_send_then_recv_eager(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            src = np.full(4, 7.0, np.float32)
+            s, kind = mb.push_native(_key(2), src)
+            assert kind == "eager" and s.test()   # staged copy: complete
+            src[:] = -1.0   # sender may reuse its buffer immediately
+            d = np.zeros(4, np.float32)
+            r = mb.post_recv_native(_key(2), d)
+            assert r.test() and d[0] == 7.0
+        finally:
+            mb.destroy()
+
+    def test_send_then_recv_rndv(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            big = np.arange(5000, dtype=np.float64)
+            s, kind = mb.push_native(_key(3), big, 8192)
+            # > eager limit and unexpected: parked zero-copy, send
+            # pending until a recv lands it
+            assert kind == "rndv" and not s.test()
+            d = np.zeros(5000, np.float64)
+            r = mb.post_recv_native(_key(3), d)
+            assert r.test() and s.test()
+            np.testing.assert_array_equal(d, big)
+        finally:
+            mb.destroy()
+
+    def test_eager_limit_is_respected(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            data = np.zeros(100, np.uint8)
+            _, kind_small = mb.push_native(_key(4), data, 100)
+            _, kind_large = mb.push_native(_key(5), data, 99)
+            assert kind_small == "eager" and kind_large == "rndv"
+        finally:
+            mb.destroy()
+
+    def test_unexpected_message_queue_fifo(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            mb.push_native(_key(6), np.full(4, 1.0, np.float32))
+            mb.push_native(_key(6), np.full(4, 2.0, np.float32))
+            d1 = np.zeros(4, np.float32)
+            d2 = np.zeros(4, np.float32)
+            r1 = mb.post_recv_native(_key(6), d1)
+            r2 = mb.post_recv_native(_key(6), d2)
+            assert r1.test() and r2.test()
+            assert d1[0] == 1.0 and d2[0] == 2.0
+        finally:
+            mb.destroy()
 
     def test_key_isolation(self):
         from ucc_tpu.native import NativeMailbox
         mb = NativeMailbox()
-        da = np.zeros(2, np.int32)
-        ra = mb.post_recv_native(("a",), da)
-        mb.push_native(("b",), np.full(2, 9, np.int32))
-        assert not ra.test()   # different key must not match
-        mb.push_native(("a",), np.full(2, 5, np.int32))
-        assert ra.test() and da[0] == 5
-        mb.destroy()
+        try:
+            da = np.zeros(2, np.int32)
+            ra = mb.post_recv_native(_key(7, slot=1), da)
+            mb.push_native(_key(7, slot=2), np.full(2, 9, np.int32))
+            assert not ra.test()   # different slot must not match
+            mb.push_native(_key(7, slot=1), np.full(2, 5, np.int32))
+            assert ra.test() and da[0] == 5
+        finally:
+            mb.destroy()
 
-    def test_truncated_recv(self):
+    def test_tuple_tags_and_generic_keys(self):
         from ucc_tpu.native import NativeMailbox
         mb = NativeMailbox()
-        dst = np.zeros(2, np.int32)       # 8 bytes capacity
-        r = mb.post_recv_native(("k",), dst)
-        mb.push_native(("k",), np.arange(8, dtype=np.int32))  # 32 bytes
-        assert r.test()
-        assert r.nbytes == 8              # clamped to capacity
+        try:
+            # service tags are ("svc", n) tuples in the coll_tag position
+            d = np.zeros(2, np.int64)
+            r = mb.post_recv_native(("t", 0, ("svc", 3), 0, 1), d)
+            mb.push_native(("t", 0, ("svc", 3), 0, 1),
+                           np.full(2, 11, np.int64))
+            assert r.test() and d[0] == 11
+            # ...and svc tags stay isolated from each other
+            r2 = mb.post_recv_native(("t", 0, ("svc", 4), 0, 1),
+                                     np.zeros(2, np.int64))
+            assert not r2.test()
+            # non-canonical keys (tests, one-sided replies) still work
+            d3 = np.zeros(2, np.int64)
+            r3 = mb.post_recv_native(("odd", "key"), d3)
+            mb.push_native(("odd", "key"), np.full(2, 5, np.int64))
+            assert r3.test() and d3[0] == 5
+        finally:
+            mb.destroy()
+
+    def test_zero_length_message(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            s, kind = mb.push_native(_key(8), np.empty(0, np.uint8))
+            assert kind == "eager" and s.test()
+            r = mb.post_recv_native(_key(8), np.empty(0, np.uint8))
+            assert r.test() and r.nbytes == 0 and r.error is None
+        finally:
+            mb.destroy()
+
+
+class TestNativeTruncation:
+    """The C matcher must flag sends larger than the recv capacity
+    (clamped copy, loud failure). Counts are labeled in BYTES — the C
+    side sees only byte lengths and dst may carry any dtype, unlike the
+    python matcher which flattens to uint8 before matching."""
+
+    def test_truncated_send_sets_error(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            dst = np.zeros(4, np.uint8)
+            rreq = mb.post_recv_native(_key(1), dst)
+            sreq, _ = mb.push_native(_key(1), np.arange(10, dtype=np.uint8))
+            assert rreq.test() and sreq.test()
+            assert rreq.error is not None and "truncated" in rreq.error
+            assert "sent 10 bytes" in rreq.error
+            assert "4-byte recv buffer" in rreq.error
+            assert rreq.nbytes == 4          # clamped to capacity
+        finally:
+            mb.destroy()
+
+    def test_truncated_unexpected_order(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            mb.push_native(_key(2), np.arange(10, dtype=np.uint8))
+            rreq = mb.post_recv_native(_key(2), np.zeros(4, np.uint8))
+            assert rreq.test()
+            assert rreq.error is not None and "truncated" in rreq.error
+        finally:
+            mb.destroy()
+
+    def test_exact_size_no_error(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            dst = np.zeros(8, np.uint8)
+            rreq = mb.post_recv_native(_key(3), dst)
+            mb.push_native(_key(3), np.arange(8, dtype=np.uint8))
+            assert rreq.test()
+            assert rreq.error is None and rreq.nbytes == 8
+        finally:
+            mb.destroy()
+
+
+class TestNativeCancel:
+    def test_cancel_skip_at_match(self):
+        """A cancelled posted recv must be SKIPPED at match time: the
+        message goes to the next live recv (or parks), never into the
+        cancelled buffer — the PR-2 recv-withdrawal contract."""
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            dead = np.zeros(4, np.uint8)
+            r1 = mb.post_recv_native(_key(1), dead)
+            r1.cancel()
+            assert r1.test() and r1.cancelled and r1.error == "canceled"
+            live = np.zeros(4, np.uint8)
+            r2 = mb.post_recv_native(_key(1), live)
+            s, kind = mb.push_native(_key(1), np.full(4, 3, np.uint8))
+            assert kind == "direct"          # skipped straight to r2
+            assert r2.test() and live[0] == 3
+            assert not dead.any()            # cancelled buffer untouched
+        finally:
+            mb.destroy()
+
+    def test_cancel_after_delivery_stays_delivered(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            d = np.zeros(4, np.uint8)
+            r = mb.post_recv_native(_key(2), d)
+            mb.push_native(_key(2), np.full(4, 9, np.uint8))
+            r.cancel()
+            assert r.test() and r.cancelled
+            assert r.error is None and d[0] == 9   # data stands
+        finally:
+            mb.destroy()
+
+    def test_cancel_only_skips_the_cancelled_entry(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            d1, d2 = np.zeros(2, np.uint8), np.zeros(2, np.uint8)
+            r1 = mb.post_recv_native(_key(3), d1)
+            r2 = mb.post_recv_native(_key(3), d2)
+            r2.cancel()
+            mb.push_native(_key(3), np.full(2, 5, np.uint8))
+            assert r1.test() and d1[0] == 5
+            assert r2.cancelled and not d2.any()
+        finally:
+            mb.destroy()
+
+
+class TestNativeFence:
+    """Epoch fences in the C matcher: parked stale state purged, late
+    stale arrivals discarded at the match boundary — the machinery that
+    lets UCC_FT=shrink run on the native matcher."""
+
+    def test_fence_purges_parked_stale_state(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            stale = np.zeros(4, np.uint8)
+            r = mb.post_recv_native(_key(1, epoch=0), stale)
+            mb.push_native(_key(2, epoch=0), np.full(2, 1, np.uint8))
+            purged = mb.fence("t", 1)
+            assert purged == 2
+            # the purged recv completes as fenced so its buffer may be
+            # reclaimed; a purged unexpected send is simply gone
+            assert r.test() and "fenced" in r.error and r.cancelled
+            d = np.zeros(2, np.uint8)
+            r2 = mb.post_recv_native(_key(2, epoch=1), d)
+            assert not r2.test()   # the old-epoch send did NOT survive
+        finally:
+            mb.destroy()
+
+    def test_stale_send_discarded_at_boundary(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            mb.fence("t", 1)
+            s, kind = mb.push_native(_key(1, epoch=0),
+                                     np.full(2, 1, np.uint8))
+            assert kind == "fenced" and s.test()   # sender proceeds
+            # nothing parked: a new-epoch recv must not see it
+            r = mb.post_recv_native(_key(1, epoch=1), np.zeros(2, np.uint8))
+            assert not r.test()
+        finally:
+            mb.destroy()
+
+    def test_stale_post_recv_fails_locally(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            mb.fence("t", 2)
+            r = mb.post_recv_native(_key(1, epoch=1), np.zeros(2, np.uint8))
+            assert r.test() and "fenced" in r.error
+        finally:
+            mb.destroy()
+
+    def test_fence_purges_rndv_send(self):
+        """A parked zero-copy rndv send in a fenced epoch completes (the
+        sender must stop waiting) and its C-side request is freed."""
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            big = np.zeros(100000, np.uint8)
+            s, kind = mb.push_native(_key(1, epoch=0), big, 8192)
+            assert kind == "rndv" and not s.test()
+            assert mb.fence("t", 1) == 1
+            assert s.test()
+        finally:
+            mb.destroy()
+
+    def test_fence_is_team_scoped(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            other = np.zeros(2, np.uint8)
+            r = mb.post_recv_native(_key(1, team="other"), other)
+            assert mb.fence("t", 5) == 0
+            assert not r.test()    # unrelated team untouched
+            mb.push_native(_key(1, team="other"), np.full(2, 4, np.uint8))
+            assert r.test() and other[0] == 4
+        finally:
+            mb.destroy()
+
+
+class TestNativeLifecycle:
+    def test_purge_reclaims_abandoned_requests(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            reqs = [mb.post_recv_native(_key(i), np.zeros(4, np.uint8))
+                    for i in range(8)]
+            big = np.zeros(100000, np.uint8)
+            s, _ = mb.push_native(_key(99), big, 8192)   # parked rndv
+            assert mb.purge() > 0
+            # abandoned handles read as complete after the purge
+            for r in reqs:
+                assert r.test()
+            assert s.test()
+            assert not mb._send_keep
+        finally:
+            mb.destroy()
+
+    def test_send_request_freed_at_delivery(self):
+        """rndv send requests are freed when the recv lands them: the
+        sender's keepalive drains at its next poll, and the mailbox does
+        not accumulate C-side requests (the v1 leak-on-abandon)."""
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            big = np.zeros(100000, np.uint8)
+            s, _ = mb.push_native(_key(1), big, 8192)
+            assert mb._send_keep            # payload pinned while parked
+            d = np.zeros(100000, np.uint8)
+            r = mb.post_recv_native(_key(1), d)
+            assert r.test() and s.test()
+            assert not mb._send_keep        # keepalive dropped at poll
+        finally:
+            mb.destroy()
+
+    def test_slot_reuse(self):
+        """Completed request slots are recycled: a tight loop must not
+        grow the slot table."""
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            for i in range(3000):
+                d = np.zeros(4, np.uint8)
+                r = mb.post_recv_native(_key(i), d)
+                mb.push_native(_key(i), np.full(4, 1, np.uint8))
+                assert r.test()
+            # ids encode (gen<<20 | slot): slot indexes must stay small
+            r = mb.post_recv_native(_key(9999), np.zeros(1, np.uint8))
+            assert (r.rid & ((1 << 20) - 1)) < 2048
+        finally:
+            mb.destroy()
+
+    def test_poll_pending_mixed(self):
+        """poll_pending batches native requests per mailbox and falls
+        back to test() for everything else."""
+        from ucc_tpu.native import NativeMailbox, poll_pending
+
+        class FakeReq:
+            def __init__(self, done):
+                self._d = done
+
+            def test(self):
+                return self._d
+
+        mb = NativeMailbox()
+        try:
+            d = np.zeros(4, np.uint8)
+            r_pend = mb.post_recv_native(_key(1), d)
+            r_done = mb.post_recv_native(_key(2), np.zeros(4, np.uint8))
+            mb.push_native(_key(2), np.full(4, 1, np.uint8))
+            pending = poll_pending([r_pend, r_done, FakeReq(True),
+                                    FakeReq(False)])
+            kinds = {type(p).__name__ for p in pending}
+            assert len(pending) == 2 and "FakeReq" in kinds
+            assert any(p is r_pend for p in pending)
+        finally:
+            mb.destroy()
+
+    def test_closed_mailbox_is_safe(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        r = mb.post_recv_native(_key(1), np.zeros(4, np.uint8))
         mb.destroy()
+        assert r.test()                      # reads as complete, no crash
+        s, kind = mb.push_native(_key(1), np.zeros(4, np.uint8))
+        assert s.test() and kind == "eager"  # nowhere to land; no crash
+        with pytest.raises(RuntimeError):
+            mb.post_recv_native(_key(1), np.zeros(4, np.uint8))
+
+    def test_destroyed_mailbox_is_parked_and_recycled(self):
+        """destroy() parks the C mailbox for reuse instead of freeing it,
+        so a request handle that raced destroy polls bumped generations
+        (reads complete) — never freed heap — even after the mailbox is
+        recycled into a new endpoint's NativeMailbox."""
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        old_ptr = mb.ptr
+        r = mb.post_recv_native(_key(1), np.zeros(4, np.uint8))
+        stale = (r.mb, r.rid)
+        mb.destroy()
+        mb2 = NativeMailbox()           # pops the parked mailbox
+        try:
+            assert mb2.ptr == old_ptr
+            # the old-life handle still reads complete against the
+            # recycled mailbox's slot table (generation mismatch)
+            assert int(mb2.lib.ucc_req_poll(mb2.ptr, stale[1])) != 0
+            # and the recycled mailbox works as a fresh one
+            d = np.zeros(4, np.uint8)
+            r2 = mb2.post_recv_native(_key(2), d)
+            s2, kind = mb2.push_native(_key(2), np.ones(4, np.uint8))
+            assert kind == "direct" and s2.test() and r2.test()
+            assert d[0] == 1
+        finally:
+            mb2.destroy()
+
+    def test_test_many_batch_poll(self):
+        from ucc_tpu.native import NativeMailbox
+        mb = NativeMailbox()
+        try:
+            dsts = [np.zeros(4, np.uint8) for _ in range(6)]
+            reqs = [mb.post_recv_native(_key(i), d)
+                    for i, d in enumerate(dsts)]
+            for i in (0, 2, 4):
+                mb.push_native(_key(i), np.full(4, i + 1, np.uint8))
+            pending = mb.test_many(list(reqs))
+            assert {r.rid for r in pending} == {reqs[i].rid
+                                                for i in (1, 3, 5)}
+            for i in (0, 2, 4):
+                assert reqs[i].test() and dsts[i][0] == i + 1
+        finally:
+            mb.destroy()
 
 
 class TestNativeMpmc:
@@ -106,6 +509,56 @@ class TestNativeMpmc:
         q.destroy()
 
 
+class TestTransportOverNative:
+    """InProcTransport semantics with the native matcher engaged."""
+
+    def test_native_default_on(self):
+        from ucc_tpu.tl.host.transport import InProcTransport
+        t = InProcTransport()
+        try:
+            assert t.native is not None   # default in BOTH thread modes
+        finally:
+            t.close()
+
+    def test_counters_and_copy_free(self):
+        from ucc_tpu.tl.host.transport import InProcTransport
+        t = InProcTransport()
+        try:
+            key = ("tm", 0, 1, 0, 0)
+            d = np.zeros(16, np.float32)
+            r = t.recv_nb(key, d)
+            s = t.send_nb(t, key, np.arange(16, dtype=np.float32))
+            assert t.n_direct == 1 and s.test() and r.test()
+            np.testing.assert_array_equal(
+                d.view(np.float32), np.arange(16, dtype=np.float32))
+            t.send_nb(t, ("tm", 0, 2, 0, 0), np.zeros(4, np.uint8))
+            assert t.n_eager == 1
+            t.send_nb(t, ("tm", 0, 3, 0, 0),
+                      np.zeros(t.EAGER_THRESHOLD + 1, np.uint8))
+            assert t.n_rndv == 1
+        finally:
+            t.close()
+
+    def test_fence_routes_to_native_no_warning(self, caplog):
+        import logging
+        from ucc_tpu.tl.host.transport import InProcTransport
+        t = InProcTransport()
+        try:
+            assert t.native is not None
+            key = ("tk", 0, 1, 0, 0)
+            r = t.recv_nb(key, np.zeros(4, np.uint8))
+            with caplog.at_level(logging.WARNING):
+                purged = t.fence("tk", 1)
+            assert purged == 1 and r.test() and "fenced" in r.error
+            assert not any("python matcher" in rec.message
+                           for rec in caplog.records)
+            # late stale send is discarded and counted
+            s = t.send_nb(t, key, np.ones(4, np.uint8))
+            assert s.test() and t.n_fenced == 1
+        finally:
+            t.close()
+
+
 class TestCollectivesOverNative:
     def test_allreduce_native_transport(self, monkeypatch):
         monkeypatch.setenv("UCC_TL_SHM_NATIVE", "y")
@@ -128,31 +581,47 @@ class TestCollectivesOverNative:
         finally:
             job.cleanup()
 
-
-class TestNativeTruncation:
-    """The C matcher must flag sends larger than the recv capacity
-    (parity with the python Mailbox's truncation detection)."""
-
-    def test_truncated_send_sets_error(self):
-        from ucc_tpu.native import NativeMailbox
-        mb = NativeMailbox()
+    def test_collective_matrix_large_msgs(self, monkeypatch):
+        """Rndv-sized payloads through full collectives on the native
+        matcher (zero-copy parking + keepalive discipline)."""
+        monkeypatch.setenv("UCC_TL_SHM_NATIVE", "y")
+        monkeypatch.setenv("UCC_HOST_EAGER_LIMIT", "1k")
+        job = UccJob(4)
         try:
-            dst = np.zeros(4, np.uint8)
-            rreq = mb.post_recv_native(("k", 1), dst)
-            sreq = mb.push_native(("k", 1), np.arange(10, dtype=np.uint8))
-            assert rreq.test() and sreq.test()
-            assert rreq.error is not None and "truncated" in rreq.error
+            teams = job.create_team()
+            count = 8192          # 32KB payloads >> 1K eager limit
+            srcs = [np.full(count, r + 1.0, np.float32) for r in range(4)]
+            dsts = [np.zeros(4 * count, np.float32) for _ in range(4)]
+            job.run_coll(teams, lambda r: CollArgs(
+                coll_type=CollType.ALLGATHER,
+                src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+                dst=BufferInfo(dsts[r], 4 * count, DataType.FLOAT32)))
+            for r in range(4):
+                for p in range(4):
+                    np.testing.assert_allclose(
+                        dsts[r][p * count:(p + 1) * count], p + 1.0)
         finally:
-            mb.destroy()
+            job.cleanup()
 
-    def test_exact_size_no_error(self):
-        from ucc_tpu.native import NativeMailbox
-        mb = NativeMailbox()
-        try:
-            dst = np.zeros(8, np.uint8)
-            rreq = mb.post_recv_native(("k", 2), dst)
-            mb.push_native(("k", 2), np.arange(8, dtype=np.uint8))
-            assert rreq.test()
-            assert rreq.error is None and rreq.nbytes == 8
-        finally:
-            mb.destroy()
+
+class TestNativeFtShrink:
+    """UCC_FT=shrink on the NATIVE matcher: the PR-4 capability fork is
+    closed — kill -> agree -> shrink -> resume must pass with the native
+    matcher forced on, with no python-matcher fallback warning, and a
+    pre-shrink stale send must be provably fenced (n_fenced > 0)."""
+
+    def test_kill_shrink_resume_native(self, monkeypatch, caplog):
+        import logging
+        from ucc_tpu.fault.soak import run_kill_shrink_soak
+        monkeypatch.setenv("UCC_TL_SHM_NATIVE", "y")
+        with caplog.at_level(logging.WARNING):
+            report = run_kill_shrink_soak(
+                n_ranks=4, kill_rank=2, pre_iters=2, post_iters=10,
+                iter_deadline_s=30.0)
+        assert report["violations"] == []
+        assert report["post_iters"] == 10
+        assert report["matcher"] == "native"
+        # the stale-send probe drives n_fenced > 0 on the native matcher
+        assert report["stale_send_fenced"] is True
+        assert not any("python matcher" in rec.message
+                       for rec in caplog.records)

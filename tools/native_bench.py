@@ -1,17 +1,24 @@
-"""Native-vs-Python tag matcher benchmark under ThreadMode.MULTIPLE.
+"""Native-vs-Python tag matcher benchmark, both thread modes.
 
-The C++ matcher (native/ucc_tpu_core.cc) exists for exactly one claim:
-GIL-released matching should win when MANY OS threads drive progress
-concurrently (single-threaded it measured ~2x SLOWER — per-call ffi +
-key serialization dominate; tl/host/transport.py). This harness measures
-that claim: an 8-rank ThreadMode.MULTIPLE world, every rank in its own
-OS thread, a storm of small allreduces (tag-matcher thrash, the
-ucc_progress_queue_mt.c regime). Run directly for one mode, or with
---compare to spawn both modes in subprocesses and print the verdict.
+The v2 C++ matcher (native/ucc_tpu_core.cc) carries two claims that this
+harness measures head-to-head against the in-GIL python matcher:
 
-Output: one JSON line per mode
-  {"mode": "native"|"python", "threads": N, "colls": K, "wall_s": ...,
-   "colls_per_s": ...}
+  * ThreadMode.MULTIPLE (default mode here): GIL-released matching wins
+    when many OS threads drive progress concurrently — every rank in its
+    own OS thread, a storm of small allreduces (tag-matcher thrash, the
+    ucc_progress_queue_mt.c regime).
+  * --single: ThreadMode.SINGLE, all ranks progressed cooperatively from
+    ONE thread (the tests/gate regime). v1 measured ~2x SLOWER here
+    (per-call ffi + pickled keys dominated); v2's packed binary keys and
+    mapped completion window are required to hold parity.
+
+Run directly for one matcher, or with --compare to spawn both matchers
+in subprocesses and print the verdict. Output records match perftest's
+--json shape (avg/min/max/p50/p99 us) plus colls_per_s.
+
+    python tools/native_bench.py --compare            # MT verdict
+    python tools/native_bench.py --compare --single   # ST verdict
+    python tools/native_bench.py --json --single
 """
 from __future__ import annotations
 
@@ -27,7 +34,26 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def run_once(n: int, iters: int, count: int) -> dict:
+def _stats(lats) -> dict:
+    import numpy as np
+    a = np.asarray(lats, dtype=np.float64) * 1e6
+    return {"avg_us": round(float(a.mean()), 3),
+            "min_us": round(float(a.min()), 3),
+            "max_us": round(float(a.max()), 3),
+            "p50_us": round(float(np.percentile(a, 50)), 3),
+            "p99_us": round(float(np.percentile(a, 99)), 3)}
+
+
+def _mode_of(ctx) -> str:
+    # label from what actually ran, not the env: native is the default in
+    # both thread modes, so an unset env IS a native run when available
+    return ("native" if ctx.tl_contexts["shm"].obj.transport.native
+            is not None else "python")
+
+
+def run_multi(n: int, iters: int, count: int) -> dict:
+    """ThreadMode.MULTIPLE: every rank posts + progresses from its own
+    OS thread (concurrent matcher access; the GIL-release regime)."""
     import numpy as np
     import ucc_tpu
     from ucc_tpu import (BufferInfo, CollArgs, CollType, Context,
@@ -52,6 +78,7 @@ def run_once(n: int, iters: int, count: int) -> dict:
     teams = [None] * n
     errors = []
     barrier = threading.Barrier(n)
+    lats0 = []
     t_wall = [0.0]
 
     def rank_main(r):
@@ -75,7 +102,12 @@ def run_once(n: int, iters: int, count: int) -> dict:
             barrier.wait()
             t0 = time.perf_counter()
             for _ in range(iters):
-                one()
+                if r == 0:
+                    i0 = time.perf_counter()
+                    one()
+                    lats0.append(time.perf_counter() - i0)
+                else:
+                    one()
             if r == 0:
                 t_wall[0] = time.perf_counter() - t0
         except Exception as e:  # noqa: BLE001
@@ -88,43 +120,61 @@ def run_once(n: int, iters: int, count: int) -> dict:
         t.join(600)
     if errors:
         raise RuntimeError(f"bench failed: {errors}")
-    # label from what actually ran, not the env: ThreadMode.MULTIPLE
-    # defaults to the native matcher, so an unset env IS a native run
-    mode = "native" if ctxs[0].tl_contexts["shm"].obj.transport.native \
-        is not None else "python"
+    mode = _mode_of(ctxs[0])
     for t in teams:
         t.destroy()
     for c in ctxs:
         c.destroy()
     wall = t_wall[0]
-    return {"mode": mode,
-            "threads": n, "colls": iters, "count": count,
+    return {"bench": "native", "threadmode": "multiple", "matcher": mode,
+            "coll": "allreduce", "ranks": n, "count": count,
+            "size_bytes": count * 8, "iters": iters,
+            **_stats(lats0),
             "wall_s": round(wall, 4),
             "colls_per_s": round(iters / wall, 1) if wall else None}
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("-n", type=int, default=8, help="ranks/threads")
+    ap.add_argument("-n", type=int, default=8, help="ranks")
     ap.add_argument("--iters", type=int, default=200)
     ap.add_argument("--count", type=int, default=64,
                     help="elements per allreduce (small = matcher-bound)")
+    ap.add_argument("--single", action="store_true",
+                    help="ThreadMode.SINGLE cooperative driver instead "
+                    "of one OS thread per rank")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable record only: suppress the "
+                    "human-readable summary line (stderr). stdout is "
+                    "always one JSON record per run, matching perftest's "
+                    "--json shape")
     ap.add_argument("--compare", action="store_true",
-                    help="run both modes in subprocesses")
+                    help="run python + native matchers in subprocesses "
+                    "and print the verdict")
     args = ap.parse_args(argv)
 
     if not args.compare:
-        print(json.dumps(run_once(args.n, args.iters, args.count)))
+        fn = _run_single_impl if args.single else run_multi
+        rec = fn(args.n, args.iters, args.count)
+        print(json.dumps(rec))
+        if not args.json:
+            print(f"# {rec['matcher']} matcher ({rec['threadmode']}): "
+                  f"{rec['colls_per_s']} colls/s, p50 {rec['p50_us']}us, "
+                  f"p99 {rec['p99_us']}us over {rec['iters']} iters",
+                  file=sys.stderr)
         return 0
 
     results = {}
     for mode, flag in (("python", "n"), ("native", "y")):
         env = dict(os.environ, UCC_TL_SHM_NATIVE=flag,
                    JAX_PLATFORMS="cpu")
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "-n", str(args.n),
-             "--iters", str(args.iters), "--count", str(args.count)],
-            env=env, capture_output=True, text=True, timeout=900)
+        argv_child = [sys.executable, os.path.abspath(__file__),
+                      "-n", str(args.n), "--iters", str(args.iters),
+                      "--count", str(args.count)]
+        if args.single:
+            argv_child.append("--single")
+        out = subprocess.run(argv_child, env=env, capture_output=True,
+                             text=True, timeout=900)
         line = (out.stdout or "").strip().splitlines()[-1] if out.stdout \
             else ""
         if out.returncode != 0 or not line:
@@ -133,11 +183,82 @@ def main(argv=None) -> int:
             return 1
         results[mode] = json.loads(line)
         print(line)
+        # the record labels what ACTUALLY ran (_mode_of): a kill switch
+        # (UCC_NATIVE=n) or a failed build in the child makes both runs
+        # python — comparing them as native-vs-python is a silently
+        # wrong baseline, so refuse instead
+        got = results[mode].get("matcher")
+        if got != mode:
+            print(f"# {mode} run actually used matcher={got!r} "
+                  f"(UCC_NATIVE kill switch? build failure?) — "
+                  f"comparison is meaningless, aborting", file=sys.stderr)
+            return 1
     ratio = results["python"]["wall_s"] / results["native"]["wall_s"]
-    print(json.dumps({"native_speedup_vs_python": round(ratio, 3),
-                      "verdict": "native wins" if ratio > 1.05 else
-                      ("parity" if ratio > 0.95 else "python wins")}))
+    print(json.dumps({
+        "threadmode": "single" if args.single else "multiple",
+        "native_speedup_vs_python": round(ratio, 3),
+        "python_colls_per_s": results["python"]["colls_per_s"],
+        "native_colls_per_s": results["native"]["colls_per_s"],
+        "verdict": "native wins" if ratio > 1.05 else
+        ("parity" if ratio > 0.95 else "python wins")}))
+    if not args.json:
+        print(f"# {'single' if args.single else 'multiple'}: native "
+              f"{ratio:.3f}x python "
+              f"({results['native']['colls_per_s']} vs "
+              f"{results['python']['colls_per_s']} colls/s)",
+              file=sys.stderr)
     return 0
+
+
+def _run_single_impl(n: int, iters: int, count: int) -> dict:
+    """ThreadMode.SINGLE: one thread posts the collective on every rank
+    and drives all contexts cooperatively (the tests/gate regime — the
+    regime where the v1 matcher lost ~2x to python)."""
+    import numpy as np
+    from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType,
+                         ReductionOp, Status)
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from harness import UccJob
+
+    job = UccJob(n)
+    try:
+        teams = job.create_team()
+        srcs = [np.full(count, float(r + 1), np.float64) for r in range(n)]
+        dsts = [np.zeros(count, np.float64) for _ in range(n)]
+
+        def one_round():
+            reqs = [t.collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+                dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+                op=ReductionOp.SUM)) for r, t in enumerate(teams)]
+            for rq in reqs:
+                rq.post()
+            while not all(rq.test() != Status.IN_PROGRESS for rq in reqs):
+                for c in job.contexts:
+                    c.progress()
+            for rq in reqs:
+                assert rq.test() == Status.OK
+                rq.finalize()
+
+        for _ in range(max(2, iters // 10)):    # warmup
+            one_round()
+        lats = []
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            i0 = time.perf_counter()
+            one_round()
+            lats.append(time.perf_counter() - i0)
+        wall = time.perf_counter() - t0
+        mode = _mode_of(job.contexts[0])
+    finally:
+        job.cleanup()
+    return {"bench": "native", "threadmode": "single", "matcher": mode,
+            "coll": "allreduce", "ranks": n, "count": count,
+            "size_bytes": count * 8, "iters": iters,
+            **_stats(lats),
+            "wall_s": round(wall, 4),
+            "colls_per_s": round(iters / wall, 1) if wall else None}
 
 
 if __name__ == "__main__":

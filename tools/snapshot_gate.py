@@ -330,6 +330,97 @@ def _quant_smoke(env) -> None:
           flush=True)
 
 
+def _native_smoke(env) -> None:
+    """WARN-ONLY native-matcher probe (ISSUE 7 CI satellite, same
+    harness as the other smokes): run tools/native_bench.py --compare in
+    BOTH thread modes and check the v2 core's two claims — native >=
+    python colls/s under concurrent progress threads, and within 5%
+    single-threaded (where v1 lost ~2x). Skips itself when the core is
+    not built. Disable with UCC_GATE_NATIVE=0."""
+    import json
+    if os.environ.get("UCC_GATE_NATIVE", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] native smoke: skipped (UCC_GATE_NATIVE=0)",
+              flush=True)
+        return
+    print("[gate] native smoke (warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    # same de-instrumentation as the perf smoke: any armed subsystem
+    # flips the TLs onto the instrumented per-message path and biases
+    # both matchers low
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE",
+                                      "UCC_TL_SHM_NATIVE"))}
+    sys.path.insert(0, REPO)
+    try:
+        from ucc_tpu.native import available
+        if not available():
+            print("[gate] native smoke: core not built; skipping",
+                  flush=True)
+            return
+    except Exception:  # noqa: BLE001
+        print("[gate] native smoke: core probe failed; skipping",
+              flush=True)
+        return
+
+    def run_mode(single: bool):
+        argv = [sys.executable, "tools/native_bench.py", "--compare",
+                "--iters", "200"]
+        if single:
+            argv.append("--single")
+        try:
+            r = subprocess.run(argv, cwd=REPO, env=smoke_env,
+                               capture_output=True, text=True, timeout=600)
+        except subprocess.TimeoutExpired:
+            return None
+        for ln in reversed((r.stdout or "").strip().splitlines()):
+            if ln.startswith("{") and "native_speedup_vs_python" in ln:
+                try:
+                    return json.loads(ln)
+                except ValueError:
+                    continue
+        return None
+
+    mt = run_mode(single=False)
+    # ST parity sits inside the box's run-to-run noise (BASELINE round 7
+    # records 0.93-1.50x across healthy runs): judge the MEDIAN of three
+    # runs — the baseline's own methodology — so the warn doesn't fire
+    # on a single unlucky draw and train operators to ignore it
+    st_runs = [r for r in (run_mode(single=True) for _ in range(3))
+               if r is not None]
+    # lower-middle on even counts: with a lost run (subprocess timeout)
+    # the optimistic pick would mask exactly the ST regression this
+    # smoke exists to catch
+    st = (sorted(st_runs, key=lambda r: float(
+        r.get("native_speedup_vs_python") or 0.0))[(len(st_runs) - 1) // 2]
+        if st_runs else None)
+    dt = time.monotonic() - t0
+    if mt is None or st is None:
+        print(f"[gate] WARN: native smoke produced no verdict in "
+              f"{dt:.0f}s (not a gate failure)", flush=True)
+        return
+    problems = []
+    if float(mt.get("native_speedup_vs_python") or 0.0) < 1.0:
+        problems.append(
+            f"MT: native {mt.get('native_colls_per_s')} colls/s below "
+            f"python {mt.get('python_colls_per_s')}")
+    if float(st.get("native_speedup_vs_python") or 0.0) < 0.95:
+        problems.append(
+            f"ST: native {st.get('native_colls_per_s')} colls/s (median "
+            f"of {len(st_runs)} runs) more "
+            f"than 5% below python {st.get('python_colls_per_s')}")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] native smoke: MT native "
+          f"{mt.get('native_speedup_vs_python')}x python "
+          f"({mt.get('native_colls_per_s')} vs "
+          f"{mt.get('python_colls_per_s')} colls/s), ST "
+          f"{st.get('native_speedup_vs_python')}x "
+          f"({st.get('native_colls_per_s')} vs "
+          f"{st.get('python_colls_per_s')}) in {dt:.0f}s -> {verdict}",
+          flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -386,6 +477,11 @@ def main(argv=None) -> int:
         # warn-only: int8 allreduce beats exact on wire bytes and stays
         # inside the error budget on the wire-bound host path (ISSUE 6)
         _quant_smoke(env)
+        # warn-only: the v2 native matcher holds its perf claims in both
+        # thread modes — >= python under concurrent progress, within 5%
+        # single-threaded (ISSUE 7). The kill+shrink soak above already
+        # exercises native+FT: native is the default matcher now.
+        _native_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

@@ -264,8 +264,11 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
     health.configure("shrink", interval=hb_interval, timeout=hb_timeout)
     ctxs = _make_job(n_ranks)
     teams = _make_team(ctxs)
+    # matcher/stale_send_fenced defaults: _probe_stale_send_fence may
+    # find no probeable transport and return without setting either key
     report: Dict = {"pre_iters": 0, "post_iters": 0, "violations": [],
-                    "outcomes": {}, "detected": {}, "agreed": {}}
+                    "outcomes": {}, "detected": {}, "agreed": {},
+                    "matcher": None, "stale_send_fenced": None}
     bufs: Dict = {}
     new_teams = None
     try:
@@ -347,6 +350,13 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
                 f"survivors diverged on (dead set, epoch): {views}")
         if not report["violations"]:
             new_teams = [shrinks[r].new_team for r in survivors]
+            # regression probe: a STALE pre-shrink send posted after the
+            # fence must be discarded at the match boundary (n_fenced),
+            # never parked where a recycled buffer could meet it. Runs on
+            # whichever matcher the endpoint actually uses — the native
+            # v2 core fences too, so UCC_FT=shrink no longer pins the
+            # python matcher.
+            _probe_stale_send_fence(teams[survivors[0]], report)
 
         # -- resume on the shrunk team --------------------------------
         if new_teams:
@@ -373,6 +383,37 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
             except Exception:  # noqa: BLE001
                 pass
     return report
+
+
+def _probe_stale_send_fence(old_team, report) -> None:
+    """Post a send into the OLD (fenced) epoch of a shrunk team and
+    assert it is discarded at the matching boundary: the send completes
+    (the sender must not wait forever) and the endpoint's ``n_fenced``
+    counter ticks. Records which matcher handled it."""
+    import numpy as np
+    from ..tl.host.transport import InProcTransport
+    for team_key, tr in old_team._tl_tag_spaces():
+        # select loopback-capable endpoints BY TYPE: catching TypeError
+        # around the send itself would also swallow a TypeError from the
+        # native key-packing/push path this probe exists to regression-
+        # test (socket TL endpoints have a different send_nb signature)
+        if not isinstance(tr, InProcTransport):
+            continue
+        before = tr.n_fenced
+        # epoch 0 is the pre-shrink tag space; any coll tag/slot works
+        key = (team_key, 0, (1 << 20) + 1, 999, 0)
+        req = tr.send_nb(tr, key, np.ones(8, np.uint8))
+        ok = bool(req.test()) and tr.n_fenced == before + 1
+        report["stale_send_fenced"] = ok
+        report["matcher"] = ("native"
+                             if getattr(tr, "native", None) is not None
+                             else "python")
+        if not ok:
+            report["violations"].append(
+                "stale pre-shrink send was not fenced "
+                f"(n_fenced {before} -> {tr.n_fenced})")
+        return
+    report["stale_send_fenced"] = None
 
 
 def _drive_iter(ctxs, teams, coll, n, count, bufs, deadline_s, report,

@@ -1,23 +1,35 @@
-"""ctypes bindings for the native runtime core (native/ucc_tpu_core.cc).
+"""ctypes bindings for the native runtime core v2 (native/ucc_tpu_core.cc).
 
 Auto-builds the shared library on first use when a toolchain is present
 (the reference ships autotools-built .so components; here one ``make`` in
-native/). Everything degrades gracefully: if the library can't be built or
-loaded, callers fall back to the pure-Python implementations.
+native/), REBUILDING when the source is newer than the library, and
+rejecting a stale build via ``ucc_abi_version`` instead of symbol
+probing. Everything degrades gracefully: if the library can't be built
+or loaded, callers fall back to the pure-Python implementations.
 
-``NativeMailbox`` implements the same push/post_recv contract as
-tl/host/transport.Mailbox, with matching + payload copies in C++ (the
-tl/ucp tag-matching hot loop, done native). Selected via
-``UCC_TL_SHM_NATIVE`` (default: on when available).
+``NativeMailbox`` implements the full push/post_recv contract of
+tl/host/transport.Mailbox in C++ — copy-free delivery into posted recvs,
+eager/rndv split at ``UCC_HOST_EAGER_LIMIT`` for unexpected sends, the
+truncation contract, cancelled-entry skip, and epoch fences — so it is
+the default matcher in BOTH thread modes, including under
+``UCC_FT=shrink`` (``UCC_TL_SHM_NATIVE`` overrides; ``UCC_NATIVE=n``
+disables the core entirely).
+
+Tag keys are packed into three u64 words (team_id<<32|epoch, coll_tag,
+slot<<32|src): the per-message pickle serialization of v1 is gone —
+non-integer key parts (team keys, tuple tags) are interned once per
+mailbox. Completion state is published by the C side into a flat array
+this module maps once, so polling a request costs a memory load, not an
+ffi call; ``ucc_req_test_many`` batch-polls for callers without the
+mapping.
 """
 from __future__ import annotations
 
 import ctypes
 import os
-import pickle
 import subprocess
 import threading
-from typing import Any, Dict, Optional
+from typing import Optional
 
 import numpy as np
 
@@ -25,75 +37,348 @@ from .utils.log import get_logger
 
 logger = get_logger("native")
 
+#: must match kAbiVersion in native/ucc_tpu_core.cc
+ABI_VERSION = 2
+
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 _LOCK = threading.Lock()
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "ucc_tpu_core.cc")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libucc_tpu_core.so")
+_EXT_PATH = os.path.join(_NATIVE_DIR, "ucc_tpu_core_ext.so")
+_BUILD_LOG = os.path.join(_NATIVE_DIR, "build.log")
+
+#: optional CPython fastcall module wrapping the two per-message hot
+#: calls (push/post_recv): buffer protocol instead of ctypes marshalling.
+#: A thin wrapper linked against libucc_tpu_core.so (one matcher copy in
+#: the process). None = not built (no Python.h) — ctypes is used instead.
+_EXT = None
+
+# request-id layout (mirrors the C side): rid = (gen << 20) | slot index;
+# pub word = (gen << 32) | (min(nbytes, _NB_MAX) << 3) | state
+_SLOT_BITS = 20
+_MAX_SLOTS = 1 << _SLOT_BITS
+_IDX_MASK = _MAX_SLOTS - 1
+_NB_MAX = (1 << 29) - 1
+
+_ST_OK = 1
+_ST_TRUNCATED = 2
+_ST_FENCED = 3
+_ST_CANCELED = 4
+
+_KIND_STR = ("direct", "eager", "rndv", "fenced")
+
+# process-global team-id counter: see NativeMailbox._intern_team
+_NEXT_TEAM_ID = 1
+_TEAM_ID_LOCK = threading.Lock()
+
+_DEFAULT_EAGER_LIMIT = 8192
+
+_EAGER_LIMIT: Optional[int] = None
 
 
-def _build() -> bool:
-    if not os.path.isdir(_NATIVE_DIR):
-        return False
+def _eager_limit() -> int:
+    """Process eager limit for direct ``push_native`` callers: resolved
+    once through the transport's UCC_HOST_EAGER_LIMIT knob (env or
+    config file) so the two layers cannot split eager/rndv at different
+    thresholds. Transport endpoints pass their own limit explicitly."""
+    global _EAGER_LIMIT
+    if _EAGER_LIMIT is None:
+        try:
+            from .tl.host.transport import eager_limit_from_env
+            _EAGER_LIMIT = eager_limit_from_env()
+        except Exception:  # noqa: BLE001 - import cycle/teardown only
+            return _DEFAULT_EAGER_LIMIT
+    return _EAGER_LIMIT
+
+# ("svc", n) tags count up for the life of a service team: special-cased
+# into a reserved range so they never grow the intern table
+_SVC_TAG_BASE = 1 << 60
+_TUPLE_TAG_BASE = 1 << 61
+
+
+def _register_cfg():
+    """UCC_NATIVE in the config registry so ``ucc_info -cf`` lists it and
+    ``get_lib`` resolves it with standard precedence (env wins over
+    UCC_CONFIG_FILE — the knob gates library LOADING, so it needs no
+    context config, only the process environment)."""
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
-        return os.path.isfile(_SO_PATH)
+        from .utils.config import (ConfigField, ConfigTable, parse_bool,
+                                   register_table)
+        return register_table(ConfigTable(
+            prefix="", name="native-core", fields=[
+                ConfigField(
+                    "NATIVE", "y",
+                    "build/load the native C++ runtime core "
+                    "(native/libucc_tpu_core.so): tag matching, copy-free "
+                    "delivery, epoch fences and GIL-free completion "
+                    "polling in C++. n disables the core process-wide "
+                    "(every endpoint falls back to the python matcher); "
+                    "per-endpoint selection is UCC_TL_SHM_NATIVE",
+                    parse_bool),
+            ]))
+    except Exception:  # noqa: BLE001 - registration is advisory
+        return None
+
+
+_NATIVE_CONFIG = _register_cfg()
+
+
+def _native_enabled() -> bool:
+    """Resolve UCC_NATIVE (default y) with the repo-wide bool grammar and
+    standard precedence: env, then UCC_CONFIG_FILE, then the default."""
+    if _NATIVE_CONFIG is not None:
+        try:
+            from .utils.config import Config
+            return bool(Config(_NATIVE_CONFIG).native)
+        except Exception:  # noqa: BLE001 - malformed value: fall through
+            pass
+    raw = os.environ.get("UCC_NATIVE", "y").strip().lower()
+    return raw not in ("n", "no", "0", "off", "false", "f")
+
+
+def _write_build_log(text: str) -> None:
+    try:
+        with open(_BUILD_LOG, "w") as fh:
+            fh.write(text)
+    except OSError:
+        pass
+
+
+def _build(force: bool = False) -> Optional[bool]:
+    """Run make; *force* rebuilds even when mtimes say up-to-date (the
+    ABI-mismatch path — e.g. a checkout restored with preserved
+    timestamps — would otherwise be a guaranteed no-op). Returns True
+    when the library built, False when the toolchain exists but the
+    compile FAILED, and None when there is no toolchain to try (the
+    caller may still trust an existing .so in that case)."""
+    if not os.path.isdir(_NATIVE_DIR):
+        return None
+    cmd = ["make", "-C", _NATIVE_DIR] + (["-B"] if force else [])
+    try:
+        pre_mtime = os.path.getmtime(_SO_PATH)
+    except OSError:
+        pre_mtime = None
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=300)
     except (subprocess.SubprocessError, OSError) as e:
-        logger.debug("native core build failed: %s", e)
+        # no make / no toolchain: an expected environment, stay quiet
+        _write_build_log(f"make did not run: {e}\n")
+        logger.debug("native core build skipped (see %s): %s",
+                     _BUILD_LOG, e)
+        return None
+    if r.returncode != 0:
+        _write_build_log((r.stdout or "") + (r.stderr or ""))
+        # make can fail AFTER the main library built (the optional
+        # fastcall ext compiles last): the ctypes path still runs, so
+        # only the ext is lost — don't claim a full python fallback.
+        # The core is known-good when it RECOMPILED this run (mtime moved
+        # off pre_mtime) or, incrementally, was already fresh vs the
+        # source. Under force the source/so mtimes lie (the ABI-mismatch
+        # caller exists because a stale .so can look up-to-date), so only
+        # a moved mtime counts there.
+        try:
+            post_mtime = os.path.getmtime(_SO_PATH)
+        except OSError:
+            post_mtime = None
+        lib_fresh = post_mtime is not None and post_mtime != pre_mtime
+        if not lib_fresh and not force and post_mtime is not None:
+            try:
+                lib_fresh = not os.path.isfile(_SRC_PATH) or \
+                    post_mtime >= os.path.getmtime(_SRC_PATH)
+            except OSError:
+                lib_fresh = False
+        if lib_fresh:
+            logger.warning("native fastcall ext build failed rc=%s — "
+                           "core loads via ctypes (see %s)", r.returncode,
+                           _BUILD_LOG)
+            return True
+        # the toolchain EXISTS but the compile failed (with -Werror a
+        # new compiler warning lands here): this silently costs the
+        # native matcher everywhere, so it must be loud, not debug-level
+        logger.warning("native core build FAILED rc=%s — python matcher "
+                       "fallback everywhere (see %s)", r.returncode,
+                       _BUILD_LOG)
         return False
+    if not os.path.isfile(_SO_PATH):
+        _write_build_log((r.stdout or "") + (r.stderr or ""))
+        logger.warning("native core build produced no %s — python "
+                       "matcher fallback everywhere (see %s)", _SO_PATH,
+                       _BUILD_LOG)
+        return False
+    return True
+
+
+def _ext_buildable() -> bool:
+    """Mirror the Makefile's PYINC probe: the fastcall ext target only
+    exists when Python headers are discoverable."""
+    try:
+        import sysconfig
+        inc = sysconfig.get_paths().get("include")
+        return bool(inc) and os.path.isfile(os.path.join(inc, "Python.h"))
+    except Exception:  # noqa: BLE001 - probe only
+        return False
+
+
+def _stale() -> bool:
+    """True when the on-disk library must be (re)built: missing, or the
+    source is newer than any built artifact (v1 loaded a stale .so and
+    only noticed by symbol probing). A stale or missing EXT only counts
+    when make could actually rebuild it — otherwise (headers removed
+    after the ext was built) every process start would pay a make
+    subprocess that can never cure the staleness; _load_ext refuses the
+    stale ext either way."""
+    if not os.path.isfile(_SO_PATH):
+        return True
+    if not os.path.isfile(_SRC_PATH):
+        return False           # distribution without sources: trust the .so
+    try:
+        src_mtime = os.path.getmtime(_SRC_PATH)
+        if src_mtime > os.path.getmtime(_SO_PATH):
+            return True
+        if not os.path.isfile(_EXT_PATH):
+            # core built before headers appeared (or the ext was
+            # deleted): without this, the advertised fastcall ext would
+            # silently never materialize
+            return _ext_buildable()
+        return src_mtime > os.path.getmtime(_EXT_PATH) and _ext_buildable()
+    except OSError:
+        return False
+
+
+def _load_ext():
+    """Import the optional fastcall extension; None when absent, ABI-
+    mismatched, or unloadable (the ctypes path covers everything)."""
+    if not os.path.isfile(_EXT_PATH):
+        return None
+    # the thin ext holds no matcher code (it links libucc_tpu_core.so),
+    # but a stale wrapper can still have been compiled against older C
+    # entry-point signatures than the core now exports, and the ABI gate
+    # below only catches that when kAbiVersion was bumped. Require the
+    # ext to be at least as new as BOTH the source and the core library
+    # (make builds core then ext, so a healthy pair always satisfies
+    # this); e.g. the ext compile failed under -Werror after the core
+    # step succeeded, or the core was rebuilt with no Python headers.
+    try:
+        ext_mtime = os.path.getmtime(_EXT_PATH)
+        if os.path.isfile(_SRC_PATH) and \
+                os.path.getmtime(_SRC_PATH) > ext_mtime:
+            logger.debug("fastcall ext older than %s; using ctypes path",
+                         _SRC_PATH)
+            return None
+        if os.path.isfile(_SO_PATH) and \
+                os.path.getmtime(_SO_PATH) > ext_mtime:
+            logger.debug("fastcall ext older than %s; using ctypes path",
+                         _SO_PATH)
+            return None
+    except OSError:
+        return None
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("ucc_tpu_core_ext",
+                                                      _EXT_PATH)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        if int(mod.abi_version()) != ABI_VERSION:
+            logger.debug("fastcall ext ABI mismatch; using ctypes path")
+            return None
+        return mod
+    except Exception as e:  # noqa: BLE001 - optional accelerator only
+        logger.debug("fastcall ext load failed (%s); using ctypes path", e)
+        return None
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
-    """Load (building if needed) the native core; None when unavailable."""
+    """Load (building/rebuilding if needed) the native core; None when
+    unavailable or when the on-disk build does not speak ABI_VERSION."""
     global _LIB, _TRIED
     with _LOCK:
         if _TRIED:
             return _LIB
         _TRIED = True
-        if os.environ.get("UCC_NATIVE", "y").lower() in ("n", "no", "0",
-                                                         "off"):
+        if not _native_enabled():
             return None
-        if not os.path.isfile(_SO_PATH) and not _build():
-            return None
+        if _stale():
+            built = _build()
+            if built is False:
+                # the toolchain exists but the compile FAILED: the
+                # on-disk .so no longer matches the source, and loading
+                # it would silently run a stale matcher while _build's
+                # warning claims a python fallback — make the fallback
+                # real instead
+                return None
+            if built is None and not os.path.isfile(_SO_PATH):
+                return None          # nothing built, nothing to load
+            # built is None with an existing .so: no toolchain to
+            # rebuild with (e.g. mtime skew on a prebuilt distribution)
+            # — trust the .so, the ABI gate below still protects
+            # contract breaks
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError as e:
             logger.warning("native core load failed: %s", e)
             return None
-        lib.ucc_mailbox_create.restype = ctypes.c_void_p
-        lib.ucc_mailbox_destroy.argtypes = [ctypes.c_void_p]
-        lib.ucc_mailbox_push.restype = ctypes.c_uint64
-        lib.ucc_mailbox_push.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
-            ctypes.c_void_p, ctypes.c_size_t]
-        lib.ucc_mailbox_post_recv.restype = ctypes.c_uint64
-        lib.ucc_mailbox_post_recv.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
-            ctypes.c_void_p, ctypes.c_size_t]
-        lib.ucc_req_test.restype = ctypes.c_int
-        lib.ucc_req_test.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.ucc_req_nbytes.restype = ctypes.c_uint64
-        lib.ucc_req_nbytes.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        try:
-            lib.ucc_req_truncated.restype = ctypes.c_int
-            lib.ucc_req_truncated.argtypes = [ctypes.c_void_p,
-                                              ctypes.c_uint64]
-        except AttributeError:   # stale .so without the symbol
-            lib.ucc_req_truncated = None
-        lib.ucc_req_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.ucc_mpmc_create.restype = ctypes.c_void_p
-        lib.ucc_mpmc_create.argtypes = [ctypes.c_uint64]
-        lib.ucc_mpmc_destroy.argtypes = [ctypes.c_void_p]
+        abi_fn = getattr(lib, "ucc_abi_version", None)
+        if abi_fn is not None:
+            abi_fn.restype = ctypes.c_uint64
+        abi = int(abi_fn()) if abi_fn is not None else 0
+        if abi != ABI_VERSION:
+            # stale binary that mtime could not catch (e.g. checkout with
+            # preserved timestamps). dlopen caches by path, so a rebuild
+            # cannot take effect in THIS process — force-rebuild (mtimes
+            # say up-to-date here, plain make would no-op) for the next
+            # process and fall back loudly now.
+            rebuilt = _build(force=True)
+            logger.warning(
+                "native core ABI mismatch (got %s, want %s): %s — using "
+                "the python matcher for this process", abi, ABI_VERSION,
+                "rebuilt; restart to enable" if rebuilt
+                else f"rebuild failed (see {_BUILD_LOG})")
+            return None
+        u64 = ctypes.c_uint64
+        vp = ctypes.c_void_p
+        lib.ucc_mailbox_create.restype = vp
+        lib.ucc_mailbox_destroy.argtypes = [vp]
+        lib.ucc_mailbox_pub_base.restype = vp
+        lib.ucc_mailbox_pub_base.argtypes = [vp]
+        lib.ucc_mailbox_push.restype = u64
+        lib.ucc_mailbox_push.argtypes = [vp, u64, u64, u64, vp, u64, u64]
+        lib.ucc_mailbox_post_recv.restype = u64
+        lib.ucc_mailbox_post_recv.argtypes = [vp, u64, u64, u64, vp, u64]
+        lib.ucc_mailbox_fence.restype = u64
+        lib.ucc_mailbox_fence.argtypes = [vp, u64, u64]
+        lib.ucc_mailbox_purge.restype = u64
+        lib.ucc_mailbox_purge.argtypes = [vp]
+        lib.ucc_req_poll.restype = u64
+        lib.ucc_req_poll.argtypes = [vp, u64]
+        lib.ucc_req_test_many.restype = u64
+        lib.ucc_req_test_many.argtypes = [vp, u64, ctypes.POINTER(u64),
+                                          ctypes.POINTER(u64)]
+        lib.ucc_req_nbytes.restype = u64
+        lib.ucc_req_nbytes.argtypes = [vp, u64]
+        lib.ucc_req_sent_nbytes.restype = u64
+        lib.ucc_req_sent_nbytes.argtypes = [vp, u64]
+        lib.ucc_req_cancel.restype = ctypes.c_int
+        lib.ucc_req_cancel.argtypes = [vp, u64]
+        lib.ucc_req_free.argtypes = [vp, u64]
+        lib.ucc_req_free_many.argtypes = [vp, u64, ctypes.POINTER(u64)]
+        lib.ucc_mpmc_create.restype = vp
+        lib.ucc_mpmc_create.argtypes = [u64]
+        lib.ucc_mpmc_destroy.argtypes = [vp]
         lib.ucc_mpmc_push.restype = ctypes.c_int
-        lib.ucc_mpmc_push.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ucc_mpmc_push.argtypes = [vp, u64]
         lib.ucc_mpmc_pop.restype = ctypes.c_int
-        lib.ucc_mpmc_pop.argtypes = [ctypes.c_void_p,
-                                     ctypes.POINTER(ctypes.c_uint64)]
+        lib.ucc_mpmc_pop.argtypes = [vp, ctypes.POINTER(u64)]
+        global _EXT
+        _EXT = _load_ext()
         _LIB = lib
-        logger.info("native runtime core loaded: %s", _SO_PATH)
+        logger.info("native runtime core v%d loaded: %s (hot path: %s)",
+                    abi, _SO_PATH,
+                    "fastcall ext" if _EXT is not None else "ctypes")
         return _LIB
 
 
@@ -105,13 +390,40 @@ def available() -> bool:
 # native requests/mailbox with the python transport's interface
 # ---------------------------------------------------------------------------
 
+class _DoneSend:
+    """Send request that completed inside the push call (direct delivery,
+    eager staging copy, or fenced discard): the sender may reuse its
+    buffer immediately."""
+
+    __slots__ = ("cancelled",)
+    done = True
+    _done = True          # test_many/poll_pending filter on _done
+
+    def __init__(self):
+        self.cancelled = False
+
+    def test(self) -> bool:
+        return True
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
 class NativeSendReq:
-    __slots__ = ("mb", "rid", "_done")
+    """Rendezvous send: parked zero-copy in the peer's unexpected queue;
+    completes when a matching recv lands it (the C side frees the request
+    at delivery — a bumped generation reads as complete). The mailbox
+    keeps the payload alive (``_send_keep``) until then."""
+
+    __slots__ = ("mb", "rid", "_idx", "_gen", "_done", "cancelled")
 
     def __init__(self, mb: "NativeMailbox", rid: int):
         self.mb = mb
         self.rid = rid
+        self._idx = rid & _IDX_MASK
+        self._gen = rid >> _SLOT_BITS
         self._done = False
+        self.cancelled = False
 
     @property
     def done(self) -> bool:
@@ -120,25 +432,48 @@ class NativeSendReq:
     def test(self) -> bool:
         if self._done:
             return True
-        if self.mb.ptr is None:       # mailbox destroyed mid-flight
+        mb = self.mb
+        pub = mb._pub
+        if pub is None:               # mailbox destroyed mid-flight
             self._done = True
             return True
-        if self.mb.lib.ucc_req_test(self.mb.ptr, self.rid):
-            self.mb.lib.ucc_req_free(self.mb.ptr, self.rid)
-            self._done = True
+        v = pub[self._idx]
+        if (v >> 32) != self._gen or (v & 7):
+            # confirm with an acquire-ordered ffi load before releasing
+            # the payload keepalive: the receiver's delivery memcpy must
+            # be visible-complete on weakly-ordered architectures before
+            # the sender may reuse/free the buffer (one ffi per request
+            # lifetime; see NativeRecvReq.test). ptr snapshot: a racing
+            # destroy() nulls mb.ptr, and the C mailbox itself is parked,
+            # not freed, so a stale snapshot stays safe to poll.
+            ptr = mb.ptr
+            if ptr is None or int(mb.lib.ucc_req_poll(ptr, self.rid)):
+                mb._send_keep.pop(self.rid, None)
+                self._done = True
         return self._done
+
+    def cancel(self) -> None:
+        """Stop waiting. The message itself cannot be unsent (it sits in
+        the peer's unexpected queue); the payload keepalive stays with
+        the mailbox so a late match cannot read freed memory."""
+        self.cancelled = True
+        self._done = True
 
 
 class NativeRecvReq:
-    __slots__ = ("mb", "rid", "dst_keepalive", "_done", "nbytes", "error")
+    __slots__ = ("mb", "rid", "_idx", "_gen", "dst_keepalive", "_done",
+                 "nbytes", "error", "cancelled")
 
     def __init__(self, mb: "NativeMailbox", rid: int, dst: np.ndarray):
         self.mb = mb
         self.rid = rid
+        self._idx = rid & _IDX_MASK
+        self._gen = rid >> _SLOT_BITS
         self.dst_keepalive = dst     # pin the buffer the C side writes into
         self._done = False
         self.nbytes = 0
         self.error = None
+        self.cancelled = False
 
     @property
     def done(self) -> bool:
@@ -147,59 +482,343 @@ class NativeRecvReq:
     def test(self) -> bool:
         if self._done:
             return True
-        if self.mb.ptr is None:       # mailbox destroyed mid-flight
+        pub = self.mb._pub
+        if pub is None:               # mailbox destroyed mid-flight
             self._done = True
             return True
-        if self.mb.lib.ucc_req_test(self.mb.ptr, self.rid):
-            self.nbytes = int(self.mb.lib.ucc_req_nbytes(self.mb.ptr,
-                                                         self.rid))
-            trunc_fn = getattr(self.mb.lib, "ucc_req_truncated", None)
-            if trunc_fn is not None and trunc_fn(self.mb.ptr, self.rid):
-                self.error = (f"message truncated: send exceeded the "
-                              f"{self.dst_keepalive.size}-byte recv buffer")
-            self.mb.lib.ucc_req_free(self.mb.ptr, self.rid)
+        v = pub[self._idx]
+        if (v >> 32) != self._gen:
+            self._done = True         # freed under us (endpoint purge)
+            return True
+        if not (v & 7):
+            return False
+        # the mapped read is a completion HINT: confirm through one
+        # acquire-ordered ffi load before touching the delivered payload,
+        # so the dst bytes (written GIL-released by the sender's thread)
+        # are visible on weakly-ordered architectures too. One ffi per
+        # request LIFETIME, not per poll; free on x86. ptr snapshot: a
+        # racing destroy() nulls mb.ptr, and the C mailbox is parked,
+        # not freed, so a stale snapshot stays safe to poll.
+        mb = self.mb
+        ptr = mb.ptr
+        if ptr is None:
             self._done = True
-        return self._done
+            return True
+        v = int(mb.lib.ucc_req_poll(ptr, self.rid))
+        if v == 0:
+            return False
+        self._finish(v, ptr)
+        return True
+
+    def _finish(self, v: int, ptr=None) -> None:
+        """Harvest a completed pub word and free the C-side request."""
+        mb = self.mb
+        ptr = ptr if ptr is not None else mb.ptr
+        st = v & 7
+        nb = (v >> 3) & _NB_MAX
+        if nb == _NB_MAX and ptr is not None:  # saturated: exact size
+            nb = int(mb.lib.ucc_req_nbytes(ptr, self.rid))
+        self.nbytes = nb
+        if st == _ST_TRUNCATED:
+            sent = int(mb.lib.ucc_req_sent_nbytes(ptr, self.rid)) \
+                if ptr is not None else 0
+            # counts are BYTES: the C side sees only byte lengths, and
+            # dst may carry any dtype (the python matcher says "elements"
+            # because it always flattens to uint8 first)
+            self.error = (f"message truncated: sent {sent} bytes into "
+                          f"a {self.dst_keepalive.nbytes}-byte recv "
+                          f"buffer")
+        elif st == _ST_FENCED:
+            self.error = "fenced: stale team epoch"
+            self.cancelled = True
+        elif st == _ST_CANCELED:
+            self.error = self.error or "canceled"
+            self.cancelled = True
+        mb._free(self.rid)
+        self._done = True
+
+    def cancel(self) -> None:
+        """Withdraw a posted recv: the native matcher skips cancelled
+        entries at match time, under the same shard lock that delivers —
+        cancel-vs-match cannot interleave, and a req that was already
+        delivered stays delivered (python RecvReq.cancel contract)."""
+        if self._done:
+            self.cancelled = True
+            return
+        mb = self.mb
+        ptr = mb.ptr                  # snapshot: see test()
+        if ptr is None:
+            self.error = self.error or "canceled"
+            self.cancelled = True
+            self._done = True
+            return
+        if mb.lib.ucc_req_cancel(ptr, self.rid):
+            self.error = self.error or "canceled"
+            self.cancelled = True
+            self._done = True
+            mb._free(self.rid)
+        else:
+            self.test()               # already delivered/fenced: harvest
+            self.cancelled = True
 
 
 class NativeMailbox:
-    """C++ tag matcher behind the Mailbox interface."""
+    """C++ tag matcher behind the Mailbox interface (v2)."""
 
     def __init__(self):
-        self.lib = get_lib()
-        if self.lib is None:
+        lib = get_lib()
+        if lib is None:
             raise RuntimeError("native core unavailable")
-        self.ptr = self.lib.ucc_mailbox_create()
-        self._key_cache: Dict[Any, bytes] = {}
+        self.lib = lib
+        self.ptr = lib.ucc_mailbox_create()
+        if not self.ptr:
+            raise RuntimeError("native mailbox allocation failed")
+        # completion-publication window: one aligned u64 load per poll
+        base = lib.ucc_mailbox_pub_base(self.ptr)
+        self._pub_buf = (ctypes.c_uint64 * _MAX_SLOTS).from_address(base)
+        # ctypes exports format '<Q' which memoryview cannot index; the
+        # double cast yields a plain machine-native u64 view (one aligned
+        # load per poll, no ffi)
+        self._pub = memoryview(self._pub_buf).cast("B").cast("Q")
+        # key interning: non-integer key parts -> small ids, once
+        self._team_ids = {}
+        self._tag_ids = {}
+        self._intern_mu = threading.Lock()
+        #: rndv payload keepalives: the C side parks a raw pointer, so the
+        #: mailbox must pin the ndarray until delivery (popped at the
+        #: sender's completion poll; cleared by purge/destroy)
+        self._send_keep = {}
+        self._free_pending = []
+        self._free_mu = threading.Lock()
+        # hot-path entry points bound once; the fastcall ext (when built)
+        # replaces ctypes marshalling with the buffer protocol
+        self._push_fn = lib.ucc_mailbox_push
+        self._post_fn = lib.ucc_mailbox_post_recv
+        ext = _EXT
+        self._ext_push = ext.push if ext is not None else None
+        self._ext_post = ext.post_recv if ext is not None else None
 
-    def _key_bytes(self, key) -> bytes:
-        kb = self._key_cache.get(key)
-        if kb is None:
-            kb = pickle.dumps(key)
-            if len(self._key_cache) < 65536:
-                self._key_cache[key] = kb
-        return kb
+    # -- key packing ---------------------------------------------------
+    def _intern(self, table: dict, obj, base: int) -> int:
+        v = table.get(obj)
+        if v is None:
+            with self._intern_mu:
+                v = table.setdefault(obj, base + len(table))
+        return v
 
-    def push_native(self, key, data: np.ndarray) -> NativeSendReq:
-        kb = self._key_bytes(key)
-        data = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
-        rid = self.lib.ucc_mailbox_push(
-            self.ptr, kb, len(kb),
-            data.ctypes.data_as(ctypes.c_void_p), data.nbytes)
-        return NativeSendReq(self, rid)
+    def _intern_team(self, team_key) -> int:
+        """Team ids come from a PROCESS-GLOBAL counter, not the table
+        size: the C mailbox is recycled across endpoint lives (see
+        destroy), and per-life ids restarting at 1 would let a stale
+        message pushed by a racing sender match a NEW endpoint's recv
+        whose packed key words happen to coincide. Globally unique team
+        ids make cross-life key collision impossible."""
+        v = self._team_ids.get(team_key)
+        if v is None:
+            global _NEXT_TEAM_ID
+            with _TEAM_ID_LOCK:
+                v = self._team_ids.get(team_key)
+                if v is None:
+                    v = _NEXT_TEAM_ID
+                    _NEXT_TEAM_ID += 1
+                    self._team_ids[team_key] = v
+        return v
+
+    def _pack(self, key):
+        """TagKey -> three u64 words. The canonical host-TL key is
+        (team_key, epoch, coll_tag, slot, src); anything else (tests,
+        one-sided replies) is interned wholesale as a team id with
+        epoch 0, which keeps fence semantics consistent."""
+        try:
+            team, epoch, tag, slot, src = key
+        except (TypeError, ValueError):
+            return self._pack_other(key)
+        if type(epoch) is not int or type(slot) is not int \
+                or type(src) is not int:
+            return self._pack_other(key)
+        if type(tag) is not int:
+            if isinstance(tag, tuple) and len(tag) == 2 \
+                    and tag[0] == "svc" and type(tag[1]) is int:
+                tag = _SVC_TAG_BASE | (tag[1] & 0xFFFFFFFFFFFF)
+            else:
+                tag = self._intern(self._tag_ids, tag, _TUPLE_TAG_BASE)
+        team_id = self._intern_team(team)
+        return ((team_id << 32) | (epoch & 0xFFFFFFFF), tag,
+                ((slot & 0xFFFFFFFF) << 32) | (src & 0xFFFFFFFF))
+
+    def _pack_other(self, key):
+        return (self._intern_team(key) << 32, 0, 0)
+
+    def team_id(self, team_key) -> int:
+        return self._intern_team(team_key)
+
+    # -- data path -----------------------------------------------------
+    def push_native(self, key, data: np.ndarray,
+                    eager_limit: Optional[int] = None):
+        """Send: returns ``(req, kind)`` with kind in direct / eager /
+        rndv / fenced (the python Mailbox.send contract). Direct sends
+        deliver copy-free into the posted dst inside this call."""
+        ptr = self.ptr                # snapshot: see NativeRecvReq.test
+        if ptr is None:
+            # endpoint already closed: the message has nowhere to land
+            # (python-matcher parity: a send into an orphaned mailbox
+            # completes and is never read)
+            return _DoneSend(), "eager"
+        if eager_limit is None:
+            eager_limit = _eager_limit()
+        a, b, c = self._pack(key)
+        ext = self._ext_push
+        if ext is not None:
+            try:
+                ret = ext(ptr, a, b, c, data, eager_limit)
+            except (BufferError, ValueError):
+                data = np.ascontiguousarray(data)
+                ret = ext(ptr, a, b, c, data, eager_limit)
+        else:
+            if not data.flags["C_CONTIGUOUS"]:
+                data = np.ascontiguousarray(data)
+            ret = self._push_fn(ptr, a, b, c, data.ctypes.data,
+                                data.nbytes, eager_limit)
+        kind = ret & 7
+        if kind == 2:                 # rndv: parked zero-copy
+            rid = ret >> 3
+            self._send_keep[rid] = data
+            return NativeSendReq(self, rid), "rndv"
+        return _DoneSend(), _KIND_STR[kind]
 
     def post_recv_native(self, key, dst: np.ndarray) -> NativeRecvReq:
-        kb = self._key_bytes(key)
-        dst_u8 = dst.reshape(-1).view(np.uint8)
-        rid = self.lib.ucc_mailbox_post_recv(
-            self.ptr, kb, len(kb),
-            dst_u8.ctypes.data_as(ctypes.c_void_p), dst_u8.nbytes)
-        return NativeRecvReq(self, rid, dst_u8)
+        ptr = self.ptr                # snapshot: see NativeRecvReq.test
+        if ptr is None:
+            raise RuntimeError("native mailbox is closed")
+        a, b, c = self._pack(key)
+        ext = self._ext_post
+        if ext is not None:
+            try:
+                rid = ext(ptr, a, b, c, dst)
+            except (BufferError, ValueError) as e:
+                # same contract as the python matcher's .view(np.uint8)
+                raise ValueError(
+                    f"recv destination must be C-contiguous and "
+                    f"writable: {e}") from e
+        else:
+            if not dst.flags["C_CONTIGUOUS"] or not dst.flags["WRITEABLE"]:
+                # same contract as the ext's PyBUF_WRITABLE and the
+                # python matcher's slice-assign: a read-only dst must
+                # fail loudly, not be scribbled through .ctypes.data
+                raise ValueError("recv destination must be C-contiguous "
+                                 "and writable")
+            rid = self._post_fn(ptr, a, b, c, dst.ctypes.data,
+                                dst.nbytes)
+        if rid == 0:
+            raise RuntimeError("native mailbox request slots exhausted")
+        return NativeRecvReq(self, rid, dst)
+
+    def fence(self, team_key, min_epoch: int) -> int:
+        """Epoch-fence *team_key* (see transport.Mailbox.fence): purge
+        parked entries below *min_epoch* and discard late stale arrivals
+        at the match boundary. Returns the number of purged entries."""
+        ptr = self.ptr                # snapshot: see NativeRecvReq.test
+        if ptr is None:
+            return 0
+        return int(self.lib.ucc_mailbox_fence(
+            ptr, self.team_id(team_key), min_epoch))
+
+    # -- request plumbing ----------------------------------------------
+    def _free(self, rid: int) -> None:
+        """Batched request free: one ffi call per 256 completions."""
+        with self._free_mu:
+            fp = self._free_pending
+            fp.append(rid)
+            ptr = self.ptr            # snapshot: see NativeRecvReq.test
+            if len(fp) >= 256 and ptr:
+                n = len(fp)
+                arr = (ctypes.c_uint64 * n)(*fp)
+                self.lib.ucc_req_free_many(ptr, n, arr)
+                fp.clear()
+
+    def test_many(self, reqs):
+        """Batch-poll native requests in ONE ffi call (ucc_req_test_many);
+        completed ones are finished in place. Returns the still-pending
+        subset. The mapped pub window makes per-request ``test()`` just
+        as cheap in-process; this entry point serves progress loops that
+        poll many requests at once and the no-mapping fallback."""
+        # a python-side-completed request (e.g. a cancelled rndv send)
+        # can have a still-pending C slot: batching it would report it
+        # pending forever, diverging from req.test()
+        reqs = [r for r in reqs if not r._done]
+        n = len(reqs)
+        if n == 0:
+            return []
+        ptr = self.ptr                # snapshot: see NativeRecvReq.test
+        if ptr is None:
+            # mailbox destroyed mid-flight: per-request test() marks each
+            # request done in this state — returning [] without doing the
+            # same would leave permanently in-progress handles
+            for r in reqs:
+                r.test()
+            return []
+        rids = (ctypes.c_uint64 * n)(*[r.rid for r in reqs])
+        out = (ctypes.c_uint64 * n)()
+        self.lib.ucc_req_test_many(ptr, n, rids, out)
+        pending = []
+        for i, r in enumerate(reqs):
+            v = int(out[i])
+            if v == 0:
+                pending.append(r)
+            elif isinstance(r, NativeRecvReq):
+                if not r._done:
+                    r._finish(v)
+            else:
+                r.test()
+        return pending
+
+    def purge(self) -> int:
+        """Reclaim every outstanding request and parked message (used at
+        endpoint destroy/finalize — abandoned requests otherwise live
+        until mailbox destroy). Outstanding request handles read as
+        complete afterwards."""
+        ptr = self.ptr                # snapshot: see NativeRecvReq.test
+        if ptr is None:
+            return 0
+        with self._free_mu:
+            self._free_pending.clear()
+        n = int(self.lib.ucc_mailbox_purge(ptr))
+        # only AFTER the C purge (serialized on the shard locks) has
+        # dropped every parked Unexp.ptr may the rndv payloads be
+        # released — clearing first would let a racing post_recv memcpy
+        # from a freed buffer
+        self._send_keep.clear()
+        return n
 
     def destroy(self) -> None:
+        """Release the C mailbox. The C side purges and PARKS it for
+        recycling rather than freeing, so a thread that snapshotted the
+        pointer (or the mapped pub window) just before this call polls
+        bumped generations — "freed == complete" — never freed heap."""
         if self.ptr:
-            self.lib.ucc_mailbox_destroy(self.ptr)
-            self.ptr = None
+            ptr, self.ptr = self.ptr, None
+            self._pub = None
+            self._pub_buf = None
+            self.lib.ucc_mailbox_destroy(ptr)
+            # rndv keepalives released only after the destroy-time purge
+            # has removed every parked Unexp.ptr (see purge())
+            self._send_keep.clear()
+
+
+def poll_pending(reqs):
+    """Poll a mixed request list, batching native requests per mailbox
+    through ``ucc_req_test_many``; returns the still-pending subset."""
+    groups = {}
+    pending = []
+    for r in reqs:
+        mb = getattr(r, "mb", None)
+        if mb is not None and getattr(r, "rid", 0) and not r._done:
+            groups.setdefault(id(mb), (mb, []))[1].append(r)
+        elif not r.test():
+            pending.append(r)
+    for mb, group in groups.values():
+        pending.extend(mb.test_many(group))
+    return pending
 
 
 class NativeMpmcQueue:

@@ -25,7 +25,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ... import native as _native   # registers UCC_NATIVE (ucc_info -cf)
 from ...status import Status
+
+del _native
 
 #: matching key: (team_key, epoch, coll_tag, slot, src_uid). The epoch
 #: field is the team's recovery epoch (0 for every team that never
@@ -305,7 +308,7 @@ class InProcTransport:
     EAGER_THRESHOLD = _DEFAULT_EAGER_LIMIT
 
     def __init__(self, use_native: Optional[bool] = None,
-                 default_native: bool = False):
+                 default_native: bool = True):
         self.uid = uuid.uuid4().hex
         self.mailbox = Mailbox()
         self.EAGER_THRESHOLD = eager_limit_from_env()
@@ -316,20 +319,30 @@ class InProcTransport:
         self.n_rndv = 0          # unexpected zero-copy rendezvous views
         self.n_fenced = 0        # stale-epoch sends discarded at the fence
         self.native = None
+        forced = False
         if use_native is None:
             import os
-            # measured on this machine (tools/native_bench.py, numbers in
-            # BASELINE.md): the ctypes-bound C++ matcher is ~2x slower
-            # than the in-GIL python matcher for single-threaded progress
-            # (per-call ffi + key serialization dominate) but 3.6x FASTER
-            # when 8 OS threads drive progress concurrently (GIL-released
-            # matching). Callers set default_native for ThreadMode.
-            # MULTIPLE; UCC_TL_SHM_NATIVE overrides in either direction.
-            env = os.environ.get("UCC_TL_SHM_NATIVE", "").lower()
-            if env:
-                use_native = env in ("y", "yes", "1", "on")
+            # the v2 core (native/ucc_tpu_core.cc) reaches contract
+            # parity with the python Mailbox — copy-free delivery,
+            # eager/rndv split, cancel-skip, epoch fences — and polls
+            # completions through a mapped publication window (no ffi on
+            # the poll path), so it is the default in BOTH thread modes,
+            # including under UCC_FT=shrink. GIL-released matching still
+            # wins big when many OS threads drive progress concurrently
+            # (tools/native_bench.py). UCC_TL_SHM_NATIVE overrides in
+            # either direction.
+            env = os.environ.get("UCC_TL_SHM_NATIVE", "").strip().lower()
+            if env and env != "auto":   # auto = same as unset
+                from ...utils.config import parse_bool
+                try:
+                    use_native = parse_bool(env)
+                    forced = use_native
+                except ValueError:      # unrecognized: behave as auto
+                    use_native = default_native
             else:
                 use_native = default_native
+        else:
+            forced = bool(use_native)
         if use_native:
             try:
                 from ...native import NativeMailbox, available
@@ -337,13 +350,16 @@ class InProcTransport:
                     self.native = NativeMailbox()
             except Exception:  # noqa: BLE001 - fall back to python matcher
                 self.native = None
-            if self.native is None:
+            if self.native is None and forced:
+                # only an EXPLICIT request warns: the default-on path must
+                # stay silent on toolchain-less machines (debug-logged by
+                # ucc_tpu.native instead)
                 from ...utils.log import get_logger
                 get_logger("tl_shm").warning(
                     "native matcher requested but unavailable (no source "
-                    "checkout / build failed) — falling back to the "
-                    "python matcher; ThreadMode.MULTIPLE loses ~3.6x "
-                    "(tools/native_bench.py)")
+                    "checkout / build failed, see native/build.log) — "
+                    "falling back to the python matcher "
+                    "(tools/native_bench.py quantifies the cost)")
         with _SHM_LOCK:
             _SHM_WORLD[self.uid] = self
 
@@ -357,26 +373,36 @@ class InProcTransport:
             return _SHM_WORLD.get(addr.decode())
 
     # -- data path -----------------------------------------------------
+    def _count_send(self, kind: str) -> None:
+        if kind == "direct":
+            self.n_direct += 1
+        elif kind == "eager":
+            self.n_eager += 1
+        elif kind == "rndv":
+            self.n_rndv += 1
+        else:
+            self.n_fenced += 1
+
     def send_nb(self, peer: "InProcTransport", key: TagKey,
                 data: np.ndarray) -> SendReq:
         if peer.native is not None:
             # matching lives in the RECEIVER's mailbox: route by the peer's
             # matcher only (a mixed pair must not split send/recv across
-            # python and native matchers)
-            return peer.native.push_native(key, data)
-        # copy-free fast path: a send whose recv is already posted lands
-        # directly in the destination buffer — the eager staging copy is
-        # paid only for genuinely unexpected small messages
-        req, kind = peer.mailbox.send(key, data.reshape(-1).view(np.uint8),
-                                      self.EAGER_THRESHOLD)
-        if kind == "direct":
-            self.n_direct += 1
-        elif kind == "eager":
-            self.n_eager += 1
-        elif kind == "fenced":
-            self.n_fenced += 1
+            # python and native matchers). The native push applies the
+            # same copy-free / eager / rndv / fenced protocol as the
+            # python Mailbox.send below, with the delivery memcpy done
+            # GIL-released in C++.
+            req, kind = peer.native.push_native(key, data,
+                                                self.EAGER_THRESHOLD)
         else:
-            self.n_rndv += 1
+            # copy-free fast path: a send whose recv is already posted
+            # lands directly in the destination buffer — the eager
+            # staging copy is paid only for genuinely unexpected small
+            # messages
+            req, kind = peer.mailbox.send(
+                key, data.reshape(-1).view(np.uint8),
+                self.EAGER_THRESHOLD)
+        self._count_send(kind)
         return req
 
     def recv_nb(self, key: TagKey, dst: np.ndarray) -> RecvReq:
@@ -389,16 +415,17 @@ class InProcTransport:
 
     def fence(self, team_key, min_epoch: int) -> int:
         """Epoch-fence *team_key* on this endpoint's receive side (see
-        Mailbox.fence). The native matcher has no fence support — teams
-        running rank-failure recovery keep the python matcher (documented
-        FT limitation); the warning makes a silent mismatch loud."""
+        Mailbox.fence). Routed to the native matcher's fence when this
+        endpoint matches natively — the v2 core purges parked stale
+        entries and discards late stale arrivals at the match boundary,
+        so UCC_FT=shrink no longer forces the python matcher (the PR-4
+        capability fork is closed). The python mailbox is fenced too:
+        it is unused while a native matcher is attached, but keeping both
+        floors consistent is free."""
+        purged = self.mailbox.fence(team_key, min_epoch)
         if self.native is not None:
-            from ...utils.log import get_logger
-            get_logger("tl_shm").warning(
-                "epoch fence requested on a native-matcher endpoint; "
-                "stale-epoch messages in the native mailbox are NOT "
-                "purged (UCC_FT=shrink requires the python matcher)")
-        return self.mailbox.fence(team_key, min_epoch)
+            purged += self.native.fence(team_key, min_epoch)
+        return purged
 
     def progress(self) -> None:
         pass  # delivery happens inline at send/recv

@@ -24,6 +24,8 @@ from .host.config_fields import HOST_ALG_FIELDS
 from .host.team import HostTlTeam
 from .host.transport import InProcTransport
 
+from ..utils.config import parse_bool, parse_string
+
 TL_SHM_CONFIG = register_table(ConfigTable(
     prefix="TL_SHM_", name="tl/shm", fields=HOST_ALG_FIELDS + [
         ConfigField("EAGER_THRESH", "auto", "eager copy threshold for "
@@ -31,20 +33,34 @@ TL_SHM_CONFIG = register_table(ConfigTable(
                     "rendezvous (sends matching a posted recv are always "
                     "copy-free). auto = defer to UCC_HOST_EAGER_LIMIT "
                     "(default 8k)", parse_memunits),
+        ConfigField("NATIVE", "auto", "use the native C++ tag matcher "
+                    "(v2: copy-free delivery, eager/rndv split at the "
+                    "eager limit, cancel-skip, epoch fences — FT-safe) "
+                    "for this endpoint. auto = on when the core is "
+                    "built, in both thread modes; y/n forces. The "
+                    "process-wide kill switch is UCC_NATIVE",
+                    parse_string),
     ]))
 
 
 class TlShmContext(BaseContext):
     def __init__(self, comp_lib, core_context, config):
         super().__init__(comp_lib, core_context, config)
-        # GIL-released C++ matching wins 3.6x when many OS threads drive
-        # progress concurrently (tools/native_bench.py, BASELINE.md), so
-        # MULTIPLE defaults to the native matcher; single-threaded it
-        # loses ~2x to the in-GIL matcher and stays Python. The
-        # UCC_TL_SHM_NATIVE env knob still overrides either way.
-        from ..constants import ThreadMode
-        mt = core_context.lib.params.thread_mode == ThreadMode.MULTIPLE
-        self.transport = InProcTransport(default_native=mt)
+        # the v2 native core (copy-free matching, epoch fences, mapped
+        # completion window instead of per-poll ffi) is the default in
+        # BOTH thread modes — single-threaded it holds parity with the
+        # in-GIL python matcher and GIL-released matching wins big under
+        # concurrent progress threads (tools/native_bench.py). The
+        # UCC_TL_SHM_NATIVE knob (env or config file) overrides.
+        use_native = None
+        if config is not None:
+            try:
+                nv = str(config.get("native")).strip().lower()
+                if nv and nv != "auto":
+                    use_native = parse_bool(nv)
+            except (KeyError, ValueError):  # unrecognized: behave as auto
+                pass
+        self.transport = InProcTransport(use_native=use_native)
         if config is not None:
             from ..utils.config import SIZE_AUTO
             if config.eager_thresh != SIZE_AUTO:
