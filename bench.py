@@ -46,7 +46,10 @@ def _force_cpu_if_requested() -> None:
 
 
 def _make_job(n):
-    """Full-stack job: one lib/context per rank, one team over all ranks."""
+    """Full-stack job: one lib/context per rank, one team over all ranks.
+    Returns (ctxs, teams, create_s) — team-create latency rides every
+    bench record's detail so the scale trajectory (ISSUE 8: bootstrap +
+    activation cost) is tracked across rounds like busbw."""
     import threading
 
     import ucc_tpu
@@ -64,6 +67,7 @@ def _make_job(n):
         t.start()
     for t in ths:
         t.join()
+    t0 = time.perf_counter()
     tw = ThreadOobWorld(n)
     teams = [c.create_team_post(TeamParams(oob=tw.endpoint(i)))
              for i, c in enumerate(ctxs)]
@@ -73,7 +77,7 @@ def _make_job(n):
             c.progress()
         if all(s == Status.OK for s in sts):
             break
-    return ctxs, teams
+    return ctxs, teams, time.perf_counter() - t0
 
 
 def _persistent_reqs(coll: str, teams, ctxs, srcs, count: int, n: int):
@@ -281,7 +285,8 @@ def main(sweep: bool = False, quant: bool = False) -> None:
     n = len(devices)
     on_accel = devices[0].platform not in ("cpu",)
     mesh = jax.make_mesh((n,), ("r",))
-    ctxs, teams = _make_job(n)
+    ctxs, teams, team_create_s = _make_job(n)
+    team_create_ms = round(team_create_s * 1e3, 1)
 
     count = (16 << 20) if on_accel else (1 << 20)   # 64 MiB / 4 MiB f32
     iters = 20 if on_accel else 30
@@ -312,7 +317,8 @@ def main(sweep: bool = False, quant: bool = False) -> None:
                                "platform": plat, "alg": alg,
                                "ucc_lat_ms": round(ut * 1e3, 3),
                                "raw_lat_ms": round(rt * 1e3, 3),
-                               "mc_pool": pool}}
+                               "mc_pool": pool,
+                               "team_create_ms": team_create_ms}}
             else:
                 # 1 chip: busbw is identically 0 (the 2(n-1)/n factor) —
                 # the honest per-size number is e2e latency vs raw
@@ -324,7 +330,8 @@ def main(sweep: bool = False, quant: bool = False) -> None:
                     "detail": {"n_chips": n, "msg_bytes": cnt * 4,
                                "platform": plat, "alg": alg,
                                "raw_lat_us": round(rt * 1e6, 2),
-                               "mc_pool": pool}}
+                               "mc_pool": pool,
+                               "team_create_ms": team_create_ms}}
             if quant and coll == "allreduce" and n > 1:
                 rec["detail"]["quant"] = _quant_detail(teams, ctxs,
                                                        devices, cnt, ub)
@@ -351,6 +358,7 @@ def main(sweep: bool = False, quant: bool = False) -> None:
                 "raw_psum_lat_ms": round(raw_time * 1e3, 3),
                 "raw_busbw_GBps": round(raw_bw, 3),
                 "mc_pool": pool,
+                "team_create_ms": team_create_ms,
             },
         }
         if quant:

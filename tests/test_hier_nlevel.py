@@ -1,0 +1,318 @@
+"""N-level hierarchy topology tests (ISSUE 8 satellite): HierTree /
+TeamTopo.node_layout / sbgp construction on ASYMMETRIC layouts (unequal
+ranks-per-node, single-rank nodes, one-node pods) — previously only the
+symmetric two-level case was exercised — plus end-to-end nlvl
+collectives over an asymmetric 3-level (chip/node/pod) simulated team.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import (BufferInfo, CollArgs, CollArgsFlags, CollType, DataType,
+                     ReductionOp)
+from ucc_tpu.topo.proc_info import ProcInfo, fake_topology
+from ucc_tpu.topo.sbgp import SbgpStatus, SbgpType
+from ucc_tpu.topo.topo import ContextTopo, HierTree, TeamTopo
+from ucc_tpu.utils.ep_map import EpMap
+
+from harness import UccJob
+
+
+def _paths(node_of, pod_of=None):
+    """Per-rank attribute paths from a rank->node map (and optional
+    node->pod map), hashed the way the context fake-topology hook does."""
+    import zlib
+    out = []
+    for r, node in enumerate(node_of):
+        hh = zlib.crc32(f"fake-node-{node}".encode())
+        if pod_of is None:
+            out.append((hh,))
+        else:
+            out.append((zlib.crc32(f"fake-pod-{pod_of[node]}".encode()), hh))
+    return out
+
+
+class TestHierTreePaths:
+    """HierTree from raw paths: arbitrary asymmetric layouts without a
+    context."""
+
+    def test_two_level_asymmetric(self):
+        # nodes of 2,1,3: a single-rank node in the middle
+        tree = HierTree(_paths([0, 0, 1, 2, 2, 2]), my_rank=0)
+        assert tree.n_levels == 2
+        assert tree.level(0).groups == [[0, 1], [2], [3, 4, 5]]
+        assert tree.level(1).groups == [[0, 2, 3]]     # node leaders
+        assert tree.tree_order == [0, 1, 2, 3, 4, 5]
+
+    def test_three_level_with_one_node_pod(self):
+        # pods: nodes {0,1} -> pod 0, node {2} -> pod 1 (one-node pod)
+        tree = HierTree(_paths([0, 0, 1, 2, 2, 2], pod_of=[0, 0, 1]),
+                        my_rank=0)
+        assert tree.n_levels == 3
+        assert tree.level(0).groups == [[0, 1], [2], [3, 4, 5]]
+        assert tree.level(1).groups == [[0, 2], [3]]   # per-pod leaders
+        assert tree.level(2).groups == [[0, 3]]        # pod leaders
+        # rank 4's representative chain: itself -> node leader 3 -> 3
+        assert tree.rep(0, 4) == 4
+        assert tree.rep(1, 4) == 3
+        assert tree.rep(2, 4) == 3
+        assert not tree.is_member(1, 4)
+        assert tree.is_member(1, 3) and tree.is_member(2, 3)
+
+    def test_all_single_rank_nodes(self):
+        tree = HierTree(_paths([0, 1, 2, 3]), my_rank=2)
+        assert tree.n_levels == 2
+        assert all(len(g) == 1 for g in tree.level(0).groups)
+        assert tree.level(1).groups == [[0, 1, 2, 3]]
+        # every rank is its own node leader
+        assert all(tree.is_member(1, r) for r in range(4))
+
+    def test_interleaved_ranks_stay_grouped(self):
+        # node membership need not be rank-contiguous
+        tree = HierTree(_paths([0, 1, 0, 1]), my_rank=0)
+        assert tree.level(0).groups == [[0, 2], [1, 3]]
+        assert tree.level(1).groups == [[0, 1]]
+        # subtrees contiguous in tree order
+        assert tree.tree_order == [0, 2, 1, 3]
+
+    def test_invariants_on_lopsided_layout(self):
+        # 11 ranks: pods of very different shapes incl. single-rank ones
+        node_of = [0, 0, 0, 0, 1, 2, 2, 3, 4, 4, 4]
+        pod_of = [0, 0, 0, 1, 2]
+        tree = HierTree(_paths(node_of, pod_of), my_rank=5)
+        n = len(node_of)
+        for lvl in range(tree.n_levels):
+            groups = tree.level(lvl).groups
+            members = sorted(r for g in groups for r in g)
+            if lvl == 0:
+                assert members == list(range(n))
+            else:
+                prev = sorted(g[0] for g in tree.level(lvl - 1).groups)
+                assert members == prev
+            for g in groups:
+                assert g == sorted(g)          # leader = lowest rank
+        assert len(tree.level(tree.n_levels - 1).groups) == 1
+        for r in range(n):
+            for lvl in range(tree.n_levels):
+                rep = tree.rep(lvl, r)
+                assert rep in tree.group(lvl, r)
+                assert tree.is_member(lvl, r) == (rep == r)
+                assert tree.group(lvl, r)[tree.rep_group_rank(lvl, r)] == rep
+
+    def test_describe_names_levels(self):
+        tree = HierTree(_paths([0, 0, 1, 1], pod_of=[0, 1]), my_rank=0)
+        text = tree.describe()
+        assert "3 levels" in text and "node" in text and "top" in text
+
+
+class TestFakeTopology:
+    def test_cyclic_ppn(self):
+        env = {"UCC_TOPO_FAKE_PPN": "2,1,3"}
+        nodes = [fake_topology(r, env)[0] for r in range(8)]
+        assert nodes == [0, 0, 1, 2, 2, 2, 3, 3]
+
+    def test_pods(self):
+        env = {"UCC_TOPO_FAKE_PPN": "2", "UCC_TOPO_FAKE_NODES_PER_POD": "2"}
+        pods = [fake_topology(r, env)[1] for r in range(8)]
+        assert pods == [0, 0, 0, 0, 1, 1, 1, 1]
+
+    def test_unset(self):
+        assert fake_topology(3, {}) == (None, None)
+
+
+def _topo(procs, my_rank=0):
+    return TeamTopo(ContextTopo(procs), EpMap.full(len(procs)), my_rank)
+
+
+def _procs(node_of, pod_of=None):
+    import zlib
+    out = []
+    for r, node in enumerate(node_of):
+        hh = zlib.crc32(f"fake-node-{node}".encode())
+        ph = -1 if pod_of is None else \
+            zlib.crc32(f"fake-pod-{pod_of[node]}".encode())
+        out.append(ProcInfo(host_hash=hh, pid=1000 + r, real_host_hash=hh,
+                            pod_hash=ph))
+    return out
+
+
+class TestTeamTopoAsymmetric:
+    """TeamTopo.node_layout / sbgp construction beyond the symmetric
+    two-level case."""
+
+    def test_node_layout_sorted_counts(self):
+        topo = _topo(_procs([0, 0, 1, 2, 2, 2]))
+        assert topo.node_layout() == (1, 2, 3)
+
+    def test_node_layout_single_rank_nodes(self):
+        topo = _topo(_procs([0, 1, 2]))
+        assert topo.node_layout() == (1, 1, 1)
+
+    def test_node_sbgp_on_single_rank_node(self):
+        topo = _topo(_procs([0, 0, 1, 2, 2, 2]), my_rank=2)
+        node = topo.get_sbgp(SbgpType.NODE)
+        assert node.status == SbgpStatus.ENABLED
+        assert node.size == 1 and node.group_rank == 0
+
+    def test_leaders_sbgp_asymmetric(self):
+        topo = _topo(_procs([0, 0, 1, 2, 2, 2]), my_rank=3)
+        leaders = topo.get_sbgp(SbgpType.NODE_LEADERS)
+        assert leaders.status == SbgpStatus.ENABLED
+        assert [int(leaders.map.eval(i)) for i in range(leaders.size)] \
+            == [0, 2, 3]
+
+    def test_net_not_exists_on_unequal_ppn(self):
+        topo = _topo(_procs([0, 0, 1]))
+        assert topo.get_sbgp(SbgpType.NET).status == SbgpStatus.NOT_EXISTS
+
+    def test_hier_tree_depth_and_cap(self):
+        procs = _procs([0, 0, 1, 1], pod_of=[0, 1])
+        topo = _topo(procs)
+        assert topo.pods_active()
+        assert topo.hier_tree().n_levels == 3
+        # a 2-level cap collapses the pod attribute (classic split)
+        capped = topo.hier_tree(max_levels=2)
+        assert capped.n_levels == 2
+        assert capped.level(1).groups == [[0, 2]]
+
+    def test_unknown_pods_degrade_to_two_levels(self):
+        topo = _topo(_procs([0, 0, 1, 1]))   # pod_hash = -1 everywhere
+        assert not topo.pods_active()
+        assert topo.hier_tree().n_levels == 2
+
+
+@pytest.fixture(scope="module")
+def job():
+    # 8 ranks -> nodes of 2,1,3,2 (cyclic "2,1,3"); nodes per pod 2 ->
+    # pods {node0,node1} {node2,node3}: asymmetric everything, incl. a
+    # single-rank node whose leader serves two tree levels
+    os.environ["UCC_TOPO_FAKE_PPN"] = "2,1,3"
+    os.environ["UCC_TOPO_FAKE_NODES_PER_POD"] = "2"
+    j = UccJob(8)
+    yield j
+    j.cleanup()
+    os.environ.pop("UCC_TOPO_FAKE_PPN", None)
+    os.environ.pop("UCC_TOPO_FAKE_NODES_PER_POD", None)
+
+
+@pytest.fixture(scope="module")
+def teams(job):
+    return job.create_team()
+
+
+def hier_team_of(team):
+    for clt in team.cl_teams:
+        if clt.name == "hier":
+            return clt
+    return None
+
+
+class TestNlvlEndToEnd:
+    """Collectives composed over the asymmetric 3-level tree."""
+
+    def test_tree_resolved(self, teams):
+        ht = hier_team_of(teams[0])
+        assert ht is not None
+        assert ht.n_levels == 3
+        assert ht.tree.level(0).groups == [[0, 1], [2], [3, 4, 5], [6, 7]]
+        assert ht.tree.level(1).groups == [[0, 2], [3, 6]]
+        assert ht.tree.level(2).groups == [[0, 3]]
+        # units exist exactly where this rank is a member
+        assert all(ht.level_unit(l) is not None for l in range(3))
+        ht4 = hier_team_of(teams[4])
+        assert ht4.level_unit(0) is not None
+        assert ht4.level_unit(1) is None and ht4.level_unit(2) is None
+        text = ht.describe_topology()
+        assert "3 levels" in text and "not a participant" not in text
+
+    def test_nlvl_is_default_on_pods(self, teams):
+        cands = teams[0].score_map.lookup(
+            CollType.ALLREDUCE, ucc_tpu.MemoryType.HOST, 1 << 16)
+        assert cands[0].alg_name == "nrab"
+        bc = teams[0].score_map.lookup(
+            CollType.BCAST, ucc_tpu.MemoryType.HOST, 1 << 16)
+        assert bc[0].alg_name == "nstep"
+
+    @pytest.mark.parametrize("count", [1, 37, 4096])
+    def test_allreduce(self, job, teams, count):
+        n = 8
+        srcs = [np.full(count, r + 1.0, np.float32) for r in range(n)]
+        dsts = [np.zeros(count, np.float32) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+            op=ReductionOp.SUM))
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], 36.0)
+
+    def test_allreduce_avg_inplace(self, job, teams):
+        n, count = 8, 65
+        bufs = [np.full(count, float(r), np.float64) for r in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLREDUCE, op=ReductionOp.AVG,
+            src=None, dst=BufferInfo(bufs[r], count, DataType.FLOAT64),
+            flags=CollArgsFlags.IN_PLACE))
+        for r in range(n):
+            np.testing.assert_allclose(bufs[r], 3.5)
+
+    # roots chosen to hit every tree position: a pod/global leader, a
+    # node leader that is not a pod leader, a plain member, and the
+    # single-rank-node rank that serves two upper levels
+    @pytest.mark.parametrize("root", [0, 2, 4, 6, 7])
+    def test_bcast(self, job, teams, root):
+        n, count = 8, 50
+        bufs = [(np.arange(count, dtype=np.float32) if r == root
+                 else np.zeros(count, np.float32)) for r in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.BCAST, root=root,
+            src=BufferInfo(bufs[r], count, DataType.FLOAT32)))
+        for r in range(n):
+            np.testing.assert_allclose(bufs[r],
+                                       np.arange(count, dtype=np.float32))
+
+    @pytest.mark.parametrize("root", [0, 2, 5])
+    def test_reduce(self, job, teams, root):
+        n, count = 8, 29
+        srcs = [np.full(count, float(r + 1), np.float32) for r in range(n)]
+        dst = np.zeros(count, np.float32)
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.REDUCE, root=root, op=ReductionOp.SUM,
+            src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+            dst=BufferInfo(dst, count, DataType.FLOAT32)
+            if r == root else None))
+        np.testing.assert_allclose(dst, 36.0)
+
+    def test_barrier(self, job, teams):
+        job.run_coll(teams, lambda r: CollArgs(coll_type=CollType.BARRIER))
+
+    def test_allgather(self, job, teams):
+        n, blk = 8, 3
+        srcs = [np.full(blk, r + 1.0, np.float32) for r in range(n)]
+        dsts = [np.zeros(blk * n, np.float32) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLGATHER,
+            src=BufferInfo(srcs[r], blk, DataType.FLOAT32),
+            dst=BufferInfo(dsts[r], blk * n, DataType.FLOAT32)))
+        exp = np.repeat(np.arange(1, n + 1, dtype=np.float32), blk)
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], exp)
+
+    def test_allgatherv_uneven(self, job, teams):
+        from ucc_tpu.api.types import BufferInfoV
+        n = 8
+        counts = [r + 1 for r in range(n)]
+        total = sum(counts)
+        displs = list(np.cumsum([0] + counts[:-1]))
+        srcs = [np.full(counts[r], r + 1.0, np.float32) for r in range(n)]
+        dsts = [np.zeros(total, np.float32) for _ in range(n)]
+        job.run_coll(teams, lambda r: CollArgs(
+            coll_type=CollType.ALLGATHERV,
+            src=BufferInfo(srcs[r], counts[r], DataType.FLOAT32),
+            dst=BufferInfoV(dsts[r], counts, displs, DataType.FLOAT32)))
+        exp = np.concatenate([np.full(c, i + 1.0, np.float32)
+                              for i, c in enumerate(counts)])
+        for r in range(n):
+            np.testing.assert_allclose(dsts[r], exp)

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Pod-scale simulation harness (repo-root entry).
+
+Thin shim over the packaged CLI — the implementation lives in
+ucc_tpu/tools/scale.py (installed as the `ucc_scale` console script).
+Simulates a 512–2048-rank host-TL mesh bootstrapped through the
+tree-structured OOB exchange with a synthetic multi-node/multi-pod
+layout, runs the collective matrix, and measures N-level hier against
+the flat DCN default per size cell.
+
+    python tools/scale.py -n 512 --ppn 8 --npp 8 --json
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ucc_tpu.tools.scale import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -421,6 +421,99 @@ def _native_smoke(env) -> None:
           flush=True)
 
 
+def _scale_smoke(env) -> None:
+    """WARN-ONLY pod-scale probe (ISSUE 8 CI satellite, same harness as
+    the other smokes): simulate a 512-rank host-TL mesh (thread OOB
+    bootstrapped through the TREE exchange, synthetic 8-pods × 8-nodes ×
+    8-ranks layout), create the team, run the collective matrix, and
+    check the round's two claims — bootstrap OOB rounds/fan-in scale
+    logarithmically (rounds per allgather ≤ 2·tree-levels, per-store
+    fan-in ≤ max(ppn, radix) instead of the flat store's n connections),
+    and the N-level hier allreduce beats the flat DCN default on the
+    measured cell (run on a min(n, 128)-rank mesh — see
+    run_sim.cells_n). UCC_GATE_SCALE_N downsizes the mesh; skip with
+    UCC_GATE_SCALE=0."""
+    import json
+    import math
+    if os.environ.get("UCC_GATE_SCALE", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] scale smoke: skipped (UCC_GATE_SCALE=0)", flush=True)
+        return
+    try:
+        n = int(os.environ.get("UCC_GATE_SCALE_N", "512"))
+    except ValueError:
+        n = 512
+    # pod shape that keeps >1 pod (3 hier levels) whenever the mesh has
+    # >=2 nodes: 8-rank nodes, pods of at most 8 nodes but never more
+    # than half the node count. A single-node mesh (UCC_GATE_SCALE_N<=8)
+    # can only resolve 2 levels — expect that instead of warning on it.
+    nodes = max(1, (n + 7) // 8)
+    npp = max(1, min(8, nodes // 2))
+    pods = (nodes + npp - 1) // npp
+    want_levels = 3 if pods >= 2 else 2
+    print(f"[gate] scale smoke ({n} ranks, ppn 8, {npp} nodes/pod, "
+          f"warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE"))}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ucc_tpu.tools.scale", "-n", str(n),
+             "--ppn", "8", "--npp", str(npp), "--cell-sizes", "65536",
+             "--cell-iters", "3", "--json"],
+            cwd=REPO, env=smoke_env, capture_output=True, text=True,
+            timeout=1500)
+    except subprocess.TimeoutExpired:
+        print("[gate] WARN: scale smoke timed out (not a gate failure)",
+              flush=True)
+        return
+    rec = None
+    for ln in (r.stdout or "").splitlines():
+        if ln.startswith("{"):
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+    dt = time.monotonic() - t0
+    if rec is None or rec.get("error"):
+        why = (rec or {}).get("error") or f"rc={r.returncode}, no record"
+        print(f"[gate] WARN: scale smoke — {why} in {dt:.0f}s "
+              f"(not a gate failure)", flush=True)
+        return
+    problems = []
+    oob = (rec.get("oob") or {}).get("team") or {}
+    levels = int(oob.get("levels") or 0)
+    fanin = int(oob.get("max_fanin") or 0)
+    rounds = float(oob.get("rounds_per_allgather_max") or 0.0)
+    # the logarithmic claim: tree depth within log2(n), per-allgather
+    # store rounds bounded by one up + one down pass of the tree, and
+    # no store serving more than max(ppn, radix) members (flat = n)
+    if not levels or levels > math.log2(max(2, n)):
+        problems.append(f"tree depth {levels} not logarithmic for n={n}")
+    if rounds > 2 * levels:
+        problems.append(f"bootstrap rounds/allgather {rounds} exceed "
+                        f"2*levels={2 * levels}")
+    if not fanin or fanin >= n or fanin > 16:
+        problems.append(f"store fan-in {fanin} not bounded (flat={n})")
+    if len(rec.get("matrix") or []) < 6:
+        problems.append(f"collective matrix incomplete: {rec.get('matrix')}")
+    if int(rec.get("hier_levels") or 0) < want_levels:
+        problems.append(f"hier resolved {rec.get('hier_levels')} levels, "
+                        f"expected {want_levels} (pods not detected)")
+    cells = rec.get("cells") or []
+    best = max((c.get("hier_speedup") or 0.0 for c in cells), default=0.0)
+    if best <= 1.0:
+        problems.append(f"hier allreduce did not beat the flat DCN "
+                        f"default on any cell (best {best}x)")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] scale smoke: {n} ranks team_create "
+          f"{rec.get('team_create_s')}s, tree levels {levels}, fan-in "
+          f"{fanin} (flat {n}), rounds/allgather {rounds}, hier vs flat "
+          f"DCN best {best}x @ {rec.get('cells_ranks')} ranks "
+          f"in {dt:.0f}s -> {verdict}", flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -482,6 +575,11 @@ def main(argv=None) -> int:
         # single-threaded (ISSUE 7). The kill+shrink soak above already
         # exercises native+FT: native is the default matcher now.
         _native_smoke(env)
+        # warn-only: 512-rank simulated pod bootstraps through the tree
+        # OOB with O(log n) rounds/fan-in, activates, passes the
+        # collective matrix, and the N-level hier allreduce beats the
+        # flat DCN default (ISSUE 8)
+        _scale_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

@@ -41,7 +41,9 @@ from .core.lib import Lib, init  # noqa: F401
 from .core.context import Context  # noqa: F401
 from .core.team import Team, TeamState  # noqa: F401
 from .core.coll import CollRequest, collective_init  # noqa: F401
-from .core.oob import SubsetOob, TcpStoreOob, ThreadOob, ThreadOobWorld  # noqa: F401
+from .core.oob import (SubsetOob, TcpStoreOob, TcpTreeOob,  # noqa: F401
+                       ThreadOob, ThreadOobWorld, ThreadTreeOobWorld,
+                       TreeOob, tree_layout)
 from .core.ee import Ee, UccEvent  # noqa: F401
 from . import ops  # noqa: F401
 

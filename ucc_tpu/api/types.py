@@ -44,9 +44,22 @@ class OobRequest:
         pass
 
     def wait(self) -> List[bytes]:
+        # adaptive backoff: pure sleep(0) spinning turns a 512-thread
+        # simulated bootstrap into GIL thrash that starves even thread
+        # STARTUP; after a short hot spin, waiters back off
+        # exponentially to a 20ms poll — invisible against store RTTs
+        # and bootstrap deadlines, and it keeps the GIL available for
+        # ranks still doing real work
         import time
+        spins = 0
+        delay = 0.0005
         while self.test() == Status.IN_PROGRESS:
-            time.sleep(0)
+            spins += 1
+            if spins < 20:
+                time.sleep(0)
+            else:
+                time.sleep(delay)
+                delay = min(delay * 1.5, 0.02)
         return self.result
 
 

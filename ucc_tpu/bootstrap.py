@@ -44,6 +44,8 @@ class World:
                  lib_params=None, timeout: float = 120.0):
         import ucc_tpu
         from ucc_tpu import ContextParams, TcpStoreOob, TeamParams
+        from ucc_tpu.core.oob import (TcpTreeOob, parse_node_sizes,
+                                      tree_mode_enabled)
 
         host, port_s = coordinator.rsplit(":", 1)
         base_port = int(port_s)
@@ -51,6 +53,42 @@ class World:
         self.nprocs = nprocs
         n = nprocs * ranks_per_proc
         self.world_size = n
+
+        # bootstrap topology (ISSUE 8): UCC_OOB_TREE=y|n|auto selects the
+        # tree-structured store exchange (per-node leader stores + radix-
+        # bounded parent stores, O(log n) rounds) over the single flat
+        # store every rank funnels through. auto = tree from
+        # UCC_OOB_TREE_THRESH ranks up, LOOPBACK coordinators only (all
+        # group stores bind on the coordinator host, so auto must never
+        # break a multi-host flat bootstrap; explicit y asserts
+        # single-host). Node shape from UCC_OOB_TREE_PPN (int or cyclic
+        # comma list), defaulting to ranks_per_proc so each process's
+        # ranks share one leader store. All knobs honor UCC_CONFIG_FILE.
+        from ucc_tpu.core.oob import _knob as _oob_knob
+        tree_ppn = parse_node_sizes(_oob_knob("UCC_OOB_TREE_PPN", "")) \
+            or ([ranks_per_proc] if ranks_per_proc > 1 else None)
+        use_tree = tree_mode_enabled(n, host=host)
+        if use_tree:
+            # port block: [base+3, ...) — base+0/+1 stay the legacy flat
+            # stores' ports, base+2 stays jax.distributed's
+            tree_ports = TcpTreeOob.ports_needed(n, ppn=tree_ppn)
+
+            def ctx_oob(r):
+                return TcpTreeOob(r, n, host=host, base_port=base_port + 3,
+                                  key="ucc-ctx", ppn=tree_ppn,
+                                  timeout_s=timeout)
+
+            def team_oob(r):
+                return TcpTreeOob(r, n, host=host,
+                                  base_port=base_port + 3 + tree_ports,
+                                  key="ucc-team", ppn=tree_ppn,
+                                  timeout_s=timeout)
+        else:
+            def ctx_oob(r):
+                return TcpStoreOob(r, n, host=host, port=base_port)
+
+            def team_oob(r):
+                return TcpStoreOob(r, n, host=host, port=base_port + 1)
 
         if jax_distributed:
             import jax
@@ -78,8 +116,7 @@ class World:
         def mk(i, r):
             try:
                 self.contexts[i] = ucc_tpu.Context(
-                    self.libs[i], ContextParams(oob=TcpStoreOob(
-                        r, n, host=host, port=base_port)))
+                    self.libs[i], ContextParams(oob=ctx_oob(r)))
             except Exception as e:  # noqa: BLE001
                 ctx_errs.append(e)
 
@@ -107,8 +144,7 @@ class World:
         def mkteam(i, r):
             try:
                 self.teams[i] = self.contexts[i].create_team_post(
-                    TeamParams(oob=TcpStoreOob(r, n, host=host,
-                                               port=base_port + 1)))
+                    TeamParams(oob=team_oob(r)))
             except Exception as e:  # noqa: BLE001
                 team_errs.append(e)
 

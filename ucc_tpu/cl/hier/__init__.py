@@ -16,6 +16,12 @@ CL_HIER_CONFIG = register_table(ConfigTable(
         ConfigField("NET_TLS", "socket,shm,self",
                     "TLs for the per-rail NET unit", parse_list),
         ConfigField("FULL_TLS", "all", "TLs for the FULL unit", parse_list),
+        ConfigField("LEVELS", "auto",
+                    "number of hierarchy-tree unit levels (ISSUE 8 "
+                    "N-level composition): auto = full detected depth "
+                    "(chip->ICI node->DCN pod when pod identity is "
+                    "known); 2 = classic node/leaders split even when "
+                    "pods exist", parse_string),
         ConfigField("ALLREDUCE_RAB_PIPELINE", "n",
                     "pipeline spec for RAB allreduce, e.g. "
                     "thresh=64K:fragsize=1M:nfrags=4:pdepth=2:ordered",

@@ -1257,6 +1257,25 @@ def build_hier_scores(hier_team) -> CollScore:
     add(CollType.REDUCE, HIER_SCORE, reduce_2step_init, "2step")
     add(CollType.BARRIER, HIER_SCORE, barrier_init, "knomial_hier")
 
+    # N-level tree composition (ISSUE 8): on 3+-level layouts (pods
+    # detected) the tree algorithms are the hier DEFAULT — the flat
+    # leaders unit would push every pod's traffic over DCN directly.
+    # On classic 2-level layouts they register as low-score candidates
+    # so the PR-5 tuner (and TUNE strings) can still explore them
+    # without changing the static default.
+    tree = getattr(hier_team, "tree", None)
+    if tree is not None and tree.n_levels >= 2:
+        from .nlevel import (allgather_nlvl_init, allgatherv_nlvl_init,
+                             allreduce_nlvl_init, barrier_nlvl_init,
+                             bcast_nlvl_init, reduce_nlvl_init)
+        nscore = HIER_SCORE + 1 if tree.n_levels >= 3 else 1
+        add(CollType.ALLREDUCE, nscore, allreduce_nlvl_init, "nrab")
+        add(CollType.BCAST, nscore, bcast_nlvl_init, "nstep")
+        add(CollType.REDUCE, nscore, reduce_nlvl_init, "nstep")
+        add(CollType.BARRIER, nscore, barrier_nlvl_init, "nlvl")
+        add(CollType.ALLGATHERV, nscore, allgatherv_nlvl_init, "nlvl")
+        add(CollType.ALLGATHER, nscore, allgather_nlvl_init, "nlvl")
+
     # TPU-memory (HBM) rows: the pod path. allreduce runs its node stages
     # on device via the unit's TL/XLA team (rab_tpu); the others stage at
     # the hierarchy boundary. Matches cl_hier's CUDA-memory registration

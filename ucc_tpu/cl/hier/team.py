@@ -40,15 +40,19 @@ class SbgpCoreTeamFacade:
     map composition, the reference's sbgp->team->ctx chain).
     """
 
-    def __init__(self, core_team, sbgp_type: SbgpType, sbgp):
+    def __init__(self, core_team, sbgp_type: SbgpType, sbgp,
+                 unit_key: Optional[int] = None):
         self.parent = core_team
         self.context = core_team.context
         self.ctx_map = core_team.ctx_map.compose(sbgp.map)
         self.rank = sbgp.group_rank
         self.size = sbgp.size
         # the ctx-rank tuple disambiguates sibling units of the same type
-        # (e.g. each node's NODE team) sharing one process
-        self.team_key = (core_team.team_key, "hier", int(sbgp_type),
+        # (e.g. each node's NODE team) sharing one process; unit_key
+        # disambiguates tree-level units whose membership could coincide
+        # with a classic sbgp's on degenerate layouts
+        self.team_key = (core_team.team_key, "hier",
+                         int(sbgp_type) if unit_key is None else unit_key,
                          tuple(int(self.ctx_map.eval(i))
                                for i in range(self.size)))
         self.id = core_team.id
@@ -61,20 +65,22 @@ class HierSbgp:
     """ucc_hier_sbgp_t (cl_hier.h:86-101): sbgp + TL teams + score map."""
 
     def __init__(self, sbgp_type: SbgpType, sbgp, core_team,
-                 tl_allow: List[str]):
+                 tl_allow: List[str], unit_key: Optional[int] = None):
         self.type = sbgp_type
         self.sbgp = sbgp
         self.tl_teams: List[Any] = []
         self._pending: List[Any] = []
         self.score_map: Optional[ScoreMap] = None
-        self.facade = SbgpCoreTeamFacade(core_team, sbgp_type, sbgp)
+        self.facade = SbgpCoreTeamFacade(core_team, sbgp_type, sbgp,
+                                         unit_key)
+        key_id = int(sbgp_type) if unit_key is None else unit_key
         ctx = core_team.context
         for name, handle in ctx.tl_contexts.items():
             if tl_allow != ["all"] and name not in tl_allow:
                 continue
             try:
                 self._pending.append(handle.tl_lib.tl_cls.team_cls(
-                    handle.obj, self.facade, scope=f"hier_{int(sbgp_type)}"))
+                    handle.obj, self.facade, scope=f"hier_{key_id}"))
             except UccError:
                 continue
 
@@ -139,6 +145,55 @@ class ClHierTeam(BaseTeam):
                     pass
             self.sbgps[st] = HierSbgp(st, sbgp, core_team, allow)
 
+        # N-level topology tree (ISSUE 8): one unit per tree level this
+        # rank participates in, derived from proc-info paths (chip ->
+        # ICI node -> DCN pod). Level 0 aliases the NODE unit and a
+        # depth-2 top aliases NODE_LEADERS (no duplicate TL teams for
+        # the classic split); deeper layouts add per-pod leader units.
+        cap = None
+        if cfg is not None:
+            try:
+                lv = str(cfg.get("LEVELS")).strip().lower()
+                if lv and lv != "auto":
+                    cap = max(2, int(lv))
+            except (KeyError, ValueError):
+                logger.warning("bad UCC_CL_HIER_LEVELS value; using auto")
+        self.tree = topo.hier_tree(cap)
+        self.level_units: List[Optional[HierSbgp]] = []
+        self._extra_units: List[HierSbgp] = []
+        from ...topo.sbgp import Sbgp
+        for lvl in range(self.tree.n_levels):
+            if not self.tree.is_member(lvl):
+                self.level_units.append(None)
+                continue
+            members = self.tree.group(lvl)
+            unit = self._alias_unit(members)
+            if unit is None:
+                st = SbgpType.NODE if lvl == 0 else SbgpType.NODE_LEADERS
+                sbgp = Sbgp(st, SbgpStatus.ENABLED,
+                            members.index(core_team.rank),
+                            EpMap.from_array(members))
+                allow = ["all"]
+                if cfg is not None:
+                    try:
+                        allow = cfg.get(f"{st.name}_TLS")
+                    except KeyError:
+                        pass
+                unit = HierSbgp(st, sbgp, core_team, allow,
+                                unit_key=100 + lvl)
+                self._extra_units.append(unit)
+            self.level_units.append(unit)
+
+    def _alias_unit(self, members: List[int]) -> Optional[HierSbgp]:
+        """Reuse a classic unit whose membership coincides with a tree
+        level's, so the two-level layout builds no extra TL teams."""
+        for st in (SbgpType.NODE, SbgpType.NODE_LEADERS):
+            u = self.sbgps.get(st)
+            if u is not None and u.sbgp.map is not None and \
+                    list(int(x) for x in u.sbgp.map.to_array()) == members:
+                return u
+        return None
+
     def create_test(self) -> Status:
         any_in_progress = False
         for st in list(self.sbgps):
@@ -150,6 +205,16 @@ class ClHierTeam(BaseTeam):
                     return s       # hierarchy needs its core units
                 self.sbgps[st].destroy()
                 del self.sbgps[st]
+        for u in self._extra_units:
+            s = u.create_test()
+            if s == Status.IN_PROGRESS:
+                any_in_progress = True
+            elif s.is_error:
+                # level units are load-bearing for the N-level
+                # composition: failing the CL here keeps the outcome
+                # symmetric (CL_AGREE drops hier team-wide) instead of
+                # leaving ranks with divergent candidate sets
+                return s
         if any_in_progress:
             return Status.IN_PROGRESS
         if SbgpType.NODE not in self.sbgps and \
@@ -165,6 +230,31 @@ class ClHierTeam(BaseTeam):
     def sbgp(self, st: SbgpType) -> Optional[HierSbgp]:
         return self.sbgps.get(st)
 
+    # -- N-level tree accessors (ISSUE 8) ------------------------------
+    @property
+    def n_levels(self) -> int:
+        return self.tree.n_levels
+
+    def level_unit(self, lvl: int) -> Optional[HierSbgp]:
+        """The unit team for tree level *lvl*, or None when this rank is
+        not a participant at that level."""
+        return self.level_units[lvl]
+
+    def describe_topology(self) -> str:
+        """Resolved hierarchy rendering for team-activation logs and
+        ``ucc_info -s``: the tree plus, per level this rank serves, the
+        TLs its unit team actually created — a mis-detected topology
+        shows up here instead of silently degrading to flat."""
+        lines = [self.tree.describe()]
+        for lvl, unit in enumerate(self.level_units):
+            if unit is None:
+                lines.append(f"  L{lvl}: (not a participant)")
+            else:
+                tls = ",".join(t.name for t in unit.tl_teams) or "pending"
+                lines.append(f"  L{lvl}: unit size {unit.sbgp.size} "
+                             f"rank {unit.sbgp.group_rank} tls [{tls}]")
+        return "\n".join(lines)
+
     @property
     def is_node_leader(self) -> bool:
         nl = self.sbgps.get(SbgpType.NODE_LEADERS)
@@ -173,6 +263,8 @@ class ClHierTeam(BaseTeam):
     def destroy(self) -> None:
         for s in self.sbgps.values():
             s.destroy()
+        for u in self._extra_units:
+            u.destroy()
 
 
 def _team_topo(core_team):

@@ -61,19 +61,24 @@ class Context:
         self.rank = oob.oob_ep if oob else 0
         self.size = oob.n_oob_eps if oob else 1
         self.proc_info = local_proc_info()
-        # test hook: UCC_TOPO_FAKE_PPN=N groups ranks into virtual "nodes"
-        # of N so hierarchy paths (CL/HIER node/node_leaders/net) are
-        # exercisable in a single-host in-process job — the same role the
-        # reference's simulated-topology gtest fixtures play
-        import os as _os
-        fake_ppn = _os.environ.get("UCC_TOPO_FAKE_PPN", "")
-        if fake_ppn:
+        # test hook: UCC_TOPO_FAKE_PPN groups ranks into virtual "nodes"
+        # (int N, or a cyclic comma list of node sizes for asymmetric
+        # layouts) and UCC_TOPO_FAKE_NODES_PER_POD groups those nodes
+        # into virtual DCN pods, so hierarchy paths (CL/HIER units at
+        # every level) are exercisable in a single-host in-process job —
+        # the same role the reference's simulated-topology gtest
+        # fixtures play
+        from ..topo.proc_info import fake_topology
+        fake_node, fake_pod = fake_topology(self.rank)
+        if fake_node is not None:
             import dataclasses
             import zlib
-            node = self.rank // max(1, int(fake_ppn))
-            self.proc_info = dataclasses.replace(
-                self.proc_info,
-                host_hash=zlib.crc32(f"fake-node-{node}".encode()))
+            repl = {"host_hash":
+                    zlib.crc32(f"fake-node-{fake_node}".encode())}
+            if fake_pod is not None:
+                repl["pod_hash"] = zlib.crc32(
+                    f"fake-pod-{fake_pod}".encode())
+            self.proc_info = dataclasses.replace(self.proc_info, **repl)
 
         if lib.params.thread_mode == ThreadMode.MULTIPLE:
             self.progress_queue = ProgressQueueMT()

@@ -93,6 +93,33 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
                 "ranks are failed with ERR_TIMED_OUT naming the absent "
                 "ranks instead of hanging the job (<=0 = wait forever)",
                 parse_string),
+    ConfigField("OOB_TREE", "auto", "bootstrap store topology: n = one "
+                "flat store every rank connects to (O(n) server fan-in); "
+                "y = tree-structured exchange (per-node leader stores + "
+                "radix-bounded parent stores, O(log n) rounds and "
+                "max(ppn, radix) fan-in per server — every store binds "
+                "the coordinator host, so y asserts a single-host job); "
+                "auto = tree from OOB_TREE_THRESH ranks up, loopback "
+                "coordinators only", parse_string),
+    ConfigField("OOB_TREE_PPN", "", "ranks-per-node shape of the "
+                "bootstrap tree: an int (nodes of N) or a cyclic comma "
+                "list of node sizes; empty = ranks_per_proc under "
+                "bootstrap.World, else radix-sized blocks", parse_string),
+    ConfigField("OOB_TREE_RADIX", "8", "max members per upper-level "
+                "bootstrap store (leader-of-leaders group size)",
+                parse_string),
+    ConfigField("OOB_TREE_THRESH", "32", "team size from which "
+                "UCC_OOB_TREE=auto switches the TCP bootstrap onto the "
+                "tree exchange", parse_string),
+    ConfigField("TOPO_FAKE_PPN", "", "simulated topology: group context "
+                "ranks into virtual nodes — an int N (nodes of N) or a "
+                "cyclic comma list of node sizes (\"2,1,3\") for "
+                "asymmetric layouts; empty = real host detection",
+                parse_string),
+    ConfigField("TOPO_FAKE_NODES_PER_POD", "", "simulated topology: "
+                "group every M consecutive virtual nodes into a DCN pod "
+                "(activates the 3-level chip->node->pod hierarchy tree "
+                "in CL/HIER); empty = no pod grouping", parse_string),
     ConfigField("TEAM_IDS_POOL_SIZE", "32", "team id pool size per context",
                 parse_uint),
     ConfigField("TUNER", "off", "measurement-driven algorithm autotuner: "

@@ -254,6 +254,15 @@ class Team:
                 # show with their (learned) provenance
                 logger.info("%s", self.score_map.print_info(
                     f"team {self.id} size {self.size}"))
+                # resolved hierarchy next to the score provenance: a
+                # mis-detected topology (wrong level count, lopsided
+                # units) is visible at activation instead of silently
+                # degrading to flat algorithms (ISSUE 8 satellite)
+                for cl in self.cl_teams:
+                    describe = getattr(cl, "describe_topology", None)
+                    if describe is not None:
+                        logger.info("team %s %s topology:\n%s",
+                                    self.id, cl.name, describe())
             self.state = TeamState.ACTIVE
 
         if self.state == TeamState.ACTIVE:
@@ -366,13 +375,17 @@ class Team:
         # uniform (create_from_parent gives it to all members). A
         # per-rank choice (e.g. "service team if I have one") would
         # itself diverge under exactly the component-load asymmetry this
-        # step exists to reconcile, and deadlock. SubsetOob rounds would
-        # require non-member participation (core/oob.py contract) and
-        # ep_map teams have no OOB at all — both skip: their CL sets can
-        # only diverge through component-load asymmetry, which the
-        # OOB-rooted parent team has already reconciled.
+        # step exists to reconcile, and deadlock. LEGACY SubsetOob
+        # rounds would require non-member participation (core/oob.py
+        # contract) and ep_map teams have no OOB at all — both skip:
+        # their CL sets can only diverge through component-load
+        # asymmetry, which the OOB-rooted parent team has already
+        # reconciled. Subset-CAPABLE SubsetOobs (members-only rounds)
+        # run the agreement like any OOB team — uniformly, since
+        # capability is a property of the shared parent.
         from .oob import SubsetOob
-        if self.oob is None or isinstance(self.oob, SubsetOob):
+        if self.oob is None or (isinstance(self.oob, SubsetOob) and
+                                not self.oob.SUBSET_CAPABLE):
             if not self.cl_teams:
                 raise UccError(Status.ERR_NO_RESOURCE,
                                "no CL could create a team")
@@ -513,7 +526,11 @@ class Team:
             raise UccError(Status.ERR_INVALID_PARAM,
                            "parent team has no OOB to split")
         if parent.rank not in ranks:
-            SubsetOob.participate(parent.oob)   # keep members' round whole
+            # subset-capable parents (thread OOB worlds, nested subsets)
+            # exchange among members only — non-members skip entirely, so
+            # a nested subgroup create costs no whole-team round at any
+            # level of the tree; participate() is the no-op there
+            SubsetOob.participate(parent.oob)
             return None
         sub_oob = SubsetOob(parent.oob, ranks)
         return Team(parent.context, TeamParams(oob=sub_oob))
@@ -562,6 +579,8 @@ class Team:
             if sbgps:
                 for sub in sbgps.values():
                     visit(sub)
+            for sub in getattr(t, "_extra_units", ()) or ():
+                visit(sub)   # cl/hier N-level tree units
 
         visit(self.service_team)
         for cl in self.cl_teams:

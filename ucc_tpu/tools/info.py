@@ -133,6 +133,15 @@ def print_scores(team_size: int = 1) -> None:
         if time.monotonic() > deadline:
             raise RuntimeError("probe team create timed out (60s)")
     print(teams[0].score_map.print_info(f"probe team (size {n})"))
+    # resolved hierarchy next to the score rows (ISSUE 8 satellite): the
+    # tree cl/hier derived from the (possibly faked) topology, so a
+    # mis-detected layout shows here instead of silently running flat —
+    # e.g. `UCC_TOPO_FAKE_PPN=2 UCC_TOPO_FAKE_NODES_PER_POD=2 ucc_info -s 8`
+    for cl in teams[0].cl_teams:
+        describe = getattr(cl, "describe_topology", None)
+        if describe is not None:
+            print(f"# resolved {cl.name} hierarchy:")
+            print(describe())
     for t in teams:
         t.destroy()
     for c in ctxs:

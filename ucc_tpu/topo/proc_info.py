@@ -28,6 +28,11 @@ class ProcInfo:
     #: process-locality decisions (which ranks share this process's
     #: device rendezvous) must use the real one. -1 = same as host_hash.
     real_host_hash: int = -1
+    #: DCN pod identity (a group of ICI-connected hosts behind one DCN
+    #: domain). -1 = unknown — hosts with unknown pods are treated as one
+    #: pod, so the hierarchy degrades to the classic node/leaders split.
+    #: Sourced from UCC_POD_ID (launcher-set) or the FAKE topology knobs.
+    pod_hash: int = -1
 
     def same_host(self, other: "ProcInfo") -> bool:
         return self.host_hash == other.host_hash
@@ -41,6 +46,47 @@ class ProcInfo:
 def host_hash(name: str = "") -> int:
     name = name or _socket.gethostname()
     return zlib.crc32(name.encode())
+
+
+def fake_topology(rank: int, env=None):
+    """Simulated-topology knobs, resolved for one context rank.
+
+    ``UCC_TOPO_FAKE_PPN`` groups in-process ranks into virtual nodes: a
+    single int N (nodes of N, the classic form) or a comma list of node
+    sizes applied cyclically (``"2,1,3"`` -> nodes of 2,1,3,2,1,3,...) so
+    asymmetric layouts are exercisable too. ``UCC_TOPO_FAKE_NODES_PER_POD``
+    additionally groups every M consecutive virtual nodes into a DCN pod
+    (the multi-pod shape the N-level hierarchy consumes). Returns
+    ``(node_idx, pod_idx)``; each is None when its knob is unset or
+    malformed (same fall-back-to-real-detection behavior as
+    core/oob.py parse_node_sizes, which shares this grammar)."""
+    env = os.environ if env is None else env
+    spec = env.get("UCC_TOPO_FAKE_PPN", "").strip()
+    if not spec:
+        return None, None
+    try:
+        sizes = [max(1, int(tok)) for tok in spec.split(",")
+                 if tok.strip()]
+    except ValueError:
+        return None, None
+    if not sizes:
+        return None, None
+    cycle = sum(sizes)
+    node = (rank // cycle) * len(sizes)
+    off = rank % cycle
+    for s in sizes:
+        if off < s:
+            break
+        off -= s
+        node += 1
+    npp = env.get("UCC_TOPO_FAKE_NODES_PER_POD", "").strip()
+    pod = None
+    if npp:
+        try:
+            pod = node // max(1, int(npp))
+        except ValueError:
+            pod = None
+    return node, pod
 
 
 def local_proc_info() -> ProcInfo:
@@ -59,5 +105,7 @@ def local_proc_info() -> ProcInfo:
         except Exception:  # noqa: BLE001
             jax_proc = -1
     hh = host_hash()
+    pod = os.environ.get("UCC_POD_ID", "")
+    ph = host_hash(f"pod-{pod}") if pod else -1
     return ProcInfo(host_hash=hh, pid=os.getpid(), jax_process=jax_proc,
-                    real_host_hash=hh)
+                    real_host_hash=hh, pod_hash=ph)

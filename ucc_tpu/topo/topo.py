@@ -7,6 +7,7 @@ proc-info table gathered at context address exchange; per-team
 """
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from ..utils.ep_map import EpMap
@@ -113,6 +114,35 @@ class TeamTopo:
         # NUMA/SOCKET flavors: single-socket hosts assumed on TPU pods
         return Sbgp(t, SbgpStatus.NOT_EXISTS)
 
+    # ------------------------------------------------------------------
+    # N-level hierarchy tree (ISSUE 8): chip -> ICI node -> DCN pod,
+    # derived from the proc-info paths (pod_hash, host_hash). The tree
+    # replaces the fixed two-tier NODE/NODE_LEADERS split as the source
+    # of truth for CL/HIER's unit construction; depth is bounded by the
+    # layout actually present (no pods -> the classic two levels).
+    def rank_path(self, team_rank: int, with_pods: bool) -> tuple:
+        p = self._proc(team_rank)
+        return (p.pod_hash, p.host_hash) if with_pods else (p.host_hash,)
+
+    def pods_active(self) -> bool:
+        """True when the team spans more than one DCN pod (ranks with
+        unknown pod identity count as one shared pod)."""
+        pods = {self._proc(r).pod_hash for r in range(self.team_size)}
+        return len(pods) > 1
+
+    def hier_tree(self, max_levels: Optional[int] = None) -> "HierTree":
+        """Build the team's hierarchy tree. ``max_levels`` caps the number
+        of unit levels (2 = classic node/leaders split even when pods
+        exist); None/oversized = full depth."""
+        with_pods = self.pods_active()
+        if max_levels is not None and max_levels < 3:
+            # a 2-level cap collapses the pod attribute: groups form by
+            # host only, leaders span pods directly (the PR-pre-8 shape)
+            with_pods = False
+        paths = [self.rank_path(r, with_pods)
+                 for r in range(self.team_size)]
+        return HierTree(paths, self.my_rank)
+
     def node_layout(self) -> tuple:
         """Per-node member counts of THIS team, sorted — the node-shape
         component of the autotuner's topology signature
@@ -135,3 +165,137 @@ class TeamTopo:
 
     def all_procs_same_node(self) -> bool:
         return self.is_single_node()
+
+
+@dataclass
+class HierTreeLevel:
+    """One tier of the hierarchy: a partition of (a subset of) team ranks
+    into unit groups. Level 0 partitions ALL team ranks into nodes; level
+    l >= 1 partitions the level-(l-1) group leaders by shrinking path
+    prefix; the top level is a single group. Within a group members are
+    in ascending team-rank order, so ``group[0]`` is the group's leader;
+    groups are in hierarchical (parent-subtree-contiguous) order."""
+
+    name: str
+    groups: List[List[int]]
+    prefix_len: int
+
+
+class HierTree:
+    """Topology tree over a team, built from per-rank attribute paths
+    (e.g. ``(pod_hash, host_hash)``). Constructed from raw paths so unit
+    tests can exercise arbitrary (asymmetric) layouts without a context.
+
+    Definitions used throughout CL/HIER's N-level algorithms, for a team
+    rank ``r`` and level ``l``:
+
+    - ``rep(l, r)``: r's representative at level l — r itself at level 0,
+      then the leader of the previous representative's group (the chain
+      data travels when funneled up the tree).
+    - ``group_index(l, r)``: the level-l unit associated with r (the one
+      containing ``rep(l, r)``); defined for every rank, member or not.
+    - ``is_member(l, r)``: whether r itself participates in its level-l
+      unit (``rep(l, r) == r``). Every rank is a member at level 0.
+    """
+
+    def __init__(self, paths: List[tuple], my_rank: int):
+        if not paths:
+            raise ValueError("empty team")
+        self.my_rank = my_rank
+        self.team_size = n = len(paths)
+        self.paths = list(paths)
+        depth = len(paths[0])
+        if any(len(p) != depth for p in paths):
+            raise ValueError("inconsistent path depths")
+        # hierarchical order: subtrees contiguous, ordered by the first
+        # team rank appearing under each prefix (deterministic and
+        # identical on every rank)
+        first_of: Dict[tuple, int] = {}
+        for r in range(n):
+            for i in range(depth + 1):
+                first_of.setdefault(paths[r][:i], min(
+                    first_of.get(paths[r][:i], r), r))
+
+        def sort_key(r: int) -> tuple:
+            return tuple(first_of[paths[r][:i]]
+                         for i in range(1, depth + 1)) + (r,)
+
+        self.tree_order: List[int] = sorted(range(n), key=sort_key)
+        # level 0: full-path groups over all ranks; level l: previous
+        # leaders grouped by prefix of length depth-l; top: one group
+        self.levels: List[HierTreeLevel] = []
+        members = self.tree_order
+        for l in range(depth + 1):
+            plen = depth - l
+            groups: List[List[int]] = []
+            seen: Dict[tuple, int] = {}
+            for r in members:       # members already in hierarchical order
+                key = paths[r][:plen]
+                gi = seen.get(key)
+                if gi is None:
+                    gi = seen[key] = len(groups)
+                    groups.append([])
+                groups[gi].append(r)
+            for g in groups:
+                g.sort()
+            name = ("node" if l == 0 else
+                    "top" if plen == 0 else f"tier{l}")
+            self.levels.append(HierTreeLevel(name, groups, plen))
+            leaders = [g[0] for g in groups]
+            members = sorted(leaders, key=sort_key)
+        # per-level maps: rank -> group index (via path prefix)
+        self._gidx: List[Dict[tuple, int]] = []
+        for lvl in self.levels:
+            d = {}
+            for gi, g in enumerate(lvl.groups):
+                d[paths[g[0]][:lvl.prefix_len]] = gi
+            self._gidx.append(d)
+
+    # ------------------------------------------------------------------
+    @property
+    def n_levels(self) -> int:
+        return len(self.levels)
+
+    def level(self, l: int) -> HierTreeLevel:
+        return self.levels[l]
+
+    def group_index(self, l: int, rank: Optional[int] = None) -> int:
+        rank = self.my_rank if rank is None else rank
+        return self._gidx[l][self.paths[rank][:self.levels[l].prefix_len]]
+
+    def group(self, l: int, rank: Optional[int] = None) -> List[int]:
+        return self.levels[l].groups[self.group_index(l, rank)]
+
+    def rep(self, l: int, rank: Optional[int] = None) -> int:
+        """Team rank of *rank*'s representative at level l."""
+        rank = self.my_rank if rank is None else rank
+        r = rank
+        for i in range(l):
+            r = self.levels[i].groups[self.group_index(i, rank)][0]
+        return r
+
+    def is_member(self, l: int, rank: Optional[int] = None) -> bool:
+        rank = self.my_rank if rank is None else rank
+        return self.rep(l, rank) == rank
+
+    def rep_group_rank(self, l: int, rank: Optional[int] = None) -> int:
+        """Index of *rank*'s representative within its level-l group (the
+        root index a rooted sub-collective at that level needs)."""
+        rank = self.my_rank if rank is None else rank
+        return self.group(l, rank).index(self.rep(l, rank))
+
+    def describe(self) -> str:
+        """One line per level: sizes and leader ranks (truncated), the
+        team-activation log / ucc_info -s rendering."""
+        out = [f"hier tree: {self.n_levels} levels over "
+               f"{self.team_size} ranks"]
+        for l, lvl in enumerate(self.levels):
+            sizes = [len(g) for g in lvl.groups]
+            leaders = [g[0] for g in lvl.groups]
+            s_sizes = ",".join(str(s) for s in sizes[:8]) + \
+                (",..." if len(sizes) > 8 else "")
+            s_lead = ",".join(str(x) for x in leaders[:8]) + \
+                (",..." if len(leaders) > 8 else "")
+            out.append(f"  L{l} {lvl.name:<6} x{len(lvl.groups):<4} "
+                       f"sizes [{s_sizes}] leaders [{s_lead}]")
+        return "\n".join(out)
