@@ -65,7 +65,7 @@
 namespace {
 // visible to BOTH artifacts: the loader's ABI gate compares the ext's
 // compiled-in value (py_abi_version) against the core's ucc_abi_version()
-constexpr uint64_t kAbiVersion = 2;
+constexpr uint64_t kAbiVersion = 3;
 }  // namespace
 
 // The thin extension build (-DUCC_TPU_EXT_THIN) compiles ONLY the CPython
@@ -503,6 +503,29 @@ uint64_t ucc_mailbox_purge(void* mbp) {
         }
     }
     return n;
+}
+
+// Backlog snapshot for the observability layer (cold diagnostic path):
+// out[0] = parked unexpected messages, out[1] = parked posted recvs,
+// out[2] = live request slots (allocated minus freed — the slot-table
+// in-use count the watchdog/interval dumps sample as a gauge).
+void ucc_mailbox_occupancy(void* mbp, uint64_t* out) {
+    auto* mb = static_cast<Mailbox*>(mbp);
+    uint64_t unexp = 0, posted = 0;
+    for (int i = 0; i < kShards; ++i) {
+        Shard& sh = mb->shards[i];
+        std::lock_guard<std::mutex> g(sh.mu);
+        for (auto& kv : sh.unexpected) unexp += kv.second.size();
+        for (auto& kv : sh.posted) posted += kv.second.size();
+    }
+    uint64_t live;
+    {
+        std::lock_guard<std::mutex> g(mb->alloc_mu);
+        live = mb->next_slot - mb->free_list.size();
+    }
+    out[0] = unexp;
+    out[1] = posted;
+    out[2] = live;
 }
 
 // Poll one request: 0 = pending, else (nbytes<<3)|state — the same word

@@ -21,6 +21,16 @@ if not _REAL_CHIP:
         os.environ["XLA_FLAGS"] = (
             _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# the always-on flight recorder (obs/flight.py) dumps to ucc_flight.json
+# in the CWD by default; tests that trigger collection (watchdog dumps,
+# rank-failure drills) must not litter the repo checkout — route the
+# default to a per-session temp file (read at ucc_tpu import, so this
+# must run before the first test import)
+if "UCC_FLIGHT_FILE" not in os.environ:
+    import tempfile
+    os.environ["UCC_FLIGHT_FILE"] = os.path.join(
+        tempfile.gettempdir(), f"ucc_flight_test_{os.getpid()}.json")
+
 # this environment preloads jax at interpreter startup, so the env vars
 # above may arrive too late for jax's import-time config read — force the
 # platform through the runtime config as well (backends init lazily)

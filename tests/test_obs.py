@@ -1,5 +1,7 @@
 """Observability subsystem tests: metrics registry counts, span tracing
-nesting, stall-watchdog state dumps, and the ucc_stats tool."""
+nesting, stall-watchdog state dumps, the flight recorder (rings,
+cross-rank collection, desync/straggler diagnosis, Perfetto export),
+and the ucc_stats / ucc_fr tools."""
 import json
 import time
 
@@ -9,9 +11,17 @@ import pytest
 import ucc_tpu
 from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType, ReductionOp,
                      Status)
-from ucc_tpu.obs import metrics, watchdog
+from ucc_tpu.obs import diagnose, flight, metrics, watchdog
 
 from harness import UccJob
+
+
+def _allreduce_args(srcs, dsts, count):
+    return lambda r: CollArgs(
+        coll_type=CollType.ALLREDUCE,
+        src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+        dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+        op=ReductionOp.SUM)
 
 
 @pytest.fixture
@@ -302,3 +312,491 @@ class TestUccStatsTool:
         assert main([p, "--self-diff"]) == 0
         assert "+4" in capsys.readouterr().out
         assert main([str(tmp_path / "nope.json")]) == 1
+
+    def test_diff_last_two_of_one_file(self, stats, tmp_path, capsys):
+        from ucc_tpu.tools.stats import main
+        p = str(tmp_path / "d.json")
+        metrics.inc("x", 1)
+        metrics.dump(p)
+        metrics.inc("x", 2)
+        metrics.dump(p)
+        metrics.inc("x", 5)
+        metrics.dump(p)
+        assert main([p, "--diff"]) == 0
+        # last two snapshots: 3 -> 8, delta +5 (not the first's +7)
+        assert "+5" in capsys.readouterr().out
+        # needs two snapshots
+        p1 = str(tmp_path / "one.json")
+        metrics.dump(p1)
+        assert main([p1, "--diff"]) == 1
+
+    def test_percentiles_from_log2_buckets(self):
+        from ucc_tpu.tools.stats import hist_percentile
+        # all ten samples in bucket 3 = [4, 8): p50 interpolates inside
+        slot = {"count": 10, "max": 7.5, "buckets": {"3": 10}}
+        p50 = hist_percentile(slot, 0.50)
+        assert 4.0 <= p50 <= 7.5
+        # two buckets: 90 samples < 1, 10 in [512, 1024) -> p50 tiny,
+        # p99 inside the top bucket (clamped to the exact max)
+        slot = {"count": 100, "max": 600.0,
+                "buckets": {"0": 90, "10": 10}}
+        assert hist_percentile(slot, 0.50) < 1.0
+        p99 = hist_percentile(slot, 0.99)
+        assert 512.0 <= p99 <= 600.0
+        assert hist_percentile({"count": 0, "buckets": {}}, 0.5) == 0.0
+
+    def test_percentiles_in_snapshot_output(self, stats, capsys):
+        from ucc_tpu.tools.stats import print_snapshot
+        for v in (100, 200, 300, 400, 10000):
+            metrics.observe("lat_us", v, component="core")
+        print_snapshot(metrics.snapshot())
+        out = capsys.readouterr().out
+        assert "p50=" in out and "p99=" in out
+        # raw buckets only with show_buckets
+        assert "13:1" not in out
+        print_snapshot(metrics.snapshot(), show_buckets=True)
+        assert "14:1" in capsys.readouterr().out  # 10000 -> bucket 14
+
+    def test_watch_mode_prints_delta(self, stats, tmp_path, capsys):
+        from ucc_tpu.tools.stats import watch
+        p = str(tmp_path / "w.json")
+        metrics.inc("x", 3)
+        metrics.dump(p)
+        assert watch(p, interval=0.01, count=2) == 0
+        out = capsys.readouterr().out
+        assert "snapshot(s)" in out and "x" in out
+
+
+class TestFlightRing:
+    def test_ring_wraps_at_depth(self):
+        rec = flight.FlightRecorder(0, "uid", depth=16)
+        for i in range(40):
+            rec.post(1, 0, i, i, "allreduce", "ring", 64)
+        evs = rec.coll.events()
+        assert len(evs) == 16
+        # oldest-first, oldest surviving fseq is 24
+        assert [e["fseq"] for e in evs] == list(range(24, 40))
+        assert rec.coll.dropped == 24
+        assert all(e["coll"] == "allreduce" and e["size"] == 64
+                   for e in evs)
+
+    def test_appends_allocate_nothing(self):
+        """The always-on claim rests on appends never feeding the GC:
+        steady-state post/complete/wire appends must create zero
+        gc-tracked objects."""
+        import gc
+        rec = flight.FlightRecorder(0, "uid", depth=64)
+        key = (("t", 9, 1), 0, 7, 3, 0)
+        # warm the interner so steady state is label-stable
+        rec.post(1, 0, 0, 0, "allreduce", "ring", 64)
+        rec.complete(1, 0, 0, "allreduce", "ring", None, 0.1, "OK")
+        rec.wire.append("direct", key, 64)
+        gc.collect()
+        before = len(gc.get_objects())
+        for i in range(200):
+            rec.post(1, 0, i, i, "allreduce", "ring", 64)
+            rec.complete(1, 0, i, "allreduce", "ring", None, 0.1, "OK")
+            rec.wire.append("direct", key, 64)
+        after = len(gc.get_objects())
+        assert after - before < 20, (before, after)
+
+    def test_lifecycle_events_recorded(self):
+        """A scripted run leaves post/start/cmpl events with team id,
+        epoch, per-team fseq in program order, coll/alg/size labels."""
+        n, count, iters = 2, 8, 3
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            srcs = [np.full(count, r + 1.0) for r in range(n)]
+            dsts = [np.zeros(count) for _ in range(n)]
+            for _ in range(iters):
+                job.run_coll(teams, _allreduce_args(srcs, dsts, count))
+            for r in range(n):
+                rec = job.contexts[r].flight
+                assert rec is not None
+                snap = rec.snapshot()
+                posts = [e for e in snap["events"] if e["ev"] == "post"]
+                assert [e["fseq"] for e in posts] == [1, 2, 3]
+                for e in posts:
+                    assert e["team"] == teams[0].id
+                    assert e["epoch"] == 0
+                    assert e["coll"] == "allreduce"
+                    assert e["alg"]
+                    assert e["size"] == count * 8
+                cmpls = [e for e in snap["events"] if e["ev"] == "cmpl"]
+                assert len(cmpls) >= iters
+                assert all(c["status"] == "OK" for c in cmpls)
+                # wire ring saw the rounds, kinds from the real protocol
+                kinds = {w["kind"] for w in snap["wire"]}
+                assert kinds <= {"direct", "eager", "rndv", "fenced"}
+                assert snap["wire"]
+        finally:
+            job.cleanup()
+
+    def test_disabled_records_nothing(self):
+        flight.configure(enabled=False)
+        try:
+            job = UccJob(2)
+            try:
+                teams = job.create_team()
+                assert job.contexts[0].flight is None
+                srcs = [np.full(4, 1.0) for _ in range(2)]
+                dsts = [np.zeros(4) for _ in range(2)]
+                job.run_coll(teams, _allreduce_args(srcs, dsts, 4))
+            finally:
+                job.cleanup()
+        finally:
+            flight.configure(enabled=True)
+
+
+class TestFlightCollection:
+    def test_cooperative_cross_rank_collection(self):
+        """collect_team gathers every rank's ring over the service team;
+        the merged dump is identical on every member and diagnoses
+        clean on a healthy run."""
+        n, count = 3, 16
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            srcs = [np.full(count, r + 1.0) for r in range(n)]
+            dsts = [np.zeros(count) for _ in range(n)]
+            for _ in range(4):
+                job.run_coll(teams, _allreduce_args(srcs, dsts, count))
+            reqs = [flight.collect_team_post(t, reason="test")
+                    for t in teams]
+            job.progress_until(lambda: all(
+                r.test() != Status.IN_PROGRESS for r in reqs))
+            merged = reqs[0].result
+            assert sorted(merged["ranks"], key=int) == ["0", "1", "2"]
+            assert merged["absent_ranks"] == []
+            # every member holds the same rank set
+            for rq in reqs[1:]:
+                assert sorted(rq.result["ranks"]) == \
+                    sorted(merged["ranks"])
+            diag = diagnose.diagnose(merged)
+            assert diag["desync"] == []
+            assert diag["missing"] == []
+            assert diag["failed"] == []
+        finally:
+            job.cleanup()
+
+    def test_collection_past_killed_rank_degrades(self):
+        """REGRESSION: collection with a killed rank must not hang — the
+        dead rank is excluded from the exchange up front, the surviving
+        rings merge, and the absent rank is NAMED in the dump and the
+        diagnosis."""
+        from ucc_tpu.fault import inject as fault
+        n = 4
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            srcs = [np.full(8, r + 1.0) for r in range(n)]
+            dsts = [np.zeros(8) for _ in range(n)]
+            job.run_coll(teams, _allreduce_args(srcs, dsts, 8))
+            flight.reset()
+            fault.configure("kill=3", seed=0)
+            try:
+                reqs = [flight.collect_team_post(teams[r], reason="kill",
+                                                 timeout=20)
+                        for r in range(3)]   # survivors only
+                deadline = time.monotonic() + 30
+                while not all(r.test() != Status.IN_PROGRESS
+                              for r in reqs):
+                    for c in job.contexts[:3]:
+                        c.progress()
+                    assert time.monotonic() < deadline, \
+                        "collection hung past a killed rank"
+            finally:
+                fault.reset()
+            merged = reqs[0].result
+            assert sorted(merged["ranks"], key=int) == ["0", "1", "2"]
+            assert merged["absent_ranks"] == [3]
+            assert merged.get("partial")
+            failed = diagnose.detect_failed(merged)
+            assert any(f["rank"] == 3 and f.get("absent")
+                       for f in failed)
+        finally:
+            job.cleanup()
+
+
+class TestDesyncDiagnosis:
+    @staticmethod
+    def _post(t, fseq, coll="allreduce", alg="ring", size=128, team=7,
+              seq=None):
+        return {"t": t, "ev": "post", "team": team, "epoch": 0,
+                "fseq": fseq, "seq": seq if seq is not None else fseq,
+                "coll": coll, "alg": alg, "size": size}
+
+    @staticmethod
+    def _cmpl(t, seq, dur=0.001, status="OK", team=7, stage=None,
+              coll="allreduce", alg="ring"):
+        d = {"t": t, "ev": "cmpl", "team": team, "epoch": 0, "seq": seq,
+             "dur_s": dur, "status": status}
+        if stage:
+            d["stage"] = stage
+        else:
+            d["coll"], d["alg"] = coll, alg
+        return d
+
+    @classmethod
+    def _merged(cls, events_by_rank, wire_by_rank=None, absent=()):
+        return {"ranks": {str(r): {"events": ev,
+                                   "wire": (wire_by_rank or {}).get(r, [])}
+                          for r, ev in events_by_rank.items()},
+                "absent_ranks": list(absent)}
+
+    def test_mismatched_post_names_minority_rank(self):
+        P = self._post
+        merged = self._merged({
+            0: [P(1.0, 1), P(2.0, 2)],
+            1: [P(1.0, 1), P(2.0, 2)],
+            2: [P(1.0, 1), P(2.0, 2, coll="allgather", alg="linear",
+                             size=64)],
+        })
+        findings = diagnose.detect_desync(merged)
+        assert len(findings) == 1
+        f = findings[0]
+        assert f["fseq"] == 2 and f["culprits"] == [2]
+        assert f["expect"]["coll"] == "allreduce"
+        assert f["got"]["2"]["coll"] == "allgather"
+        # folded into the top-level summary with the rank named
+        summary = diagnose.diagnose(merged)["summary"]
+        assert any("DESYNC" in s and "rank(s) 2" in s for s in summary)
+
+    def test_size_mismatch_is_desync_too(self):
+        P = self._post
+        merged = self._merged({
+            0: [P(1.0, 1, size=256)],
+            1: [P(1.0, 1, size=256)],
+            2: [P(1.0, 1, size=512)],
+        })
+        f = diagnose.detect_desync(merged)
+        assert f and f[0]["culprits"] == [2]
+
+    def test_missing_participant_named(self):
+        P, C = self._post, self._cmpl
+        merged = self._merged({
+            0: [P(1.0, 1), C(1.1, 1), P(2.0, 2), C(2.1, 2),
+                P(3.0, 3), P(9.0, 4)],
+            1: [P(1.0, 1), C(1.1, 1), P(2.0, 2), C(2.1, 2),
+                P(3.0, 3), P(9.0, 4)],
+            2: [P(1.0, 1), C(1.1, 1), P(2.0, 2), C(2.1, 2)],
+        })
+        findings = diagnose.detect_missing(merged)
+        miss = [f for f in findings if f["kind"] == "missing"]
+        assert len(miss) == 1
+        assert miss[0]["culprits"] == [2]
+        assert miss[0]["last_fseq"]["2"] == 2
+        # ranks 0/1 show their never-completed posts as stuck
+        stuck = [f for f in findings if f["kind"] == "stuck"]
+        assert {f["rank"] for f in stuck} == {0, 1}
+        assert {f["fseq"] for f in stuck} == {3, 4}
+
+    def test_healthy_timeline_is_clean(self):
+        P, C = self._post, self._cmpl
+        ev = [P(1.0, 1), C(1.1, 1), P(2.0, 2), C(2.1, 2)]
+        merged = self._merged({0: list(ev), 1: list(ev), 2: list(ev)})
+        diag = diagnose.diagnose(merged)
+        assert diag["summary"] == []
+
+
+class TestStragglerDiagnosis(TestDesyncDiagnosis):
+    def test_duration_outlier_names_rank(self):
+        P, C = self._post, self._cmpl
+        ranks = {}
+        for r in range(4):
+            dur = 0.5 if r == 2 else 0.01
+            ranks[r] = [P(1.0, 1), C(1.0 + dur, 1, dur=dur),
+                        P(2.0, 2), C(2.0 + dur, 2, dur=dur)]
+        findings = diagnose.detect_stragglers(self._merged(ranks))
+        dur_f = [f for f in findings if f["signal"] == "duration"]
+        assert len(dur_f) == 1
+        assert dur_f[0]["rank"] == 2 and dur_f[0]["outlier_colls"] == 2
+        assert dur_f[0]["coll"] == "allreduce"
+
+    def test_wire_lag_names_source_rank_and_seq(self):
+        P, C = self._post, self._cmpl
+        events, wire = {}, {}
+        for r in range(3):
+            lag = 0.08 if r == 1 else 0.0
+            events[r] = [P(1.0, 5, seq=50), C(1.5, 50, dur=0.5)]
+            wire[r] = [{"t": 1.01 + lag + 0.1 * s, "ev": "snd",
+                        "kind": "direct", "tkey": "tk", "epoch": 0,
+                        "tag": 9, "slot": s, "nbytes": 64}
+                       for s in range(4)]
+        findings = diagnose.detect_stragglers(
+            self._merged(events, wire))
+        lag_f = [f for f in findings if f["signal"] == "wire_lag"]
+        assert len(lag_f) == 1
+        assert lag_f[0]["rank"] == 1
+        assert lag_f[0]["lag_s"] == pytest.approx(0.08, abs=0.01)
+        # the straggler's in-flight collective is attributed
+        assert {s["fseq"] for s in lag_f[0]["seqs"]} == {5}
+
+    def test_stage_outlier_names_tree_level(self):
+        P, C = self._post, self._cmpl
+        ranks = {}
+        for r in range(4):
+            dur = 0.2 if r == 3 else 0.005
+            ranks[r] = [C(1.0, 100 + r, dur=dur,
+                          stage="rab.leaders_allreduce"),
+                        C(2.0, 200 + r, dur=0.005,
+                          stage="rab.node_bcast")]
+        findings = diagnose.detect_stragglers(self._merged(ranks))
+        st = [f for f in findings if f["signal"] == "stage"]
+        assert len(st) == 1
+        assert st[0]["rank"] == 3
+        assert st[0]["stage"] == "rab.leaders_allreduce"
+
+    def test_symmetric_timings_are_quiet(self):
+        P, C = self._post, self._cmpl
+        ranks = {r: [P(1.0, 1), C(1.01, 1, dur=0.01)] for r in range(4)}
+        assert diagnose.detect_stragglers(self._merged(ranks)) == []
+
+
+class TestPerfettoExport(TestDesyncDiagnosis):
+    def test_export_has_per_rank_tracks(self, tmp_path):
+        P, C = self._post, self._cmpl
+        ranks = {r: [P(1.0, 1), C(1.2, 1, dur=0.2),
+                     C(1.1, 9, dur=0.05, stage="rab.node_reduce")]
+                 for r in range(3)}
+        wire = {0: [{"t": 1.05, "ev": "snd", "kind": "direct",
+                     "tkey": "tk", "epoch": 0, "tag": 1, "slot": 0,
+                     "nbytes": 64}]}
+        trace = diagnose.to_chrome_trace(self._merged(ranks, wire))
+        evs = trace["traceEvents"]
+        json.dumps(trace)   # must serialize
+        pids = {e["pid"] for e in evs}
+        assert pids == {0, 1, 2}
+        # one X slice per completion, named coll:alg
+        slices = [e for e in evs if e["ph"] == "X"]
+        assert any(e["name"] == "allreduce:ring" for e in slices)
+        # hier stages get their own named track
+        tnames = [e for e in evs
+                  if e["ph"] == "M" and e["name"] == "thread_name"]
+        names = {e["args"]["name"] for e in tnames}
+        assert {"collectives", "wire", "rab.node_reduce"} <= names
+        # posts + wire sends as instants
+        assert any(e["ph"] == "i" and e["name"].startswith("post ")
+                   for e in evs)
+        assert any(e["ph"] == "i" and e["name"] == "snd:direct"
+                   for e in evs)
+
+    def test_export_from_live_run_loads(self, tmp_path):
+        n, count = 2, 8
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            srcs = [np.full(count, r + 1.0) for r in range(n)]
+            dsts = [np.zeros(count) for _ in range(n)]
+            job.run_coll(teams, _allreduce_args(srcs, dsts, count))
+            merged = flight.collect_process(job.contexts[0], "test")
+        finally:
+            job.cleanup()
+        out = tmp_path / "trace.json"
+        trace = diagnose.to_chrome_trace(merged)
+        out.write_text(json.dumps(trace))
+        back = json.loads(out.read_text())
+        assert back["traceEvents"]
+        assert {e["pid"] for e in back["traceEvents"]} == {0, 1}
+
+
+class TestFlightTools:
+    def test_ucc_fr_merges_and_diagnoses(self, tmp_path, capsys):
+        from ucc_tpu.tools.fr import main
+        path = tmp_path / "fl.json"
+        n = 2
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            srcs = [np.full(8, r + 1.0) for r in range(n)]
+            dsts = [np.zeros(8) for _ in range(n)]
+            job.run_coll(teams, _allreduce_args(srcs, dsts, 8))
+            for ctx in job.contexts:
+                flight.dump_local(ctx.flight, "test", str(path))
+        finally:
+            job.cleanup()
+        assert main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "2 rank(s)" in out and "clean" in out
+        # perfetto export side channel
+        trace_path = tmp_path / "t.json"
+        assert main([str(path), "--perfetto", str(trace_path),
+                     "--json"]) == 0
+        out = capsys.readouterr().out
+        rec = json.loads(out.splitlines()[-1])
+        assert rec["ranks"] == ["0", "1"]
+        assert json.loads(trace_path.read_text())["traceEvents"]
+        # no records -> error
+        empty = tmp_path / "empty.json"
+        empty.write_text("")
+        assert main([str(empty)]) == 1
+
+    def test_merge_records_prefers_latest_merged(self):
+        recs = [
+            {"kind": "flight_local", "rank": 0, "events": []},
+            {"kind": "flight_merged", "reason": "old", "ranks": {}},
+            {"kind": "flight_merged", "reason": "new",
+             "ranks": {"0": {"events": []}}},
+        ]
+        m = diagnose.merge_records(recs)
+        assert m["reason"] == "new"
+        locals_only = diagnose.merge_records(
+            [{"kind": "flight_local", "rank": 1, "events": [],
+              "wire": []}])
+        assert "1" in locals_only["ranks"]
+
+    def test_delay_rank_spec_parses_and_pins(self):
+        from ucc_tpu.fault.inject import parse_spec
+        spec = parse_spec("delay=1.0:0.02,delay_rank=2")
+        assert spec.delay == 1.0 and spec.delay_rank == 2
+        assert spec.active
+        with pytest.raises(ValueError):
+            parse_spec("delay_rnk=2")
+
+
+class TestWatchdogFlightFoldIn:
+    def test_dump_includes_diagnosis_config_and_occupancy(self, wd):
+        """A watchdog state dump carries the flight diagnosis, resolved
+        config provenance (quant/tuner/ft), and transport backlog."""
+        queue = type("Q", (), {"_q": []})()
+        report = watchdog.dump_state(queue, [], [], reason="test")
+        assert "flight_diagnosis" in report
+        assert "summary" in report["flight_diagnosis"]
+        cfg = report["config"]
+        assert "quant" in cfg and "tuner" in cfg and "ft" in cfg
+        assert isinstance(report["transports"], list)
+        # the JSON line on disk parses and carries the same sections
+        line = json.loads(wd.read_text().splitlines()[-1])
+        assert "config" in line and "flight_diagnosis" in line
+
+    def test_mailbox_occupancy_counts_backlog(self):
+        from ucc_tpu.tl.host.transport import InProcTransport
+        tr = InProcTransport(use_native=False)
+        try:
+            key = (("t", 1, 2), 0, 1, 0, 0)
+            tr.send_nb(tr, key, np.zeros(4))          # unexpected eager
+            occ = tr.occupancy()
+            assert occ["unexpected"] == 1
+            tr.recv_nb((("t", 1, 2), 0, 2, 0, 0), np.zeros(4))
+            occ = tr.occupancy()
+            assert occ["posted"] == 1
+        finally:
+            tr.close()
+
+    def test_backlog_gauges_in_stats_snapshot(self, stats):
+        """The registered sampler publishes mailbox gauges into every
+        metrics snapshot; the progress loop publishes queue depth."""
+        n = 2
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            srcs = [np.full(4, 1.0) for _ in range(n)]
+            dsts = [np.zeros(4) for _ in range(n)]
+            job.run_coll(teams, _allreduce_args(srcs, dsts, 4))
+            snap = metrics.snapshot()
+            assert "progress_queue_depth" in snap["gauges"]
+            assert "mailbox_unexpected" in snap["gauges"]
+            assert "mailbox_posted_recvs" in snap["gauges"]
+        finally:
+            job.cleanup()

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Flight-recorder console (repo-root entry).
+
+Thin shim over the packaged CLI — the implementation lives in
+ucc_tpu/tools/fr.py (installed as the `ucc_fr` console script). Merges
+per-rank flight dumps, runs the desync/straggler/missing-participant
+diagnosis, exports Chrome-trace/Perfetto timelines, and can trigger a
+live dump via SIGUSR2.
+
+    python tools/fr.py ucc_flight.json --perfetto trace.json
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ucc_tpu.tools.fr import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -514,6 +514,66 @@ def _scale_smoke(env) -> None:
           f"in {dt:.0f}s -> {verdict}", flush=True)
 
 
+def _fr_smoke(env) -> None:
+    """WARN-ONLY flight-recorder diagnosis probe (ISSUE 9 CI satellite,
+    same harness as the other smokes): `ucc_fr --smoke` runs a 4-rank
+    job under UCC_FAULT=delay pinned to ONE rank (a known controlled
+    straggler), collects the rings cross-rank over the service team,
+    and the diagnosis must name exactly that rank plus the collective
+    sequence(s) it was slow in. Skip with UCC_GATE_FR=0."""
+    import json
+    if os.environ.get("UCC_GATE_FR", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] fr smoke: skipped (UCC_GATE_FR=0)", flush=True)
+        return
+    print("[gate] flight-recorder smoke (warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    # the drill sets its own UCC_FAULT; strip the gate's watchdog arming
+    # so escalation doesn't cancel the deliberately-delayed collectives
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE"))}
+    smoke_env["UCC_FLIGHT"] = "y"
+    smoke_env["UCC_FLIGHT_FILE"] = "/tmp/ucc_gate_flight.json"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ucc_tpu.tools.fr", "--smoke"],
+            cwd=REPO, env=smoke_env, capture_output=True, text=True,
+            timeout=600)
+    except subprocess.TimeoutExpired:
+        print("[gate] WARN: fr smoke timed out (not a gate failure)",
+              flush=True)
+        return
+    rec = None
+    for ln in (r.stdout or "").splitlines():
+        if ln.startswith("{"):
+            try:
+                cand = json.loads(ln)
+            except ValueError:
+                continue
+            if cand.get("metric") == "fr_smoke":
+                rec = cand
+    dt = time.monotonic() - t0
+    if rec is None or rec.get("error"):
+        why = (rec or {}).get("error") or f"rc={r.returncode}, no record"
+        print(f"[gate] WARN: fr smoke — {why} in {dt:.0f}s "
+              f"(not a gate failure)", flush=True)
+        return
+    problems = []
+    if rec.get("culprit_ranks") != [rec.get("pinned_rank")]:
+        problems.append(
+            f"diagnosis named rank(s) {rec.get('culprit_ranks')} "
+            f"instead of the pinned rank {rec.get('pinned_rank')}")
+    if not rec.get("stuck_seqs"):
+        problems.append("no collective sequence attributed to the "
+                        "straggler")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] fr smoke: pinned rank {rec.get('pinned_rank')}, "
+          f"diagnosed {rec.get('culprit_ranks')} over seqs "
+          f"{rec.get('stuck_seqs')} in {dt:.0f}s -> {verdict}",
+          flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -538,6 +598,9 @@ def main(argv=None) -> int:
     env.setdefault("UCC_WATCHDOG_ACTION", "cancel")
     env.setdefault("UCC_WATCHDOG_HARD_TIMEOUT", "200")
     env.setdefault("UCC_WATCHDOG_FILE", WATCHDOG_FILE)
+    # flight-recorder dumps (always-on) out of the checkout: a watchdog
+    # or rank-failure trigger in any gate child writes here
+    env.setdefault("UCC_FLIGHT_FILE", "/tmp/ucc_gate_flight.json")
 
     ok = True
     if args.quick:
@@ -580,6 +643,9 @@ def main(argv=None) -> int:
         # collective matrix, and the N-level hier allreduce beats the
         # flat DCN default (ISSUE 8)
         _scale_smoke(env)
+        # warn-only: flight-recorder diagnosis names a fault-injected
+        # straggler rank and its stuck collective seq (ISSUE 9)
+        _fr_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

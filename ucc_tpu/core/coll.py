@@ -102,11 +102,19 @@ class CollRequest:
     #: the PR-3 ``_instr`` binding pattern, so UCC_TUNER=off adds no
     #: per-post branch to this hot path
     _tuner = None
+    #: flight recorder (obs/flight.py): the context's recorder, bound
+    #: once at init (same pattern) — None when UCC_FLIGHT=n, so the post
+    #: path pays exactly one branch
+    _flight = None
+    _flight_msgsize = 0
 
     def __init__(self, task: CollTask, team: Team, args: CollArgs):
         self.task = task
         self.team = team
         self.args = args
+        fr = team.context.flight
+        if fr is not None:
+            self._flight = fr
         self._posted = False
         self._finalized = False
         #: runtime fallback chain: (init_args, [remaining MsgRange]) set
@@ -174,6 +182,8 @@ class CollRequest:
                         metrics.inc("coll_fast_repost", component="core",
                                     coll=task.coll_name or "",
                                     alg=task.alg_name or "")
+                    if self._flight is not None:
+                        self._flight_post(task)
                     return task.fast_repost()
             self.task.reset()
         self._posted = True
@@ -182,11 +192,25 @@ class CollRequest:
             metrics.inc("coll_posted", component="core",
                         coll=self.task.coll_name or "",
                         alg=self.task.alg_name or "")
+        if self._flight is not None:
+            self._flight_post(self.task)
         if self._trace:
             logger.info("coll post: %s team %s seq %d",
                         coll_type_str(self.args.coll_type), self.team.id,
                         self.task.seq_num)
         return self.task.post()
+
+    def _flight_post(self, task: CollTask) -> None:
+        """Flight-ring post event. The per-team ``flight_seq`` advances
+        in program order — identical on every member by the UCC
+        ordered-issue contract — and is the cross-rank join key the
+        desync/straggler diagnosis correlates on (obs/diagnose.py)."""
+        team = self.team
+        fs = team.flight_seq + 1
+        team.flight_seq = fs
+        self._flight.post(team.id, team.epoch, fs, task.seq_num,
+                          task.coll_name or "", task.alg_name or "",
+                          self._flight_msgsize)
 
     def _probe_fast(self) -> bool:
         try:
@@ -321,6 +345,8 @@ class CollRequest:
             metrics.inc("coll_posted", component="core",
                         coll=new_task.coll_name or "",
                         alg=new_task.alg_name or "")
+        if self._flight is not None:
+            self._flight_post(new_task)
         if self._trace:
             logger.info("coll post (tuner explore): %s alg %s team %s "
                         "seq %d", new_task.coll_name, new_task.alg_name,
@@ -537,6 +563,7 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
     if profiling.ENABLED:
         _attach_profiling(task, ct)
     req = CollRequest(task, team, args)
+    req._flight_msgsize = msgsize
     tuner = team.tuner
     if tuner is not None and task is inner and args.active_set is None \
             and tuner.wants(ct, mem_type, msgsize, candidates):

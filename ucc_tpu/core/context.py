@@ -89,6 +89,13 @@ class Context:
         # (UCC_FT=shrink) the heartbeat-board key peers watch for liveness
         import uuid as _uuid
         self._ctx_uid = _uuid.uuid4().hex
+        # flight recorder (obs/flight.py, UCC_FLIGHT — on by default):
+        # this rank's preallocated event rings, registered process-wide
+        # so a watchdog/rank-failure trigger can collect every ring the
+        # process can see. None when disabled; every producer guards on
+        # that with one branch.
+        from ..obs import flight as _flight
+        self.flight = _flight.register_context(self)
         self.health = None
         if ft_health.ENABLED:
             self.health = ft_health.HealthRegistry(self)

@@ -65,6 +65,12 @@ class Team:
     #: successor team
     _shrunk = False
     _destroyed = False
+    #: per-team flight-recorder sequence (obs/flight.py): bumped once
+    #: per collective post in program order — identical across members
+    #: by the UCC ordered-issue contract, so it is the cross-rank join
+    #: key the flight diagnosis correlates on. Class attr: zero cost
+    #: until the first post.
+    flight_seq = 0
     #: online autotuner (score/tuner.py OnlineTuner), attached at
     #: activation when UCC_TUNER=online; None (class attr, zero cost)
     #: otherwise — core dispatch checks it once per collective INIT
@@ -596,6 +602,9 @@ class Team:
         purged = 0
         for team_key, transport in self._tl_tag_spaces():
             purged += transport.fence(team_key, min_epoch)
+        fr = self.context.flight
+        if fr is not None:
+            fr.fence(self.team_key, min_epoch, purged)
         return purged
 
     def shrink_post(self, dead_hint: Optional[List[int]] = None

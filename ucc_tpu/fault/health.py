@@ -118,10 +118,13 @@ def note_dead_target(ctx_rank: int, registry: Optional["HealthRegistry"],
     _STANDALONE_NOTED.add(ctx_rank)
     logger.error("rank failure detected: ctx rank %d (source=%s%s)",
                  ctx_rank, source, f": {detail}" if detail else "")
-    from ..obs import metrics, watchdog
+    from ..obs import flight, metrics, watchdog
     if metrics.ENABLED:
         metrics.inc("rank_failures_detected", component="fault", alg=source)
     watchdog.note_rank_failure([ctx_rank], source, detail)
+    # flight recorder: dump what this process can see with the failed
+    # rank named — the "what was in flight when rank N died" record
+    flight.on_rank_failure(ctx_rank, source, detail)
 
 
 # ---------------------------------------------------------------------------
@@ -205,11 +208,12 @@ class HealthRegistry:
             self.suspected.pop(ctx_rank, None)
         logger.error("rank failure detected: ctx rank %d (source=%s%s)",
                      ctx_rank, source, f": {detail}" if detail else "")
-        from ..obs import metrics, watchdog
+        from ..obs import flight, metrics, watchdog
         if metrics.ENABLED:
             metrics.inc("rank_failures_detected", component="fault",
                         alg=source)
         watchdog.note_rank_failure(sorted(self.dead), source, detail)
+        flight.on_rank_failure(ctx_rank, source, detail)
         return True
 
     def suspect(self, ctx_rank: int, source: str = "watchdog",

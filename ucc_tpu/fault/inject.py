@@ -41,6 +41,7 @@ class FaultSpec:
     drop: float = 0.0          # P(send dropped)
     delay: float = 0.0         # P(send delayed)
     delay_s: float = 0.0       # delay duration
+    delay_rank: Optional[int] = None   # pin delays to one ctx rank
     error: float = 0.0         # P(send/recv post fails)
     post_error: float = 0.0    # P(task post fails before wire traffic)
     kill: Set[int] = field(default_factory=set)   # dead ctx ranks
@@ -52,9 +53,12 @@ class FaultSpec:
 
 
 def parse_spec(s: str) -> FaultSpec:
-    """Parse ``drop=P,delay=P:S,error=P,post_error=P,kill=R[+R..]``.
-    Unknown keys raise: a typo'd fault drill that silently injects
-    nothing would report a no-hang pass it never earned."""
+    """Parse ``drop=P,delay=P:S,delay_rank=R,error=P,post_error=P,
+    kill=R[+R..]``. ``delay_rank`` pins send delays to one ctx rank —
+    the controlled-straggler drill the flight-recorder diagnosis smoke
+    uses (a known culprit the diagnosis must name). Unknown keys raise:
+    a typo'd fault drill that silently injects nothing would report a
+    no-hang pass it never earned."""
     spec = FaultSpec()
     s = (s or "").strip()
     if not s or s.lower() in ("n", "no", "off", "0"):
@@ -75,6 +79,8 @@ def parse_spec(s: str) -> FaultSpec:
                 spec.delay, spec.delay_s = float(p), float(d)
             else:
                 spec.delay, spec.delay_s = float(v), 0.001
+        elif k == "delay_rank":
+            spec.delay_rank = int(v)
         elif k == "error":
             spec.error = float(v)
         elif k == "post_error":
@@ -159,7 +165,8 @@ def send_action(ctx_rank: Optional[int] = None):
         COUNTS["error"] += 1
         return "error"
     r -= SPEC.error
-    if r < SPEC.delay:
+    if r < SPEC.delay and (SPEC.delay_rank is None or
+                           ctx_rank == SPEC.delay_rank):
         COUNTS["delay"] += 1
         return ("delay", SPEC.delay_s)
     return None

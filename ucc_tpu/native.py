@@ -38,7 +38,8 @@ from .utils.log import get_logger
 logger = get_logger("native")
 
 #: must match kAbiVersion in native/ucc_tpu_core.cc
-ABI_VERSION = 2
+#: (3: adds ucc_mailbox_occupancy — backlog gauges for obs dumps)
+ABI_VERSION = 3
 
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -353,6 +354,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.ucc_mailbox_fence.argtypes = [vp, u64, u64]
         lib.ucc_mailbox_purge.restype = u64
         lib.ucc_mailbox_purge.argtypes = [vp]
+        lib.ucc_mailbox_occupancy.restype = None
+        lib.ucc_mailbox_occupancy.argtypes = [vp, ctypes.POINTER(u64)]
         lib.ucc_req_poll.restype = u64
         lib.ucc_req_poll.argtypes = [vp, u64]
         lib.ucc_req_test_many.restype = u64
@@ -722,6 +725,17 @@ class NativeMailbox:
             return 0
         return int(self.lib.ucc_mailbox_fence(
             ptr, self.team_id(team_key), min_epoch))
+
+    def occupancy(self):
+        """(unexpected parked msgs, posted recvs, live request slots) —
+        the backlog gauges the watchdog/interval dumps sample. Cold
+        diagnostic path (one ffi call + shard locks)."""
+        ptr = self.ptr                # snapshot: see NativeRecvReq.test
+        if ptr is None:
+            return (0, 0, 0)
+        out = (ctypes.c_uint64 * 3)()
+        self.lib.ucc_mailbox_occupancy(ptr, out)
+        return (int(out[0]), int(out[1]), int(out[2]))
 
     # -- request plumbing ----------------------------------------------
     def _free(self, rid: int) -> None:

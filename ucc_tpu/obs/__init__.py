@@ -16,10 +16,20 @@ exactly this telemetry + hang-diagnostics pairing):
   the detect→survive bridge of the fault-tolerance layer (PR 2; the
   injection side lives in ``ucc_tpu.fault``).
 
-Every pillar is zero-cost when its env knob is unset: hot paths guard
-with module-level booleans (``metrics.ENABLED`` / ``watchdog.ENABLED``
-/ ``profiling.ENABLED``) before any formatting or locking.
-"""
-from . import metrics, watchdog  # noqa: F401
+- ``obs.flight``   — ALWAYS-ON cluster flight recorder (``UCC_FLIGHT``,
+  default y): per-rank fixed-size rings of compact collective lifecycle
+  events, collected across ranks on watchdog escalation / rank failure
+  / SIGUSR2 / ``ucc_fr``, and diagnosed by ``obs.diagnose`` (desync,
+  straggler, missing-participant naming) with Chrome-trace/Perfetto
+  export.
 
-__all__ = ["metrics", "watchdog"]
+Every optional pillar is zero-cost when its env knob is unset: hot
+paths guard with module-level booleans (``metrics.ENABLED`` /
+``watchdog.ENABLED`` / ``profiling.ENABLED``) before any formatting or
+locking. The flight recorder is the deliberate exception — on by
+default, sized so the steady-state cost is one wait-free ring append
+per event (``UCC_FLIGHT=n`` removes even that).
+"""
+from . import flight, metrics, watchdog  # noqa: F401
+
+__all__ = ["flight", "metrics", "watchdog"]

@@ -107,8 +107,25 @@ def _flatten(table: Dict[Key, Any]) -> Dict[str, Dict[str, Any]]:
     return out
 
 
+#: gauge samplers: callbacks run at snapshot time (cold) so components
+#: can publish point-in-time state — e.g. tl/host mailbox occupancy —
+#: into interval/exit/SIGUSR2 dumps without a hot-path gauge write
+_samplers: list = []
+
+
+def register_sampler(fn) -> None:
+    if fn not in _samplers:
+        _samplers.append(fn)
+
+
 def snapshot() -> Dict[str, Any]:
     """Deep-copied point-in-time view of every series."""
+    if ENABLED:
+        for fn in list(_samplers):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - a broken sampler must not
+                pass           # poison the dump it feeds
     with _lock:
         return {
             "ts": time.time(),
@@ -174,15 +191,23 @@ _bg_started = False
 _interval_thread: Optional[threading.Thread] = None
 
 
-def _sigusr2(_signum, _frame) -> None:
-    if not ENABLED:
-        return
-    # NEVER dump inline: the handler runs on the main thread between
-    # bytecodes, possibly while that thread holds the non-reentrant
-    # _lock inside inc()/observe() — snapshot() would deadlock the
-    # process. A short-lived thread simply waits its turn for the lock.
-    threading.Thread(target=dump, kwargs={"reason": "SIGUSR2"},
-                     daemon=True, name="ucc-stats-sigusr2").start()
+_prev_sigusr2 = None
+
+
+def _sigusr2(signum, frame) -> None:
+    if ENABLED:
+        # NEVER dump inline: the handler runs on the main thread between
+        # bytecodes, possibly while that thread holds the non-reentrant
+        # _lock inside inc()/observe() — snapshot() would deadlock the
+        # process. A short-lived thread simply waits its turn for the
+        # lock.
+        threading.Thread(target=dump, kwargs={"reason": "SIGUSR2"},
+                         daemon=True, name="ucc-stats-sigusr2").start()
+    # chain an earlier handler (obs.flight arms the same signal) instead
+    # of unseating it
+    prev = _prev_sigusr2
+    if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+        prev(signum, frame)
 
 
 def _interval_loop() -> None:
@@ -203,6 +228,10 @@ def _start_background(dump_at_exit: bool = True) -> None:
         try:
             # only valid in the main thread; embedders that import
             # off-main simply lose the signal trigger, not the registry
+            global _prev_sigusr2
+            prev = signal.getsignal(signal.SIGUSR2)
+            if prev is not _sigusr2:
+                _prev_sigusr2 = prev
             signal.signal(signal.SIGUSR2, _sigusr2)
         except (ValueError, OSError):
             pass

@@ -68,6 +68,11 @@ class ProgressQueue:
         self._throttle = (self._throttle + 1) % self._throttle_period
         if metrics.ENABLED:
             metrics.inc("progress_iterations", component="schedule")
+            # backlog gauge: a deep queue is the first visible symptom
+            # of a progress stall (satellite of the flight-recorder PR —
+            # last write wins, so snapshots see the current depth)
+            metrics.gauge("progress_queue_depth", len(self._q),
+                          component="schedule")
         if watchdog.ENABLED:
             # self-throttled to ~1 scan/s; fires one-shot state dumps
             # for tasks IN_PROGRESS past the soft deadline, and (with

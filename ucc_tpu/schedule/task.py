@@ -24,12 +24,22 @@ from typing import Any, Callable, List, Optional, Tuple
 
 from ..constants import EventType
 from ..fault import inject as fault
-from ..obs import metrics
+from ..obs import flight, metrics
 from ..status import Status
 from ..utils import profiling
 from ..utils.log import get_logger
 
 logger = get_logger("schedule")
+
+
+def _flight_rec(task: "CollTask"):
+    """The owning context's flight recorder (None when UCC_FLIGHT=n or
+    the task has no team — bare internal tasks). Cold-ish: called once
+    per labeled task lifecycle step, never per message."""
+    core = getattr(task.team, "core_team", task.team)
+    if core is None:
+        return None
+    return getattr(getattr(core, "context", None), "flight", None)
 
 _seq_counter = 0
 
@@ -161,6 +171,20 @@ class CollTask:
                 f"task_{type(self).__name__}", self.seq_num,
                 parent=self.schedule.seq_num if self.schedule is not None
                 else None, **fields)
+        if flight.ENABLED and self.obs_stage:
+            # flight-ring start event: STAGED tasks only (CL/hier phase
+            # tasks — obs_stage names the tree level). Plain top-level
+            # tasks skip it: the CollRequest post event already records
+            # their identity, and the completion event carries the
+            # duration, so a start would be a redundant hot-path append.
+            rec = _flight_rec(self)
+            if rec is not None:
+                core = getattr(self.team, "core_team", self.team)
+                tag = self.__dict__.get("tag")
+                rec.start(getattr(core, "id", None),
+                          getattr(core, "epoch", 0), self.seq_num,
+                          self.coll_name, self.alg_name, self.obs_stage,
+                          tag if isinstance(tag, int) else None)
         if fault.ENABLED:
             bad = fault.post_inject(self)
             if bad is not None:
@@ -216,6 +240,13 @@ class CollTask:
         if metrics.ENABLED:
             metrics.inc("coll_cancelled", component="core",
                         coll=self.coll_name or "", alg=self.alg_name or "")
+        if flight.ENABLED and (self.coll_name or self.obs_stage):
+            rec = _flight_rec(self)
+            if rec is not None:
+                core = getattr(self.team, "core_team", self.team)
+                rec.cancel(getattr(core, "id", None),
+                           getattr(core, "epoch", 0), self.seq_num,
+                           self.coll_name, self.alg_name, status.name)
         if not self.is_completed():  # cancel_fn may have completed us
             self.complete(status)
 
@@ -280,6 +311,16 @@ class CollTask:
             else:
                 metrics.inc("coll_completed", component="core",
                             coll=self.coll_name, alg=alg)
+        if flight.ENABLED and (self.coll_name or self.obs_stage):
+            rec = _flight_rec(self)
+            if rec is not None:
+                core = getattr(self.team, "core_team", self.team)
+                dur = (time.monotonic() - self.start_time) \
+                    if self.start_time else 0.0
+                rec.complete(getattr(core, "id", None),
+                             getattr(core, "epoch", 0), self.seq_num,
+                             self.coll_name, self.alg_name,
+                             self.obs_stage, dur, st.name)
         if st.is_error:
             if self.timeout and st == Status.ERR_TIMED_OUT:
                 logger.warning(

@@ -215,6 +215,16 @@ class Mailbox:
             self.unexpected.setdefault(key, deque()).append(ps)
             return ps.req, kind
 
+    def occupancy(self) -> Tuple[int, int]:
+        """(parked unexpected messages, live posted recvs) — the backlog
+        gauges the interval/watchdog dumps sample (a growing unexpected
+        queue is the first visible symptom of a receiver falling
+        behind). Cold path: takes the lock."""
+        with self.lock:
+            unexp = sum(len(q) for q in self.unexpected.values())
+            posted = sum(len(q) for q in self.posted.values())
+        return unexp, posted
+
     def post_recv(self, key: TagKey, req: RecvReq) -> None:
         with self.lock:
             req._mb = self
@@ -316,8 +326,14 @@ class InProcTransport:
         # unconditionally; tests and bench read them directly)
         self.n_direct = 0        # copy-free deliveries into posted recvs
         self.n_eager = 0         # unexpected sends staged via eager copy
-        self.n_rndv = 0          # unexpected zero-copy rendezvous views
+        self.n_rndv = 0         # unexpected zero-copy rendezvous views
         self.n_fenced = 0        # stale-epoch sends discarded at the fence
+        # flight recorder wire ring (obs/flight.py): bound ONCE by the
+        # owning TL context — the endpoint-level analog of the PR-3
+        # `_instr` per-post binding, so the send path pays one branch
+        # when off and one ring append when on. Covers native sends too:
+        # they route back through _count_send with their kind.
+        self._flight = None
         self.native = None
         forced = False
         if use_native is None:
@@ -383,6 +399,23 @@ class InProcTransport:
         else:
             self.n_fenced += 1
 
+    def occupancy(self) -> Dict[str, int]:
+        """Mailbox backlog gauges: python unexpected/posted queue
+        lengths plus (when the native matcher is attached) the C core's
+        unexpected/posted/live-slot counts. Cold path."""
+        unexp, posted = self.mailbox.occupancy()
+        d = {"unexpected": unexp, "posted": posted}
+        if self.native is not None:
+            try:
+                n = self.native.occupancy()
+            except Exception:  # noqa: BLE001 - diagnostics only
+                n = None
+            if n is not None:
+                d["unexpected"] += int(n[0])
+                d["posted"] += int(n[1])
+                d["native_slots_in_use"] = int(n[2])
+        return d
+
     def send_nb(self, peer: "InProcTransport", key: TagKey,
                 data: np.ndarray) -> SendReq:
         if peer.native is not None:
@@ -403,6 +436,12 @@ class InProcTransport:
                 key, data.reshape(-1).view(np.uint8),
                 self.EAGER_THRESHOLD)
         self._count_send(kind)
+        fr = self._flight
+        if fr is not None:
+            # flight-recorder round event: how this message traveled
+            # (direct/eager/rndv/fenced) plus its round identity — one
+            # allocation-free ring append (obs/flight.py WireRing)
+            fr.append(kind, key, data.nbytes)
         return req
 
     def recv_nb(self, key: TagKey, dst: np.ndarray) -> RecvReq:
@@ -436,3 +475,51 @@ class InProcTransport:
         if self.native is not None:
             self.native.destroy()
             self.native = None
+
+
+# ---------------------------------------------------------------------------
+# backlog observability (cold: watchdog dumps + UCC_STATS snapshots)
+# ---------------------------------------------------------------------------
+
+def occupancy_snapshot(limit: int = 64) -> List[Dict[str, int]]:
+    """Per-endpoint mailbox backlog for diagnostic dumps: unexpected
+    queue length, posted recvs, native slot-table in-use. A backlog is
+    otherwise invisible until it becomes a stall."""
+    with _SHM_LOCK:
+        eps = list(_SHM_WORLD.values())[:limit]
+    out = []
+    for ep in eps:
+        try:
+            d = ep.occupancy()
+        except Exception:  # noqa: BLE001 - diagnostics only
+            continue
+        if any(d.values()):
+            d["uid"] = ep.uid[:8]
+            out.append(d)
+    return out
+
+
+def _occupancy_sampler() -> None:
+    """Aggregate backlog gauges, sampled into every UCC_STATS snapshot
+    (interval/exit/SIGUSR2 dumps) via the metrics sampler hook."""
+    from ...obs import metrics
+    unexp = posted = nslots = 0
+    with _SHM_LOCK:
+        eps = list(_SHM_WORLD.values())
+    for ep in eps[:256]:
+        try:
+            d = ep.occupancy()
+        except Exception:  # noqa: BLE001
+            continue
+        unexp += d.get("unexpected", 0)
+        posted += d.get("posted", 0)
+        nslots += d.get("native_slots_in_use", 0)
+    metrics.gauge("mailbox_unexpected", unexp, component="tl/host")
+    metrics.gauge("mailbox_posted_recvs", posted, component="tl/host")
+    metrics.gauge("native_slots_in_use", nslots, component="tl/host")
+
+
+from ...obs import metrics as _obs_metrics  # noqa: E402 - sampler wiring
+
+_obs_metrics.register_sampler(_occupancy_sampler)
+del _obs_metrics

@@ -61,6 +61,12 @@ class TlShmContext(BaseContext):
             except (KeyError, ValueError):  # unrecognized: behave as auto
                 pass
         self.transport = InProcTransport(use_native=use_native)
+        # flight-recorder wire ring: bound once per endpoint (the PR-3
+        # bind-at-post pattern applied at endpoint scope) — None keeps
+        # the send path branch-false
+        rec = getattr(core_context, "flight", None)
+        if rec is not None:
+            self.transport._flight = rec.wire
         if config is not None:
             from ..utils.config import SIZE_AUTO
             if config.eager_thresh != SIZE_AUTO:

@@ -1,0 +1,223 @@
+"""ucc_fr — flight-recorder collection, diagnosis, and Perfetto export.
+
+The operator console of the cluster flight recorder (obs/flight.py +
+obs/diagnose.py):
+
+    ucc_fr ucc_flight.json                   # merge + diagnose dumps
+    ucc_fr ucc_flight.json --json            # machine-readable findings
+    ucc_fr ucc_flight.json --perfetto t.json # Chrome-trace export
+    ucc_fr --pid 12345                       # trigger a live dump
+                                             # (SIGUSR2 -> every rank's
+                                             # ring appended to its
+                                             # UCC_FLIGHT_FILE)
+    ucc_fr --smoke                           # self-contained diagnosis
+                                             # drill (snapshot_gate's
+                                             # UCC_GATE_FR probe)
+
+Input files hold one JSON record per line — ``flight_local`` (one
+rank's ring, written on SIGUSR2 or by embedders) and/or
+``flight_merged`` (a cross-rank collection, written by watchdog
+escalation / rank-failure detection / ``flight.collect_team``). The
+freshest merged record wins; otherwise local lines are merged latest-
+per-rank (obs/diagnose.merge_records).
+
+The ``--smoke`` drill is the acceptance probe for the diagnosis layer:
+a 4-rank in-process job runs collectives under ``UCC_FAULT=delay``
+pinned to ONE rank (a known controlled straggler), collects the rings
+cross-rank, and reports whether the diagnosis named that rank and the
+collective sequence(s) it was slow in.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    recs = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("kind", "").startswith(
+                    "flight"):
+                recs.append(rec)
+    return recs
+
+
+def print_report(merged: Dict[str, Any], diag: Dict[str, Any],
+                 out=None) -> None:
+    w = (out or sys.stdout).write
+    ranks = merged.get("ranks") or {}
+    w(f"# flight dump: {len(ranks)} rank(s), reason="
+      f"{merged.get('reason', '?')}")
+    absent = merged.get("absent_ranks") or []
+    if absent:
+        w(f", ABSENT ranks {','.join(str(r) for r in absent)}")
+    w("\n")
+    for r in sorted(ranks, key=int):
+        snap = ranks[r]
+        ev = snap.get("events") or []
+        w(f"#   rank {r}: {len(ev)} events, "
+          f"{len(snap.get('wire') or [])} wire, "
+          f"dropped {snap.get('dropped', 0)}\n")
+    summary = diag.get("summary") or []
+    if not summary:
+        w("clean: no desync, stragglers, missing participants, or "
+          "failures detected\n")
+        return
+    for line in summary:
+        w(line + "\n")
+
+
+def _smoke(args) -> int:
+    """Self-contained diagnosis drill (see module doc). Prints one JSON
+    record the gate parses:
+    ``{"metric": "fr_smoke", "pinned_rank": R, "culprit_ranks": [...],
+    "stuck_seqs": [...], "ok": bool}``."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    rec: Dict[str, Any] = {"metric": "fr_smoke",
+                           "pinned_rank": args.smoke_rank}
+    try:
+        import numpy as np
+
+        from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType,
+                             ReductionOp, Status)
+        from ucc_tpu.fault import inject as fault
+        from ucc_tpu.obs import diagnose, flight
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), "tests"))
+        from harness import UccJob
+
+        flight.configure(enabled=True)
+        n, count = 4, 4096
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            # pin send delays to ONE rank: every send it posts is held
+            # for delay_s — the controlled straggler the diagnosis must
+            # name from the merged rings alone
+            fault.configure(
+                f"delay=1.0:{args.smoke_delay},"
+                f"delay_rank={args.smoke_rank}", seed=0)
+            try:
+                srcs = [np.full(count, r + 1.0) for r in range(n)]
+                dsts = [np.zeros(count) for _ in range(n)]
+                for _ in range(args.smoke_iters):
+                    job.run_coll(teams, lambda r: CollArgs(
+                        coll_type=CollType.ALLREDUCE,
+                        src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+                        dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+                        op=ReductionOp.SUM), timeout=120)
+            finally:
+                fault.reset()
+            reqs = [flight.collect_team_post(t, reason="fr_smoke")
+                    for t in teams]
+            job.progress_until(lambda: all(
+                r.test() != Status.IN_PROGRESS for r in reqs), 60)
+            merged = reqs[0].result
+        finally:
+            job.cleanup()
+        diag = diagnose.diagnose(merged)
+        lag = [f for f in diag.get("stragglers", ())
+               if f.get("signal") == "wire_lag"]
+        rec["culprit_ranks"] = sorted({f["rank"] for f in lag})
+        rec["stuck_seqs"] = sorted({
+            s.get("fseq") for f in lag for s in f.get("seqs", ())
+            if s.get("fseq") is not None})
+        rec["summary"] = diag.get("summary", [])[:6]
+        rec["ok"] = rec["culprit_ranks"] == [args.smoke_rank] and \
+            bool(rec["stuck_seqs"])
+    except Exception as e:  # noqa: BLE001 - the gate reports, not raises
+        rec["error"] = f"{type(e).__name__}: {e}"
+        rec["ok"] = False
+    print(json.dumps(rec))
+    return 0 if rec.get("ok") else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ucc_fr",
+        description="flight-recorder merge / diagnose / export")
+    ap.add_argument("files", nargs="*",
+                    help="flight dump file(s) (JSON lines; "
+                         "UCC_FLIGHT_FILE)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged diagnosis as JSON")
+    ap.add_argument("--perfetto", metavar="OUT",
+                    help="write a Chrome-trace/Perfetto JSON export of "
+                         "the merged timeline (one track per rank and "
+                         "per hier level)")
+    ap.add_argument("--pid", type=int,
+                    help="send SIGUSR2 to a live process: every rank in "
+                         "it appends its ring to its UCC_FLIGHT_FILE")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the self-contained diagnosis drill "
+                         "(4-rank job, delay pinned to one rank; exit 0 "
+                         "iff the diagnosis names it)")
+    ap.add_argument("--smoke-rank", type=int, default=1,
+                    help="ctx rank the smoke pins the delay to")
+    ap.add_argument("--smoke-delay", type=float, default=0.05,
+                    help="per-send delay (s) injected on the pinned rank")
+    ap.add_argument("--smoke-iters", type=int, default=6,
+                    help="collectives the smoke runs under delay")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return _smoke(args)
+    if args.pid is not None:
+        try:
+            os.kill(args.pid, signal.SIGUSR2)
+        except OSError as e:
+            print(f"ucc_fr: cannot signal pid {args.pid}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"ucc_fr: SIGUSR2 sent to {args.pid}; rings will append "
+              f"to that process's UCC_FLIGHT_FILE")
+        return 0
+    if not args.files:
+        ap.error("no dump files given (and neither --pid nor --smoke)")
+
+    from ucc_tpu.obs import diagnose
+    records: List[Dict[str, Any]] = []
+    for path in args.files:
+        try:
+            records.extend(load_records(path))
+        except OSError as e:
+            print(f"ucc_fr: {e}", file=sys.stderr)
+            return 1
+    if not records:
+        print("ucc_fr: no flight records found", file=sys.stderr)
+        return 1
+    merged = diagnose.merge_records(records)
+    diag = merged.get("diagnosis") or diagnose.diagnose(merged)
+
+    if args.perfetto:
+        trace = diagnose.to_chrome_trace(merged)
+        with open(args.perfetto, "w") as fh:
+            json.dump(trace, fh)
+        print(f"# wrote {len(trace['traceEvents'])} trace events -> "
+              f"{args.perfetto}")
+    if args.json:
+        print(json.dumps({"reason": merged.get("reason"),
+                          "ranks": sorted(merged.get("ranks") or {},
+                                          key=int),
+                          "absent_ranks": merged.get("absent_ranks"),
+                          "diagnosis": diag}))
+    else:
+        print_report(merged, diag)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,4 @@
-"""ucc_stats — pretty-print and diff UCC_STATS metric dumps.
+"""ucc_stats — pretty-print, diff, and watch UCC_STATS metric dumps.
 
 The stats-dump consumer (the reference pairs its stats counters with a
 ``ucc_info``-style reader). ``obs.metrics`` appends one JSON snapshot
@@ -7,16 +7,24 @@ per line to ``UCC_STATS_FILE``; this tool renders them:
     ucc_stats dump.json                  # latest snapshot, pretty
     ucc_stats dump.json --first          # earliest snapshot instead
     ucc_stats a.json b.json              # diff: latest(a) -> latest(b)
+    ucc_stats dump.json --diff           # diff last two snapshots
     ucc_stats dump.json --self-diff      # diff first -> last of one file
+    ucc_stats dump.json --watch 2        # live: re-read every 2s and
+                                         # print the delta per interval
+                                         # (pair with UCC_STATS_INTERVAL)
 
-Counter diffs print deltas; gauges print (old -> new); histograms print
-count/sum deltas. Exit status 1 on unreadable/empty input.
+Histograms are rendered as derived p50/p99 estimates (log-interpolated
+inside the log2 buckets) rather than raw bucket counts — pass
+``--buckets`` for the raw distribution. Counter diffs print deltas;
+gauges print (old -> new); histograms print count/sum deltas. Exit
+status 1 on unreadable/empty input.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+import time
 from typing import Any, Dict, List, Optional
 
 
@@ -54,7 +62,33 @@ def _fmt_signed(v: float) -> str:
     return f"{int(v):+,}"
 
 
-def print_snapshot(snap: Dict[str, Any], out=None) -> None:
+def hist_percentile(slot: Dict[str, Any], q: float) -> float:
+    """Estimate the q-quantile (0..1) of a log2-bucket histogram slot.
+    Bucket b counts samples in [2^(b-1), 2^b) (bucket 0: [0, 1)); the
+    position inside the winning bucket is linearly interpolated, and the
+    top estimate is clamped to the recorded exact max."""
+    count = slot.get("count", 0)
+    buckets = slot.get("buckets") or {}
+    if not count or not buckets:
+        return 0.0
+    target = max(1e-9, q * count)
+    cum = 0.0
+    mx = float(slot.get("max", 0) or 0)
+    for b, c in sorted(buckets.items(), key=lambda kv: int(kv[0])):
+        b = int(b)
+        if cum + c >= target:
+            lo = 0.0 if b == 0 else float(1 << (b - 1))
+            hi = 1.0 if b == 0 else float(1 << b)
+            if mx:
+                hi = min(hi, mx)
+            frac = (target - cum) / c
+            return lo + frac * max(0.0, hi - lo)
+        cum += c
+    return mx
+
+
+def print_snapshot(snap: Dict[str, Any], out=None,
+                   show_buckets: bool = False) -> None:
     w = (out or sys.stdout).write
     w(f"# pid {snap.get('pid')} uptime {snap.get('uptime_s')}s "
       f"reason={snap.get('reason', '?')}\n")
@@ -68,16 +102,20 @@ def print_snapshot(snap: Dict[str, Any], out=None) -> None:
                 w(f"  {name:<28} {_fmt_key(k):<40} {_fmt_val(v)}\n")
     hists = snap.get("histograms") or {}
     if hists:
-        w("\n[histograms]  (log2 buckets: b counts samples in "
-          "[2^(b-1), 2^b))\n")
+        w("\n[histograms]  (p50/p99 interpolated from log2 buckets"
+          + ("" if show_buckets else "; --buckets for raw counts")
+          + ")\n")
         for name in sorted(hists):
             for k, slot in sorted(hists[name].items()):
                 count = slot.get("count", 0)
                 avg = (slot.get("sum", 0) / count) if count else 0
+                p50 = hist_percentile(slot, 0.50)
+                p99 = hist_percentile(slot, 0.99)
                 w(f"  {name:<28} {_fmt_key(k):<40} "
-                  f"count={count} avg={avg:.1f} max={slot.get('max', 0)}\n")
+                  f"count={count} avg={avg:.1f} p50={p50:.1f} "
+                  f"p99={p99:.1f} max={slot.get('max', 0)}\n")
                 buckets = slot.get("buckets") or {}
-                if buckets:
+                if show_buckets and buckets:
                     bs = " ".join(
                         f"{b}:{c}" for b, c in
                         sorted(buckets.items(), key=lambda kv: int(kv[0])))
@@ -117,18 +155,68 @@ def diff_snapshots(old: Dict[str, Any], new: Dict[str, Any],
                   f"{nc - oc:+} samples ({nsum - osum:+.1f})\n")
 
 
+def watch(path: str, interval: float, count: int = 0, out=None) -> int:
+    """Live mode: poll *path* and print the delta whenever a new
+    snapshot line lands (pair with UCC_STATS_INTERVAL so the producer
+    keeps appending). *count* > 0 bounds the number of polls (tests);
+    0 polls until interrupted."""
+    out = out or sys.stdout
+    prev: Optional[Dict[str, Any]] = None
+    seen = 0
+    polls = 0
+    try:
+        while True:
+            try:
+                snaps = load_snapshots(path)
+            except OSError:
+                snaps = []
+            if len(snaps) > seen:
+                cur = snaps[-1]
+                out.write(f"\n=== {time.strftime('%H:%M:%S')} "
+                          f"({len(snaps)} snapshot(s)) ===\n")
+                if prev is None:
+                    print_snapshot(cur, out)
+                else:
+                    diff_snapshots(prev, cur, out)
+                out.flush()
+                prev = cur
+                seen = len(snaps)
+            polls += 1
+            if count and polls >= count:
+                return 0
+            time.sleep(max(0.05, interval))
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ucc_stats",
-        description="pretty-print / diff UCC_STATS JSON dumps")
+        description="pretty-print / diff / watch UCC_STATS JSON dumps")
     ap.add_argument("files", nargs="+",
                     help="one dump file (print) or two (diff latest of "
                          "each)")
     ap.add_argument("--first", action="store_true",
                     help="use the earliest snapshot instead of the latest")
+    ap.add_argument("--diff", action="store_true",
+                    help="diff the last two snapshots of a single file "
+                         "(two files always diff, with or without this)")
     ap.add_argument("--self-diff", action="store_true",
                     help="diff first -> last snapshot of a single file")
+    ap.add_argument("--buckets", action="store_true",
+                    help="also print raw log2 bucket counts under each "
+                         "histogram (default shows derived p50/p99 only)")
+    ap.add_argument("--watch", type=float, metavar="SECS", default=None,
+                    help="live mode: re-read the file every SECS seconds "
+                         "and print the per-interval delta")
+    ap.add_argument("--watch-count", type=int, default=0,
+                    help="stop --watch after N polls (0 = until ^C)")
     args = ap.parse_args(argv)
+
+    if args.watch is not None:
+        if len(args.files) != 1:
+            ap.error("--watch takes exactly one file")
+        return watch(args.files[0], args.watch, args.watch_count)
 
     snapsets = []
     for path in args.files:
@@ -147,8 +235,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             diff_snapshots(snapsets[0][-1], snapsets[1][-1])
         elif args.self_diff:
             diff_snapshots(snapsets[0][0], snapsets[0][-1])
+        elif args.diff:
+            if len(snapsets[0]) < 2:
+                print("ucc_stats: --diff needs at least two snapshots",
+                      file=sys.stderr)
+                return 1
+            diff_snapshots(snapsets[0][-2], snapsets[0][-1])
         else:
-            print_snapshot(snapsets[0][0 if args.first else -1])
+            print_snapshot(snapsets[0][0 if args.first else -1],
+                           show_buckets=args.buckets)
     except BrokenPipeError:
         # `ucc_stats dump | head` closes the pipe early — that is not an
         # error worth a traceback
