@@ -514,6 +514,79 @@ def _scale_smoke(env) -> None:
           f"in {dt:.0f}s -> {verdict}", flush=True)
 
 
+def _gen_smoke(env) -> None:
+    """WARN-ONLY collective-compiler probe (ISSUE 10 CI satellite, same
+    harness as the other smokes): ``python -m ucc_tpu.dsl.smoke``
+    compiles + statically verifies every built-in generated family,
+    runs the collective matrix with a generated allreduce pinned, and
+    drives the tuner end-to-end with generated candidates (sweep ->
+    cache -> reload -> tuned activation must land on a LEARNED
+    generated selection). Skip with UCC_GATE_GEN=0."""
+    import json
+    if os.environ.get("UCC_GATE_GEN", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] gen smoke: skipped (UCC_GATE_GEN=0)", flush=True)
+        return
+    print("[gate] collective-compiler smoke (warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    # same de-instrumentation as the other smokes, plus a clean GEN/
+    # QUANT/TUNER slate: the smoke arms its own knobs per probe job
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE",
+                                      "UCC_GEN", "UCC_QUANT",
+                                      "UCC_TUNER"))}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ucc_tpu.dsl.smoke"],
+            cwd=REPO, env=smoke_env, capture_output=True, text=True,
+            timeout=600)
+    except subprocess.TimeoutExpired:
+        print("[gate] WARN: gen smoke timed out (not a gate failure)",
+              flush=True)
+        return
+    rec = None
+    for ln in (r.stdout or "").splitlines():
+        if ln.startswith("{"):
+            try:
+                cand = json.loads(ln)
+            except ValueError:
+                continue
+            if cand.get("metric") == "gen_gate_smoke":
+                rec = cand
+    dt = time.monotonic() - t0
+    if rec is None or rec.get("error"):
+        why = (rec or {}).get("error") or f"rc={r.returncode}, no record"
+        print(f"[gate] WARN: gen smoke — {why} in {dt:.0f}s "
+              f"(not a gate failure)", flush=True)
+        return
+    problems = []
+    if int(rec.get("programs_verified") or 0) < 6:
+        problems.append(f"only {rec.get('programs_verified')} generated "
+                        f"programs survived verification")
+    if len(rec.get("matrix") or []) < 6:
+        problems.append(f"collective matrix incomplete with a generated "
+                        f"allreduce pinned: {rec.get('matrix')}")
+    if not rec.get("pinned_engaged"):
+        problems.append("TUNE-pinned generated allreduce did not engage")
+    if not rec.get("learned_generated_selection"):
+        problems.append(
+            f"tuner round trip did not land on a learned generated "
+            f"selection (winner {rec.get('tuned_winner')}, origin "
+            f"{rec.get('tuned_origin')})")
+    if not rec.get("tuned_dispatch_ok"):
+        problems.append("tuned generated dispatch failed")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] gen smoke: {rec.get('programs_verified')} programs "
+          f"verified ({', '.join((rec.get('programs') or [])[:4])}...), "
+          f"matrix {len(rec.get('matrix') or [])}/6 with "
+          f"{rec.get('pinned_alg')} pinned, tuner round trip -> "
+          f"{rec.get('tuned_winner')} ({rec.get('tuned_origin')} "
+          f"{rec.get('tuned_gen')}) dispatched as "
+          f"{rec.get('tuned_dispatch_alg')} in {dt:.0f}s -> {verdict}",
+          flush=True)
+
+
 def _fr_smoke(env) -> None:
     """WARN-ONLY flight-recorder diagnosis probe (ISSUE 9 CI satellite,
     same harness as the other smokes): `ucc_fr --smoke` runs a 4-rank
@@ -646,6 +719,9 @@ def main(argv=None) -> int:
         # warn-only: flight-recorder diagnosis names a fault-injected
         # straggler rank and its stuck collective seq (ISSUE 9)
         _fr_smoke(env)
+        # warn-only: generated DSL families compile + verify, run the
+        # matrix, and tune end-to-end (ISSUE 10)
+        _gen_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

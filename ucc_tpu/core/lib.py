@@ -166,6 +166,19 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
     ConfigField("QUANT_STOCHASTIC", "n", "stochastic rounding in the int8 "
                 "encoder (unbiased under repeated accumulation, slightly "
                 "higher per-element error)", parse_bool),
+    ConfigField("GEN", "n", "collective compiler (ucc_tpu/dsl): y = "
+                "generate, statically verify, and register DSL "
+                "algorithm families (ring chunking, recursive halving/"
+                "doubling radix, SRA pipeline depth, fused "
+                "allreduce+quantize) as low-score tuner-explorable "
+                "score-map candidates with origin tag 'generated'; n "
+                "(default) = zero cost, candidate lists unchanged",
+                parse_bool),
+    ConfigField("GEN_FAMILIES", "", "generated families and parameter "
+                "grids, e.g. 'ring(1,2,4),rhd(2,8),sra_pipe(2),qdirect'"
+                " — empty = every built-in family at its default grid; "
+                "programs failing the static verifier or inapplicable "
+                "at the team size are skipped", parse_string),
     ConfigField("CHECK_ASYMMETRIC_DT", "n", "validate datatype consistency "
                 "for gather(v)/scatter(v) via a service allreduce before "
                 "the collective (off by default for performance, matching "

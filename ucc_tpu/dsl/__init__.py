@@ -1,0 +1,45 @@
+"""Collective compiler — a dataflow DSL for generated host-TL algorithms.
+
+GC3 (PAPERS.md) showed that collective algorithms expressed as small
+chunk-dataflow programs can be compiled, specialized, and outperform
+hand-tuned implementations; HiCCL makes the same case for composition
+from primitives. This package closes ROADMAP item 5: instead of
+hand-writing every variant (a new radix, chunking factor, or pipeline
+depth each being a new generator function in tl/host), whole algorithm
+FAMILIES are *generated* as per-rank dataflow programs, statically
+verified, compiled onto the existing host-TL machinery, and registered
+as ordinary score-map candidates the PR-5 tuner explores.
+
+Layers:
+
+- :mod:`ir` — the collective-program IR: a per-rank dataflow over
+  symbolic ranks and buffer chunks (``send``/``recv``/``reduce``/
+  ``copy`` ops grouped into rounds), authored via :class:`ir.ProgramBuilder`.
+- :mod:`verify` — the static verifier every program passes BEFORE
+  registration: symbolic chunk tracking proves each rank's final buffer
+  holds the collective's postcondition, and a round-ordered wait-graph
+  check proves deadlock-freedom. Verification failures reject the
+  program (they never ship).
+- :mod:`compile` — lowers a verified program to a ``HostCollTask``
+  schedule reusing the existing machinery: mc-pool ``scratch()`` leases
+  for chunk buffers, ``reduce_arrays(out=)`` accumulation,
+  ``send_nb``/``recv_nb`` posting, and ``PipelinedSchedule`` for the
+  pipelined families. Programs tagged with a wire precision insert the
+  PR-6 quant codec at send edges.
+- :mod:`families` — the built-in generator functions producing
+  parameterized program families: ``ring`` (variable chunking), ``rhd``
+  (recursive halving/doubling at variable radix), ``sra_pipe``
+  (SRA pipeline at variable depth), ``qdirect`` (fused
+  allreduce+quantize).
+- :mod:`registry` — gates everything behind ``UCC_GEN`` /
+  ``UCC_GEN_FAMILIES`` and produces the ``AlgSpec`` rows (origin tag
+  ``generated``, low default score) the host TL merges into its
+  algorithm table.
+"""
+from __future__ import annotations
+
+from .ir import Op, OpKind, Program, ProgramBuilder, RankProgram
+from .verify import VerifyError, verify
+
+__all__ = ["Op", "OpKind", "Program", "ProgramBuilder", "RankProgram",
+           "VerifyError", "verify"]

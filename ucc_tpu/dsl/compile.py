@@ -1,0 +1,327 @@
+"""Program compiler — lowers verified programs to host-TL tasks.
+
+A :class:`GeneratedCollTask` interprets one rank's instruction stream of
+a verified :class:`~.ir.Program` on the existing host-TL machinery:
+
+- chunk buffers are views of the user dst vector (the standard
+  near-equal block split) — no staging copies for exact programs;
+- temporaries (reduce landing zones, quantized wire buffers) are
+  mc-pool ``scratch()`` leases keyed by round position, so the steady
+  state of a persistent generated collective is zero-alloc exactly like
+  the hand-written algorithms;
+- accumulation runs through ``reduce_arrays(out=)``;
+- wire ops post through the task's ``send_nb``/``recv_nb`` (the cached
+  ctx-rank fast path, fault injection, cancellation and flight
+  recording all apply unchanged);
+- programs tagged with a wire precision insert the PR-6 codec at every
+  send edge: the chunk is block-scale encoded into a leased wire
+  buffer, sent, and the sender's own copy is re-decoded from that wire
+  so every rank ends with bit-identical dequantized values (the
+  cross-rank agreement rule the hand-written quantized variants follow).
+
+The pipelined family wraps per-fragment ``GeneratedCollTask``s in the
+PR-3 :class:`~..schedule.pipelined.PipelinedSchedule` (fragment k+1's
+reduce-scatter overlaps fragment k's allgather).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import quant
+from ..constants import (CollArgsFlags, CollType, DataType, ReductionOp,
+                         dt_numpy)
+from ..ec.cpu import reduce_arrays
+from ..status import Status, UccError
+from ..tl.base import binfo_typed
+from ..tl.host.task import HostCollTask
+from ..utils.mathutils import block_count, block_offset
+from .ir import OpKind, Program
+
+_F32 = np.dtype(np.float32)
+_DT_F32 = DataType.FLOAT32
+
+#: reduction operators the generated executor supports: associative +
+#: commutative ops reduce_arrays(out=) accumulates in place (AVG runs
+#: SUM and scales the fully-reduced vector once at the end — sound
+#: because the verifier proves every chunk ends as the full reduction)
+_EXACT_OPS = frozenset((ReductionOp.SUM, ReductionOp.AVG, ReductionOp.PROD,
+                        ReductionOp.MAX, ReductionOp.MIN))
+
+
+class GeneratedCollTask(HostCollTask):
+    """Interpreter for one rank of a verified collective program."""
+
+    def __init__(self, init_args, team, program: Program, subset=None):
+        super().__init__(init_args, team, subset)
+        args = init_args.args
+        if args.coll_type != program.coll:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           f"program {program.name} serves "
+                           f"{program.coll!r}")
+        if self.gsize != program.nranks:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           f"program {program.name} compiled for "
+                           f"{program.nranks} ranks (team has "
+                           f"{self.gsize})")
+        self.prog = program
+        self.count = int(args.dst.count)
+        self.dt = args.dst.datatype
+        op = args.op if args.op is not None else ReductionOp.SUM
+        if op not in _EXACT_OPS:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           f"generated programs support "
+                           f"{sorted(o.name for o in _EXACT_OPS)} "
+                           f"(got {op.name})")
+        self.op = op
+        if self.count < program.nchunks:
+            # zero-element chunks would post zero-byte wire traffic for
+            # no benefit; the fallback walk lands on an exact algorithm
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           f"count {self.count} below program chunk "
+                           f"count {program.nchunks}")
+        self.qp = None
+        if program.wire:
+            qp = quant.params_for(team, program.coll)
+            if qp is None or qp.mode != program.wire:
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               f"wire precision {program.wire} not "
+                               f"enabled (UCC_QUANT)")
+            if self.dt not in quant.QUANT_DTS:
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               f"quantized wire needs a float payload "
+                               f"(got {self.dt})")
+            if op not in (ReductionOp.SUM, ReductionOp.AVG):
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               "quantized generated programs support "
+                               f"SUM/AVG (got {op.name})")
+            # one quantization per phase (send edges only): the direct
+            # error model, gated by the same user budget as the
+            # hand-written variants
+            if not quant.admits(qp, program.coll, self.gsize, "direct"):
+                raise UccError(
+                    Status.ERR_NOT_SUPPORTED,
+                    f"quantized {qp.mode} predicted error exceeds "
+                    f"error budget {qp.budget:.4f}")
+            self.qp = qp
+        # my instruction stream, split per round into wire/local phases
+        # once at init (posts interpret the precompiled lists)
+        self._rounds: List[Tuple[list, list, list]] = []
+        max_reduces = max_sends = max_recvs = 0
+        for ops in program.ranks[self.grank].rounds:
+            wire_sends = [op for op in ops if op.kind == OpKind.SEND]
+            wire_recvs = [op for op in ops
+                          if op.kind in (OpKind.RECV, OpKind.REDUCE)]
+            local = [op for op in ops if op.kind == OpKind.COPY]
+            self._rounds.append((wire_sends, wire_recvs, local))
+            max_sends = max(max_sends, len(wire_sends))
+            max_recvs = max(max_recvs, len(wire_recvs))
+            max_reduces = max(max_reduces, sum(
+                1 for op in wire_recvs if op.kind == OpKind.REDUCE))
+        self._max_sends = max_sends
+        self._max_recvs = max_recvs
+        self._max_reduces = max_reduces
+
+    # ------------------------------------------------------------------
+    def _chunk_bounds(self) -> List[Tuple[int, int]]:
+        nch = self.prog.nchunks
+        return [(block_offset(self.count, nch, c),
+                 block_count(self.count, nch, c)) for c in range(nch)]
+
+    def run(self):
+        if self.qp is not None:
+            yield from self._run_wire()
+            return
+        args = self.args
+        dst = binfo_typed(args.dst, self.count)
+        if not args.is_inplace:
+            dst[:] = binfo_typed(args.src, self.count)
+        red_op = ReductionOp.SUM if self.op == ReductionOp.AVG else self.op
+        # gsize >= 2 always: generators refuse n < 2 and __init__
+        # rejects a program/team size mismatch
+        size = self.gsize
+        bounds = self._chunk_bounds()
+        max_chunk = max(c for _, c in bounds)
+        nd = dt_numpy(self.dt)
+        rtmp = self.scratch("rt", (max(1, self._max_reduces),
+                                   max(1, max_chunk)), nd)
+
+        def view(c):
+            off, cnt = bounds[c]
+            return dst[off:off + cnt]
+
+        for sends, recvs, local in self._rounds:
+            reqs = []
+            landings = []
+            for op in sends:
+                reqs.append(self.send_nb(op.peer, view(op.chunk),
+                                         slot=op.slot))
+            ri = 0
+            for op in recvs:
+                if op.kind == OpKind.RECV:
+                    # allgather-style move: deliver straight into the
+                    # destination slice, no staging copy
+                    reqs.append(self.recv_nb(op.peer, view(op.chunk),
+                                             slot=op.slot))
+                else:
+                    tmp = rtmp[ri, :bounds[op.chunk][1]]
+                    ri += 1
+                    reqs.append(self.recv_nb(op.peer, tmp, slot=op.slot))
+                    landings.append((op.chunk, tmp))
+            if reqs:
+                yield from self.wait(*reqs)
+            for chunk, tmp in landings:
+                acc = view(chunk)
+                reduce_arrays([acc, tmp], red_op, self.dt, out=acc)
+            for op in local:
+                view(op.chunk)[:] = view(op.src_chunk)
+        if self.op == ReductionOp.AVG:
+            dst[:] = reduce_arrays([dst], ReductionOp.SUM, self.dt,
+                                   alpha=1.0 / size)
+
+    # ------------------------------------------------------------------
+    def _run_wire(self):
+        """Quantized interpretation: f32 accumulate, codec at send
+        edges, sender-side re-decode for cross-rank bit agreement."""
+        args = self.args
+        qp = self.qp
+        dst = binfo_typed(args.dst, self.count)
+        if not args.is_inplace:
+            dst[:] = binfo_typed(args.src, self.count)
+        size = self.gsize
+        if dst.dtype == _F32:
+            work = dst
+        else:
+            work = self.scratch("work", self.count, np.float32)
+            work[:] = dst
+        bounds = self._chunk_bounds()
+        max_chunk = max(c for _, c in bounds)
+        max_wire = quant.wire_count(max_chunk, qp.block)
+        ws = self.scratch("ws", (max(1, self._max_sends), max_wire),
+                          np.uint8)
+        wr = self.scratch("wr", (max(1, self._max_recvs), max_wire),
+                          np.uint8)
+        dtmp = self.scratch("deq", max(1, max_chunk), np.float32)
+        rng = np.random.default_rng() if qp.stochastic else None
+
+        def view(c):
+            off, cnt = bounds[c]
+            return work[off:off + cnt]
+
+        for sends, recvs, local in self._rounds:
+            reqs = []
+            landings = []
+            # one encode per (round, chunk): a chunk sent to several
+            # peers this round (the allgather fan-out) reuses its wire
+            encoded = {}
+            si = 0
+            for op in sends:
+                w = encoded.get(op.chunk)
+                if w is None:
+                    cnt = bounds[op.chunk][1]
+                    w = ws[si, :quant.wire_count(cnt, qp.block)]
+                    si += 1
+                    src = view(op.chunk)
+                    qp.codec.encode(src, w, qp.block,
+                                    stochastic=qp.stochastic, rng=rng)
+                    # re-decode into my own copy: receivers hold
+                    # decode(wire), so the sender must too or ranks
+                    # disagree bitwise on this slice
+                    qp.codec.decode(w, cnt, qp.block, src)
+                    encoded[op.chunk] = w
+                reqs.append(self.send_nb(op.peer, w, slot=op.slot))
+            for wi, op in enumerate(recvs):
+                cnt = bounds[op.chunk][1]
+                w = wr[wi, :quant.wire_count(cnt, qp.block)]
+                reqs.append(self.recv_nb(op.peer, w, slot=op.slot))
+                landings.append((op, w, cnt))
+            if reqs:
+                yield from self.wait(*reqs)
+            for op, w, cnt in landings:
+                if op.kind == OpKind.RECV:
+                    qp.codec.decode(w, cnt, qp.block, view(op.chunk))
+                else:
+                    t = dtmp[:cnt]
+                    qp.codec.decode(w, cnt, qp.block, t)
+                    acc = view(op.chunk)
+                    # work is always f32 (dst view or scratch), so the
+                    # accumulate runs in f32 like the hand-written
+                    # quantized variants
+                    reduce_arrays([acc, t], ReductionOp.SUM, _DT_F32,
+                                  out=acc)
+            for op in local:
+                view(op.chunk)[:] = view(op.src_chunk)
+        if self.op == ReductionOp.AVG:
+            np.multiply(work, 1.0 / size, out=work)
+        if work is not dst:
+            dst[:] = work
+
+
+# ---------------------------------------------------------------------------
+# init fns (score-map candidates)
+# ---------------------------------------------------------------------------
+
+def generated_init(init_args, team, program: Program):
+    """Plain (single-schedule) generated algorithm init."""
+    return GeneratedCollTask(init_args, team, program)
+
+
+def generated_pipelined_init(init_args, team, program: Program):
+    """Pipelined-family init: split the vector into ``depth`` fragments,
+    each running *program*, driven through a PipelinedSchedule window
+    (sequential order, window 2 — fragment k+1 starts when fragment k
+    completes its matching stage, overlapping reduce-scatter with the
+    previous fragment's allgather)."""
+    from ..api.types import BufferInfo, CollArgs
+    from ..schedule.pipelined import PipelinedSchedule, PipelineOrder
+    from ..schedule.schedule import Schedule
+
+    depth = int(program.params.get("depth", 2))
+    args = init_args.args
+    count = int(args.dst.count)
+    dt = args.dst.datatype
+    esz = dt_numpy(dt).itemsize
+    # every fragment needs at least one element per chunk
+    if block_count(count, depth, depth - 1) < program.nchunks:
+        raise UccError(Status.ERR_NOT_SUPPORTED,
+                       f"count {count} too small for pipeline depth "
+                       f"{depth} x {program.nchunks} chunks")
+    full_dst = binfo_typed(args.dst, count)
+    full_src = full_dst if args.is_inplace else binfo_typed(args.src, count)
+    ia_cls = type(init_args)
+
+    def frag_args(frag_num: int) -> CollArgs:
+        off = block_offset(count, depth, frag_num)
+        cnt = block_count(count, depth, frag_num)
+        return CollArgs(
+            coll_type=CollType.ALLREDUCE,
+            src=BufferInfo(full_src[off:off + cnt], cnt, dt),
+            dst=BufferInfo(full_dst[off:off + cnt], cnt, dt),
+            op=args.op,
+            flags=args.flags & ~(CollArgsFlags.PERSISTENT
+                                 | CollArgsFlags.IN_PLACE))
+
+    def frag_init(sched_p, idx):
+        frag = Schedule(team=team)
+        fa = frag_args(idx)
+        fia = ia_cls(args=fa, team=init_args.team,
+                     mem_type=init_args.mem_type,
+                     msgsize=int(fa.dst.count) * esz)
+        t = GeneratedCollTask(fia, team, program)
+        frag.add_task(t)
+        frag.add_dep_on_schedule_start(t)
+        return frag
+
+    def frag_setup(sched_p, frag, frag_num):
+        fa = frag_args(frag_num)
+        for t in frag.tasks:
+            t.args.src = fa.src
+            t.args.dst = fa.dst
+            t.count = int(fa.dst.count)
+        return Status.OK
+
+    return PipelinedSchedule(
+        team=team, args=init_args.args, frag_init=frag_init,
+        frag_setup=frag_setup, n_frags=min(2, depth), n_frags_total=depth,
+        order=PipelineOrder.SEQUENTIAL)

@@ -1,0 +1,226 @@
+"""Built-in program families — parameterized generators.
+
+Each generator produces a verified-shape :class:`~.ir.Program` for one
+concrete team size (or raises :class:`Inapplicable` when the parameter
+does not fit that size, e.g. a radix that does not divide the team).
+The registry sweeps each family's parameter grid, verifies every
+program, and registers the survivors as score-map candidates — so a new
+variant is a new *parameter*, not a new hand-written algorithm.
+
+Families (first targets from ROADMAP item 5):
+
+``ring(chunks=m)``
+    The bandwidth allreduce ring (reduce-scatter ring + allgather ring)
+    with each rank-block split into ``m`` wire chunks: ``m=1`` is the
+    classic hand-written ring; higher ``m`` moves the same bytes as
+    more, smaller messages per hop (transport-pipelining the copy-free
+    matcher can overlap).
+
+``rhd(radix=r)``
+    Recursive halving/doubling — the SRA structure at radix ``r``:
+    reduce-scatter by recursive vector splitting, allgather by replaying
+    the splits in reverse. Needs ``n == r^k``. ``r == n`` degenerates to
+    the DIRECT exchange (one reduce-scatter round + one allgather round
+    with n-1 concurrent messages) — applicable at every team size.
+
+``sra_pipe(depth=d)``
+    The rhd program per vector fragment, driven through the PR-3
+    ``PipelinedSchedule`` with ``d`` total fragments — fragment k+1's
+    reduce-scatter overlaps fragment k's allgather (the
+    ALLREDUCE_SRA_KN_PIPELINE role, generated).
+
+``qdirect``
+    Fused allreduce+quantize: the direct (radix = n) program with the
+    PR-6 block-scaled codec inserted at every send edge — each value is
+    quantized once per phase, the same (n + 1) half-step error model as
+    the hand-written ``q<mode>_sra``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..constants import CollType
+from .ir import Program, ProgramBuilder
+
+
+class Inapplicable(Exception):
+    """The (family, param) pair cannot target this team size."""
+
+
+def _part(lo: int, hi: int, r: int, t: int) -> Tuple[int, int]:
+    n = hi - lo
+    return lo + (t * n) // r, lo + ((t + 1) * n) // r
+
+
+# ---------------------------------------------------------------------------
+# ring(chunks=m)
+# ---------------------------------------------------------------------------
+
+def gen_ring(n: int, chunks: int = 1) -> Program:
+    """Allreduce ring over ``n * chunks`` chunks; block ``b`` of the
+    vector is chunks ``[b*chunks, (b+1)*chunks)``."""
+    m = int(chunks)
+    if n < 2:
+        raise Inapplicable(f"ring needs >= 2 ranks (got {n})")
+    if m < 1:
+        raise Inapplicable(f"ring chunking must be >= 1 (got {m})")
+    b = ProgramBuilder("ring", CollType.ALLREDUCE, n, n * m,
+                       params={"chunks": m})
+
+    def chunks_of(block: int) -> List[int]:
+        return list(range(block * m, (block + 1) * m))
+
+    # phase 1: reduce-scatter ring
+    for step in range(n - 1):
+        b.next_round()
+        for me in range(n):
+            right = (me + 1) % n
+            left = (me - 1) % n
+            sb = (me - 1 - step) % n
+            rb = (me - 2 - step) % n
+            for c in chunks_of(sb):
+                b.send(me, c, to=right)
+            for c in chunks_of(rb):
+                b.reduce(me, c, frm=left)
+    # phase 2: allgather ring
+    for step in range(n - 1):
+        b.next_round()
+        for me in range(n):
+            right = (me + 1) % n
+            left = (me - 1) % n
+            sb = (me - step) % n
+            rb = (me - step - 1) % n
+            for c in chunks_of(sb):
+                b.send(me, c, to=right)
+            for c in chunks_of(rb):
+                b.recv(me, c, frm=left)
+    return b.build(f"gen_ring_c{m}")
+
+
+# ---------------------------------------------------------------------------
+# rhd(radix=r)
+# ---------------------------------------------------------------------------
+
+def _rhd_levels(n: int, r: int) -> List[int]:
+    """Distances of the recursive split, outermost first; raises
+    Inapplicable unless n == r^k (k >= 1)."""
+    if n < 2:
+        raise Inapplicable(f"rhd needs >= 2 ranks (got {n})")
+    if r < 2 or r > n:
+        raise Inapplicable(f"radix {r} out of range [2, {n}]")
+    dists = []
+    full = 1
+    while full < n:
+        full *= r
+    if full != n:
+        raise Inapplicable(f"team size {n} is not a power of radix {r}")
+    dist = n // r
+    while dist >= 1:
+        dists.append(dist)
+        dist //= r
+    return dists
+
+
+def gen_rhd(n: int, radix: int = 2, wire: str = "") -> Program:
+    """Recursive halving/doubling allreduce at radix ``radix`` over
+    ``n`` chunks (one per rank-block). ``wire`` tags the program for
+    quantized send edges (the qdirect family passes it)."""
+    r = int(radix)
+    dists = _rhd_levels(n, r)
+    family = "qdirect" if wire else "rhd"
+    name = f"gen_q{wire}_direct" if wire else f"gen_rhd_r{r}"
+    b = ProgramBuilder(family, CollType.ALLREDUCE, n, n,
+                       params={"radix": r}, wire=wire)
+
+    # per-rank segment walk is pure, so precompute each rank's (lo, hi)
+    # at every level
+    def seg_walk(me: int) -> List[Tuple[int, int]]:
+        lo, hi = 0, n
+        segs = [(lo, hi)]
+        for dist in dists:
+            lo, hi = _part(lo, hi, r, (me // dist) % r)
+            segs.append((lo, hi))
+        return segs
+
+    walks = [seg_walk(me) for me in range(n)]
+
+    # phase 1: reduce-scatter by recursive splitting
+    for lvl, dist in enumerate(dists):
+        b.next_round()
+        for me in range(n):
+            lo, hi = walks[me][lvl]
+            d = (me // dist) % r
+            base = me - d * dist
+            keep = _part(lo, hi, r, d)
+            for t in range(r):
+                if t == d:
+                    continue
+                peer = base + t * dist
+                give = _part(lo, hi, r, t)
+                for c in range(give[0], give[1]):
+                    b.send(me, c, to=peer)
+                for c in range(keep[0], keep[1]):
+                    b.reduce(me, c, frm=peer)
+    # phase 2: allgather by replaying the splits in reverse
+    for lvl in range(len(dists) - 1, -1, -1):
+        dist = dists[lvl]
+        b.next_round()
+        for me in range(n):
+            lo, hi = walks[me][lvl]
+            d = (me // dist) % r
+            base = me - d * dist
+            mine = walks[me][lvl + 1]
+            for t in range(r):
+                if t == d:
+                    continue
+                peer = base + t * dist
+                theirs = _part(lo, hi, r, t)
+                for c in range(mine[0], mine[1]):
+                    b.send(me, c, to=peer)
+                for c in range(theirs[0], theirs[1]):
+                    b.recv(me, c, frm=peer)
+    return b.build(name)
+
+
+def gen_qdirect(n: int, mode: str) -> Program:
+    """Fused allreduce+quantize: the direct (radix = n) exchange with
+    the ``mode`` codec at every send edge."""
+    if mode not in ("int8", "fp8"):
+        raise Inapplicable(f"unknown wire precision '{mode}'")
+    return gen_rhd(n, radix=n, wire=mode)
+
+
+# ---------------------------------------------------------------------------
+# sra_pipe(depth=d) — fragment program + pipeline metadata
+# ---------------------------------------------------------------------------
+
+def sra_pipe_fragment(n: int, depth: int) -> Program:
+    """The per-fragment program of the pipelined SRA family: rhd at
+    radix 2 when the team is a power of two (the canonical SRA halving
+    instance), else the direct exchange. ``depth`` (>= 2) is pipeline
+    metadata consumed by the compiler (PipelinedSchedule fragment
+    count), not part of the dataflow itself — it is folded into the
+    program's params/name so each depth is a distinct tuner candidate."""
+    d = int(depth)
+    if d < 2:
+        raise Inapplicable(f"pipeline depth must be >= 2 (got {d})")
+    radix = 2 if n >= 2 and (n & (n - 1)) == 0 else n
+    prog = gen_rhd(n, radix=radix)
+    prog.family = "sra_pipe"
+    prog.params = {"depth": d, "radix": radix}
+    prog.name = f"gen_sra_pipe_d{d}"
+    return prog
+
+
+# ---------------------------------------------------------------------------
+# default parameter grids (the registry/ucc_tune sweep space)
+# ---------------------------------------------------------------------------
+
+DEFAULT_GRIDS: Dict[str, List[int]] = {
+    "ring": [1, 2, 4],
+    "rhd": [2, 4, 8, 0],       # 0 = radix n (the direct exchange)
+    "sra_pipe": [2, 4],
+    "qdirect": [0],            # parameterized by UCC_QUANT, not a grid
+}
+
+FAMILY_NAMES = tuple(DEFAULT_GRIDS)

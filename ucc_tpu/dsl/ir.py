@@ -1,0 +1,210 @@
+"""Collective-program IR — per-rank dataflow over symbolic chunks.
+
+A :class:`Program` describes one collective algorithm for one concrete
+team size as a set of per-rank instruction streams. The data model:
+
+- The collective's vector is split into ``nchunks`` near-equal chunks
+  (the standard ``ucc_buffer_block_count/offset`` split, so any element
+  count works). Chunk ``c`` of every rank's buffer refers to the SAME
+  vector slice — programs move and combine *contributions* to slices,
+  never raw offsets.
+- Ops are grouped into ``rounds``. Execution posts every op of a round
+  nonblocking, waits for all of them, applies the round's local
+  reductions/copies, then advances — the same shape as the hand-written
+  generator algorithms (tl/host), so the compiled task inherits their
+  cancellation/fault/observability behavior unchanged.
+- Matching is by ``(src_rank, dst_rank, slot)``: a ``send`` on rank
+  ``p`` with slot ``s`` to ``q`` pairs with exactly one ``recv`` or
+  ``reduce`` on rank ``q`` with peer ``p`` and slot ``s`` (the verifier
+  enforces 1:1 matching). The builder auto-assigns collision-free slots
+  (``round * nchunks + chunk``); authors only pass ``slot=`` explicitly
+  to express deliberate cross-round matches.
+
+Op kinds:
+
+``SEND(chunk, peer)``
+    Post chunk ``chunk``'s current content to ``peer``.
+``RECV(chunk, peer)``
+    Receive into chunk ``chunk``, REPLACING its content (allgather-style
+    data movement).
+``REDUCE(chunk, peer)``
+    Receive the peer's copy of chunk ``chunk`` into a temporary and
+    reduce it into the local chunk with the collective's operator
+    (reduce-scatter-style accumulation).
+``COPY(chunk, src_chunk)``
+    Local chunk-to-chunk copy (applied after the round's deliveries).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..constants import CollType
+
+
+class OpKind(enum.IntEnum):
+    SEND = 0
+    RECV = 1
+    REDUCE = 2
+    COPY = 3
+
+
+@dataclass(frozen=True)
+class Op:
+    """One IR instruction. ``peer`` is the remote rank for wire ops and
+    unused (-1) for COPY; ``src_chunk`` is only meaningful for COPY."""
+
+    kind: OpKind
+    chunk: int
+    peer: int = -1
+    slot: int = 0
+    src_chunk: int = -1
+
+    def describe(self) -> str:
+        k = self.kind.name.lower()
+        if self.kind == OpKind.COPY:
+            return f"copy(chunk {self.src_chunk} -> {self.chunk})"
+        d = "to" if self.kind == OpKind.SEND else "from"
+        return f"{k}(chunk {self.chunk} {d} rank {self.peer}, slot {self.slot})"
+
+
+@dataclass
+class RankProgram:
+    """One rank's instruction stream: ``rounds[k]`` is the op list of
+    round ``k``. Every rank of a program has the same round count (a
+    rank idle in a round simply has an empty list)."""
+
+    rounds: List[List[Op]] = field(default_factory=list)
+
+
+@dataclass
+class Program:
+    """A compiled-form collective program for one concrete team size."""
+
+    name: str                    #: algorithm name (score map / TUNE / tuner)
+    family: str                  #: generator family, e.g. "ring"
+    params: Dict[str, int]       #: family parameters, e.g. {"chunks": 4}
+    coll: CollType
+    nranks: int
+    nchunks: int
+    ranks: List[RankProgram]
+    #: wire precision for fused quantized programs ("int8"/"fp8"; empty
+    #: = exact). The compiler inserts the PR-6 codec at send edges.
+    wire: str = ""
+
+    @property
+    def n_rounds(self) -> int:
+        return len(self.ranks[0].rounds) if self.ranks else 0
+
+    @property
+    def param_str(self) -> str:
+        """Human/provenance form, e.g. ``ring(chunks=4)`` — shown in the
+        score dump's generated column and carried into tuner cache
+        entries and sweep measurement records."""
+        inner = ",".join(f"{k}={v}" for k, v in sorted(self.params.items()))
+        if self.wire:
+            inner = f"{inner},{self.wire}" if inner else self.wire
+        return f"{self.family}({inner})"
+
+    def __repr__(self):
+        return (f"Program({self.name}, n={self.nranks}, "
+                f"chunks={self.nchunks}, rounds={self.n_rounds})")
+
+
+class ProgramBuilder:
+    """Author API for program generators.
+
+    Usage::
+
+        b = ProgramBuilder("ring", CollType.ALLREDUCE, nranks=4,
+                           nchunks=4, params={"chunks": 1})
+        for step in range(3):
+            b.next_round()
+            for me in range(4):
+                b.send(me, chunk, to=right)
+                b.reduce(me, chunk, frm=left)
+        prog = b.build("gen_ring_c1")
+
+    Rounds are global: ``next_round()`` advances every rank's stream at
+    once (generated programs are symmetric; a rank with no ops in a
+    round is simply idle). Slots default to ``round * nchunks + chunk``
+    — unique per (src, dst) within a round and across rounds — and can
+    be overridden for deliberate cross-round matches.
+    """
+
+    def __init__(self, family: str, coll: CollType, nranks: int,
+                 nchunks: int, params: Optional[Dict[str, int]] = None,
+                 wire: str = ""):
+        if nranks < 1:
+            raise ValueError(f"nranks must be >= 1 (got {nranks})")
+        if nchunks < 1:
+            raise ValueError(f"nchunks must be >= 1 (got {nchunks})")
+        self.family = family
+        self.coll = coll
+        self.nranks = nranks
+        self.nchunks = nchunks
+        self.params = dict(params or {})
+        self.wire = wire
+        self._rounds: List[List[List[Op]]] = []   # [round][rank] -> ops
+        self._round = -1
+
+    # ------------------------------------------------------------------
+    def next_round(self) -> int:
+        self._rounds.append([[] for _ in range(self.nranks)])
+        self._round += 1
+        return self._round
+
+    def _auto_slot(self, chunk: int) -> int:
+        return self._round * self.nchunks + chunk
+
+    def _check(self, rank: int, chunk: int, peer: Optional[int]) -> None:
+        if self._round < 0:
+            raise ValueError("no open round: call next_round() first")
+        if not 0 <= rank < self.nranks:
+            raise ValueError(f"rank {rank} out of range [0, {self.nranks})")
+        if not 0 <= chunk < self.nchunks:
+            raise ValueError(f"chunk {chunk} out of range "
+                             f"[0, {self.nchunks})")
+        if peer is not None:
+            if not 0 <= peer < self.nranks:
+                raise ValueError(f"peer {peer} out of range "
+                                 f"[0, {self.nranks})")
+            if peer == rank:
+                raise ValueError(f"rank {rank}: self-send/recv")
+
+    def send(self, rank: int, chunk: int, to: int,
+             slot: Optional[int] = None) -> None:
+        self._check(rank, chunk, to)
+        self._rounds[self._round][rank].append(
+            Op(OpKind.SEND, chunk, to,
+               self._auto_slot(chunk) if slot is None else slot))
+
+    def recv(self, rank: int, chunk: int, frm: int,
+             slot: Optional[int] = None) -> None:
+        self._check(rank, chunk, frm)
+        self._rounds[self._round][rank].append(
+            Op(OpKind.RECV, chunk, frm,
+               self._auto_slot(chunk) if slot is None else slot))
+
+    def reduce(self, rank: int, chunk: int, frm: int,
+               slot: Optional[int] = None) -> None:
+        self._check(rank, chunk, frm)
+        self._rounds[self._round][rank].append(
+            Op(OpKind.REDUCE, chunk, frm,
+               self._auto_slot(chunk) if slot is None else slot))
+
+    def copy(self, rank: int, dst_chunk: int, src_chunk: int) -> None:
+        self._check(rank, dst_chunk, None)
+        self._check(rank, src_chunk, None)
+        self._rounds[self._round][rank].append(
+            Op(OpKind.COPY, dst_chunk, -1, 0, src_chunk))
+
+    # ------------------------------------------------------------------
+    def build(self, name: str) -> Program:
+        ranks = [RankProgram(rounds=[self._rounds[k][r]
+                                     for k in range(len(self._rounds))])
+                 for r in range(self.nranks)]
+        return Program(name=name, family=self.family, params=self.params,
+                       coll=self.coll, nranks=self.nranks,
+                       nchunks=self.nchunks, ranks=ranks, wire=self.wire)

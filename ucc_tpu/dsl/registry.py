@@ -1,0 +1,255 @@
+"""Registration gate — generated programs as score-map candidates.
+
+Behind ``UCC_GEN`` (default off: the candidate lists, dispatch path and
+tuner rotation stay byte-identical to a build without this package,
+the same zero-cost contract as ``UCC_QUANT``). When on, the host TL's
+algorithm table gains one :class:`~..tl.base.AlgSpec` per applicable
+(family, parameter) pair:
+
+- every program is built once per (family, param, team size, wire)
+  process-wide (cached — in-process multi-rank jobs verify each program
+  once, not once per rank) and passes the static verifier; a program
+  that fails verification is logged and SKIPPED, never registered;
+- candidates register at a LOW default score (tuner-explorable,
+  TUNE-addressable by name, never the static default) with provenance
+  ``origin="generated"`` and the family/parameter string shown by
+  ``ucc_info -s`` and carried into tuner cache entries;
+- the fused quantized program registers only when ``UCC_QUANT`` selects
+  a precision (and carries that precision tag like the hand-written
+  quantized variants).
+
+``UCC_GEN_FAMILIES`` restricts/parameterizes the families, e.g.
+``ring(1,2,4),rhd(2,8),sra_pipe(2)``; empty = every family at its
+default grid (families.DEFAULT_GRIDS).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..constants import CollType
+from ..status import Status, UccError
+from ..utils.log import get_logger
+from . import families as fam
+from .compile import generated_init, generated_pipelined_init
+from .ir import Program
+from .verify import VerifyError, verify
+
+logger = get_logger("dsl")
+
+#: AlgSpec id base for generated candidates (hand-written tables use
+#: single digits; @100+ keeps numeric TUNE addressing unambiguous)
+GEN_ALG_ID_BASE = 100
+
+#: program generation is O(n^2) ops (every rank's stream is built and
+#: verified); beyond this team size generated candidates are skipped
+#: with a log line (pod-scale teams are served by CL/HIER composition,
+#: not per-rank flat programs)
+MAX_GEN_RANKS = 128
+
+#: process-wide verified-program cache: (family, param, n, wire) ->
+#: Program (or None for inapplicable/rejected, so failures are also
+#: computed once)
+_CACHE: Dict[Tuple[str, int, int, str], Optional[Program]] = {}
+
+
+def _lib_config(team):
+    try:
+        return team.core_team.context.lib.config
+    except AttributeError:
+        return None
+
+
+def _cfg_str(team, field: str, env: str, default: str = "") -> str:
+    cfg = _lib_config(team)
+    if cfg is not None:
+        try:
+            return str(cfg.get(field) or "").strip().lower()
+        except KeyError:
+            pass
+    return os.environ.get(env, default).strip().lower()
+
+
+def gen_enabled(team) -> bool:
+    """One config read per team create (alg-table construction) — never
+    on the dispatch path."""
+    return _cfg_str(team, "gen", "UCC_GEN") in ("y", "yes", "on", "1",
+                                                "true", "t")
+
+
+def parse_families(spec: str) -> Dict[str, List[int]]:
+    """``ring(1,2,4),rhd(2,8),qdirect`` -> {family: params}. Empty spec
+    = every family at its default grid. Unknown families or malformed
+    params raise ValueError (a typo'd knob must not silently register
+    nothing)."""
+    spec = (spec or "").strip().lower()
+    if not spec:
+        return {k: list(v) for k, v in fam.DEFAULT_GRIDS.items()}
+    out: Dict[str, List[int]] = {}
+    # split on commas at paren depth 0 (params use commas too)
+    toks, depth, cur = [], 0, ""
+    for ch in spec:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth < 0:
+                raise ValueError(f"unbalanced ')' in '{spec}'")
+        if ch == "," and depth == 0:
+            toks.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if depth != 0:
+        raise ValueError(f"unbalanced '(' in '{spec}'")
+    toks.append(cur)
+    for tok in toks:
+        tok = tok.strip()
+        if not tok:
+            continue
+        name, _, rest = tok.partition("(")
+        name = name.strip()
+        if name not in fam.DEFAULT_GRIDS:
+            raise ValueError(f"unknown generated family '{name}' "
+                             f"(known: {', '.join(fam.FAMILY_NAMES)})")
+        if rest:
+            if not rest.endswith(")"):
+                raise ValueError(f"malformed family token '{tok}'")
+            params = [int(p) for p in rest[:-1].split(",") if p.strip()]
+            if not params:
+                # 'ring()' registering nothing would be exactly the
+                # silent-typo failure this parser exists to reject
+                raise ValueError(f"empty parameter list in '{tok}'")
+        else:
+            params = list(fam.DEFAULT_GRIDS[name])
+        lst = out.setdefault(name, [])
+        for p in params:
+            if p not in lst:
+                lst.append(p)
+    return out
+
+
+def build_program(family: str, param: int, n: int,
+                  wire: str = "") -> Optional[Program]:
+    """Build + verify one program; cached process-wide. Returns None
+    when the (family, param) pair is inapplicable at this size or the
+    program failed verification (logged — rejected programs never
+    ship)."""
+    key = (family, int(param), int(n), wire)
+    if key in _CACHE:
+        return _CACHE[key]
+    prog: Optional[Program] = None
+    try:
+        if family == "ring":
+            prog = fam.gen_ring(n, chunks=param)
+        elif family == "rhd":
+            prog = fam.gen_rhd(n, radix=(param or n))
+        elif family == "sra_pipe":
+            prog = fam.sra_pipe_fragment(n, depth=param)
+        elif family == "qdirect":
+            prog = fam.gen_qdirect(n, mode=wire)
+        else:
+            raise ValueError(f"unknown family '{family}'")
+        verify(prog)
+    except fam.Inapplicable as e:
+        logger.debug("dsl: %s(%s) inapplicable at n=%d: %s", family,
+                     param, n, e)
+        prog = None
+    except VerifyError as e:
+        # a generator bug: reject loudly, never register
+        logger.error("dsl: generated program %s(%s) n=%d REJECTED by "
+                     "the verifier: %s", family, param, n, e)
+        prog = None
+    _CACHE[key] = prog
+    return prog
+
+
+def built_in_programs(n: int,
+                      quant_mode: str = "",
+                      spec: str = "") -> List[Program]:
+    """Every verified built-in program at team size *n* (the gate
+    smoke's compile+verify sweep). ``quant_mode`` enables the fused
+    quantized program."""
+    out: List[Program] = []
+    names: set = set()
+    for family, params in parse_families(spec).items():
+        if family == "qdirect":
+            if quant_mode:
+                p = build_program(family, 0, n, wire=quant_mode)
+                if p is not None and p.name not in names:
+                    names.add(p.name)
+                    out.append(p)
+            continue
+        for param in params:
+            p = build_program(family, param, n)
+            if p is not None and p.name not in names:
+                names.add(p.name)
+                out.append(p)
+    return out
+
+
+def generated_alg_specs(team) -> Dict[CollType, List]:
+    """The generated AlgSpec rows for *team*'s algorithm table; {} when
+    UCC_GEN is off, the team is a stub/singleton, or too large (logged).
+    Called once per team create from HostTlTeam.alg_table."""
+    from ..tl.base import AlgSpec
+
+    if not gen_enabled(team):
+        return {}
+    n = int(getattr(team, "size", 0) or 0)
+    if n < 2:
+        return {}
+    if n > MAX_GEN_RANKS:
+        logger.warning("dsl: UCC_GEN skipped: team size %d above the "
+                       "%d-rank program-generation cap", n, MAX_GEN_RANKS)
+        return {}
+    spec = _cfg_str(team, "gen_families", "UCC_GEN_FAMILIES")
+    try:
+        fams = parse_families(spec)
+    except ValueError as e:
+        raise UccError(Status.ERR_INVALID_PARAM,
+                       f"bad UCC_GEN_FAMILIES: {e}")
+    from .. import quant
+    qmode = quant.coll_mode(team, CollType.ALLREDUCE) or ""
+
+    specs: List[AlgSpec] = []
+    seen: set = set()
+
+    def add(prog: Program) -> None:
+        if prog.name in seen:
+            # e.g. rhd radix 4 and radix 0 (= n) coincide on a 4-rank
+            # team — one candidate, not two rotation slots
+            return
+        seen.add(prog.name)
+        init_fn = generated_pipelined_init if prog.family == "sra_pipe" \
+            else generated_init
+
+        def init(ia, _team, _p=prog, _fn=init_fn):
+            return _fn(ia, team, _p)
+        specs.append(AlgSpec(
+            GEN_ALG_ID_BASE + len(specs), prog.name, init,
+            # low default score: never the static default, explorable by
+            # the tuner and TUNE-addressable by name exactly like the
+            # hand-written candidates
+            default_select="0-inf:2",
+            precision=prog.wire,
+            origin="generated",
+            gen=prog.param_str))
+
+    for family, params in fams.items():
+        if family == "qdirect":
+            if qmode:
+                p = build_program(family, 0, n, wire=qmode)
+                if p is not None:
+                    add(p)
+            continue
+        for param in params:
+            p = build_program(family, param, n)
+            if p is not None:
+                add(p)
+    if not specs:
+        return {}
+    logger.info("dsl: registered %d generated candidates for team size "
+                "%d: %s", len(specs), n,
+                ", ".join(s.name for s in specs))
+    return {CollType.ALLREDUCE: specs}

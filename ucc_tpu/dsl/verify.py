@@ -1,0 +1,309 @@
+"""Static verifier for collective programs.
+
+Every generated program is verified BEFORE registration; a program that
+fails verification is rejected (the registry logs and skips it — a
+broken generator can never ship a wrong or hanging algorithm). Two
+independent proofs:
+
+**Postcondition (symbolic chunk tracking).** Each (rank, chunk) location
+holds a symbolic value: the *set of source ranks whose contribution to
+that vector slice has been accumulated*. Initially rank ``r`` holds
+``{r}`` in every chunk (its own input). ``SEND`` snapshots the sender's
+set at post time; ``RECV`` replaces the destination set; ``REDUCE``
+unions it in — rejecting overlap, because with a real reduction
+operator an overlapping union means some rank's contribution is summed
+twice (silent wrong answers for SUM/PROD). After the last round, every
+rank's every chunk must equal the collective's postcondition — for
+allreduce, the full set ``{0..n-1}``.
+
+**Deadlock-freedom (round-ordered wait graph).** Execution is
+round-ordered per rank: round ``k`` posts all its wire ops, then waits
+for all of them. Completing round ``k`` on rank ``r`` therefore
+requires (a) rank ``r`` completed round ``k-1``, (b) every matched
+sender posted its send — i.e. completed the round *before* the send's —
+and (c) every matched receiver posted its recv (the conservative
+rendezvous model: a large send completes only once the peer's recv is
+up). Those are exactly the edges of a directed graph over
+``(rank, round)`` completion nodes; the program is deadlock-free iff
+that graph is acyclic. The check also enforces 1:1 send/recv matching —
+an unmatched recv is a guaranteed hang, an unmatched send a guaranteed
+stray message into a later collective's tag space.
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from ..constants import CollType
+from .ir import Op, OpKind, Program
+
+#: number of symbolically-tracked values per location; contribution sets
+#: are frozensets of source ranks
+_Val = FrozenSet[int]
+
+
+class VerifyError(Exception):
+    """A program failed static verification. ``rank``/``chunk``/``round``
+    name the first offending location (when attributable) so the
+    diagnostic points at the generator bug, not just at 'invalid'."""
+
+    def __init__(self, reason: str, rank: Optional[int] = None,
+                 chunk: Optional[int] = None, round_: Optional[int] = None):
+        self.rank = rank
+        self.chunk = chunk
+        self.round = round_
+        where = []
+        if rank is not None:
+            where.append(f"rank {rank}")
+        if round_ is not None:
+            where.append(f"round {round_}")
+        if chunk is not None:
+            where.append(f"chunk {chunk}")
+        prefix = f"[{', '.join(where)}] " if where else ""
+        super().__init__(prefix + reason)
+
+
+def _match_ops(prog: Program):
+    """1:1 send/recv matching by (src, dst, slot). Returns
+    ``{(p, q, slot): ((p, round_s, send_op), (q, round_r, recv_op))}``.
+    """
+    sends: Dict[Tuple[int, int, int], Tuple[int, int, Op]] = {}
+    recvs: Dict[Tuple[int, int, int], Tuple[int, int, Op]] = {}
+    for r, rp in enumerate(prog.ranks):
+        for k, ops in enumerate(rp.rounds):
+            for op in ops:
+                if op.kind == OpKind.SEND:
+                    key = (r, op.peer, op.slot)
+                    if key in sends:
+                        raise VerifyError(
+                            f"duplicate send to rank {op.peer} slot "
+                            f"{op.slot} (first in round "
+                            f"{sends[key][1]})", rank=r, chunk=op.chunk,
+                            round_=k)
+                    sends[key] = (r, k, op)
+                elif op.kind in (OpKind.RECV, OpKind.REDUCE):
+                    key = (op.peer, r, op.slot)
+                    if key in recvs:
+                        raise VerifyError(
+                            f"duplicate recv from rank {op.peer} slot "
+                            f"{op.slot} (first in round "
+                            f"{recvs[key][1]})", rank=r, chunk=op.chunk,
+                            round_=k)
+                    recvs[key] = (r, k, op)
+    for key, (r, k, op) in sends.items():
+        if key not in recvs:
+            raise VerifyError(
+                f"unmatched {op.describe()} — no rank posts the "
+                f"receiving side", rank=r, chunk=op.chunk, round_=k)
+    for key, (r, k, op) in recvs.items():
+        if key not in sends:
+            raise VerifyError(
+                f"unmatched {op.describe()} — no rank posts the "
+                f"sending side (guaranteed hang)", rank=r, chunk=op.chunk,
+                round_=k)
+    return {key: (sends[key], recvs[key]) for key in sends}
+
+
+def _topo_rounds(prog: Program, matches) -> List[Tuple[int, int]]:
+    """Topological order of (rank, round) completion nodes, or raise
+    VerifyError naming a node on a cycle (the deadlock)."""
+    n, R = prog.nranks, prog.n_rounds
+    nodes = [(r, k) for r in range(n) for k in range(R)]
+    edges: Dict[Tuple[int, int], List[Tuple[int, int]]] = {u: [] for u in nodes}
+    indeg = {u: 0 for u in nodes}
+
+    def add(u, v):
+        if u[1] < 0:          # waiting on "before round 0" is free
+            return
+        edges[u].append(v)
+        indeg[v] += 1
+
+    for r in range(n):
+        for k in range(1, R):
+            add((r, k - 1), (r, k))
+    for (sender, recver) in matches.values():
+        p, ks, _sop = sender
+        q, kr, _rop = recver
+        # receiver's round-kr wait needs the sender to have POSTED round
+        # ks, i.e. completed ks-1
+        add((p, ks - 1), (q, kr))
+        # sender's round-ks wait needs the receiver's recv to be up
+        # (conservative rendezvous model)
+        add((q, kr - 1), (p, ks))
+
+    order: List[Tuple[int, int]] = []
+    ready = [u for u in nodes if indeg[u] == 0]
+    while ready:
+        u = ready.pop()
+        order.append(u)
+        for v in edges[u]:
+            indeg[v] -= 1
+            if indeg[v] == 0:
+                ready.append(v)
+    if len(order) != len(nodes):
+        # every leftover node sits on (or behind) a cycle; report the
+        # first wire op of the smallest stuck node for a stable message
+        stuck = sorted(u for u in nodes if indeg[u] > 0)
+        r, k = stuck[0]
+        ops = [op for op in prog.ranks[r].rounds[k]
+               if op.kind != OpKind.COPY]
+        detail = ops[0].describe() if ops else "round barrier"
+        raise VerifyError(
+            f"cyclic wait dependency (deadlock): {detail} can never "
+            f"complete — {len(stuck)} (rank, round) states wait on each "
+            f"other", rank=r, chunk=ops[0].chunk if ops else None,
+            round_=k)
+    return order
+
+
+def _check_round_hazards(prog: Program) -> None:
+    """Intra-round buffer hazards the symbolic model cannot see.
+
+    The executor posts a round's sends and recvs concurrently, and an
+    overwriting RECV delivers STRAIGHT into the chunk's view of the
+    user vector at transport-arrival time. So within one round on one
+    rank, a RECV destination chunk must be exclusive:
+
+    - RECV dst ∩ SEND src races — the incoming delivery can overwrite
+      the slice before a parked zero-copy send of it is consumed (the
+      model's snapshot-at-post semantics silently assume otherwise);
+    - two deliveries into one chunk where any is a RECV resolve in
+      transport-arrival order, which is timing-dependent — the model's
+      program-order resolution would be fiction.
+
+    SEND+REDUCE on one chunk and multiple REDUCEs are safe: reduces
+    land in temporaries and apply after the round's wait (sends have
+    completed — delivered or staged — by then), in deterministic
+    program order, and disjoint unions commute.
+    """
+    for r, rp in enumerate(prog.ranks):
+        for k, ops in enumerate(rp.rounds):
+            send_src = set()
+            recv_dst = set()
+            reduce_dst = set()
+            for op in ops:
+                if op.kind == OpKind.SEND:
+                    send_src.add(op.chunk)
+                elif op.kind == OpKind.RECV:
+                    if op.chunk in recv_dst:
+                        raise VerifyError(
+                            f"two overwriting recvs into chunk "
+                            f"{op.chunk} within one round — resolution "
+                            f"order is transport-timing-dependent",
+                            rank=r, chunk=op.chunk, round_=k)
+                    recv_dst.add(op.chunk)
+                elif op.kind == OpKind.REDUCE:
+                    reduce_dst.add(op.chunk)
+            for c in sorted(recv_dst & reduce_dst):
+                raise VerifyError(
+                    f"multiple deliveries into chunk {c} within one "
+                    f"round with an overwriting recv — resolution "
+                    f"order is transport-timing-dependent", rank=r,
+                    chunk=c, round_=k)
+            for c in sorted(send_src & recv_dst):
+                raise VerifyError(
+                    f"chunk {c} is both a send source and an "
+                    f"overwriting recv destination in one round — the "
+                    f"incoming delivery can overwrite the slice before "
+                    f"the outgoing send is consumed", rank=r, chunk=c,
+                    round_=k)
+
+
+def _postcondition(prog: Program) -> _Val:
+    if prog.coll != CollType.ALLREDUCE:
+        raise VerifyError(
+            f"no postcondition model for {prog.coll!r}: the verifier "
+            f"currently proves allreduce programs only")
+    return frozenset(range(prog.nranks))
+
+
+def verify(prog: Program) -> None:
+    """Verify *prog*; raises :class:`VerifyError` on the first failure.
+
+    Checks, in order: structural sanity (uniform rounds), 1:1 matching,
+    deadlock-freedom, chunk consistency (a wire op's chunk must equal
+    the matched side's — contributions are per-slice), reduce
+    disjointness, and the collective postcondition on every rank/chunk.
+    """
+    want = _postcondition(prog)
+    n, R = prog.nranks, prog.n_rounds
+    if len(prog.ranks) != n:
+        raise VerifyError(f"program has {len(prog.ranks)} rank streams "
+                          f"for nranks={n}")
+    for r, rp in enumerate(prog.ranks):
+        if len(rp.rounds) != R:
+            raise VerifyError(
+                f"non-uniform round count ({len(rp.rounds)} != {R})",
+                rank=r)
+    _check_round_hazards(prog)
+    matches = _match_ops(prog)
+    for (sender, recver) in matches.values():
+        p, ks, sop = sender
+        q, kr, rop = recver
+        if sop.chunk != rop.chunk:
+            raise VerifyError(
+                f"chunk mismatch across the wire: {sop.describe()} on "
+                f"rank {p} (round {ks}) delivers into {rop.describe()} "
+                f"— contributions are per-slice, so sender and receiver "
+                f"must name the same chunk", rank=q, chunk=rop.chunk,
+                round_=kr)
+    order = _topo_rounds(prog, matches)
+
+    # ------------------------------------------------------------------
+    # symbolic execution in wait-graph topological order
+    state: List[List[_Val]] = [[frozenset((r,)) for _ in range(prog.nchunks)]
+                               for r in range(n)]
+    sendval: Dict[Tuple[int, int, int], _Val] = {}   # (src, dst, slot)
+
+    def snapshot_sends(r: int, k: int) -> None:
+        """Record send values of round *k* of rank *r* (the state the
+        sends observe: after round k-1 completed, before round k's own
+        deliveries)."""
+        if k >= R:
+            return
+        for op in prog.ranks[r].rounds[k]:
+            if op.kind == OpKind.SEND:
+                sendval[(r, op.peer, op.slot)] = state[r][op.chunk]
+
+    for r in range(n):
+        snapshot_sends(r, 0)
+    for (r, k) in order:
+        # deliveries first (wire ops), then local copies — the executor
+        # applies the same order
+        for op in prog.ranks[r].rounds[k]:
+            if op.kind == OpKind.RECV:
+                state[r][op.chunk] = sendval[(op.peer, r, op.slot)]
+            elif op.kind == OpKind.REDUCE:
+                incoming = sendval[(op.peer, r, op.slot)]
+                cur = state[r][op.chunk]
+                dup = incoming & cur
+                if dup:
+                    raise VerifyError(
+                        f"contribution of rank(s) "
+                        f"{sorted(dup)} reduced twice by "
+                        f"{op.describe()} — the reduction would "
+                        f"double-count them", rank=r, chunk=op.chunk,
+                        round_=k)
+                state[r][op.chunk] = cur | incoming
+        for op in prog.ranks[r].rounds[k]:
+            if op.kind == OpKind.COPY:
+                state[r][op.chunk] = state[r][op.src_chunk]
+        snapshot_sends(r, k + 1)
+
+    for r in range(n):
+        for c in range(prog.nchunks):
+            got = state[r][c]
+            if got != want:
+                missing = sorted(want - got)
+                extra = sorted(got - want)
+                detail = []
+                if missing:
+                    detail.append(f"missing contributions from rank(s) "
+                                  f"{missing}")
+                if extra:
+                    detail.append(f"unexpected contributions from "
+                                  f"rank(s) {extra}")
+                raise VerifyError(
+                    f"postcondition violated: final buffer holds "
+                    f"{sorted(got)}, expected the full reduction "
+                    f"{sorted(want)} ({'; '.join(detail)})",
+                    rank=r, chunk=c)

@@ -48,13 +48,20 @@ class MsgRange:
     alg_name: str = ""
     #: provenance of this range's (score, alg): "default" = component
     #: alg-table defaults, "tune-str" = a UCC_*_TUNE overlay touched it,
-    #: "learned" = the autotuner promoted it from measurements. Shown in
+    #: "learned" = the autotuner promoted it from measurements,
+    #: "generated" = a compiled DSL program (ucc_tpu/dsl). Shown in
     #: the score dump so team logs say WHY an algorithm was chosen.
     origin: str = "default"
     #: wire-precision tag of quantized algorithm variants ("int8"/"fp8";
     #: empty = exact). Preserved across tune-str/learned splits so the
     #: score dump marks quantized (incl. learned-quantized) ranges.
     precision: str = ""
+    #: generated-program family/parameter string of DSL candidates
+    #: (e.g. "ring(chunks=4)"; empty = hand-written). Preserved across
+    #: learned splits so tuned generated windows stay attributable from
+    #: `ucc_info -s` alone, and part of the deterministic candidate tie
+    #: break (score_map._cand_order).
+    gen: str = ""
 
     def contains(self, msgsize: int) -> bool:
         return self.start <= msgsize < self.end or \
@@ -83,13 +90,14 @@ class CollScore:
     # ------------------------------------------------------------------
     def add_range(self, coll: CollType, mem: MemoryType, start: int, end: int,
                   score: int, init: Optional[Callable] = None, team: Any = None,
-                  alg_name: str = "", precision: str = "") -> Status:
+                  alg_name: str = "", precision: str = "",
+                  origin: str = "default", gen: str = "") -> Status:
         """ucc_coll_score_add_range (ucc_coll_score.h:73)."""
         if start >= end or score < 0:
             return Status.ERR_INVALID_PARAM
         self.ranges.setdefault((coll, mem), []).append(
             MsgRange(start, end, score, init, team, alg_name,
-                     precision=precision))
+                     origin=origin, precision=precision, gen=gen))
         return Status.OK
 
     def merge(self, other: "CollScore") -> "CollScore":
@@ -177,9 +185,11 @@ class CollScore:
                 mid.alg_name = alg or ""
                 mid.origin = "tune-str"
                 # the resolver only hands back an init fn; a swapped-in
-                # algorithm's precision is unknown here — drop the old
-                # range's tag rather than mislabel the new algorithm
+                # algorithm's precision/generated params are unknown
+                # here — drop the old range's tags rather than mislabel
+                # the new algorithm
                 mid.precision = ""
+                mid.gen = ""
             out.append(mid)
             if hi < r.end:
                 out.append(replace(r, start=hi))

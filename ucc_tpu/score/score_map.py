@@ -34,15 +34,20 @@ def comp_name(r: MsgRange) -> str:
 
 
 def _cand_order(lst: List[MsgRange]) -> List[MsgRange]:
-    """Deterministic candidate order: (score desc, alg name, component,
-    registration order). Score alone left equal-score candidates to
-    list/merge ordering — any cross-rank divergence there makes ranks
-    pick different algorithms for the same collective and deadlocks the
-    team, so ties break on content, not construction history."""
+    """Deterministic candidate order: (score desc, alg name, generated
+    parameter string, component, registration order). Score alone left
+    equal-score candidates to list/merge ordering — any cross-rank
+    divergence there makes ranks pick different algorithms for the same
+    collective and deadlocks the team, so ties break on content, not
+    construction history. The generated parameter string participates
+    because DSL variants register many same-score candidates at once:
+    a family that ever produced two variants under one alg name (or a
+    plugin cloning a name) must still order identically on every rank
+    for the tuner's lockstep rotation."""
     return [r for _, r in sorted(
         enumerate(lst),
-        key=lambda p: (-p[1].score, p[1].alg_name or "", comp_name(p[1]),
-                       p[0]))]
+        key=lambda p: (-p[1].score, p[1].alg_name or "", p[1].gen or "",
+                       comp_name(p[1]), p[0]))]
 
 
 class ScoreMap:
@@ -177,6 +182,13 @@ class ScoreMap:
                 # auditable from `ucc_info -s` alone
                 if r.precision:
                     origin = f"{origin},{r.precision}"
+                # generated candidates additionally name their program
+                # family/parameters — "(generated gen:ring(chunks=4))",
+                # or "(learned gen:ring(chunks=4))" once the tuner
+                # promotes one — so the provenance column distinguishes
+                # DSL variants from hand-written algorithms
+                if r.gen:
+                    origin = f"{origin} gen:{r.gen}"
                 key = (comp, name, r.start, r.end, r.score, origin)
                 if key in seen:
                     continue

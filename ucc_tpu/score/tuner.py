@@ -238,7 +238,8 @@ def compile_measurements(records: Sequence[dict]) -> List[dict]:
         cur = by_point.get(key)
         if cur is None or lat < cur[1]:
             by_point[key] = ((alg, r.get("comp"),
-                              str(r.get("precision") or "")), lat)
+                              str(r.get("precision") or ""),
+                              str(r.get("gen") or "")), lat)
     series: Dict[Tuple[str, str], List[Tuple[int, Tuple[str, Any]]]] = {}
     for (coll, mem, size), (winner, _lat) in by_point.items():
         series.setdefault((coll, mem), []).append((size, winner))
@@ -251,13 +252,15 @@ def compile_measurements(records: Sequence[dict]) -> List[dict]:
             j = i
             while j + 1 < len(pts) and pts[j + 1][1] == pts[i][1]:
                 j += 1
-            alg, comp, prec = pts[i][1]
+            alg, comp, prec, gen = pts[i][1]
             e = {"coll": coll, "mem": mem, "start": bounds[i],
                  "end": bounds[j + 1], "alg": alg}
             if comp:
                 e["comp"] = comp
             if prec:
                 e["precision"] = prec
+            if gen:
+                e["gen"] = gen
             entries.append(e)
             i = j + 1
     return entries
@@ -547,11 +550,15 @@ class OnlineTuner:
         if ok and self.team.rank == 0 and self.cache_path:
             entry = {"coll": coll_type_str(coll), "mem": mem.name.lower(),
                      "start": start, "end": end, "alg": alg, "comp": comp}
-            # record the winner's wire-precision tag (quantized variants)
-            # so cache files name the precision a learned range runs at
+            # record the winner's wire-precision tag (quantized
+            # variants) and generated family/parameters (DSL variants)
+            # so cache files name what a learned range actually runs
             for r in self.team.score_map.lookup(coll, mem, start):
-                if cand_label(r) == winner and r.precision:
-                    entry["precision"] = r.precision
+                if cand_label(r) == winner:
+                    if r.precision:
+                        entry["precision"] = r.precision
+                    if r.gen:
+                        entry["gen"] = r.gen
                     break
             try:
                 store_entries(self.cache_path, self.signature, [entry],
@@ -690,14 +697,15 @@ def forced_request(team, args, coll: CollType, mem: MemoryType,
 def measurement_record(coll_name: str, mem: MemoryType, ranks: int,
                        label: Label, size_bytes: int, count: int,
                        iters: int, stats: Dict[str, float],
-                       precision: str = "") -> dict:
+                       precision: str = "", gen: str = "") -> dict:
     """The one sweep measurement-record shape (`ucc_tune` and
     `ucc_perftest --sweep` both emit it; `compile_measurements` and
     `ucc_tune --from` consume it). Centralized so the producers cannot
     drift — in particular ``mem`` is the CANONICAL memory-type name
     (mem.name.lower()), never a user-input alias like "cuda" that
     ``apply_entries`` would silently fail to resolve. ``precision``
-    tags quantized candidates' rows (carried into compiled cache
+    tags quantized candidates' rows and ``gen`` generated candidates'
+    family/parameter string (both carried into compiled cache
     entries)."""
     comp, alg = label
     rec = {"bench": "sweep", "coll": coll_name, "mem": mem.name.lower(),
@@ -706,6 +714,8 @@ def measurement_record(coll_name: str, mem: MemoryType, ranks: int,
            **{k: round(v, 3) for k, v in stats.items()}}
     if precision:
         rec["precision"] = precision
+    if gen:
+        rec["gen"] = gen
     return rec
 
 

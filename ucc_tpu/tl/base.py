@@ -113,6 +113,15 @@ class AlgSpec:
     #: exact). Carried into every MsgRange so score dumps and learned
     #: tuning ranges name the precision, not just the algorithm.
     precision: str = ""
+    #: provenance of this algorithm: "default" for hand-written,
+    #: "generated" for compiled DSL programs (ucc_tpu/dsl). Stamped into
+    #: every MsgRange the spec produces.
+    origin: str = "default"
+    #: generated-program family/parameter string ("ring(chunks=4)");
+    #: empty for hand-written algorithms. Shown in score dumps, carried
+    #: into tuner cache entries and sweep measurement records, and part
+    #: of the deterministic candidate tie break.
+    gen: str = ""
 
 
 def load_coll_plugins(tl_name: str):
@@ -177,11 +186,13 @@ def build_scores(team: BaseTeam, default_score: int,
                         score.add_range(coll, mt, parse_memunits(lo),
                                         parse_memunits(hi), int(sc),
                                         spec.init, team, spec.name,
-                                        precision=spec.precision)
+                                        precision=spec.precision,
+                                        origin=spec.origin, gen=spec.gen)
                 else:
                     score.add_range(coll, mt, 0, SIZE_INF, default_score,
                                     spec.init, team, spec.name,
-                                    precision=spec.precision)
+                                    precision=spec.precision,
+                                    origin=spec.origin, gen=spec.gen)
     if tune_env:
         tune = os.environ.get(tune_env, "")
         if tune:

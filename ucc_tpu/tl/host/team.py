@@ -363,6 +363,14 @@ class HostTlTeam(TlTeamBase):
             table[CollType.ALLGATHER].append(
                 spec(7, f"q{q_ag}_linear", AllgatherQuant,
                      sel=f"0-64k:1,64k-inf:{S + 6}", precision=q_ag))
+        # generated candidates (ucc_tpu/dsl, GC3-style compiled dataflow
+        # programs): registered — verified, origin-tagged `generated`,
+        # at a low tuner-explorable score — only when UCC_GEN is set, so
+        # the off path keeps candidate lists, dispatch and tuner
+        # rotation byte-identical (the UCC_QUANT contract)
+        from ...dsl.registry import generated_alg_specs
+        for coll, gen_specs in generated_alg_specs(self).items():
+            table.setdefault(coll, []).extend(gen_specs)
         return table
 
     def get_scores(self) -> CollScore:

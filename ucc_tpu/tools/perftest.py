@@ -353,7 +353,8 @@ def run_sweep_mode(args, job, coll, dt, op, mem, bmin, bmax, n,
                 continue    # candidate refused these args / failed / hung
             print(json.dumps(measurement_record(
                 args.coll, mem, n, (comp, alg), size, count, args.iters,
-                lat_stats(lats), precision=cands[idx].precision)),
+                lat_stats(lats), precision=cands[idx].precision,
+                gen=cands[idx].gen)),
                 flush=True)
         size *= 2
     return 0
@@ -688,6 +689,15 @@ def main(argv=None) -> int:
                         "explicit int8/fp8 value sets UCC_QUANT for this "
                         "run; bare --quant uses the ambient UCC_QUANT "
                         "(defaulting to int8)")
+    p.add_argument("--gen", nargs="?", const="all", default="",
+                   metavar="FAMILIES",
+                   help="register GENERATED candidates (ucc_tpu/dsl) "
+                        "for this run: sets UCC_GEN=y before lib "
+                        "creation; an optional value restricts the "
+                        "family grids (UCC_GEN_FAMILIES syntax). With "
+                        "--sweep, generated candidates are swept and "
+                        "emitted in the same measurement-record format "
+                        "(rows carry their gen family/parameter string)")
     p.add_argument("-p", "--nprocs", type=int, default=0,
                    help="in-process ranks (default: one per device for tpu "
                         "mem, else 4)")
@@ -740,6 +750,18 @@ def main(argv=None) -> int:
             _os.environ["UCC_QUANT"] = "int8"
         if args.store:
             raise SystemExit("perftest: --quant requires in-process mode")
+
+    if args.gen:
+        # same contract as --quant: generated candidates register at
+        # team create from the lib config, so the env must be set first
+        # — and only in-process, where every rank shares it (per-rank
+        # env divergence would desync candidate tables and deadlock)
+        import os as _os
+        _os.environ["UCC_GEN"] = "y"
+        if args.gen != "all":
+            _os.environ["UCC_GEN_FAMILIES"] = args.gen
+        if args.store:
+            raise SystemExit("perftest: --gen requires in-process mode")
 
     global _TRAFFIC_MATRIX
     coll = COLLS[args.coll]

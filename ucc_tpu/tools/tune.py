@@ -115,7 +115,7 @@ def run_sweep(job: _Job, colls: List[str], sizes: List[int], iters: int,
                 st = lat_stats(lats)
                 records.append(measurement_record(
                     cname, mem, n, (comp, alg), size, count, iters, st,
-                    precision=cands[idx].precision))
+                    precision=cands[idx].precision, gen=cands[idx].gen))
                 if verbose:
                     print(f"# {cname:>12} {memunits_str(size):>8} "
                           f"{comp}/{alg:<20} p50 {st['p50_us']:>10.2f}us",
@@ -257,6 +257,16 @@ def main(argv=None) -> int:
                         "UCC_QUANT already exported, quantized candidates "
                         "are swept automatically — this flag just makes "
                         "the opt-in explicit per run")
+    p.add_argument("--gen", nargs="?", const="all", default="",
+                   metavar="FAMILIES",
+                   help="include GENERATED candidates (ucc_tpu/dsl) in "
+                        "the sweep: sets UCC_GEN=y for the probe jobs; "
+                        "an optional value restricts/parameterizes the "
+                        "family grids (UCC_GEN_FAMILIES syntax, e.g. "
+                        "'ring(1,2,4),rhd(2,8)'). Winners compile into "
+                        "the tuning cache with their family/parameter "
+                        "string, so a later UCC_TUNER=offline run with "
+                        "UCC_GEN=y starts on the generated winner")
     args = p.parse_args(argv)
 
     if args.quant:
@@ -264,6 +274,10 @@ def main(argv=None) -> int:
             os.environ["UCC_QUANT"] = args.quant
         elif not os.environ.get("UCC_QUANT"):
             os.environ["UCC_QUANT"] = "int8"
+    if args.gen:
+        os.environ["UCC_GEN"] = "y"
+        if args.gen != "all":
+            os.environ["UCC_GEN_FAMILIES"] = args.gen
 
     from ucc_tpu.utils.jaxshim import ensure_live_backend
     ensure_live_backend(virtual_cpu_devices=max(args.nprocs, 4))
