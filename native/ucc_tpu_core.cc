@@ -65,7 +65,9 @@
 namespace {
 // visible to BOTH artifacts: the loader's ABI gate compares the ext's
 // compiled-in value (py_abi_version) against the core's ucc_abi_version()
-constexpr uint64_t kAbiVersion = 3;
+// (4: native execution plans — ucc_plan_build/post/test/cancel retire a
+// verified DSL program's whole round schedule against the mailbox in C++)
+constexpr uint64_t kAbiVersion = 4;
 }  // namespace
 
 // The thin extension build (-DUCC_TPU_EXT_THIN) compiles ONLY the CPython
@@ -95,6 +97,7 @@ enum State : uint32_t {
     kTruncated = 2,   // matched send exceeded dst capacity (clamped)
     kFenced = 3,      // stale team epoch at the match boundary
     kCanceled = 4,    // withdrawn by ucc_req_cancel
+    kAssist = 5,      // plan state word only: python assist callback due
 };
 
 // push() return kinds, packed into the low 3 bits of the return word
@@ -130,6 +133,7 @@ struct Slot {
     uint64_t sent = 0;              // recv: matched send's TOTAL bytes
     void* dst = nullptr;            // recv destination
     uint64_t cap = 0;               // recv capacity
+    void* plan = nullptr;           // owning execution plan (nudge target)
 };
 
 // parked unexpected send (the _PendingSend analog)
@@ -138,6 +142,7 @@ struct Unexp {
     const void* ptr = nullptr;      // rndv payload (caller keeps it alive)
     uint64_t len = 0;
     uint64_t sreq = 0;              // rndv send request id (0 = eager)
+    void* src_plan = nullptr;       // sending plan (nudged at delivery)
 };
 
 struct Shard {
@@ -210,6 +215,7 @@ struct Mailbox {
         s->sent = 0;
         s->dst = nullptr;
         s->cap = 0;
+        s->plan = nullptr;
         pub[idx].store(static_cast<uint64_t>(gen) << 32,
                        std::memory_order_release);
         *out = s;
@@ -277,6 +283,427 @@ uint64_t poll_rid(Mailbox* mb, uint64_t rid) {
 std::mutex g_park_mu;
 std::vector<Mailbox*> g_parked;
 
+// ---------------------------------------------------------------------------
+// native execution plans — a verified DSL program's per-rank stream,
+// lowered by ucc_tpu/dsl/plan.py to a packed op table and retired here
+// entirely in C++: one ffi crossing posts the plan, rounds advance
+// delivery-driven (the thread that completes a round's last message
+// advances the owning plan), reductions run in C, and the owner polls a
+// single completion word in the mapped pub window. Python re-enters only
+// for per-plan "assist" rounds (non-f32/f64 reduces, quantized codec
+// edges) flagged at build time.
+// ---------------------------------------------------------------------------
+
+// packed op entry: 8 u64 words (dsl/plan.py PLAN_OP_WORDS must match):
+//   w0 = kind | (flags << 8)           flags on WAIT_ROUND: 1 = pre-assist
+//                                      (python runs ENCODE before sends),
+//                                      2 = post-assist (python runs the
+//                                      round's REDUCE/COPY/DECODE)
+//   w1 = key word a of the TARGET mailbox (team_id<<32 | epoch)
+//   w2 = key word c (slot<<32 | src ctx rank)
+//   w3 = peer index into the peer-mailbox array (sends only)
+//   w4 = dst region | src region<<4 | dtype<<8 | reduce op<<16
+//        regions: 0 = user dst vector (rebased every post), 1 = plan
+//        scratch (mc-pool lease, fixed for the plan's lifetime)
+//   w5 = dst byte offset
+//   w6 = src byte offset (REDUCE landing zone / COPY source)
+//   w7 = nbytes
+// Key word b (the per-post collective tag) is patched in at post time so
+// a cached plan survives persistent re-posts and tag-space advancement.
+enum PlanOpKind : uint32_t {
+    kOpPostSend = 0,
+    kOpPostRecv = 1,
+    kOpWaitRound = 2,
+    kOpReduce = 3,
+    kOpCopy = 4,
+    kOpEncode = 5,    // python-assist only: C validates + skips
+    kOpDecode = 6,    // python-assist only: C validates + skips
+};
+
+constexpr uint64_t kPlanOpWords = 8;
+constexpr uint32_t kPlanFlagPreAssist = 1;
+constexpr uint32_t kPlanFlagPostAssist = 2;
+
+enum PlanStage : uint32_t {
+    kPlanIdle = 0,
+    kPlanPostRecvs,
+    kPlanPreAssist,    // waiting for ucc_plan_assist_done (encode phase)
+    kPlanPostSends,
+    kPlanWait,
+    kPlanPostAssist,   // waiting for ucc_plan_assist_done (local phase)
+    kPlanDone,
+};
+
+struct PlanWireOp {
+    uint64_t key_a = 0, key_c = 0;
+    uint32_t peer = 0;       // index into Plan::peers (sends)
+    uint32_t region = 0;
+    uint64_t off = 0, nbytes = 0;
+};
+
+struct PlanLocalOp {
+    uint32_t kind = 0, dtype = 0, rop = 0;
+    uint32_t region_dst = 0, region_src = 0;
+    uint64_t off_dst = 0, off_src = 0, nbytes = 0;
+};
+
+struct PlanRound {
+    std::vector<PlanWireOp> sends, recvs;
+    std::vector<PlanLocalOp> locals;
+    bool pre_assist = false, post_assist = false;
+};
+
+struct PendingReq {
+    Mailbox* mb;      // rndv send rids live in the PEER's slot table
+    uint64_t rid;
+    bool recv;
+};
+
+struct Plan {
+    std::mutex mu;
+    Mailbox* mb = nullptr;               // my (receiving) mailbox
+    std::vector<Mailbox*> peers;
+    std::vector<PlanRound> rounds;
+    std::vector<PendingReq> pending;     // current round's live requests
+    uint64_t state_rid = 0;              // completion word in mb's pub map
+    uint64_t eager_limit = 0;
+    uint8_t* user_base = nullptr;        // rebased every post
+    uint8_t* scratch_base = nullptr;     // plan-lifetime mc-pool lease
+    uint64_t tag = 0;                    // key word b, patched per post
+    uint32_t round = 0;
+    uint32_t stage = kPlanIdle;
+    bool live = false;
+    bool canceled = false;
+    bool parked = false;
+    // accounting, mapped read-only by python after an acquire-ordered
+    // confirm of the state word: [0..3] send kinds direct/eager/rndv/
+    // fenced, [4] rounds completed, [5] recvs withdrawn by cancel
+    uint64_t ctr[8] = {0};
+};
+
+// data-path ffi crossings (ucc_plan_post/test/assist_done): the debug
+// counter the CI plans-smoke reads to prove crossings-per-collective==1
+std::atomic<uint64_t> g_plan_ffi{0};
+
+std::mutex g_plan_park_mu;
+std::vector<Plan*> g_plan_parked;   // parked like mailboxes, never freed
+
+void plan_advance(Plan* p);
+
+// Delivery-driven advancement without lock-order inversion: completions
+// discovered while holding a shard (or plan) lock only ENQUEUE the plan;
+// the outermost C entry point drains the thread-local list with no locks
+// held. Plan mutexes therefore never nest (plan.mu > shard.mu >
+// alloc_mu is the only lock order), and a cascade across many ranks
+// runs as a loop, not recursion.
+thread_local std::vector<Plan*> t_plan_ready;
+thread_local bool t_plan_drain = false;
+
+void plan_enqueue(void* pv) {
+    if (pv != nullptr) t_plan_ready.push_back(static_cast<Plan*>(pv));
+}
+
+void plan_ready(void* pv) {
+    plan_enqueue(pv);
+    if (t_plan_drain) return;
+    t_plan_drain = true;
+    while (!t_plan_ready.empty()) {
+        Plan* q = t_plan_ready.back();
+        t_plan_ready.pop_back();
+        plan_advance(q);
+    }
+    t_plan_drain = false;
+}
+
+// shared matcher core of ucc_mailbox_push and the plan executor's send
+// pass: *nudge is set to the receiving plan on a direct delivery into a
+// plan-posted recv; *src_plan* rides parked rndv entries so the sender's
+// plan is nudged when a later recv lands the message.
+uint64_t push_impl(Mailbox* mb, const Key& k, const void* data,
+                   uint64_t len, uint64_t eager_limit, void* src_plan,
+                   void** nudge) {
+    *nudge = nullptr;
+    uint32_t shard_idx;
+    Shard& sh = mb->shard_for(k, &shard_idx);
+    std::lock_guard<std::mutex> g(sh.mu);
+    if (!sh.fences.empty() && mb->is_fenced(sh, k)) return kKindFenced;
+    auto it = sh.posted.find(k);
+    if (it != sh.posted.end()) {
+        auto& dq = it->second;
+        uint64_t rid = 0;
+        Slot* s = nullptr;
+        while (!dq.empty()) {
+            rid = dq.front();
+            dq.pop_front();
+            s = mb->live_pending(rid);   // cancelled-entry skip
+            if (s != nullptr) break;
+        }
+        if (dq.empty()) sh.posted.erase(it);
+        if (s != nullptr) {
+            // copy-free delivery: sender buffer -> posted dst, under the
+            // shard lock (cancel takes the same lock, so a recv cannot be
+            // withdrawn between being matched and being written)
+            uint64_t n = len < s->cap ? len : s->cap;
+            if (n) std::memcpy(s->dst, data, n);
+            s->nbytes = n;
+            s->sent = len;
+            *nudge = s->plan;
+            mb->publish(rid, n, len > s->cap ? kTruncated : kOk);
+            return kKindDirect;
+        }
+    }
+    Slot* ss = nullptr;
+    // slot-space exhaustion (1M live requests) degrades rndv to an eager
+    // copy rather than failing — correctness over the rndv optimization
+    uint64_t sid = len <= eager_limit ? 0 : mb->alloc(&ss);
+    if (sid == 0) {
+        Unexp u;
+        u.len = len;
+        if (len)
+            u.owned.assign(static_cast<const uint8_t*>(data),
+                           static_cast<const uint8_t*>(data) + len);
+        sh.unexpected[k].push_back(std::move(u));
+        return kKindEager;
+    }
+    ss->shard = shard_idx;
+    Unexp u;
+    u.ptr = data;
+    u.len = len;
+    u.sreq = sid;
+    u.src_plan = src_plan;
+    sh.unexpected[k].push_back(std::move(u));
+    return (sid << 3) | kKindRndv;
+}
+
+// shared core of ucc_mailbox_post_recv and the plan executor's recv
+// pass: *plan_tag* marks the slot so a delivering push can nudge the
+// owning plan; *nudge is set to a parked rndv SENDER's plan when this
+// post lands its message (the send completes here).
+uint64_t post_recv_impl(Mailbox* mb, const Key& k, void* dst, uint64_t cap,
+                        void* plan_tag, void** nudge) {
+    *nudge = nullptr;
+    Slot* s = nullptr;
+    uint64_t rid = mb->alloc(&s);
+    if (rid == 0) return 0;
+    uint32_t shard_idx;
+    Shard& sh = mb->shard_for(k, &shard_idx);
+    s->dst = dst;
+    s->cap = cap;
+    s->shard = shard_idx;
+    s->plan = plan_tag;
+    std::lock_guard<std::mutex> g(sh.mu);
+    if (!sh.fences.empty() && mb->is_fenced(sh, k)) {
+        mb->publish(rid, 0, kFenced);
+        return rid;
+    }
+    auto it = sh.unexpected.find(k);
+    if (it != sh.unexpected.end() && !it->second.empty()) {
+        Unexp u = std::move(it->second.front());
+        it->second.pop_front();
+        if (it->second.empty()) sh.unexpected.erase(it);
+        uint64_t n = u.len < cap ? u.len : cap;
+        if (n)
+            std::memcpy(dst, u.ptr != nullptr ? u.ptr : u.owned.data(), n);
+        s->nbytes = n;
+        s->sent = u.len;
+        mb->publish(rid, n, u.len > cap ? kTruncated : kOk);
+        // send requests are freed AT DELIVERY: the bumped generation
+        // reads as complete on the sender's side, and the C-side Request
+        // no longer outlives its message (the v1 leak)
+        if (u.sreq) {
+            mb->free_rid(u.sreq);
+            *nudge = u.src_plan;
+        }
+        return rid;
+    }
+    sh.posted[k].push_back(rid);
+    return rid;
+}
+
+uint8_t* plan_base(Plan* p, uint32_t region) {
+    return region ? p->scratch_base : p->user_base;
+}
+
+void plan_publish(Plan* p, uint64_t payload, uint32_t state) {
+    p->mb->publish(p->state_rid, payload, state);
+}
+
+// elementwise accumulate matching numpy's out= ufuncs bit-for-bit on
+// non-NaN data (NaN propagation follows np.maximum/np.minimum: a NaN on
+// either side wins). Plain loops: -O3 autovectorizes them.
+template <typename T>
+void reduce_span(T* acc, const T* src, uint64_t n, uint32_t rop) {
+    switch (rop) {
+    case 0:
+        for (uint64_t i = 0; i < n; ++i) acc[i] += src[i];
+        break;
+    case 1:
+        for (uint64_t i = 0; i < n; ++i) acc[i] *= src[i];
+        break;
+    case 2:
+        for (uint64_t i = 0; i < n; ++i) {
+            T a = acc[i], b = src[i];
+            acc[i] = (a != a) ? a : ((b != b) ? b : (a > b ? a : b));
+        }
+        break;
+    default:
+        for (uint64_t i = 0; i < n; ++i) {
+            T a = acc[i], b = src[i];
+            acc[i] = (a != a) ? a : ((b != b) ? b : (a < b ? a : b));
+        }
+        break;
+    }
+}
+
+void plan_run_locals(Plan* p, const PlanRound& r) {
+    for (const PlanLocalOp& op : r.locals) {
+        uint8_t* dst = plan_base(p, op.region_dst) + op.off_dst;
+        const uint8_t* src = plan_base(p, op.region_src) + op.off_src;
+        if (op.kind == kOpCopy) {
+            std::memcpy(dst, src, op.nbytes);
+        } else if (op.dtype == 1) {
+            reduce_span(reinterpret_cast<float*>(dst),
+                        reinterpret_cast<const float*>(src),
+                        op.nbytes / 4, op.rop);
+        } else {
+            reduce_span(reinterpret_cast<double*>(dst),
+                        reinterpret_cast<const double*>(src),
+                        op.nbytes / 8, op.rop);
+        }
+    }
+}
+
+// caller holds p->mu
+void plan_finish_round(Plan* p) {
+    ++p->ctr[4];
+    ++p->round;
+    if (p->round >= p->rounds.size()) {
+        p->stage = kPlanDone;
+        plan_publish(p, p->ctr[4], kOk);
+    } else {
+        p->stage = kPlanPostRecvs;
+    }
+}
+
+void plan_advance(Plan* p) {
+    std::lock_guard<std::mutex> g(p->mu);
+    if (!p->live || p->canceled) return;
+    for (;;) {
+        switch (p->stage) {
+        case kPlanPostRecvs: {
+            const PlanRound& r = p->rounds[p->round];
+            for (const PlanWireOp& w : r.recvs) {
+                Key k{w.key_a, p->tag, w.key_c};
+                void* nudge = nullptr;
+                uint64_t rid = post_recv_impl(
+                    p->mb, k, plan_base(p, w.region) + w.off, w.nbytes,
+                    p, &nudge);
+                plan_enqueue(nudge);
+                if (rid == 0) {   // slot exhaustion: fail the plan
+                    p->stage = kPlanDone;
+                    plan_publish(p, p->round, kTruncated);
+                    return;
+                }
+                p->pending.push_back({p->mb, rid, true});
+            }
+            if (r.pre_assist) {
+                p->stage = kPlanPreAssist;
+                plan_publish(p, (uint64_t(p->round) << 1) | 0, kAssist);
+                return;
+            }
+            p->stage = kPlanPostSends;
+            break;
+        }
+        case kPlanPostSends: {
+            const PlanRound& r = p->rounds[p->round];
+            for (const PlanWireOp& w : r.sends) {
+                Key k{w.key_a, p->tag, w.key_c};
+                void* nudge = nullptr;
+                Mailbox* peer = p->peers[w.peer];
+                uint64_t ret = push_impl(
+                    peer, k, plan_base(p, w.region) + w.off, w.nbytes,
+                    p->eager_limit, p, &nudge);
+                plan_enqueue(nudge);
+                uint32_t kind = ret & 7u;
+                ++p->ctr[kind & 3u];
+                if (kind == kKindRndv)
+                    p->pending.push_back({peer, ret >> 3, false});
+            }
+            p->stage = kPlanWait;
+            break;
+        }
+        case kPlanWait: {
+            uint32_t err = 0;
+            bool all = true;
+            for (const PendingReq& q : p->pending) {
+                uint32_t idx = static_cast<uint32_t>(q.rid & kIdxMask);
+                uint64_t v = q.mb->pub[idx].load(std::memory_order_acquire);
+                if ((v >> 32) != (q.rid >> kSlotBits)) {
+                    // freed under us: normal completion for a rndv send
+                    // (freed at delivery or by a fence); for an owned
+                    // recv it means an endpoint purge ripped the slot
+                    // away — fail the plan, never touch the buffers
+                    if (q.recv && err == 0) err = kTruncated;
+                    continue;
+                }
+                uint32_t st = static_cast<uint32_t>(v & 7u);
+                if (st == kPending) {
+                    all = false;
+                    break;
+                }
+                if (st != kOk && err == 0) err = st;
+            }
+            if (!all) return;   // a completing delivery re-nudges us
+            for (const PendingReq& q : p->pending)
+                if (q.recv) q.mb->free_rid(q.rid);
+            p->pending.clear();
+            if (err) {
+                p->stage = kPlanDone;
+                plan_publish(p, p->round, err);
+                return;
+            }
+            const PlanRound& r = p->rounds[p->round];
+            if (r.post_assist) {
+                p->stage = kPlanPostAssist;
+                plan_publish(p, (uint64_t(p->round) << 1) | 1, kAssist);
+                return;
+            }
+            plan_run_locals(p, r);
+            plan_finish_round(p);
+            if (p->stage == kPlanDone) return;
+            break;
+        }
+        default:
+            return;   // idle / done / waiting on an assist callback
+        }
+    }
+}
+
+// caller holds p->mu: withdraw the current round's posted recvs (native
+// cancel-skip + immediate free — the plan owns them) and stop waiting on
+// rndv sends (they cannot be unsent, matching the python contract).
+uint64_t plan_cancel_locked(Plan* p) {
+    uint64_t withdrawn = 0;
+    for (const PendingReq& q : p->pending) {
+        if (!q.recv) continue;
+        uint32_t idx = static_cast<uint32_t>(q.rid & kIdxMask);
+        uint32_t gen = static_cast<uint32_t>(q.rid >> kSlotBits);
+        Slot* s = q.mb->slot_of(idx);
+        if (s == nullptr || s->gen.load(std::memory_order_acquire) != gen)
+            continue;
+        uint32_t shard = s->shard;
+        std::lock_guard<std::mutex> g2(q.mb->shards[shard].mu);
+        uint64_t v = q.mb->pub[idx].load(std::memory_order_acquire);
+        if ((v >> 32) != gen || (v & 7u) != 0) continue;
+        q.mb->publish(q.rid, 0, kCanceled);
+        q.mb->free_rid(q.rid);
+        ++withdrawn;
+    }
+    p->pending.clear();
+    p->ctr[5] += withdrawn;
+    return withdrawn;
+}
+
 }  // namespace
 
 extern "C" {
@@ -329,56 +756,13 @@ void* ucc_mailbox_pub_base(void* mbp) {
 uint64_t ucc_mailbox_push(void* mbp, uint64_t a, uint64_t b, uint64_t c,
                           const void* data, uint64_t len,
                           uint64_t eager_limit) {
-    auto* mb = static_cast<Mailbox*>(mbp);
-    Key k{a, b, c};
-    uint32_t shard_idx;
-    Shard& sh = mb->shard_for(k, &shard_idx);
-    std::lock_guard<std::mutex> g(sh.mu);
-    if (!sh.fences.empty() && mb->is_fenced(sh, k)) return kKindFenced;
-    auto it = sh.posted.find(k);
-    if (it != sh.posted.end()) {
-        auto& dq = it->second;
-        uint64_t rid = 0;
-        Slot* s = nullptr;
-        while (!dq.empty()) {
-            rid = dq.front();
-            dq.pop_front();
-            s = mb->live_pending(rid);   // cancelled-entry skip
-            if (s != nullptr) break;
-        }
-        if (dq.empty()) sh.posted.erase(it);
-        if (s != nullptr) {
-            // copy-free delivery: sender buffer -> posted dst, under the
-            // shard lock (cancel takes the same lock, so a recv cannot be
-            // withdrawn between being matched and being written)
-            uint64_t n = len < s->cap ? len : s->cap;
-            if (n) std::memcpy(s->dst, data, n);
-            s->nbytes = n;
-            s->sent = len;
-            mb->publish(rid, n, len > s->cap ? kTruncated : kOk);
-            return kKindDirect;
-        }
-    }
-    Slot* ss = nullptr;
-    // slot-space exhaustion (1M live requests) degrades rndv to an eager
-    // copy rather than failing — correctness over the rndv optimization
-    uint64_t sid = len <= eager_limit ? 0 : mb->alloc(&ss);
-    if (sid == 0) {
-        Unexp u;
-        u.len = len;
-        if (len)
-            u.owned.assign(static_cast<const uint8_t*>(data),
-                           static_cast<const uint8_t*>(data) + len);
-        sh.unexpected[k].push_back(std::move(u));
-        return kKindEager;
-    }
-    ss->shard = shard_idx;
-    Unexp u;
-    u.ptr = data;
-    u.len = len;
-    u.sreq = sid;
-    sh.unexpected[k].push_back(std::move(u));
-    return (sid << 3) | kKindRndv;
+    void* nudge = nullptr;
+    uint64_t ret = push_impl(static_cast<Mailbox*>(mbp), Key{a, b, c},
+                             data, len, eager_limit, nullptr, &nudge);
+    // a delivery into a plan-posted recv advances that plan HERE, on the
+    // delivering thread (no locks held: plan_ready drains a worklist)
+    plan_ready(nudge);
+    return ret;
 }
 
 // Post a receive into dst (capacity cap bytes). Returns the request id
@@ -386,39 +770,12 @@ uint64_t ucc_mailbox_push(void* mbp, uint64_t a, uint64_t b, uint64_t c,
 // immediately with the fenced state (local stale-team bug, surfaced).
 uint64_t ucc_mailbox_post_recv(void* mbp, uint64_t a, uint64_t b,
                                uint64_t c, void* dst, uint64_t cap) {
-    auto* mb = static_cast<Mailbox*>(mbp);
-    Slot* s = nullptr;
-    uint64_t rid = mb->alloc(&s);
-    if (rid == 0) return 0;
-    Key k{a, b, c};
-    uint32_t shard_idx;
-    Shard& sh = mb->shard_for(k, &shard_idx);
-    s->dst = dst;
-    s->cap = cap;
-    s->shard = shard_idx;
-    std::lock_guard<std::mutex> g(sh.mu);
-    if (!sh.fences.empty() && mb->is_fenced(sh, k)) {
-        mb->publish(rid, 0, kFenced);
-        return rid;
-    }
-    auto it = sh.unexpected.find(k);
-    if (it != sh.unexpected.end() && !it->second.empty()) {
-        Unexp u = std::move(it->second.front());
-        it->second.pop_front();
-        if (it->second.empty()) sh.unexpected.erase(it);
-        uint64_t n = u.len < cap ? u.len : cap;
-        if (n)
-            std::memcpy(dst, u.ptr != nullptr ? u.ptr : u.owned.data(), n);
-        s->nbytes = n;
-        s->sent = u.len;
-        mb->publish(rid, n, u.len > cap ? kTruncated : kOk);
-        // send requests are freed AT DELIVERY: the bumped generation
-        // reads as complete on the sender's side, and the C-side Request
-        // no longer outlives its message (the v1 leak)
-        if (u.sreq) mb->free_rid(u.sreq);
-        return rid;
-    }
-    sh.posted[k].push_back(rid);
+    void* nudge = nullptr;
+    uint64_t rid = post_recv_impl(static_cast<Mailbox*>(mbp), Key{a, b, c},
+                                  dst, cap, nullptr, &nudge);
+    // landing a parked rndv send completes the SENDING plan's request:
+    // advance it from here (its own thread only polls its state word)
+    plan_ready(nudge);
     return rid;
 }
 
@@ -433,6 +790,10 @@ uint64_t ucc_mailbox_fence(void* mbp, uint64_t team_id, uint64_t min_epoch) {
     uint32_t team = static_cast<uint32_t>(team_id);
     uint32_t epoch = static_cast<uint32_t>(min_epoch);
     uint64_t purged = 0;
+    // plans whose requests this fence retires: nudged AFTER the shard
+    // locks drop so they observe their fenced/freed state and error out
+    // instead of waiting forever (cold path — fences are shrink-time)
+    std::vector<void*> nudges;
     for (int i = 0; i < kShards; ++i) {
         Shard& sh = mb->shards[i];
         std::lock_guard<std::mutex> g(sh.mu);
@@ -443,7 +804,11 @@ uint64_t ucc_mailbox_fence(void* mbp, uint64_t team_id, uint64_t min_epoch) {
             if (static_cast<uint32_t>(k.a >> 32) == team &&
                 static_cast<uint32_t>(k.a) < epoch) {
                 for (uint64_t rid : it->second) {
-                    if (mb->live_pending(rid)) mb->publish(rid, 0, kFenced);
+                    Slot* s = mb->live_pending(rid);
+                    if (s != nullptr) {
+                        if (s->plan) nudges.push_back(s->plan);
+                        mb->publish(rid, 0, kFenced);
+                    }
                     ++purged;
                 }
                 it = sh.posted.erase(it);
@@ -456,7 +821,10 @@ uint64_t ucc_mailbox_fence(void* mbp, uint64_t team_id, uint64_t min_epoch) {
             if (static_cast<uint32_t>(k.a >> 32) == team &&
                 static_cast<uint32_t>(k.a) < epoch) {
                 for (Unexp& u : it->second) {
-                    if (u.sreq) mb->free_rid(u.sreq);
+                    if (u.sreq) {
+                        mb->free_rid(u.sreq);
+                        if (u.src_plan) nudges.push_back(u.src_plan);
+                    }
                     ++purged;
                 }
                 it = sh.unexpected.erase(it);
@@ -465,6 +833,7 @@ uint64_t ucc_mailbox_fence(void* mbp, uint64_t team_id, uint64_t min_epoch) {
             }
         }
     }
+    for (void* n : nudges) plan_ready(n);
     return purged;
 }
 
@@ -600,6 +969,274 @@ void ucc_req_free(void* mbp, uint64_t rid) {
 void ucc_req_free_many(void* mbp, uint64_t n, const uint64_t* rids) {
     auto* mb = static_cast<Mailbox*>(mbp);
     for (uint64_t i = 0; i < n; ++i) mb->free_rid(rids[i]);
+}
+
+// ---------------------------------------------------------------------------
+// execution-plan API (ABI 4). See the Plan section above for semantics.
+// ---------------------------------------------------------------------------
+
+// Build a plan from the packed op table (n_ops entries of kPlanOpWords
+// u64 words each; rounds are delimited by WAIT_ROUND entries whose flags
+// carry the assist bits). Returns the plan handle, or nullptr on a
+// malformed table / slot exhaustion. out[0] = the plan's state-word
+// request id in *my_mb*'s mapped pub window (poll = one memory load),
+// out[1] = the address of the plan's counter array (mapped read-only;
+// valid forever — plans are parked at destroy, never freed).
+void* ucc_plan_build(void* my_mb, uint64_t n_peers, void* const* peer_mbs,
+                     uint64_t n_ops, const uint64_t* ops,
+                     void* scratch_base, uint64_t eager_limit,
+                     uint64_t* out) {
+    auto* mb = static_cast<Mailbox*>(my_mb);
+    if (mb == nullptr || n_ops == 0) return nullptr;
+    Plan* p = nullptr;
+    {
+        std::lock_guard<std::mutex> g(g_plan_park_mu);
+        if (!g_plan_parked.empty()) {
+            p = g_plan_parked.back();
+            g_plan_parked.pop_back();
+        }
+    }
+    if (p == nullptr) p = new Plan();
+    p->rounds.clear();
+    p->peers.assign(reinterpret_cast<Mailbox* const*>(peer_mbs),
+                    reinterpret_cast<Mailbox* const*>(peer_mbs) + n_peers);
+    p->pending.clear();
+    p->mb = mb;
+    p->eager_limit = eager_limit;
+    p->scratch_base = static_cast<uint8_t*>(scratch_base);
+    p->user_base = nullptr;
+    p->tag = 0;
+    p->round = 0;
+    p->stage = kPlanIdle;
+    p->canceled = false;
+    p->parked = false;
+    for (uint64_t& c : p->ctr) c = 0;
+
+    bool ok = true;
+    PlanRound cur;
+    bool closed = true;   // table must end on a WAIT_ROUND
+    for (uint64_t i = 0; ok && i < n_ops; ++i) {
+        const uint64_t* w = ops + i * kPlanOpWords;
+        uint32_t kind = static_cast<uint32_t>(w[0] & 0xFF);
+        uint32_t flags = static_cast<uint32_t>((w[0] >> 8) & 0xFF);
+        closed = false;
+        switch (kind) {
+        case kOpPostSend: {
+            PlanWireOp op;
+            op.key_a = w[1];
+            op.key_c = w[2];
+            op.peer = static_cast<uint32_t>(w[3]);
+            op.region = static_cast<uint32_t>(w[4] & 0xF);
+            op.off = w[5];
+            op.nbytes = w[7];
+            if (op.peer >= p->peers.size() ||
+                p->peers[op.peer] == nullptr || op.region > 1) {
+                ok = false;
+                break;
+            }
+            cur.sends.push_back(op);
+            break;
+        }
+        case kOpPostRecv: {
+            PlanWireOp op;
+            op.key_a = w[1];
+            op.key_c = w[2];
+            op.region = static_cast<uint32_t>(w[4] & 0xF);
+            op.off = w[5];
+            op.nbytes = w[7];
+            if (op.region > 1) {
+                ok = false;
+                break;
+            }
+            cur.recvs.push_back(op);
+            break;
+        }
+        case kOpReduce:
+        case kOpCopy: {
+            PlanLocalOp op;
+            op.kind = kind;
+            op.region_dst = static_cast<uint32_t>(w[4] & 0xF);
+            op.region_src = static_cast<uint32_t>((w[4] >> 4) & 0xF);
+            op.dtype = static_cast<uint32_t>((w[4] >> 8) & 0xFF);
+            op.rop = static_cast<uint32_t>((w[4] >> 16) & 0xFF);
+            op.off_dst = w[5];
+            op.off_src = w[6];
+            op.nbytes = w[7];
+            if (op.region_dst > 1 || op.region_src > 1 ||
+                (kind == kOpReduce && op.rop > 3)) {
+                ok = false;
+                break;
+            }
+            cur.locals.push_back(op);
+            break;
+        }
+        case kOpEncode:
+        case kOpDecode:
+            // python-assist ops: C never executes these, but records
+            // them so the closing WAIT_ROUND is validated to carry the
+            // matching assist flag
+            cur.locals.push_back(PlanLocalOp{kind, 0, 0, 0, 0, 0, 0, 0});
+            break;
+        case kOpWaitRound: {
+            cur.pre_assist = (flags & kPlanFlagPreAssist) != 0;
+            cur.post_assist = (flags & kPlanFlagPostAssist) != 0;
+            // validate: every local op C cannot execute needs an assist
+            // flag routing the round to python (a silent skip would
+            // complete the collective with wrong data)
+            std::vector<PlanLocalOp> native_locals;
+            for (const PlanLocalOp& op : cur.locals) {
+                if (op.kind == kOpEncode) {
+                    if (!cur.pre_assist) ok = false;
+                } else if (op.kind == kOpDecode) {
+                    if (!cur.post_assist) ok = false;
+                } else if (op.kind == kOpReduce &&
+                           op.dtype != 1 && op.dtype != 2) {
+                    if (!cur.post_assist) ok = false;
+                } else {
+                    native_locals.push_back(op);
+                }
+            }
+            cur.locals = std::move(native_locals);
+            p->rounds.push_back(std::move(cur));
+            cur = PlanRound();
+            closed = true;
+            break;
+        }
+        default:
+            ok = false;
+            break;
+        }
+    }
+    if (!ok || !closed || p->rounds.empty()) {
+        std::lock_guard<std::mutex> g(g_plan_park_mu);
+        p->parked = true;
+        g_plan_parked.push_back(p);
+        return nullptr;
+    }
+    Slot* s = nullptr;
+    p->state_rid = mb->alloc(&s);
+    if (p->state_rid == 0) {
+        std::lock_guard<std::mutex> g(g_plan_park_mu);
+        p->parked = true;
+        g_plan_parked.push_back(p);
+        return nullptr;
+    }
+    p->live = true;
+    out[0] = p->state_rid;
+    out[1] = static_cast<uint64_t>(reinterpret_cast<uintptr_t>(p->ctr));
+    return p;
+}
+
+// Post the plan: ONE ffi crossing runs the whole collective — rounds
+// past the first advance delivery-driven on whichever thread completes
+// them. *user_base* rebases region-0 offsets (the caller's dst vector),
+// *tag* is baked into every key as word b. Returns 0, -1 (dead plan),
+// -2 (still running — the caller must not share one plan across
+// concurrent collectives).
+int ucc_plan_post(void* pv, void* user_base, uint64_t tag) {
+    g_plan_ffi.fetch_add(1, std::memory_order_relaxed);
+    Plan* p = static_cast<Plan*>(pv);
+    {
+        std::lock_guard<std::mutex> g(p->mu);
+        if (!p->live) return -1;
+        if (p->stage != kPlanIdle && p->stage != kPlanDone) return -2;
+        p->user_base = static_cast<uint8_t*>(user_base);
+        p->tag = tag;
+        p->round = 0;
+        p->canceled = false;
+        p->pending.clear();
+        p->ctr[0] = p->ctr[1] = p->ctr[2] = p->ctr[3] = p->ctr[4] = 0;
+        p->stage = kPlanPostRecvs;
+        plan_publish(p, 0, kPending);
+    }
+    plan_ready(p);
+    return 0;
+}
+
+// Fallback nudge (stall recovery / teardown paths): re-checks the
+// current round's completions and returns the state bits of the plan
+// word. Not needed on the happy path — deliveries advance the plan.
+uint64_t ucc_plan_test(void* pv) {
+    g_plan_ffi.fetch_add(1, std::memory_order_relaxed);
+    Plan* p = static_cast<Plan*>(pv);
+    plan_ready(p);
+    std::lock_guard<std::mutex> g(p->mu);
+    if (!p->live) return kCanceled;
+    return poll_rid(p->mb, p->state_rid);
+}
+
+// Python ran the flagged assist phase (encode before sends / the
+// round's local ops after completion): resume C-side advancement.
+void ucc_plan_assist_done(void* pv) {
+    g_plan_ffi.fetch_add(1, std::memory_order_relaxed);
+    Plan* p = static_cast<Plan*>(pv);
+    {
+        std::lock_guard<std::mutex> g(p->mu);
+        if (!p->live || p->canceled) return;
+        if (p->stage == kPlanPreAssist) {
+            plan_publish(p, 0, kPending);
+            p->stage = kPlanPostSends;
+        } else if (p->stage == kPlanPostAssist) {
+            plan_publish(p, 0, kPending);
+            plan_finish_round(p);
+        } else {
+            return;
+        }
+    }
+    plan_ready(p);
+}
+
+// Abort a posted plan: withdraw the current round's posted recvs (the
+// native cancel-skip — a late peer send can no longer scribble into
+// plan buffers), stop waiting on parked rndv sends, and publish the
+// canceled state. Returns the number of recvs withdrawn.
+uint64_t ucc_plan_cancel(void* pv) {
+    Plan* p = static_cast<Plan*>(pv);
+    std::lock_guard<std::mutex> g(p->mu);
+    if (!p->live) return 0;
+    p->canceled = true;
+    uint64_t withdrawn = plan_cancel_locked(p);
+    if (p->stage != kPlanDone && p->stage != kPlanIdle)
+        plan_publish(p, p->round, kCanceled);
+    p->stage = kPlanDone;
+    return withdrawn;
+}
+
+void ucc_plan_counters(void* pv, uint64_t* out) {
+    Plan* p = static_cast<Plan*>(pv);
+    std::lock_guard<std::mutex> g(p->mu);
+    for (int i = 0; i < 8; ++i) out[i] = p->ctr[i];
+}
+
+// Retire a plan: cancel whatever is still posted, free the state slot,
+// and PARK the plan object (like mailboxes — a delivery racing this
+// call may still hold the raw pointer; a parked plan reads !live under
+// its mutex and the nudge becomes a no-op, never a use-after-free).
+void ucc_plan_destroy(void* pv) {
+    Plan* p = static_cast<Plan*>(pv);
+    {
+        std::lock_guard<std::mutex> g(p->mu);
+        if (p->parked) return;
+        p->parked = true;
+        if (p->live) {
+            p->canceled = true;
+            plan_cancel_locked(p);
+            if (p->state_rid) p->mb->free_rid(p->state_rid);
+        }
+        p->live = false;
+        p->state_rid = 0;
+        p->rounds.clear();
+        p->peers.clear();
+        p->pending.clear();
+    }
+    std::lock_guard<std::mutex> g(g_plan_park_mu);
+    g_plan_parked.push_back(p);
+}
+
+// data-path ffi crossings so far (post/test/assist_done): the CI plans
+// smoke asserts the delta over one collective == 1 per rank.
+uint64_t ucc_plan_ffi_calls() {
+    return g_plan_ffi.load(std::memory_order_relaxed);
 }
 
 // ---------------------------------------------------------------------------

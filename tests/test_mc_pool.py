@@ -371,7 +371,11 @@ class TestSteadyStateZeroAlloc:
         finally:
             reset_host_pool(None)
 
-    def test_lease_released_on_finalize(self):
+    def test_lease_released_on_finalize(self, monkeypatch):
+        # task-lease lifetime is what this pins; the native-plan path
+        # holds a PLAN-lifetime lease in the team cache instead (its
+        # release-at-team-destroy twin lives in test_plan.py)
+        monkeypatch.setenv("UCC_GEN_NATIVE", "n")
         job = UccJob(2)
         try:
             teams = job.create_team()

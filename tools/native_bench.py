@@ -51,7 +51,7 @@ def _mode_of(ctx) -> str:
             is not None else "python")
 
 
-def run_multi(n: int, iters: int, count: int) -> dict:
+def run_multi(n: int, iters: int, count: int, f32: bool = False) -> dict:
     """ThreadMode.MULTIPLE: every rank posts + progresses from its own
     OS thread (concurrent matcher access; the GIL-release regime)."""
     import numpy as np
@@ -60,6 +60,9 @@ def run_multi(n: int, iters: int, count: int) -> dict:
                          ContextParams, DataType, LibParams, ReductionOp,
                          TeamParams, ThreadMode, ThreadOobWorld)
 
+    nd = np.float32 if f32 else np.float64
+    ucc_dt = DataType.FLOAT32 if f32 else DataType.FLOAT64
+    esz = 4 if f32 else 8
     world = ThreadOobWorld(n)
     libs = [ucc_tpu.init(LibParams(thread_mode=ThreadMode.MULTIPLE))
             for _ in range(n)]
@@ -85,14 +88,14 @@ def run_multi(n: int, iters: int, count: int) -> dict:
         try:
             team = ctxs[r].create_team(TeamParams(oob=tw.endpoint(r)))
             teams[r] = team
-            src = np.full(count, float(r + 1), np.float64)
-            dst = np.zeros(count, np.float64)
+            src = np.full(count, float(r + 1), nd)
+            dst = np.zeros(count, nd)
 
             def one():
                 req = team.collective_init(CollArgs(
                     coll_type=CollType.ALLREDUCE,
-                    src=BufferInfo(src, count, DataType.FLOAT64),
-                    dst=BufferInfo(dst, count, DataType.FLOAT64),
+                    src=BufferInfo(src, count, ucc_dt),
+                    dst=BufferInfo(dst, count, ucc_dt),
                     op=ReductionOp.SUM))
                 req.post()
                 req.wait(timeout=120)
@@ -128,10 +131,122 @@ def run_multi(n: int, iters: int, count: int) -> dict:
     wall = t_wall[0]
     return {"bench": "native", "threadmode": "multiple", "matcher": mode,
             "coll": "allreduce", "ranks": n, "count": count,
-            "size_bytes": count * 8, "iters": iters,
+            "size_bytes": count * esz, "iters": iters,
             **_stats(lats0),
             "wall_s": round(wall, 4),
             "colls_per_s": round(iters / wall, 1) if wall else None}
+
+
+def run_plans(n: int, iters: int, sizes, algs, json_only: bool) -> int:
+    """--plans: A/B per-round-Python (interpreted GeneratedCollTask) vs
+    NATIVE-PLAN execution of the same verified programs on the MT shm
+    mesh, one subprocess per (alg, size, mode) pair, plus a bitwise
+    cross-check of the two modes (2/4/8 ranks, inplace + AVG included).
+    One JSON record per line on stdout; pipe to BENCH_r12.json."""
+    records = []
+    for alg in algs:
+        fam = "ring(1)" if alg.startswith("gen_ring_c1") else \
+            "ring(2)" if alg.startswith("gen_ring_c2") else "rhd(0)"
+        for size in sizes:
+            count = max(64, size // 4)          # f32 elements
+            it = max(10, min(iters, iters * 8192 // max(8192, size)))
+            pair = {}
+            for mode, flag in (("interpreted", "n"), ("plan", "y")):
+                env = dict(os.environ, JAX_PLATFORMS="cpu",
+                           UCC_GEN="y", UCC_GEN_FAMILIES=fam,
+                           UCC_GEN_NATIVE=flag,
+                           UCC_TL_SHM_TUNE=f"allreduce:@{alg}:inf")
+                out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "-n", str(n), "--iters", str(it),
+                     "--count", str(count), "--f32", "--json"],
+                    env=env, capture_output=True, text=True, timeout=900)
+                line = (out.stdout or "").strip().splitlines()[-1] \
+                    if out.stdout else ""
+                if out.returncode != 0 or not line:
+                    print(f"# plans bench failed ({alg} {size} {mode}) "
+                          f"rc={out.returncode}: "
+                          f"{(out.stderr or '')[-300:]}", file=sys.stderr)
+                    return 1
+                pair[mode] = json.loads(line)
+            rec = {"bench": "plans", "threadmode": "multiple",
+                   "coll": "allreduce", "alg": alg, "ranks": n,
+                   "count": count, "size_bytes": count * 4, "iters": it,
+                   "interp_p50_us": pair["interpreted"]["p50_us"],
+                   "interp_p99_us": pair["interpreted"]["p99_us"],
+                   "plan_p50_us": pair["plan"]["p50_us"],
+                   "plan_p99_us": pair["plan"]["p99_us"],
+                   "plan_speedup_p50": round(
+                       pair["interpreted"]["p50_us"] /
+                       max(1e-9, pair["plan"]["p50_us"]), 3),
+                   "plan_colls_per_s": pair["plan"]["colls_per_s"],
+                   "interp_colls_per_s":
+                       pair["interpreted"]["colls_per_s"]}
+            records.append(rec)
+            print(json.dumps(rec))
+            if not json_only:
+                print(f"# {alg} {count * 4}B: plan p50 "
+                      f"{rec['plan_p50_us']}us vs interp "
+                      f"{rec['interp_p50_us']}us -> "
+                      f"{rec['plan_speedup_p50']}x", file=sys.stderr)
+    bit = _plans_bitwise()
+    print(json.dumps(bit))
+    if not json_only:
+        print(f"# bitwise plan-vs-interpreted: {bit['verdict']} over "
+              f"ranks {bit['ranks']}", file=sys.stderr)
+    wins = [r for r in records
+            if r["size_bytes"] <= 262144 and r["plan_speedup_p50"] >= 1.3]
+    verdict = {"bench": "plans", "metric": "summary",
+               "points_ge_1p3x_le_256k": len(wins),
+               "bitwise_ok": bit["verdict"] == "identical",
+               "best_speedup_p50": max(
+                   (r["plan_speedup_p50"] for r in records), default=None)}
+    print(json.dumps(verdict))
+    return 0 if (len(wins) >= 2 and bit["verdict"] == "identical") else 1
+
+
+def _plans_bitwise() -> dict:
+    """Run one matrix of allreduces (SUM/AVG/MAX x inplace x dtypes) in
+    BOTH modes across 2/4/8 ranks in subprocesses; compare result bytes."""
+    rec = {"bench": "plans", "metric": "bitwise", "ranks": [2, 4, 8],
+           "cases": 0, "mismatches": []}
+    for n in (2, 4, 8):
+        digests = {}
+        for mode, flag in (("interp", "n"), ("plan", "y")):
+            env = dict(os.environ, JAX_PLATFORMS="cpu", UCC_GEN="y",
+                       UCC_GEN_FAMILIES="ring(1),rhd(0)",
+                       UCC_GEN_NATIVE=flag,
+                       UCC_TL_SHM_TUNE="allreduce:@gen_ring_c1:inf")
+            out = subprocess.run(
+                [sys.executable, "-m", "ucc_tpu.dsl.smoke",
+                 "--plans-digest", str(n)],
+                env=env, capture_output=True, text=True, timeout=600,
+                cwd=REPO)
+            line = (out.stdout or "").strip().splitlines()[-1] \
+                if out.stdout else ""
+            try:
+                digests[mode] = json.loads(line)
+            except ValueError:
+                rec["mismatches"].append(
+                    {"ranks": n, "mode": mode,
+                     "error": (out.stderr or "no output")[-200:]})
+                digests[mode] = None
+        a, b = digests.get("interp"), digests.get("plan")
+        if a and b:
+            # "_"-prefixed keys are metadata (e.g. _plan_engaged, which
+            # legitimately differs between the modes), not result digests
+            cases = [k for k in a if not k.startswith("_")]
+            rec["cases"] += len(cases)
+            for k in cases:
+                # None = the case timed out in that mode: never a match
+                if a[k] is None or b.get(k) is None or a[k] != b.get(k):
+                    rec["mismatches"].append({"ranks": n, "case": k})
+            if not b.get("_plan_engaged", True):
+                rec["mismatches"].append(
+                    {"ranks": n, "case": "plan mode did not engage"})
+    rec["verdict"] = "identical" if rec["cases"] and \
+        not rec["mismatches"] else "MISMATCH"
+    return rec
 
 
 def main(argv=None) -> int:
@@ -140,6 +255,9 @@ def main(argv=None) -> int:
     ap.add_argument("--iters", type=int, default=200)
     ap.add_argument("--count", type=int, default=64,
                     help="elements per allreduce (small = matcher-bound)")
+    ap.add_argument("--f32", action="store_true",
+                    help="float32 payload (the plans A/B uses it: the "
+                    "native reduce fast path)")
     ap.add_argument("--single", action="store_true",
                     help="ThreadMode.SINGLE cooperative driver instead "
                     "of one OS thread per rank")
@@ -151,11 +269,25 @@ def main(argv=None) -> int:
     ap.add_argument("--compare", action="store_true",
                     help="run python + native matchers in subprocesses "
                     "and print the verdict")
+    ap.add_argument("--plans", action="store_true",
+                    help="A/B interpreted vs native-plan execution of "
+                    "generated programs (gen_ring/gen_rhd) over a "
+                    "message-size sweep + a bitwise cross-check "
+                    "(BENCH_r12 harness)")
+    ap.add_argument("--sizes", default="8192,65536,262144,1048576,4194304",
+                    help="--plans: comma list of message sizes in bytes")
     args = ap.parse_args(argv)
+
+    if args.plans:
+        sizes = [int(s) for s in args.sizes.split(",") if s.strip()]
+        # rhd at radix n (the direct exchange) is named per team size
+        return run_plans(args.n, args.iters, sizes,
+                         ("gen_ring_c1", f"gen_rhd_r{args.n}"),
+                         args.json)
 
     if not args.compare:
         fn = _run_single_impl if args.single else run_multi
-        rec = fn(args.n, args.iters, args.count)
+        rec = fn(args.n, args.iters, args.count, f32=args.f32)
         print(json.dumps(rec))
         if not args.json:
             print(f"# {rec['matcher']} matcher ({rec['threadmode']}): "
@@ -210,7 +342,7 @@ def main(argv=None) -> int:
     return 0
 
 
-def _run_single_impl(n: int, iters: int, count: int) -> dict:
+def _run_single_impl(n: int, iters: int, count: int, f32: bool = False) -> dict:
     """ThreadMode.SINGLE: one thread posts the collective on every rank
     and drives all contexts cooperatively (the tests/gate regime — the
     regime where the v1 matcher lost ~2x to python)."""
@@ -220,17 +352,20 @@ def _run_single_impl(n: int, iters: int, count: int) -> dict:
     sys.path.insert(0, os.path.join(REPO, "tests"))
     from harness import UccJob
 
+    nd = np.float32 if f32 else np.float64
+    ucc_dt = DataType.FLOAT32 if f32 else DataType.FLOAT64
+    esz = 4 if f32 else 8
     job = UccJob(n)
     try:
         teams = job.create_team()
-        srcs = [np.full(count, float(r + 1), np.float64) for r in range(n)]
-        dsts = [np.zeros(count, np.float64) for _ in range(n)]
+        srcs = [np.full(count, float(r + 1), nd) for r in range(n)]
+        dsts = [np.zeros(count, nd) for _ in range(n)]
 
         def one_round():
             reqs = [t.collective_init(CollArgs(
                 coll_type=CollType.ALLREDUCE,
-                src=BufferInfo(srcs[r], count, DataType.FLOAT64),
-                dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+                src=BufferInfo(srcs[r], count, ucc_dt),
+                dst=BufferInfo(dsts[r], count, ucc_dt),
                 op=ReductionOp.SUM)) for r, t in enumerate(teams)]
             for rq in reqs:
                 rq.post()
@@ -255,7 +390,7 @@ def _run_single_impl(n: int, iters: int, count: int) -> dict:
         job.cleanup()
     return {"bench": "native", "threadmode": "single", "matcher": mode,
             "coll": "allreduce", "ranks": n, "count": count,
-            "size_bytes": count * 8, "iters": iters,
+            "size_bytes": count * esz, "iters": iters,
             **_stats(lats),
             "wall_s": round(wall, 4),
             "colls_per_s": round(iters / wall, 1) if wall else None}

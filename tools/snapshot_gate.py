@@ -587,6 +587,70 @@ def _gen_smoke(env) -> None:
           flush=True)
 
 
+def _plans_smoke(env) -> None:
+    """WARN-ONLY native execution-plan probe (ISSUE 12 CI satellite):
+    ``python -m ucc_tpu.dsl.smoke --plans`` builds one generated
+    allreduce as a NATIVE PLAN and asserts bitwise agreement with the
+    interpreted path plus data-path ffi-crossings-per-collective == 1
+    (the C debug counter). Skips cleanly when the native core is
+    unavailable. Disable with UCC_GATE_PLANS=0."""
+    import json
+    if os.environ.get("UCC_GATE_PLANS", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] plans smoke: skipped (UCC_GATE_PLANS=0)",
+              flush=True)
+        return
+    print("[gate] native-plans smoke (warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE",
+                                      "UCC_GEN", "UCC_TUNER"))}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ucc_tpu.dsl.smoke", "--plans"],
+            cwd=REPO, env=smoke_env, capture_output=True, text=True,
+            timeout=600)
+    except subprocess.TimeoutExpired:
+        print("[gate] WARN: plans smoke timed out (not a gate failure)",
+              flush=True)
+        return
+    rec = None
+    for ln in (r.stdout or "").splitlines():
+        if ln.startswith("{"):
+            try:
+                cand = json.loads(ln)
+            except ValueError:
+                continue
+            if cand.get("metric") == "plan_gate_smoke":
+                rec = cand
+    dt = time.monotonic() - t0
+    if rec is None or rec.get("error"):
+        why = (rec or {}).get("error") or f"rc={r.returncode}, no record"
+        print(f"[gate] WARN: plans smoke — {why} in {dt:.0f}s "
+              f"(not a gate failure)", flush=True)
+        return
+    if not rec.get("native_available"):
+        print(f"[gate] plans smoke: skipped cleanly (native core "
+              f"unavailable) in {dt:.0f}s", flush=True)
+        return
+    problems = []
+    if not rec.get("plan_engaged"):
+        problems.append("native plan did not engage")
+    if not rec.get("completed"):
+        problems.append("a mode did not complete")
+    if not rec.get("bitwise_identical"):
+        problems.append("plan result != interpreted result (bitwise)")
+    if rec.get("ffi_per_collective") != 1.0:
+        problems.append(f"ffi crossings per collective = "
+                        f"{rec.get('ffi_per_collective')} (want 1)")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] plans smoke: engaged={rec.get('plan_engaged')}, "
+          f"bitwise={rec.get('bitwise_identical')}, ffi/coll="
+          f"{rec.get('ffi_per_collective')} in {dt:.0f}s -> {verdict}",
+          flush=True)
+
+
 def _fr_smoke(env) -> None:
     """WARN-ONLY flight-recorder diagnosis probe (ISSUE 9 CI satellite,
     same harness as the other smokes): `ucc_fr --smoke` runs a 4-rank
@@ -722,6 +786,10 @@ def main(argv=None) -> int:
         # warn-only: generated DSL families compile + verify, run the
         # matrix, and tune end-to-end (ISSUE 10)
         _gen_smoke(env)
+        # warn-only: a generated allreduce runs as a native execution
+        # plan bitwise-identical to the interpreted path with ONE
+        # data-path ffi crossing per collective (ISSUE 12)
+        _plans_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

@@ -179,6 +179,18 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
                 " — empty = every built-in family at its default grid; "
                 "programs failing the static verifier or inapplicable "
                 "at the team size are skipped", parse_string),
+    ConfigField("GEN_NATIVE", "auto", "native execution plans: lower a "
+                "verified collective program (generated families AND "
+                "the hand-written ring/sra allreduce bridges) to a "
+                "packed op table retired entirely inside the native "
+                "core — one ffi crossing per collective, C-side f32/f64 "
+                "reductions, mapped-word completion, native "
+                "cancel/fence semantics. auto = on when the native "
+                "matcher serves every team endpoint and the dtype/op "
+                "runs fully native; y additionally routes assist "
+                "rounds (bf16, quantized wire) through plans; n = "
+                "always interpret. Plan-executed candidates show "
+                "'+plan' in ucc_info -s", parse_string),
     ConfigField("CHECK_ASYMMETRIC_DT", "n", "validate datatype consistency "
                 "for gather(v)/scatter(v) via a service allreduce before "
                 "the collective (off by default for performance, matching "

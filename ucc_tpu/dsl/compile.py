@@ -122,6 +122,25 @@ class GeneratedCollTask(HostCollTask):
         self._max_sends = max_sends
         self._max_recvs = max_recvs
         self._max_reduces = max_reduces
+        # native execution plan (PR 12): when UCC_GEN_NATIVE resolves on
+        # for this (team, program, dtype, op), the whole round schedule
+        # retires inside ucc_tpu_core — one ffi crossing per post, C-side
+        # reductions, a mapped completion word — and run() dispatches to
+        # _run_plan instead of the interpreter. None = interpret.
+        self._plan = None
+        self._plan_active = False
+        self._plan_harvested = True
+        try:
+            from . import plan as _plan_mod
+            self._plan = _plan_mod.acquire(self, team, program)
+        except Exception:  # noqa: BLE001 - plan mode must never turn an
+            # eligible collective into a failure; the interpreter is
+            # always correct
+            from ..utils.log import get_logger
+            get_logger("dsl").exception(
+                "native plan acquisition failed; interpreting %s",
+                program.name)
+            self._plan = None
 
     # ------------------------------------------------------------------
     def _chunk_bounds(self) -> List[Tuple[int, int]]:
@@ -130,9 +149,154 @@ class GeneratedCollTask(HostCollTask):
                  block_count(self.count, nch, c)) for c in range(nch)]
 
     def run(self):
+        if self._plan is not None:
+            yield from self._run_plan()
+            return
         if self.qp is not None:
             yield from self._run_wire()
             return
+        yield from self._run_interp()
+
+    # ------------------------------------------------------------------
+    def _run_plan(self):
+        """Native-plan execution: one ffi posts the plan; this generator
+        then only polls the mapped completion word (a memory load per
+        progress pass) and services assist rounds."""
+        from . import plan as _plan_mod
+        args = self.args
+        plan = self._plan
+        if plan is not None and plan.count != self.count:
+            # pipelined-fragment retarget (frag_setup rebinds count):
+            # plans are count-exact — offsets are baked — so NEVER run a
+            # stale-geometry plan; swap through the count-keyed cache
+            _plan_mod.release(self.tl_team, plan, True)
+            plan = self._plan = _plan_mod.acquire(self, self.tl_team,
+                                                  self.prog)
+            if plan is None:
+                yield from self._run_fallback()
+                return
+        dst = binfo_typed(args.dst, self.count)
+        if not args.is_inplace:
+            dst[:] = binfo_typed(args.src, self.count)
+        self._plan_harvested = False
+        self.data_committed = True
+        rc = plan.post(dst, self.tag)
+        if rc != 0:
+            # plan unusable this post (unexpected overlap / dead core):
+            # fall back to the interpreter — same program, same result
+            self._plan_harvested = True
+            yield from self._run_fallback()
+            return
+        self._plan_active = True
+        while True:
+            st, payload = plan.poll()
+            if st == _plan_mod.ST_RUNNING:
+                yield
+            elif st == _plan_mod.ST_ASSIST:
+                plan.run_assist(payload)
+            else:
+                break
+        self._plan_active = False
+        self._plan_harvest(plan)
+        if st == _plan_mod.ST_DONE:
+            if self.op == ReductionOp.AVG:
+                # identical arithmetic to the interpreter's end scale so
+                # plan and interpreted paths stay bitwise-identical
+                if self.qp is not None:
+                    np.multiply(dst, 1.0 / self.gsize, out=dst)
+                else:
+                    dst[:] = reduce_arrays([dst], ReductionOp.SUM,
+                                           self.dt,
+                                           alpha=1.0 / self.gsize)
+            plan.release_dst()
+            return
+        # terminal error/cancel: deliberately KEEP plan._dst — the plan
+        # may have parked zero-copy sends pointing into it, and the
+        # dirty-destroy pin (NativePlan.destroy) needs the reference
+        if st == _plan_mod.ST_CANCELED:
+            raise UccError(Status.ERR_CANCELED, "native plan canceled")
+        if st == _plan_mod.ST_FENCED:
+            self._obs_error("fenced: stale team epoch (native plan)")
+        self._obs_error(f"native plan failed at round {payload} "
+                        f"(state {st})")
+
+    def _run_fallback(self):
+        """Interpreted execution of the SAME program (wire-compatible
+        with peers that did engage their plans)."""
+        if self.qp is not None:
+            yield from self._run_wire()
+        else:
+            yield from self._run_interp()
+
+    def _plan_harvest(self, plan) -> None:
+        """Fold the plan's C-side accounting back into the transport
+        counters and the flight recorder (once per post, including the
+        cancel path): wire-kind counts stay accurate with Python off the
+        data path, and ``ucc_fr`` still sees one round event per
+        completed round for straggler attribution."""
+        if self._plan_harvested:
+            return
+        self._plan_harvested = True
+        c = plan.counters()
+        tr = self.tl_team.transport
+        tr.n_direct += c["direct"]
+        tr.n_eager += c["eager"]
+        tr.n_rndv += c["rndv"]
+        tr.n_fenced += c["fenced"]
+        fr = getattr(tr, "_flight", None)
+        if fr is not None:
+            # one batched lifecycle event per COMPLETED round, derived
+            # from the C-side round counter — not per-message callbacks
+            kind = "rndv" if c["rndv"] else "direct"
+            tkey = (self.tl_team.team_key, self.tl_team.team_epoch,
+                    self.tag, 0, getattr(self.tl_team, "_my_ctx_rank", 0))
+            rb = plan.low.round_bytes
+            for rnd in range(min(c["rounds"], plan.n_rounds)):
+                fr.append(kind,
+                          (tkey[0], tkey[1], tkey[2], rnd, tkey[4]),
+                          rb[rnd] if rnd < len(rb) else 0)
+
+    def cancel_fn(self) -> None:
+        plan = self._plan
+        if plan is not None and self._plan_active:
+            try:
+                plan.cancel()   # withdraws posted recvs (native skip)
+            except Exception:  # noqa: BLE001 - cancel is best-effort
+                pass
+            self._plan_active = False
+            try:
+                self._plan_harvest(plan)
+            except Exception:  # noqa: BLE001
+                pass
+        super().cancel_fn()
+
+    def finalize_fn(self):
+        plan, self._plan = self._plan, None
+        if plan is not None:
+            from . import plan as _plan_mod
+            clean = self.super_status == Status.OK and \
+                not self.status.is_error and not self._plan_active
+            try:
+                _plan_mod.release(self.tl_team, plan, clean)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        return super().finalize_fn()
+
+    def obs_describe(self, now=None) -> dict:
+        d = super().obs_describe(now)
+        plan = self._plan
+        if plan is not None and self._plan_active:
+            try:
+                st, payload = plan.poll()
+                d["plan"] = {"state": int(st), "payload": int(payload),
+                             "rounds_done": plan.counters()["rounds"],
+                             "n_rounds": plan.n_rounds}
+            except Exception:  # noqa: BLE001 - diagnostics only
+                pass
+        return d
+
+    # ------------------------------------------------------------------
+    def _run_interp(self):
         args = self.args
         dst = binfo_typed(args.dst, self.count)
         if not args.is_inplace:

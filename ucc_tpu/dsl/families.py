@@ -191,6 +191,101 @@ def gen_qdirect(n: int, mode: str) -> Program:
 
 
 # ---------------------------------------------------------------------------
+# sra(radix=r) — the hand-written SRA structure at ANY team size
+# ---------------------------------------------------------------------------
+
+def gen_sra(n: int, radix: int = 2) -> Program:
+    """The hand-written ``sra_knomial`` allreduce as an IR program: the
+    radix-``r`` recursive halving/doubling core over ``full = r^k <= n``
+    ranks, with the extra/proxy fold for the remainder — extras hand
+    their whole vector to proxy ``e % full`` in round 0 and receive the
+    final result back in the last round (the
+    coll_patterns/recursive_knomial.h extra distribution). ``n == r^k``
+    degenerates to plain :func:`gen_rhd`. This is the bridge program the
+    native-plan path runs when the hand-written SRA candidate is
+    selected (tl/host/sra.py), verified like any family."""
+    if n < 2:
+        raise Inapplicable(f"sra needs >= 2 ranks (got {n})")
+    r = max(2, min(int(radix), n))
+    full = 1
+    while full * r <= n:
+        full *= r
+    if full < 2:
+        full = n          # r > n clamp left full == 1: direct exchange
+        r = n
+    if full == n:
+        prog = gen_rhd(n, radix=r)
+        prog.family = "sra"
+        prog.params = {"radix": r}
+        prog.name = f"gen_sra_r{r}"
+        return prog
+
+    dists = _rhd_levels(full, r)
+    b = ProgramBuilder("sra", CollType.ALLREDUCE, n, full,
+                       params={"radix": r})
+
+    def seg_walk(me: int) -> List[Tuple[int, int]]:
+        lo, hi = 0, full
+        segs = [(lo, hi)]
+        for dist in dists:
+            lo, hi = _part(lo, hi, r, (me // dist) % r)
+            segs.append((lo, hi))
+        return segs
+
+    walks = [seg_walk(me) for me in range(full)]
+
+    # round 0: extras fold their whole vector into the proxy
+    b.next_round()
+    for e in range(full, n):
+        proxy = e % full
+        for c in range(full):
+            b.send(e, c, to=proxy)
+            b.reduce(proxy, c, frm=e)
+    # rhd core among [0, full): reduce-scatter then allgather
+    for lvl, dist in enumerate(dists):
+        b.next_round()
+        for me in range(full):
+            lo, hi = walks[me][lvl]
+            d = (me // dist) % r
+            base = me - d * dist
+            keep = _part(lo, hi, r, d)
+            for t in range(r):
+                if t == d:
+                    continue
+                peer = base + t * dist
+                give = _part(lo, hi, r, t)
+                for c in range(give[0], give[1]):
+                    b.send(me, c, to=peer)
+                for c in range(keep[0], keep[1]):
+                    b.reduce(me, c, frm=peer)
+    for lvl in range(len(dists) - 1, -1, -1):
+        dist = dists[lvl]
+        b.next_round()
+        for me in range(full):
+            lo, hi = walks[me][lvl]
+            d = (me // dist) % r
+            base = me - d * dist
+            mine = walks[me][lvl + 1]
+            for t in range(r):
+                if t == d:
+                    continue
+                peer = base + t * dist
+                theirs = _part(lo, hi, r, t)
+                for c in range(mine[0], mine[1]):
+                    b.send(me, c, to=peer)
+                for c in range(theirs[0], theirs[1]):
+                    b.recv(me, c, frm=peer)
+    # last round: proxies unfold the full result to their extras
+    b.next_round()
+    for e in range(full, n):
+        proxy = e % full
+        for c in range(full):
+            b.send(proxy, c, to=e)
+            b.recv(e, c, frm=proxy)
+    return b.build(f"gen_sra_r{r}")
+
+
+# ---------------------------------------------------------------------------
 # sra_pipe(depth=d) — fragment program + pipeline metadata
 # ---------------------------------------------------------------------------
 

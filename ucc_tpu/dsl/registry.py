@@ -212,6 +212,9 @@ def generated_alg_specs(team) -> Dict[CollType, List]:
     from .. import quant
     qmode = quant.coll_mode(team, CollType.ALLREDUCE) or ""
 
+    from .plan import native_mode, team_plan_capable
+    plan_cap = team_plan_capable(team)
+    gn_mode = native_mode(team)
     specs: List[AlgSpec] = []
     seen: set = set()
 
@@ -234,7 +237,12 @@ def generated_alg_specs(team) -> Dict[CollType, List]:
             default_select="0-inf:2",
             precision=prog.wire,
             origin="generated",
-            gen=prog.param_str))
+            gen=prog.param_str,
+            # wire (quantized) programs only run as plans under an
+            # explicit UCC_GEN_NATIVE=y (auto always interprets them):
+            # don't advertise "+plan" for a candidate that cannot
+            # take the plan path in the current mode
+            plan=plan_cap and (not prog.wire or gn_mode == "y")))
 
     for family, params in fams.items():
         if family == "qdirect":

@@ -245,7 +245,8 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
                          hb_timeout: float = 0.3,
                          iter_deadline_s: float = 15.0,
                          count: int = 64,
-                         matrix=DEFAULT_MATRIX) -> Dict:
+                         matrix=DEFAULT_MATRIX,
+                         plans: bool = False) -> Dict:
     """The full recovery pipeline under drill: run the matrix healthy,
     kill one rank mid-run (``UCC_FAULT=kill``), assert every survivor
     observes ``ERR_RANK_FAILED`` naming it, shrink, then complete
@@ -262,6 +263,17 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
     prev_mode, prev_int, prev_to = (health.MODE, health.HEARTBEAT_INTERVAL,
                                     health.HEARTBEAT_TIMEOUT)
     health.configure("shrink", interval=hb_interval, timeout=hb_timeout)
+    # plan-mode drill (ISSUE 12): force the allreduces onto the native
+    # execution-plan path (ring bridge) so the kill->shrink pipeline is
+    # exercised with Python off the data path — ucc_plan_cancel must
+    # withdraw posted recvs and a pre-shrink plan's sends must be fenced
+    import os
+    plan_env = None
+    if plans:
+        plan_env = {k: os.environ.get(k)
+                    for k in ("UCC_GEN_NATIVE", "UCC_TL_SHM_TUNE")}
+        os.environ["UCC_GEN_NATIVE"] = "y"
+        os.environ["UCC_TL_SHM_TUNE"] = "allreduce:@ring:inf"
     ctxs = _make_job(n_ranks)
     teams = _make_team(ctxs)
     # matcher/stale_send_fenced defaults: _probe_stale_send_fence may
@@ -269,6 +281,10 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
     report: Dict = {"pre_iters": 0, "post_iters": 0, "violations": [],
                     "outcomes": {}, "detected": {}, "agreed": {},
                     "matcher": None, "stale_send_fenced": None}
+    if plans:
+        report["plan_mode"] = False
+        report["plan_recvs_withdrawn"] = 0
+        report["plan_stale_fenced"] = None
     bufs: Dict = {}
     new_teams = None
     try:
@@ -303,6 +319,21 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
                 c.progress()
             if all(rq.test() != Status.IN_PROGRESS for rq in reqs.values()):
                 break
+        if plans:
+            # BEFORE finalize (which releases the plan): the drilled
+            # invariant is that cancellation withdrew the stalled plans'
+            # posted recvs natively (cancel-skip), so no late send from
+            # the dead epoch can scribble into reclaimed buffers
+            for r, rq in reqs.items():
+                t = getattr(rq, "task", None)
+                p = getattr(t, "_plan", None)
+                if p is not None:
+                    report["plan_mode"] = True
+                    try:
+                        report["plan_recvs_withdrawn"] += \
+                            p.counters()["withdrawn"]
+                    except Exception:  # noqa: BLE001
+                        pass
         for r, rq in reqs.items():
             st = rq.test()
             named = rq.failed_ranks or []
@@ -357,6 +388,8 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
             # v2 core fences too, so UCC_FT=shrink no longer pins the
             # python matcher.
             _probe_stale_send_fence(teams[survivors[0]], report)
+            if plans:
+                _probe_stale_plan_fence(teams[survivors[0]], report)
 
         # -- resume on the shrunk team --------------------------------
         if new_teams:
@@ -372,6 +405,24 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
         report["injected"] = dict(inject.COUNTS)
         inject.reset()
         health.configure(prev_mode, interval=prev_int, timeout=prev_to)
+        if plan_env is not None:
+            for k, v in plan_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if plans:
+            if not report.get("plan_mode"):
+                report["violations"].append(
+                    "plan drill: native execution plans did not engage "
+                    "(native core unavailable?)")
+            elif not report.get("plan_recvs_withdrawn"):
+                report["violations"].append(
+                    "plan drill: cancellation withdrew no plan-posted "
+                    "recvs")
+            elif report.get("plan_stale_fenced") is False:
+                report["violations"].append(
+                    "plan drill: a pre-shrink plan send was NOT fenced")
         for t in list(teams) + list(new_teams or ()):
             try:
                 t.destroy()
@@ -383,6 +434,31 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
             except Exception:  # noqa: BLE001
                 pass
     return report
+
+
+def _probe_stale_plan_fence(old_team, report) -> None:
+    """Native-plan twin of ``_probe_stale_send_fence``: build a one-op
+    plan keyed to the OLD (fenced) epoch and post it — the C executor's
+    push must be discarded at the match boundary with the plan counting
+    the fenced send (no hang, ``n_fenced`` ticks)."""
+    from ..tl.host.transport import InProcTransport
+    for team_key, tr in old_team._tl_tag_spaces():
+        if not isinstance(tr, InProcTransport):
+            continue
+        try:
+            from ..dsl.plan import stale_fence_probe
+            before = tr.n_fenced
+            ok = stale_fence_probe(tr, team_key)
+        except Exception as e:  # noqa: BLE001 - the probe itself failing
+            # is a violation (it means plans cannot run on this matcher)
+            report["plan_stale_fenced"] = False
+            report["violations"].append(f"plan fence probe raised: {e}")
+            return
+        report["plan_stale_fenced"] = ok
+        if ok:
+            report["plan_fenced_counter"] = tr.n_fenced - before
+        return
+    report["plan_stale_fenced"] = None
 
 
 def _probe_stale_send_fence(old_team, report) -> None:
@@ -480,10 +556,17 @@ def main(argv=None) -> int:
                     "the probabilistic soak (UCC_FT=shrink pipeline)")
     ap.add_argument("--kill-rank", type=int, default=2)
     ap.add_argument("--post-iters", type=int, default=60)
+    ap.add_argument("--plans", action="store_true",
+                    help="with --kill-shrink: run the drill with the "
+                    "allreduces forced onto NATIVE EXECUTION PLANS "
+                    "(UCC_GEN_NATIVE=y, ring bridge) and assert "
+                    "ucc_plan_cancel withdrew posted recvs and a "
+                    "pre-shrink plan send is fenced")
     args = ap.parse_args(argv)
     if args.kill_shrink:
         report = run_kill_shrink_soak(args.ranks, args.kill_rank,
-                                      post_iters=args.post_iters)
+                                      post_iters=args.post_iters,
+                                      plans=args.plans)
         print(json.dumps(report, indent=1))
         return 1 if report["violations"] else 0
     report = run_soak(args.ranks, args.iterations, args.spec, args.seed,

@@ -38,8 +38,10 @@ from .utils.log import get_logger
 logger = get_logger("native")
 
 #: must match kAbiVersion in native/ucc_tpu_core.cc
-#: (3: adds ucc_mailbox_occupancy — backlog gauges for obs dumps)
-ABI_VERSION = 3
+#: (4: native execution plans — ucc_plan_build/post/test/cancel retire a
+#: verified DSL program's whole round schedule in C++; one ffi crossing
+#: posts the plan, completion is a mapped-word read)
+ABI_VERSION = 4
 
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -369,6 +371,22 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.ucc_req_cancel.argtypes = [vp, u64]
         lib.ucc_req_free.argtypes = [vp, u64]
         lib.ucc_req_free_many.argtypes = [vp, u64, ctypes.POINTER(u64)]
+        lib.ucc_plan_build.restype = vp
+        lib.ucc_plan_build.argtypes = [vp, u64, ctypes.POINTER(vp), u64,
+                                       ctypes.POINTER(u64), vp, u64,
+                                       ctypes.POINTER(u64)]
+        lib.ucc_plan_post.restype = ctypes.c_int
+        lib.ucc_plan_post.argtypes = [vp, vp, u64]
+        lib.ucc_plan_test.restype = u64
+        lib.ucc_plan_test.argtypes = [vp]
+        lib.ucc_plan_assist_done.argtypes = [vp]
+        lib.ucc_plan_cancel.restype = u64
+        lib.ucc_plan_cancel.argtypes = [vp]
+        lib.ucc_plan_counters.restype = None
+        lib.ucc_plan_counters.argtypes = [vp, ctypes.POINTER(u64)]
+        lib.ucc_plan_destroy.argtypes = [vp]
+        lib.ucc_plan_ffi_calls.restype = u64
+        lib.ucc_plan_ffi_calls.argtypes = []
         lib.ucc_mpmc_create.restype = vp
         lib.ucc_mpmc_create.argtypes = [u64]
         lib.ucc_mpmc_destroy.argtypes = [vp]
@@ -387,6 +405,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return get_lib() is not None
+
+
+def plan_ffi_calls() -> int:
+    """Process-global count of plan data-path ffi crossings
+    (ucc_plan_post/test/assist_done) — the debug counter the CI plans
+    smoke reads to prove crossings-per-collective == 1. 0 when the
+    native core is unavailable."""
+    lib = get_lib()
+    return int(lib.ucc_plan_ffi_calls()) if lib is not None else 0
 
 
 # ---------------------------------------------------------------------------
@@ -591,6 +618,12 @@ class NativeMailbox:
         #: mailbox must pin the ndarray until delivery (popped at the
         #: sender's completion poll; cleared by purge/destroy)
         self._send_keep = {}
+        #: coarse keepalives pinned by OTHER owners (a canceled/errored
+        #: execution plan parks zero-copy sends in THIS mailbox's C
+        #: unexpected queues with no per-entry python ref — see
+        #: dsl/plan.py NativePlan.destroy). Lifetime matches _send_keep:
+        #: dropped at purge/destroy, exactly when the C entries die.
+        self._pin_keep = []
         self._free_pending = []
         self._free_mu = threading.Lock()
         # hot-path entry points bound once; the fastcall ext (when built)
@@ -716,6 +749,13 @@ class NativeMailbox:
             raise RuntimeError("native mailbox request slots exhausted")
         return NativeRecvReq(self, rid, dst)
 
+    def pin(self, obj) -> None:
+        """Pin *obj* alive for the rest of this mailbox's life (until
+        purge/destroy): the buffer-of-last-resort for zero-copy entries
+        the C side holds raw pointers into when their owner cannot track
+        per-entry delivery (canceled/errored execution plans)."""
+        self._pin_keep.append(obj)
+
     def fence(self, team_key, min_epoch: int) -> int:
         """Epoch-fence *team_key* (see transport.Mailbox.fence): purge
         parked entries below *min_epoch* and discard late stale arrivals
@@ -802,6 +842,7 @@ class NativeMailbox:
         # released — clearing first would let a racing post_recv memcpy
         # from a freed buffer
         self._send_keep.clear()
+        self._pin_keep.clear()
         return n
 
     def destroy(self) -> None:
@@ -817,6 +858,7 @@ class NativeMailbox:
             # rndv keepalives released only after the destroy-time purge
             # has removed every parked Unexp.ptr (see purge())
             self._send_keep.clear()
+            self._pin_keep.clear()
 
 
 def poll_pending(reqs):

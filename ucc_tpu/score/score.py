@@ -62,6 +62,9 @@ class MsgRange:
     #: `ucc_info -s` alone, and part of the deterministic candidate tie
     #: break (score_map._cand_order).
     gen: str = ""
+    #: True when the candidate executes as a native plan on this team
+    #: (dsl/plan.py): rendered as "+plan" in the provenance column.
+    plan: bool = False
 
     def contains(self, msgsize: int) -> bool:
         return self.start <= msgsize < self.end or \
@@ -91,13 +94,15 @@ class CollScore:
     def add_range(self, coll: CollType, mem: MemoryType, start: int, end: int,
                   score: int, init: Optional[Callable] = None, team: Any = None,
                   alg_name: str = "", precision: str = "",
-                  origin: str = "default", gen: str = "") -> Status:
+                  origin: str = "default", gen: str = "",
+                  plan: bool = False) -> Status:
         """ucc_coll_score_add_range (ucc_coll_score.h:73)."""
         if start >= end or score < 0:
             return Status.ERR_INVALID_PARAM
         self.ranges.setdefault((coll, mem), []).append(
             MsgRange(start, end, score, init, team, alg_name,
-                     origin=origin, precision=precision, gen=gen))
+                     origin=origin, precision=precision, gen=gen,
+                     plan=plan))
         return Status.OK
 
     def merge(self, other: "CollScore") -> "CollScore":

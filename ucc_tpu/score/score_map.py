@@ -176,6 +176,11 @@ class ScoreMap:
                 comp = comp_name(r)
                 name = r.alg_name or comp
                 origin = r.origin or "default"
+                # plan-executed candidates (native execution plans,
+                # dsl/plan.py) are marked "+plan": "(default+plan)" =
+                # a hand-written algorithm retired inside ucc_tpu_core
+                if getattr(r, "plan", False):
+                    origin = f"{origin}+plan"
                 # quantized ranges carry their wire-precision tag next to
                 # the provenance — "(learned,int8)" says a LEARNED range
                 # runs the int8 variant, so tuned quantized windows are

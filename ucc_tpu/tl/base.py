@@ -122,6 +122,13 @@ class AlgSpec:
     #: into tuner cache entries and sweep measurement records, and part
     #: of the deterministic candidate tie break.
     gen: str = ""
+    #: True when this candidate executes as a NATIVE PLAN on this team
+    #: (UCC_GEN_NATIVE resolved on at table-build time): the verified
+    #: program lowers to a packed op table retired inside ucc_tpu_core.
+    #: Shown as "+plan" in the score dump's provenance column so
+    #: plan-executed candidates are distinguishable from interpreted
+    #: ones in `ucc_info -s` and team logs.
+    plan: bool = False
 
 
 def load_coll_plugins(tl_name: str):
@@ -187,12 +194,14 @@ def build_scores(team: BaseTeam, default_score: int,
                                         parse_memunits(hi), int(sc),
                                         spec.init, team, spec.name,
                                         precision=spec.precision,
-                                        origin=spec.origin, gen=spec.gen)
+                                        origin=spec.origin, gen=spec.gen,
+                                        plan=spec.plan)
                 else:
                     score.add_range(coll, mt, 0, SIZE_INF, default_score,
                                     spec.init, team, spec.name,
                                     precision=spec.precision,
-                                    origin=spec.origin, gen=spec.gen)
+                                    origin=spec.origin, gen=spec.gen,
+                                    plan=spec.plan)
     if tune_env:
         tune = os.environ.get(tune_env, "")
         if tune:

@@ -181,6 +181,27 @@ class ReduceScattervRing(HostCollTask):
         out_block[:] = mine
 
 
+def allreduce_ring_init(init_args, team):
+    """Ring allreduce — as a NATIVE EXECUTION PLAN when UCC_GEN_NATIVE
+    resolves on: the inner loop below is exactly the verified
+    ``gen_ring(chunks=1)`` IR program, so it lowers to a packed op table
+    retired inside ucc_tpu_core (one ffi crossing per collective,
+    C-side reductions) — the hand-written and generated algorithms share
+    one execution path. Falls back to the classic generator whenever the
+    plan path does not resolve (knob off, native core absent, python-
+    matched peers, unsupported dtype/op, tiny counts)."""
+    subset = team.topo_ordered_subset() \
+        if hasattr(team, "topo_ordered_subset") else None
+    try:
+        from ...dsl.plan import handwritten_plan_task
+        task = handwritten_plan_task(init_args, team, "ring",
+                                     subset=subset)
+    except Exception:  # noqa: BLE001 - plan bridge must never cost the
+        # classic path its correctness
+        task = None
+    return task if task is not None else AllreduceRing(init_args, team)
+
+
 class AllreduceRing(_TopoOrderedRingTask):
     """Bandwidth allreduce: reduce-scatter ring then allgather ring inline
     (the reference builds this as a schedule; one generator is equivalent

@@ -378,9 +378,29 @@ def sra_pipelined_init(init_args, team, radix=None):
             flags=args.flags & ~(CollArgsFlags.PERSISTENT
                                  | CollArgsFlags.IN_PLACE))
 
+    def make_task(ia):
+        # native-plan bridge: the scatter-reduce/allgather loops below
+        # are exactly the verified gen_sra(radix) IR program (radix-r
+        # core + extra/proxy fold), so when UCC_GEN_NATIVE resolves on
+        # the collective retires inside ucc_tpu_core as a packed plan —
+        # hand-written and generated algorithms share one execution
+        # path. The radix is resolved identically to the classic task so
+        # selection semantics (ALLREDUCE_SRA_RADIX) are unchanged.
+        try:
+            from ...dsl.plan import handwritten_plan_task
+            r = clamp_radix(
+                radix or team.cfg_radix("allreduce_sra_radix",
+                                        ia.msgsize, default=2),
+                max(2, int(getattr(team, "size", 2))))
+            t = handwritten_plan_task(ia, team, "sra", radix=r)
+        except Exception:  # noqa: BLE001 - bridge must never cost the
+            # classic path its correctness
+            t = None
+        return t if t is not None \
+            else AllreduceSraKnomial(ia, team, radix=radix)
+
     return _pipelined_init(
-        init_args, team, "allreduce_sra_pipeline",
-        lambda ia: AllreduceSraKnomial(ia, team, radix=radix),
+        init_args, team, "allreduce_sra_pipeline", make_task,
         count, esz, frag_args)
 
 

@@ -36,7 +36,7 @@ from .quantized import (AllgatherQuant, AllreduceQuantRing,
                         AllreduceQuantSra)
 from .ring import (AllgatherRing, AllgathervRing, AllreduceRing,
                    ReduceScatterRing, ReduceScatterRingBidirectional,
-                   ReduceScattervRing)
+                   ReduceScattervRing, allreduce_ring_init)
 from .sra import (AllreduceSraKnomial, ReduceSrgKnomial,
                   sra_pipelined_init, srg_pipelined_init)
 from .task import HostCollTask
@@ -205,14 +205,25 @@ class HostTlTeam(TlTeamBase):
             if self._ag_large_alg() == "ring" else (S + 3, S + 5)
         a2a_switch = 129 * tsize
 
-        def spec(i, name, cls, sel=None, precision="", **kw):
+        # native-plan capability, resolved ONCE per table build: ring and
+        # sra allreduce (and the generated candidates) execute as packed
+        # native plans when UCC_GEN_NATIVE resolves on — marked "+plan"
+        # in the score dump so `ucc_info -s` distinguishes plan-executed
+        # candidates from interpreted/generator ones
+        try:
+            from ...dsl.plan import team_plan_capable
+            plan_cap = team_plan_capable(self)
+        except Exception:  # noqa: BLE001 - stub teams (ucc_info -a)
+            plan_cap = False
+
+        def spec(i, name, cls, sel=None, precision="", plan=False, **kw):
             def init(ia, team, _cls=cls, _kw=kw):
                 if ia.args.active_set is not None:
                     # active-set subset execution (bcast only, enforced by
                     # core dispatch ucc_coll.c:210-214)
                     return self.coll_init_active_set(ia)
                 return _cls(ia, self, **_kw)
-            return AlgSpec(i, name, init, sel, precision)
+            return AlgSpec(i, name, init, sel, precision, plan=plan)
 
         table = {
             CollType.ALLREDUCE: [
@@ -224,9 +235,9 @@ class HostTlTeam(TlTeamBase):
                 # ALLREDUCE_SRA_PIPELINE knob fragments it (the
                 # ALLREDUCE_SRA_KN_PIPELINE role)
                 spec(1, "sra_knomial", sra_pipelined_init,
-                     sel=f"0-4k:{S - 5},4k-inf:{S + 5}"),
-                spec(2, "ring", AllreduceRing,
-                     sel=f"0-4k:{S - 6},4k-inf:{S + 4}"),
+                     sel=f"0-4k:{S - 5},4k-inf:{S + 5}", plan=plan_cap),
+                spec(2, "ring", allreduce_ring_init,
+                     sel=f"0-4k:{S - 6},4k-inf:{S + 4}", plan=plan_cap),
                 spec(3, "dbt", AllreduceDbt,
                      sel=f"0-4k:{S - 7},4k-inf:{S + 3}"),
                 # one-sided sliding window: never default (matches the
@@ -430,7 +441,18 @@ class HostTlTeam(TlTeamBase):
         return task
 
     def destroy(self) -> None:
-        pass
+        # retire cached native execution plans (dsl/plan.py): each holds
+        # a plan-lifetime mc-pool lease whose offsets are baked into the
+        # C op table — released back to the pool here, at the end of the
+        # team's tag space, never mid-life
+        cache = self.__dict__.pop("_plan_cache", None)
+        if cache:
+            for lst in cache.values():
+                for p in lst:
+                    try:
+                        p.destroy(clean=True)
+                    except Exception:  # noqa: BLE001 - teardown
+                        pass
 
 
 class _ServiceAllgather(HostCollTask):
