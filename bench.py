@@ -148,8 +148,27 @@ def _measure_point(coll: str, count: int, ctxs, teams, devices, mesh,
     argses, reqs = _persistent_reqs(coll, teams, ctxs, srcs, count, n)
     # which algorithm the score map selected for this point (ISSUE 5
     # satellite): read back from the dispatched task so BENCH_r*.json
-    # trajectories can attribute busbw changes to selection changes
+    # trajectories can attribute busbw changes to selection changes.
+    # Generated/searched programs additionally record their full
+    # provenance (ISSUE 14 satellite): the family/parameter string and
+    # the selection origin, so "gen_ring_c3[searched ring(chunks=3)]"
+    # in detail.alg names the exact synthesized program that ran
     alg = str(getattr(reqs[0].task, "alg_name", "") or "")
+    prog = getattr(reqs[0].task, "prog", None)
+    if prog is not None and alg:
+        origin = ""
+        try:
+            from ucc_tpu.constants import CollType as _CT
+            from ucc_tpu.constants import MemoryType as _MT
+            ct = {"allreduce": _CT.ALLREDUCE,
+                  "alltoall": _CT.ALLTOALL}[coll]
+            for cand in teams[0].score_map.lookup(ct, _MT.TPU, nbytes):
+                if cand.alg_name == alg:
+                    origin = cand.origin
+                    break
+        except Exception:  # noqa: BLE001 - provenance is best-effort
+            pass
+        alg = f"{alg}[{origin or 'generated'} {prog.param_str}]"
 
     def one_round():
         for rq in reqs:

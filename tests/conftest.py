@@ -31,6 +31,18 @@ if "UCC_FLIGHT_FILE" not in os.environ:
     os.environ["UCC_FLIGHT_FILE"] = os.path.join(
         tempfile.gettempdir(), f"ucc_flight_test_{os.getpid()}.json")
 
+# the DSL program/search/cost caches (ucc_tpu/dsl, ISSUE 14) default to
+# ~/.cache/ucc_tpu — tests must neither read a developer's real caches
+# (stale searched winners would change candidate lists under test) nor
+# write into them; route all three to per-session temp files
+import tempfile as _tf
+for _var, _name in (("UCC_GEN_PROG_CACHE", "programs.pkl"),
+                    ("UCC_GEN_SEARCH_CACHE", "search.json"),
+                    ("UCC_GEN_COST_CACHE", "cost.json")):
+    if _var not in os.environ:
+        os.environ[_var] = os.path.join(
+            _tf.gettempdir(), f"ucc_test_{os.getpid()}_{_name}")
+
 # this environment preloads jax at interpreter startup, so the env vars
 # above may arrive too late for jax's import-time config read — force the
 # platform through the runtime config as well (backends init lazily)

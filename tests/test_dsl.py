@@ -203,6 +203,141 @@ class TestVerifier:
 
 
 # ---------------------------------------------------------------------------
+# verifier units — the new collectives (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+class TestVerifierNewColls:
+    @pytest.mark.parametrize("n", [2, 3, 4, 5, 8, 9])
+    def test_allgather_families_verify(self, n):
+        verify(fam.gen_ag_ring(n, 1))
+        verify(fam.gen_ag_rd(n, n))          # direct: any team size
+        for m in (2, 4):
+            verify(fam.gen_ag_ring(n, m))
+
+    @pytest.mark.parametrize("n,r", [(4, 2), (8, 2), (9, 3), (16, 4)])
+    def test_allgather_rd_radix_verifies(self, n, r):
+        verify(fam.gen_ag_rd(n, r))
+
+    @pytest.mark.parametrize("n", [2, 3, 5, 8])
+    def test_reduce_scatter_families_verify(self, n):
+        verify(fam.gen_rs_ring(n, 1))
+        verify(fam.gen_rs_ring(n, 2))
+        verify(fam.gen_rs_direct(n))
+
+    @pytest.mark.parametrize("n", [2, 3, 5, 8, 9])
+    def test_bcast_families_verify(self, n):
+        verify(fam.gen_bc_kn(n, 2))
+        verify(fam.gen_bc_kn(n, n))
+        verify(fam.gen_bc_chain(n, 2))
+
+    def test_allgather_wrong_postcondition_names_rank_chunk(self):
+        """Rank 1 never receives block 0: its chunk 0 stays undefined —
+        the diagnostic must name (rank 1, chunk 0)."""
+        b = ProgramBuilder("bad", CollType.ALLGATHER, 2, 2)
+        b.next_round()
+        b.send(1, 1, to=0)
+        b.recv(0, 1, frm=1)          # rank 0 gets block 1 ...
+        # ... but rank 0 never ships block 0 to rank 1
+        with pytest.raises(VerifyError) as ei:
+            verify(b.build("bad"))
+        assert ei.value.rank == 1
+        assert ei.value.chunk == 0
+        assert "undefined" in str(ei.value)
+
+    def test_allgather_wrong_block_rejected(self):
+        """A delivery landing the WRONG owner's data in a chunk is a
+        postcondition violation, not a silent data corruption."""
+        b = ProgramBuilder("bad", CollType.ALLGATHER, 2, 2)
+        b.next_round()
+        b.send(0, 0, to=1, slot=1)
+        b.recv(1, 0, frm=0, slot=1)
+        b.send(1, 1, to=0, slot=2)
+        b.recv(0, 1, frm=1, slot=2)
+        b.next_round()
+        # rank 0 overwrites its OWN block with rank 1's copy of it —
+        # fine; now corrupt: rank 1 copies block 1 over block 0
+        b.copy(1, 0, 1)
+        with pytest.raises(VerifyError, match="postcondition"):
+            verify(b.build("bad"))
+
+    def test_reduce_in_allgather_rejected(self):
+        b = ProgramBuilder("bad", CollType.ALLGATHER, 2, 2)
+        b.next_round()
+        b.send(0, 0, to=1)
+        b.reduce(1, 0, frm=0)
+        with pytest.raises(VerifyError,
+                           match="no reduction operator"):
+            verify(b.build("bad"))
+
+    def test_reduce_in_bcast_rejected(self):
+        b = ProgramBuilder("bad", CollType.BCAST, 2, 1)
+        b.next_round()
+        b.send(0, 0, to=1)
+        b.reduce(1, 0, frm=0)
+        with pytest.raises(VerifyError,
+                           match="no reduction operator"):
+            verify(b.build("bad"))
+
+    def test_reduce_scatter_forwarded_double_count_rejected(self):
+        """A forwarded contribution reduced again at the destination:
+        the symbolic chunk tracking must catch the double count even
+        through an overwriting hop."""
+        b2 = ProgramBuilder("bad", CollType.REDUCE_SCATTER, 3, 3)
+        b2.next_round()
+        b2.send(0, 0, to=1, slot=9)
+        b2.recv(1, 0, frm=0, slot=9)  # rank 1 chunk 0 = {0} (replaced)
+        b2.next_round()
+        b2.send(1, 0, to=2, slot=11)
+        b2.reduce(2, 0, frm=1, slot=11)
+        b2.next_round()               # now double-count rank 0's part
+        b2.send(0, 0, to=2, slot=12)
+        b2.reduce(2, 0, frm=0, slot=12)
+        with pytest.raises(VerifyError, match="twice"):
+            verify(b2.build("bad2"))
+
+    def test_bcast_deadlock_rejected(self):
+        """Child waits for a send the root only posts after waiting on
+        the child: the classic cross wait."""
+        b = ProgramBuilder("cyc", CollType.BCAST, 2, 1)
+        b.next_round()
+        b.recv(1, 0, frm=0, slot=5)
+        b.recv(0, 0, frm=1, slot=6)   # root waits on the child first
+        b.next_round()
+        b.send(0, 0, to=1, slot=5)
+        b.send(1, 0, to=0, slot=6)
+        with pytest.raises(VerifyError, match="deadlock"):
+            verify(b.build("cyc"))
+
+    def test_wire_mismatch_across_edge_rejected(self):
+        b = ProgramBuilder("wm", CollType.ALLREDUCE, 2, 1)
+        b.next_round()
+        b.send(0, 0, to=1, wire="int8")
+        b.reduce(1, 0, frm=0)          # exact receiver of a q edge
+        b.send(1, 0, to=0)
+        b.reduce(0, 0, frm=1)
+        with pytest.raises(VerifyError, match="wire-precision mismatch"):
+            verify(b.build("wm"))
+
+    def test_mixed_edge_wire_modes_rejected(self):
+        b = ProgramBuilder("mx", CollType.ALLREDUCE, 2, 1)
+        b.next_round()
+        b.send(0, 0, to=1, wire="int8")
+        b.reduce(1, 0, frm=0, wire="int8")
+        b.send(1, 0, to=0, wire="fp8")
+        b.reduce(0, 0, frm=1, wire="fp8")
+        with pytest.raises(VerifyError, match="mixed per-edge wire"):
+            verify(b.build("mx"))
+
+    def test_allgather_chunks_must_divide(self):
+        b = ProgramBuilder("odd", CollType.ALLGATHER, 2, 3)
+        b.next_round()
+        b.send(0, 0, to=1)
+        b.recv(1, 0, frm=0)
+        with pytest.raises(VerifyError, match="divisible"):
+            verify(b.build("odd"))
+
+
+# ---------------------------------------------------------------------------
 # registry / knob parsing
 # ---------------------------------------------------------------------------
 
@@ -402,6 +537,210 @@ class TestGeneratedCorrectness:
             # every rank holds the SAME dequantized bits
             for d in dsts[1:]:
                 np.testing.assert_array_equal(dsts[0], d)
+        finally:
+            job.cleanup()
+
+
+def _force_coll(job, teams, argses, coll, idx, msgsize, timeout=30.0):
+    """Force candidate *idx* on every rank; rank-symmetric even when
+    init refuses (every rank attempts its init before the error
+    propagates, so coll-tag counters never diverge)."""
+    n = len(teams)
+    reqs, errs = [], []
+    for r in range(n):
+        try:
+            reqs.append(forced_request(teams[r], argses[r], coll,
+                                       MemoryType.HOST, msgsize, idx))
+        except Exception as e:  # noqa: BLE001 - symmetric refusal
+            errs.append(e)
+    if errs:
+        for rq in reqs:
+            rq.finalize()
+        raise errs[0]
+    for rq in reqs:
+        rq.post()
+    job.progress_until(lambda: all(
+        rq.test() != Status.IN_PROGRESS for rq in reqs), timeout)
+    sts = [rq.test() for rq in reqs]
+    for rq in reqs:
+        rq.finalize()
+    return sts
+
+
+class TestNewCollectiveCorrectness:
+    """Every newly registered allgather/reduce_scatter/bcast variant vs
+    numpy on 2/4/5/8 ranks (ISSUE 14 test satellite)."""
+
+    COUNT = 960          # divisible by every (n * chunks) grid pair
+
+    def _gen_idxs(self, teams, coll, msgsize):
+        cands = sweep_candidates(teams[0], coll, MemoryType.HOST,
+                                 msgsize)
+        return cands, {c.alg_name: i for i, c in enumerate(cands)
+                       if c.origin == "generated" and
+                       cand_label(c)[0] == "shm"}
+
+    @pytest.mark.parametrize("n", [2, 4, 5, 8])
+    def test_allgather_variants_match_numpy(self, n):
+        from ucc_tpu.utils.mathutils import block_count, block_offset
+        total = self.COUNT
+        msgsize = total * 4
+        job = UccJob(n, lib_overrides={"GEN": "y"})
+        try:
+            teams = job.create_team()
+            _cands, idxs = self._gen_idxs(teams, CollType.ALLGATHER,
+                                          msgsize)
+            assert idxs, "no generated allgather candidates"
+            assert any(k.startswith("gen_ag_ring") for k in idxs)
+            assert any(k.startswith(("gen_ag_rd", "gen_ag_direct"))
+                       for k in idxs)
+            rng = np.random.default_rng(n)
+            blocks = []
+            for r in range(n):
+                cnt = block_count(total, n, r)
+                blocks.append(rng.random(cnt).astype(np.float32))
+            gathered = np.concatenate(blocks)
+            for name, i in sorted(idxs.items()):
+                dsts = [np.zeros(total, np.float32) for _ in range(n)]
+                argses = [CollArgs(
+                    coll_type=CollType.ALLGATHER,
+                    src=BufferInfo(blocks[r].copy(), blocks[r].size,
+                                   DataType.FLOAT32),
+                    dst=BufferInfo(dsts[r], total, DataType.FLOAT32))
+                    for r in range(n)]
+                sts = _force_coll(job, teams, argses,
+                                  CollType.ALLGATHER, i, msgsize)
+                assert all(s == Status.OK for s in sts), (name, sts)
+                for d in dsts:
+                    np.testing.assert_array_equal(d, gathered,
+                                                  err_msg=name)
+        finally:
+            job.cleanup()
+
+    @pytest.mark.parametrize("n", [2, 4, 5, 8])
+    def test_reduce_scatter_variants_match_numpy(self, n):
+        from ucc_tpu.utils.mathutils import block_count, block_offset
+        total = self.COUNT
+        msgsize = total * 4
+        job = UccJob(n, lib_overrides={"GEN": "y"})
+        try:
+            teams = job.create_team()
+            _cands, idxs = self._gen_idxs(teams,
+                                          CollType.REDUCE_SCATTER,
+                                          msgsize)
+            assert idxs, "no generated reduce_scatter candidates"
+            rng = np.random.default_rng(n)
+            srcs = [(rng.random(total).astype(np.float32) - 0.5) * 4
+                    for _ in range(n)]
+            exact = np.sum(np.stack(srcs).astype(np.float64), axis=0)
+            for name, i in sorted(idxs.items()):
+                argses, outs = [], []
+                for r in range(n):
+                    off = block_offset(total, n, r)
+                    cnt = block_count(total, n, r)
+                    out = np.zeros(cnt, np.float32)
+                    outs.append((out, off, cnt))
+                    argses.append(CollArgs(
+                        coll_type=CollType.REDUCE_SCATTER,
+                        src=BufferInfo(srcs[r].copy(), total,
+                                       DataType.FLOAT32),
+                        dst=BufferInfo(out, cnt, DataType.FLOAT32),
+                        op=ReductionOp.SUM))
+                sts = _force_coll(job, teams, argses,
+                                  CollType.REDUCE_SCATTER, i, msgsize)
+                assert all(s == Status.OK for s in sts), (name, sts)
+                for out, off, cnt in outs:
+                    np.testing.assert_allclose(
+                        out, exact[off:off + cnt], rtol=1e-5,
+                        atol=1e-4, err_msg=name)
+                # AVG rides the same program with one end scale
+                argses, outs = [], []
+                for r in range(n):
+                    off = block_offset(total, n, r)
+                    cnt = block_count(total, n, r)
+                    out = np.zeros(cnt, np.float32)
+                    outs.append((out, off, cnt))
+                    argses.append(CollArgs(
+                        coll_type=CollType.REDUCE_SCATTER,
+                        src=BufferInfo(srcs[r].copy(), total,
+                                       DataType.FLOAT32),
+                        dst=BufferInfo(out, cnt, DataType.FLOAT32),
+                        op=ReductionOp.AVG))
+                sts = _force_coll(job, teams, argses,
+                                  CollType.REDUCE_SCATTER, i, msgsize)
+                assert all(s == Status.OK for s in sts), (name, sts)
+                for out, off, cnt in outs:
+                    np.testing.assert_allclose(
+                        out, exact[off:off + cnt] / n, rtol=1e-5,
+                        atol=1e-4, err_msg=name)
+        finally:
+            job.cleanup()
+
+    @pytest.mark.parametrize("n", [2, 4, 5, 8])
+    def test_bcast_variants_match_numpy_every_root(self, n):
+        total = self.COUNT
+        msgsize = total * 4
+        job = UccJob(n, lib_overrides={"GEN": "y"})
+        try:
+            teams = job.create_team()
+            _cands, idxs = self._gen_idxs(teams, CollType.BCAST,
+                                          msgsize)
+            assert idxs, "no generated bcast candidates"
+            assert any(k.startswith("gen_bc_kn") or
+                       k == "gen_bc_linear" for k in idxs)
+            assert any(k.startswith("gen_bc_chain") for k in idxs)
+            rng = np.random.default_rng(n)
+            payload = rng.random(total).astype(np.float32)
+            for name, i in sorted(idxs.items()):
+                for root in range(n):
+                    bufs = [payload.copy() if r == root
+                            else np.zeros(total, np.float32)
+                            for r in range(n)]
+                    argses = [CollArgs(
+                        coll_type=CollType.BCAST,
+                        src=BufferInfo(bufs[r], total,
+                                       DataType.FLOAT32),
+                        root=root) for r in range(n)]
+                    sts = _force_coll(job, teams, argses,
+                                      CollType.BCAST, i, msgsize)
+                    assert all(s == Status.OK for s in sts), \
+                        (name, root, sts)
+                    for b in bufs:
+                        np.testing.assert_array_equal(
+                            b, payload, err_msg=f"{name} root {root}")
+        finally:
+            job.cleanup()
+
+    def test_chunked_variants_refuse_non_divisible_counts(self):
+        """m-chunked block-addressed programs refuse near-equal totals
+        (the UCC split front-loads the remainder, so chunk unions would
+        misalign with the per-rank block contract) — the fallback walk
+        must land on an exact algorithm instead of corrupting data."""
+        from ucc_tpu.utils.mathutils import block_count, block_offset
+        n, total = 4, 1002            # 1002 % 8 != 0
+        msgsize = total * 4
+        job = UccJob(n, lib_overrides={"GEN": "y"})
+        try:
+            teams = job.create_team()
+            _cands, idxs = self._gen_idxs(teams, CollType.ALLGATHER,
+                                          msgsize)
+            i = idxs["gen_ag_ring_c2"]
+            blocks = [np.ones(block_count(total, n, r), np.float32)
+                      for r in range(n)]
+            argses = [CollArgs(
+                coll_type=CollType.ALLGATHER,
+                src=BufferInfo(blocks[r], blocks[r].size,
+                               DataType.FLOAT32),
+                dst=BufferInfo(np.zeros(total, np.float32), total,
+                               DataType.FLOAT32)) for r in range(n)]
+            with pytest.raises(Exception):
+                _force_coll(job, teams, argses, CollType.ALLGATHER, i,
+                            msgsize)
+            # the 1-chunk ring serves the same args fine
+            i1 = idxs["gen_ag_ring_c1"]
+            sts = _force_coll(job, teams, argses, CollType.ALLGATHER,
+                              i1, msgsize)
+            assert all(s == Status.OK for s in sts)
         finally:
             job.cleanup()
 

@@ -1,0 +1,543 @@
+"""Search-driven algorithm synthesis (ISSUE 14): the alpha-beta cost
+model (fit, pricing, link classification, persistence), the joint-space
+proposer + cost pruning, the search cache round trip with
+origin="searched" provenance, the tuner-cache staleness guard, the
+verified-program disk cache, hierarchical program composition on an
+asymmetric simulated pod layout (incl. quantized DCN edges), and the
+budgeted end-to-end search loop.
+"""
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from ucc_tpu import BufferInfo, CollArgs, Status
+from ucc_tpu.constants import (CollType, DataType, MemoryType,
+                               ReductionOp)
+from ucc_tpu.dsl import families as fam
+from ucc_tpu.dsl import registry as genreg
+from ucc_tpu.dsl import search as gensearch
+from ucc_tpu.dsl.verify import verify
+from ucc_tpu.score import cost
+from ucc_tpu.score.tuner import (apply_entries, cand_label,
+                                 forced_request, sweep_candidates)
+
+from harness import UccJob
+
+
+def _paths(node_of, pod_of=None):
+    out = []
+    for nd in node_of:
+        hh = zlib.crc32(f"n{nd}".encode())
+        if pod_of is None:
+            out.append((hh,))
+        else:
+            out.append((zlib.crc32(f"p{pod_of[nd]}".encode()), hh))
+    return out
+
+
+# asymmetric 3-level pod layout: nodes of 2,1,3,2 ranks over 2 pods
+ASYM_PATHS = _paths([0, 0, 1, 2, 2, 2, 3, 3], [0, 0, 1, 1])
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+
+class TestCostModel:
+    def test_seed_model_orders_latency_vs_bandwidth(self):
+        """Direct exchange (2 rounds) must beat ring (2n-2 rounds) at
+        tiny sizes; the crossover must flip somewhere as bytes grow —
+        the alpha/beta separation the search prunes with."""
+        m = cost.CostModel()
+        n = 8
+        ring = fam.gen_ring(n, 1)
+        direct = fam.gen_rhd(n, radix=n)
+        assert m.predict_us(direct, 256) < m.predict_us(ring, 256)
+        # both are bandwidth-optimal (~2(n-1)/n of the vector on the
+        # critical path); what separates them is ROUND count, which is
+        # exactly the alpha term the model prices
+        big = 8 << 20
+        ring_feats = m.features(ring, big)
+        direct_feats = m.features(direct, big)
+        assert ring_feats["shm"][1] <= direct_feats["shm"][1]
+        assert ring_feats["shm"][0] > direct_feats["shm"][0]
+
+    def test_quantized_edges_priced_at_wire_bytes(self):
+        m = cost.CostModel()
+        n, size = 8, 1 << 20
+        exact = fam.gen_rhd(n, radix=n)
+        q = fam.gen_rhd(n, radix=n, wire="int8")
+        fe = m.features(exact, size)["shm"]
+        fq = m.features(q, size)["shm"]
+        assert fq[0] == fe[0]                  # same rounds
+        assert fq[1] < fe[1] * 0.30            # ~4x fewer wire bytes
+
+    def test_hier_program_prices_dcn_edges_separately(self):
+        prog = fam.gen_hier(ASYM_PATHS, top=0)
+        m = cost.CostModel()
+        link_of = cost.link_of_paths(ASYM_PATHS)
+        feats = m.features(prog, 64 << 10, link_of)
+        assert "shm" in feats and "dcn" in feats
+        # quantizing the DCN edges shrinks ONLY the dcn byte feature
+        qprog = fam.gen_hier(ASYM_PATHS, top=0, wire="int8")
+        qfeats = m.features(qprog, 64 << 10, link_of)
+        assert qfeats["dcn"][1] < feats["dcn"][1] * 0.30
+        assert qfeats["shm"][1] == feats["shm"][1]
+
+    def test_fit_recovers_synthetic_coefficients(self):
+        """Records generated FROM the model must fit back to (close to)
+        the same coefficients."""
+        true = cost.CostModel()
+        true.links["shm"] = cost.LinkCoeffs(12.0, 2.0e-3)
+        n = 8
+        recs = []
+        for gen, size in (("ring(chunks=1)", 65536),
+                          ("rhd(radix=8)", 65536),
+                          ("rhd(radix=2)", 65536),
+                          ("ring(chunks=1)", 8192),
+                          ("rhd(radix=8)", 8192)):
+            famname, params, wire = cost.parse_param_str(gen)
+            prog = genreg.build_named(famname, params, n, wire=wire)
+            us = true.predict_us(prog, size)
+            recs.append({"gen": gen, "ranks": n, "size_bytes": size,
+                         "p50_us": round(us, 3)})
+        m = cost.fit_records(recs)
+        assert m is not None and m.fitted
+        got = m.links["shm"]
+        assert got.fitted
+        assert abs(got.alpha_us - 12.0) / 12.0 < 0.05
+        assert abs(got.beta_us_per_byte - 2.0e-3) / 2.0e-3 < 0.05
+        # the other classes are derived (rescaled), not fitted
+        assert not m.links["dcn"].fitted
+
+    def test_parse_param_str_roundtrip(self):
+        assert cost.parse_param_str("ring(chunks=4)") == \
+            ("ring", {"chunks": 4}, "")
+        assert cost.parse_param_str("hier(top=2,wire=int8)") == \
+            ("hier", {"top": 2}, "int8")
+        assert cost.parse_param_str("qdirect(int8,radix=8)") == \
+            ("qdirect", {"radix": 8}, "int8")
+        assert cost.parse_param_str("sra_pipe(depth=4,radix=2)") == \
+            ("sra_pipe", {"depth": 4, "radix": 2}, "")
+        assert cost.parse_param_str("garbage")[0] == ""
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = cost.fit_records([
+            {"gen": "ring(chunks=1)", "ranks": 4, "size_bytes": 4096,
+             "p50_us": 100.0},
+            {"gen": "rhd(radix=4)", "ranks": 4, "size_bytes": 4096,
+             "p50_us": 60.0},
+            {"gen": "rhd(radix=2)", "ranks": 4, "size_bytes": 4096,
+             "p50_us": 80.0}])
+        assert m is not None
+        p = str(tmp_path / "cost.json")
+        cost.save_model(m, p)
+        m2 = cost.load_model(p)
+        assert m2 is not None and m2.fitted
+        assert m2.links["shm"].alpha_us == \
+            pytest.approx(m.links["shm"].alpha_us)
+        # a never-fitted model is not worth a predicted_us column
+        cost.save_model(cost.CostModel(), p)
+        assert cost.load_model(p) is None
+
+    def test_link_of_paths(self):
+        link = cost.link_of_paths(ASYM_PATHS)
+        assert link(0, 1) == "shm"        # same node
+        assert link(0, 2) == "socket"     # same pod, different node
+        assert link(0, 3) == "dcn"        # different pod
+        flat = cost.link_of_paths(None)
+        assert flat(0, 5) == "shm"
+
+
+# ---------------------------------------------------------------------------
+# joint-space proposer + pruning
+# ---------------------------------------------------------------------------
+
+class TestPropose:
+    def test_space_exceeds_the_fixed_grids(self):
+        n = 8
+        grid = gensearch.grid_program_names(CollType.ALLREDUCE, n)
+        space = gensearch.propose(CollType.ALLREDUCE, n,
+                                  grid_names=grid)
+        names = {c.name for c in space}
+        beyond = {c.name for c in space if not c.from_grid}
+        assert "gen_ring_c3" in beyond        # chunking outside grid
+        assert "gen_sra_pipe_d3" in beyond    # depth outside grid
+        assert any(c.params.get("radix") and c.family == "sra_pipe"
+                   for c in space)            # JOINT depth x radix
+        assert grid <= names                  # grids are a subspace
+
+    def test_hier_points_need_paths(self):
+        n = len(ASYM_PATHS)
+        flat = gensearch.propose(CollType.ALLREDUCE, n)
+        assert not any(c.hier for c in flat)
+        topo = gensearch.propose(CollType.ALLREDUCE, n,
+                                 paths=ASYM_PATHS, quant_mode="int8")
+        hier = [c for c in topo if c.hier]
+        assert any(c.wire == "int8" for c in hier)
+        assert any(not c.wire for c in hier)
+
+    def test_shortlist_budget_and_per_size_predictions(self):
+        n = 8
+        space = gensearch.propose(CollType.ALLREDUCE, n)
+        m = cost.CostModel()
+        small = gensearch.shortlist(space, m, 256, 4)
+        big = gensearch.shortlist(space, m, 4 << 20, 4)
+        assert len(small) == 4 and len(big) == 4
+        # per-size copies: predictions must not clobber across sizes
+        by_name_small = {c.name: c.predicted_us for c in small}
+        for c in big:
+            if c.name in by_name_small:
+                assert c.predicted_us != by_name_small[c.name]
+        # ordering sane: a latency algorithm leads the small shortlist
+        assert small[0].predicted_us <= small[-1].predicted_us
+
+
+# ---------------------------------------------------------------------------
+# search cache + registration round trip
+# ---------------------------------------------------------------------------
+
+class TestSearchCache:
+    def test_store_replace_scope_and_load(self, tmp_path):
+        p = str(tmp_path / "search.json")
+        e1 = {"coll": "allreduce", "n": 4, "family": "ring",
+              "params": {"chunks": 3}, "wire": "", "name": "gen_ring_c3",
+              "gen": "ring(chunks=3)", "paths_digest": ""}
+        e2 = dict(e1, name="gen_ring_c6", params={"chunks": 6},
+                  gen="ring(chunks=6)")
+        gensearch.store_search_entries(p, [e1, e2])
+        assert len(gensearch.load_search_cache(p)["entries"]) == 2
+        # scope replace drops both, keeps the new winner only
+        gensearch.store_search_entries(
+            p, [e1], replace_scopes=[("allreduce", 4, "")])
+        entries = gensearch.load_search_cache(p)["entries"]
+        assert [e["name"] for e in entries] == ["gen_ring_c3"]
+        # a different scope is untouched
+        e8 = dict(e1, n=8)
+        gensearch.store_search_entries(p, [e8])
+        gensearch.store_search_entries(
+            p, [], replace_scopes=[("allreduce", 4, "")])
+        entries = gensearch.load_search_cache(p)["entries"]
+        assert [e["n"] for e in entries] == [8]
+
+    def test_searched_programs_rebuild_and_skip_stale(self, tmp_path,
+                                                      monkeypatch):
+        p = str(tmp_path / "search.json")
+        monkeypatch.setenv("UCC_GEN_SEARCH_CACHE", p)
+        gensearch.store_search_entries(p, [
+            {"coll": "allreduce", "n": 4, "family": "ring",
+             "params": {"chunks": 3}, "wire": "", "name": "gen_ring_c3",
+             "gen": "ring(chunks=3)", "paths_digest": ""},
+            # stale: unknown family no longer builds
+            {"coll": "allreduce", "n": 4, "family": "warp",
+             "params": {}, "wire": "", "name": "gen_warp",
+             "gen": "warp()", "paths_digest": ""},
+            # different team size: not applicable here
+            {"coll": "allreduce", "n": 8, "family": "ring",
+             "params": {"chunks": 6}, "wire": "", "name": "gen_ring_c6",
+             "gen": "ring(chunks=6)", "paths_digest": ""}])
+        progs = gensearch.searched_programs(None, 4)
+        assert [pr.name for pr in progs] == ["gen_ring_c3"]
+        for pr in progs:
+            verify(pr)                # registration-grade
+
+    def test_searched_candidate_registers_and_dispatches(
+            self, tmp_path, monkeypatch):
+        """The acceptance round trip: search cache -> registration
+        (origin 'searched') -> tuner promotion -> dispatch, with the
+        provenance visible in the score dump."""
+        p = str(tmp_path / "search.json")
+        monkeypatch.setenv("UCC_GEN_SEARCH_CACHE", p)
+        gensearch.store_search_entries(p, [
+            {"coll": "allreduce", "n": 2, "family": "ring",
+             "params": {"chunks": 3}, "wire": "", "name": "gen_ring_c3",
+             "gen": "ring(chunks=3)", "paths_digest": "",
+             "predicted_us": 42.0, "measured_us": 40.0}])
+        job = UccJob(2, lib_overrides={"GEN": "y", "GEN_SEARCH": "y"})
+        try:
+            teams = job.create_team()
+            cands = sweep_candidates(teams[0], CollType.ALLREDUCE,
+                                     MemoryType.HOST, 65536)
+            searched = [c for c in cands if c.origin == "searched"]
+            assert searched and searched[0].alg_name == "gen_ring_c3"
+            assert searched[0].gen == "ring(chunks=3)"
+            # tuner-cache promotion with origin=searched (every rank:
+            # diverging score maps would deadlock the dispatch)
+            for t in teams:
+                ok = t.score_map.apply_learned(
+                    CollType.ALLREDUCE, MemoryType.HOST, 0, 1 << 20,
+                    "gen_ring_c3", origin="searched")
+                assert ok
+            info = teams[0].score_map.print_info("t")
+            assert "searched gen:ring(chunks=3)" in info
+            # dispatch actually runs the searched program
+            count = 999
+            srcs = [np.full(count, r + 1.0, np.float32)
+                    for r in range(2)]
+            dsts = [np.zeros(count, np.float32) for _ in range(2)]
+            reqs = job.run_coll(teams, lambda i: CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(srcs[i], count, DataType.FLOAT32),
+                dst=BufferInfo(dsts[i], count, DataType.FLOAT32),
+                op=ReductionOp.SUM))
+            assert reqs[0].task.alg_name == "gen_ring_c3"
+            for rq in reqs:
+                rq.finalize()
+            np.testing.assert_allclose(dsts[0], np.full(count, 3.0))
+        finally:
+            job.cleanup()
+
+    def test_gen_search_off_keeps_candidates_clean(self, tmp_path,
+                                                   monkeypatch):
+        p = str(tmp_path / "search.json")
+        monkeypatch.setenv("UCC_GEN_SEARCH_CACHE", p)
+        gensearch.store_search_entries(p, [
+            {"coll": "allreduce", "n": 2, "family": "ring",
+             "params": {"chunks": 3}, "wire": "", "name": "gen_ring_c3",
+             "gen": "ring(chunks=3)", "paths_digest": ""}])
+        job = UccJob(2, lib_overrides={"GEN": "y", "GEN_SEARCH": "n"})
+        try:
+            teams = job.create_team()
+            cands = sweep_candidates(teams[0], CollType.ALLREDUCE,
+                                     MemoryType.HOST, 65536)
+            assert not any(c.origin == "searched" for c in cands)
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# tuner-cache staleness guard (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+class TestStalenessGuard:
+    def test_stale_generated_entry_dropped_with_metric(self,
+                                                       monkeypatch):
+        """A cache entry naming a generated algorithm that no longer
+        registers (UCC_GEN off here) must be DROPPED with a warning +
+        metric — never compiled into the score map — while plain
+        hand-written entries still apply."""
+        from ucc_tpu.obs import metrics
+        monkeypatch.setattr(metrics, "ENABLED", True)
+        key = metrics._key("tuner_stale_entries_dropped", "tuner",
+                           "allreduce", "gen_ring_c3")
+        job = UccJob(2)               # UCC_GEN off: no gen_* candidates
+        try:
+            teams = job.create_team()
+            sm = teams[0].score_map
+            before = sm.lookup(CollType.ALLREDUCE, MemoryType.HOST,
+                               4096)
+            n0 = metrics._counters.get(key, 0)
+            covered = apply_entries(sm, [
+                {"coll": "allreduce", "mem": "host", "start": 0,
+                 "end": 1 << 20, "alg": "gen_ring_c3",
+                 "gen": "ring(chunks=3)", "origin": "searched"},
+                {"coll": "allreduce", "mem": "host", "start": 0,
+                 "end": 4096, "alg": "sra_knomial"}])
+            # only the hand-written entry applied
+            assert covered == [(CollType.ALLREDUCE, MemoryType.HOST,
+                                0, 4096)]
+            after = sm.lookup(CollType.ALLREDUCE, MemoryType.HOST,
+                              8192)
+            assert not any(c.alg_name == "gen_ring_c3" for c in after)
+            assert len(after) == len(before)
+            assert metrics._counters.get(key, 0) == n0 + 1
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# verified-program disk cache (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+class TestProgramDiskCache:
+    def _reset(self, path):
+        genreg._CACHE.clear()
+        genreg._PENDING.clear()
+        genreg._DISK["path"] = False
+        genreg._DISK["programs"] = None
+        os.environ["UCC_GEN_PROG_CACHE"] = path
+
+    def test_roundtrip_skips_verification(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "programs.pkl")
+        self._reset(path)
+        try:
+            p1 = genreg.build_program("ring", 2, 6)
+            assert p1 is not None
+            genreg.flush_program_cache()   # writes batch (atexit flush)
+            assert os.path.exists(path)
+            # fresh process simulation: memory cache cleared, verifier
+            # booby-trapped — a disk hit must NOT re-verify
+            self._reset(path)
+
+            def boom(prog):
+                raise AssertionError("disk hit must skip verification")
+            monkeypatch.setattr(genreg, "verify", boom)
+            p2 = genreg.build_program("ring", 2, 6)
+            assert p2 is not None and p2.name == p1.name
+            assert p2.n_rounds == p1.n_rounds
+        finally:
+            self._reset("0")
+
+    def test_version_bump_invalidates(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "programs.pkl")
+        self._reset(path)
+        try:
+            assert genreg.build_program("ring", 1, 4) is not None
+            genreg.flush_program_cache()
+            # stamp the file with a stale DSL version
+            import pickle
+            with open(path, "rb") as fh:
+                data = pickle.load(fh)
+            data["version"] = -1
+            with open(path, "wb") as fh:
+                pickle.dump(data, fh)
+            self._reset(path)
+            calls = []
+            real = genreg.verify
+
+            def spy(prog):
+                calls.append(prog.name)
+                return real(prog)
+            monkeypatch.setattr(genreg, "verify", spy)
+            assert genreg.build_program("ring", 1, 4) is not None
+            assert calls, "stale-version cache must force re-verify"
+        finally:
+            self._reset("0")
+
+    def test_disabled_by_knob(self, tmp_path):
+        path = str(tmp_path / "programs.pkl")
+        self._reset("0")
+        try:
+            assert genreg.build_program("ring", 1, 4) is not None
+            genreg.flush_program_cache()
+            assert not os.path.exists(path)
+        finally:
+            self._reset("0")
+
+    def test_corrupt_cache_rebuilds(self, tmp_path):
+        path = str(tmp_path / "programs.pkl")
+        with open(path, "wb") as fh:
+            fh.write(b"not a pickle")
+        self._reset(path)
+        try:
+            assert genreg.build_program("ring", 1, 4) is not None
+        finally:
+            self._reset("0")
+
+
+# ---------------------------------------------------------------------------
+# hierarchical composition (acceptance: >= 3-level tree, quantized DCN
+# edges, asymmetric simulated pod layout)
+# ---------------------------------------------------------------------------
+
+class TestHierPrograms:
+    def test_three_level_asymmetric_verifies_with_quant_dcn(self):
+        for top in (0, 1, 2, 4):
+            for wire in ("", "int8", "fp8"):
+                prog = fam.gen_hier(ASYM_PATHS, top=top, wire=wire)
+                verify(prog)
+                assert prog.nranks == 8
+                assert prog.edge_wire_mode == wire
+                if wire:
+                    # ONLY cross-pod edges quantize
+                    from ucc_tpu.dsl.ir import OpKind
+                    for r, rp in enumerate(prog.ranks):
+                        for ops in rp.rounds:
+                            for op in ops:
+                                if op.kind == OpKind.COPY:
+                                    continue
+                                crosses = ASYM_PATHS[r][0] != \
+                                    ASYM_PATHS[op.peer][0]
+                                assert bool(op.wire) == crosses, \
+                                    (r, op)
+
+    def test_single_node_layout_inapplicable(self):
+        with pytest.raises(fam.Inapplicable):
+            fam.gen_hier(_paths([0, 0, 0, 0]), top=0)
+
+    def test_hier_matches_numpy_on_simulated_pod(self, monkeypatch):
+        """End-to-end on the fake 2,1,3-nodes x 2-pods topology: every
+        hier variant (exact + quantized-DCN) matches numpy cross-rank
+        and all ranks agree bitwise."""
+        monkeypatch.setenv("UCC_TOPO_FAKE_PPN", "2,1,3")
+        monkeypatch.setenv("UCC_TOPO_FAKE_NODES_PER_POD", "2")
+        from ucc_tpu.quant import default_budget
+        n, count = 8, 8 << 10
+        msgsize = count * 4
+        job = UccJob(n, lib_overrides={"GEN": "y", "QUANT": "int8"})
+        try:
+            teams = job.create_team()
+            cands = sweep_candidates(teams[0], CollType.ALLREDUCE,
+                                     MemoryType.HOST, msgsize)
+            idxs = {c.alg_name: i for i, c in enumerate(cands)
+                    if c.origin == "generated" and
+                    cand_label(c)[0] == "shm" and
+                    c.alg_name.startswith("gen_hier")}
+            assert any("qint8" in k for k in idxs)
+            assert any("qint8" not in k for k in idxs)
+            rng = np.random.default_rng(3)
+            srcs = [(rng.random(count).astype(np.float32) - 0.5) * 4
+                    for _ in range(n)]
+            exact = np.sum(np.stack(srcs).astype(np.float64), axis=0)
+            peak = np.max(np.abs(exact))
+            from test_dsl import _force_coll
+            for name, i in sorted(idxs.items()):
+                dsts = [np.zeros(count, np.float32) for _ in range(n)]
+                argses = [CollArgs(
+                    coll_type=CollType.ALLREDUCE,
+                    src=BufferInfo(srcs[r].copy(), count,
+                                   DataType.FLOAT32),
+                    dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+                    op=ReductionOp.SUM) for r in range(n)]
+                sts = _force_coll(job, teams, argses,
+                                  CollType.ALLREDUCE, i, msgsize)
+                assert all(s == Status.OK for s in sts), (name, sts)
+                tol = default_budget("int8") if "qint8" in name \
+                    else 1e-5
+                for d in dsts:
+                    assert np.max(np.abs(d - exact)) / peak <= tol, name
+                for d in dsts[1:]:
+                    np.testing.assert_array_equal(dsts[0], d,
+                                                  err_msg=name)
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end budgeted search (small mesh; the CI smoke runs the big one)
+# ---------------------------------------------------------------------------
+
+class TestSearchEndToEnd:
+    def test_budgeted_search_produces_persisted_winner(self, tmp_path,
+                                                       monkeypatch):
+        search_cache = str(tmp_path / "search.json")
+        tuner_cache = str(tmp_path / "tune.json")
+        monkeypatch.setenv("UCC_GEN_SEARCH_CACHE", search_cache)
+        model = cost.CostModel()     # seed model: no probe job needed
+        rep = gensearch.run_search(
+            2, ["allreduce"], [8192], iters=2, budget=4,
+            search_cache=search_cache, tuner_cache=tuner_cache,
+            model=model, verbose=False)
+        res = rep["results"][0]
+        assert res.get("winner"), rep
+        finalists = res["finalists"]
+        assert finalists and all("measured_us" in f for f in finalists)
+        # searched shortlist rows carry predicted cost provenance
+        assert any(f.get("predicted_us") is not None
+                   for f in finalists)
+        if rep.get("winners"):
+            cachef = gensearch.load_search_cache(search_cache)
+            names = {e["name"] for e in cachef["entries"]}
+            assert set(rep["winners"]) <= names
+        if rep.get("tuner_entries"):
+            with open(tuner_cache) as fh:
+                tc = json.load(fh)
+            entries = next(iter(tc["signatures"].values()))["entries"]
+            assert all(e.get("origin") == "searched" for e in entries)
+            assert all(e.get("measured_us") is not None
+                       for e in entries)

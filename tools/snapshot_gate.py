@@ -587,6 +587,76 @@ def _gen_smoke(env) -> None:
           flush=True)
 
 
+def _search_smoke(env) -> None:
+    """WARN-ONLY program-search probe (ISSUE 14 CI satellite):
+    ``python -m ucc_tpu.dsl.smoke --search`` fits the alpha-beta cost
+    model from a one-point generated sweep, runs a budgeted
+    cost-model-guided search on a small mesh, and asserts that (a) a
+    searched program verifies + registers (origin 'searched') +
+    dispatches through the tuner-cache round trip, and (b) predicted
+    cost ordering is sane — the best-predicted finalist lands in the
+    measured top half. Skip with UCC_GATE_SEARCH=0."""
+    import json
+    if os.environ.get("UCC_GATE_SEARCH", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] search smoke: skipped (UCC_GATE_SEARCH=0)",
+              flush=True)
+        return
+    print("[gate] program-search smoke (warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE",
+                                      "UCC_GEN", "UCC_QUANT",
+                                      "UCC_TUNER"))}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ucc_tpu.dsl.smoke", "--search"],
+            cwd=REPO, env=smoke_env, capture_output=True, text=True,
+            timeout=900)
+    except subprocess.TimeoutExpired:
+        print("[gate] WARN: search smoke timed out (not a gate failure)",
+              flush=True)
+        return
+    rec = None
+    for ln in (r.stdout or "").splitlines():
+        if ln.startswith("{"):
+            try:
+                cand = json.loads(ln)
+            except ValueError:
+                continue
+            if cand.get("metric") == "search_gate_smoke":
+                rec = cand
+    dt = time.monotonic() - t0
+    if rec is None or rec.get("error"):
+        why = (rec or {}).get("error") or f"rc={r.returncode}, no record"
+        print(f"[gate] WARN: search smoke — {why} in {dt:.0f}s "
+              f"(not a gate failure)", flush=True)
+        return
+    problems = []
+    if not rec.get("winner"):
+        problems.append("no measured winner")
+    if not rec.get("searched_registered"):
+        problems.append("no searched-origin candidate registered on "
+                        "the fresh team")
+    if not rec.get("dispatch_ok"):
+        problems.append("tuned dispatch failed")
+    if rec.get("searched_won") and rec.get("winner_dispatched") is False:
+        problems.append(f"searched winner {rec.get('winner')} did not "
+                        f"dispatch (got {rec.get('dispatch_alg')})")
+    if rec.get("prediction_sane") is False:
+        problems.append(f"best-predicted finalist ranked "
+                        f"{rec.get('best_predicted_rank')} of "
+                        f"{rec.get('finalists')} measured")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] search smoke: winner {rec.get('winner')} "
+          f"(predicted {rec.get('winner_predicted_us')}us, measured "
+          f"{rec.get('winner_measured_us')}us, {rec.get('finalists')} "
+          f"finalists, cost model {rec.get('cost_model')}), dispatched "
+          f"as {rec.get('dispatch_alg')} in {dt:.0f}s -> {verdict}",
+          flush=True)
+
+
 def _plans_smoke(env) -> None:
     """WARN-ONLY native execution-plan probe (ISSUE 12 CI satellite):
     ``python -m ucc_tpu.dsl.smoke --plans`` builds one generated
@@ -790,6 +860,10 @@ def main(argv=None) -> int:
         # plan bitwise-identical to the interpreted path with ONE
         # data-path ffi crossing per collective (ISSUE 12)
         _plans_smoke(env)
+        # warn-only: cost-model-guided program search fits, searches,
+        # registers and dispatches a searched winner with sane
+        # predicted-cost ordering (ISSUE 14)
+        _search_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

@@ -36,6 +36,39 @@ CL_HIER_CONFIG = register_table(ConfigTable(
     ]))
 
 
+def tree_paths_for_search(team, max_levels=None):
+    """Per-rank topology attribute paths of *team*'s hierarchy tree —
+    the CL/HIER tree exported to the DSL program search (ISSUE 14): the
+    search composes hierarchical programs along the SAME tree CL/HIER
+    builds its units from, so a synthesized pod-scale program and the
+    hand-written nrab composition agree on which edges are ICI-class
+    and which are DCN-class. Accepts a core team or a TL team (resolves
+    through ``core_team``); returns None for single-node teams (flat
+    families serve those) or when no topology is known."""
+    core = getattr(team, "core_team", None) or team
+    topo = getattr(core, "topo", None)
+    if topo is None:
+        ctx = getattr(core, "context", None)
+        ctx_topo = getattr(ctx, "topo", None)
+        cmap = getattr(team, "ctx_map", None)
+        if cmap is None:
+            cmap = getattr(core, "ctx_map", None)
+        if ctx_topo is None or cmap is None:
+            return None
+        from ...topo.topo import TeamTopo
+        topo = TeamTopo(ctx_topo, cmap, int(getattr(team, "rank", 0)))
+    try:
+        if topo.n_nodes < 2:
+            return None
+        with_pods = topo.pods_active()
+        if max_levels is not None and max_levels < 3:
+            with_pods = False
+        return [topo.rank_path(r, with_pods)
+                for r in range(topo.team_size)]
+    except Exception:  # noqa: BLE001 - topology export is best-effort
+        return None
+
+
 class ClHierContext(BaseContext):
     pass
 

@@ -179,6 +179,33 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
                 " — empty = every built-in family at its default grid; "
                 "programs failing the static verifier or inapplicable "
                 "at the team size are skipped", parse_string),
+    ConfigField("GEN_SEARCH", "y", "register persisted search winners "
+                "(ucc_tpu/dsl/search.py, written by `ucc_tune "
+                "--gen-search`) from the search cache as score-map "
+                "candidates with origin 'searched'; requires UCC_GEN=y; "
+                "zero cost when the cache has no entries for this "
+                "(team size, topology)", parse_bool),
+    ConfigField("GEN_SEARCH_CACHE", "", "search-cache file (JSON: "
+                "searched program specs + predicted/measured cost "
+                "provenance); empty = ~/.cache/ucc_tpu/search.json "
+                "(env-resolved)", parse_string),
+    ConfigField("GEN_SEARCH_BUDGET", "10", "cost-model shortlist size "
+                "per (collective, message size) grid point: the search "
+                "measures at most this many predicted-cheapest "
+                "candidates of the joint space through successive "
+                "halving", parse_uint),
+    ConfigField("GEN_PROG_CACHE", "", "verified-program disk cache "
+                "(pickle, keyed by family/params/team size/topology + "
+                "DSL_VERSION; a version bump invalidates it): repeated "
+                "runs skip O(n^2) program generation + verification; "
+                "empty = ~/.cache/ucc_tpu/programs.pkl, 0/n = disable "
+                "(env-resolved)", parse_string),
+    ConfigField("GEN_COST_CACHE", "", "fitted alpha-beta cost-model "
+                "file (JSON, written by `ucc_tune --gen-search` / the "
+                "search gate smoke; read by `ucc_perftest --sweep` for "
+                "the predicted_us column); empty = "
+                "~/.cache/ucc_tpu/cost.json (env-resolved)",
+                parse_string),
     ConfigField("GEN_NATIVE", "auto", "native execution plans: lower a "
                 "verified collective program (generated families AND "
                 "the hand-written ring/sra allreduce bridges) to a "

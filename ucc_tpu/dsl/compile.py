@@ -66,10 +66,34 @@ class GeneratedCollTask(HostCollTask):
                            f"{program.nranks} ranks (team has "
                            f"{self.gsize})")
         self.prog = program
-        self.count = int(args.dst.count)
-        self.dt = args.dst.datatype
-        op = args.op if args.op is not None else ReductionOp.SUM
-        if op not in _EXACT_OPS:
+        self.coll = program.coll
+        # buffer contract per collective (the tl/host conventions,
+        # ring.py header): the program's "vector" is the full logical
+        # vector of the collective — allreduce/allgather dst, the
+        # reduce_scatter INPUT, the bcast payload buffer
+        if self.coll == CollType.ALLGATHER:
+            self.count = int(args.dst.count)
+            self.dt = args.dst.datatype
+        elif self.coll == CollType.REDUCE_SCATTER:
+            bi = args.dst if args.is_inplace else args.src
+            self.count = int(bi.count)
+            self.dt = bi.datatype
+        elif self.coll == CollType.BCAST:
+            self.count = int(args.src.count)
+            self.dt = args.src.datatype
+        else:
+            self.count = int(args.dst.count)
+            self.dt = args.dst.datatype
+        # bcast programs are generated for root 0; other roots run the
+        # SAME program with every rank rotated by the root (my stream is
+        # rank (me - root) % n's; peers translate back at post time)
+        self.root = int(args.root or 0) if self.coll == CollType.BCAST \
+            else 0
+        self._prog_rank = (self.grank - self.root) % self.gsize
+        reducing = self.coll not in (CollType.ALLGATHER, CollType.BCAST)
+        op = args.op if (reducing and args.op is not None) \
+            else ReductionOp.SUM
+        if reducing and op not in _EXACT_OPS:
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            f"generated programs support "
                            f"{sorted(o.name for o in _EXACT_OPS)} "
@@ -81,14 +105,48 @@ class GeneratedCollTask(HostCollTask):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            f"count {self.count} below program chunk "
                            f"count {program.nchunks}")
-        self.qp = None
-        if program.wire:
-            qp = quant.params_for(team, program.coll)
-            if qp is None or qp.mode != program.wire:
+        if self.coll in (CollType.ALLGATHER, CollType.REDUCE_SCATTER) \
+                and program.nchunks != self.gsize \
+                and self.count % program.nchunks != 0:
+            # the UCC near-equal split front-loads the remainder, so an
+            # m-chunked block [b*m, (b+1)*m) only equals the collective's
+            # per-rank block when chunks divide evenly — near-equal
+            # totals are the 1-chunk variants' job (the tl/host
+            # _require_divisible precedent)
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           f"count {self.count} not divisible by "
+                           f"{program.nchunks} chunks")
+        if not args.is_inplace:
+            # block-addressed collectives: the per-rank buffer must be
+            # exactly my near-equal block of the full vector
+            my_blk = block_count(self.count, self.gsize, self._prog_rank)
+            if self.coll == CollType.ALLGATHER and \
+                    int(args.src.count) != my_blk:
                 raise UccError(Status.ERR_NOT_SUPPORTED,
-                               f"wire precision {program.wire} not "
+                               f"src.count {args.src.count} != my "
+                               f"allgather block {my_blk}")
+            if self.coll == CollType.REDUCE_SCATTER and \
+                    int(args.dst.count) < my_blk:
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               f"dst.count {args.dst.count} below my "
+                               f"reduce_scatter block {my_blk}")
+        self.qp = None
+        self._edge_wire = program.edge_wire_mode
+        wire_mode = program.wire or self._edge_wire
+        if wire_mode:
+            qp = quant.params_for(team, program.coll)
+            if qp is None or qp.mode != wire_mode:
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               f"wire precision {wire_mode} not "
                                f"enabled (UCC_QUANT)")
-            if self.dt not in quant.QUANT_DTS:
+            if self._edge_wire:
+                # per-edge codec interleaves with exact accumulation:
+                # f32 payloads only (no staging-dtype conversions)
+                if dt_numpy(self.dt) != _F32:
+                    raise UccError(Status.ERR_NOT_SUPPORTED,
+                                   "per-edge quantized programs need a "
+                                   f"float32 payload (got {self.dt})")
+            elif self.dt not in quant.QUANT_DTS:
                 raise UccError(Status.ERR_NOT_SUPPORTED,
                                f"quantized wire needs a float payload "
                                f"(got {self.dt})")
@@ -109,7 +167,8 @@ class GeneratedCollTask(HostCollTask):
         # once at init (posts interpret the precompiled lists)
         self._rounds: List[Tuple[list, list, list]] = []
         max_reduces = max_sends = max_recvs = 0
-        for ops in program.ranks[self.grank].rounds:
+        max_wire_sends = max_wire_recvs = 0
+        for ops in program.ranks[self._prog_rank].rounds:
             wire_sends = [op for op in ops if op.kind == OpKind.SEND]
             wire_recvs = [op for op in ops
                           if op.kind in (OpKind.RECV, OpKind.REDUCE)]
@@ -119,9 +178,15 @@ class GeneratedCollTask(HostCollTask):
             max_recvs = max(max_recvs, len(wire_recvs))
             max_reduces = max(max_reduces, sum(
                 1 for op in wire_recvs if op.kind == OpKind.REDUCE))
+            max_wire_sends = max(max_wire_sends, sum(
+                1 for op in wire_sends if op.wire))
+            max_wire_recvs = max(max_wire_recvs, sum(
+                1 for op in wire_recvs if op.wire))
         self._max_sends = max_sends
         self._max_recvs = max_recvs
         self._max_reduces = max_reduces
+        self._max_wire_sends = max_wire_sends
+        self._max_wire_recvs = max_wire_recvs
         # native execution plan (PR 12): when UCC_GEN_NATIVE resolves on
         # for this (team, program, dtype, op), the whole round schedule
         # retires inside ucc_tpu_core — one ffi crossing per post, C-side
@@ -130,6 +195,13 @@ class GeneratedCollTask(HostCollTask):
         self._plan = None
         self._plan_active = False
         self._plan_harvested = True
+        if self.coll != CollType.ALLREDUCE or self._edge_wire or \
+                self.root:
+            # plans lower the allreduce contract (dst-vector chunk
+            # offsets, SUM-tree reductions, AVG end scale); the new
+            # collectives, per-edge-quantized programs and rotated
+            # bcast roots interpret
+            return
         try:
             from . import plan as _plan_mod
             self._plan = _plan_mod.acquire(self, team, program)
@@ -152,7 +224,9 @@ class GeneratedCollTask(HostCollTask):
         if self._plan is not None:
             yield from self._run_plan()
             return
-        if self.qp is not None:
+        if self.qp is not None and self.prog.wire:
+            # whole-program wire (qdirect); per-edge wire (hier DCN
+            # edges) runs through the interpreter's edge codec path
             yield from self._run_wire()
             return
         yield from self._run_interp()
@@ -223,7 +297,7 @@ class GeneratedCollTask(HostCollTask):
     def _run_fallback(self):
         """Interpreted execution of the SAME program (wire-compatible
         with peers that did engage their plans)."""
-        if self.qp is not None:
+        if self.qp is not None and self.prog.wire:
             yield from self._run_wire()
         else:
             yield from self._run_interp()
@@ -296,53 +370,145 @@ class GeneratedCollTask(HostCollTask):
         return d
 
     # ------------------------------------------------------------------
+    def _peer(self, p: int) -> int:
+        """Program rank -> team (group) rank: the bcast root rotation
+        (identity for every other collective)."""
+        return (p + self.root) % self.gsize if self.root else p
+
+    def _owned_slice(self, vec: np.ndarray) -> np.ndarray:
+        """My rank-block of the full vector (the standard near-equal
+        n-way split; nested chunk splits align with it)."""
+        off = block_offset(self.count, self.gsize, self._prog_rank)
+        cnt = block_count(self.count, self.gsize, self._prog_rank)
+        return vec[off:off + cnt]
+
     def _run_interp(self):
         args = self.args
-        dst = binfo_typed(args.dst, self.count)
-        if not args.is_inplace:
-            dst[:] = binfo_typed(args.src, self.count)
+        coll = self.coll
+        nd = dt_numpy(self.dt)
+        out_block = None
+        if coll == CollType.ALLGATHER:
+            # vector = dst (total); my owned block starts as my src
+            vec = binfo_typed(args.dst, self.count)
+            if not args.is_inplace:
+                own = self._owned_slice(vec)
+                own[:] = binfo_typed(args.src, own.size)
+        elif coll == CollType.REDUCE_SCATTER:
+            # vector = the full INPUT, interpreted on scratch; my owned
+            # block lands in dst at the end (ReduceScatterRing contract)
+            vec = self.scratch("rsw", self.count, nd)
+            if args.is_inplace:
+                full = binfo_typed(args.dst, self.count)
+                vec[:] = full
+                out_block = self._owned_slice(full)
+            else:
+                vec[:] = binfo_typed(args.src, self.count)
+                out_block = binfo_typed(
+                    args.dst, min(int(args.dst.count),
+                                  self._owned_slice(vec).size))
+        elif coll == CollType.BCAST:
+            vec = binfo_typed(args.src, self.count)
+        else:                                   # ALLREDUCE
+            vec = binfo_typed(args.dst, self.count)
+            if not args.is_inplace:
+                vec[:] = binfo_typed(args.src, self.count)
         red_op = ReductionOp.SUM if self.op == ReductionOp.AVG else self.op
         # gsize >= 2 always: generators refuse n < 2 and __init__
         # rejects a program/team size mismatch
         size = self.gsize
         bounds = self._chunk_bounds()
         max_chunk = max(c for _, c in bounds)
-        nd = dt_numpy(self.dt)
         rtmp = self.scratch("rt", (max(1, self._max_reduces),
                                    max(1, max_chunk)), nd)
+        qp = self.qp if self._edge_wire else None
+        if qp is not None:
+            max_wire = quant.wire_count(max_chunk, qp.block)
+            ews = self.scratch("ews", (max(1, self._max_wire_sends),
+                                       max_wire), np.uint8)
+            ewr = self.scratch("ewr", (max(1, self._max_wire_recvs),
+                                       max_wire), np.uint8)
+            dtmp = self.scratch("edeq", max(1, max_chunk), np.float32)
+            rng = np.random.default_rng() if qp.stochastic else None
 
         def view(c):
             off, cnt = bounds[c]
-            return dst[off:off + cnt]
+            return vec[off:off + cnt]
 
         for sends, recvs, local in self._rounds:
             reqs = []
             landings = []
+            wire_landings = []
+            encoded = {}
+            if qp is not None:
+                # encode (and sender-side re-decode) BEFORE posting any
+                # send of this round: a chunk shipped both exact and
+                # quantized this round must deliver ONE value — the
+                # re-decoded one — on every edge, or ranks disagree
+                # bitwise on the slice (and the copy-free matcher could
+                # even race the mutation against a parked exact send)
+                si = 0
+                for op in sends:
+                    if not op.wire or op.chunk in encoded:
+                        continue
+                    cnt = bounds[op.chunk][1]
+                    w = ews[si, :quant.wire_count(cnt, qp.block)]
+                    si += 1
+                    src = view(op.chunk)
+                    qp.codec.encode(src, w, qp.block,
+                                    stochastic=qp.stochastic, rng=rng)
+                    qp.codec.decode(w, cnt, qp.block, src)
+                    encoded[op.chunk] = w
             for op in sends:
-                reqs.append(self.send_nb(op.peer, view(op.chunk),
-                                         slot=op.slot))
-            ri = 0
-            for op in recvs:
-                if op.kind == OpKind.RECV:
-                    # allgather-style move: deliver straight into the
-                    # destination slice, no staging copy
-                    reqs.append(self.recv_nb(op.peer, view(op.chunk),
+                peer = self._peer(op.peer)
+                if op.wire:
+                    reqs.append(self.send_nb(peer, encoded[op.chunk],
                                              slot=op.slot))
                 else:
-                    tmp = rtmp[ri, :bounds[op.chunk][1]]
+                    reqs.append(self.send_nb(peer, view(op.chunk),
+                                             slot=op.slot))
+            ri = wi = 0
+            for op in recvs:
+                peer = self._peer(op.peer)
+                cnt = bounds[op.chunk][1]
+                if op.wire:
+                    w = ewr[wi, :quant.wire_count(cnt, qp.block)]
+                    wi += 1
+                    reqs.append(self.recv_nb(peer, w, slot=op.slot))
+                    wire_landings.append((op, w, cnt))
+                elif op.kind == OpKind.RECV:
+                    # allgather-style move: deliver straight into the
+                    # destination slice, no staging copy
+                    reqs.append(self.recv_nb(peer, view(op.chunk),
+                                             slot=op.slot))
+                else:
+                    tmp = rtmp[ri, :cnt]
                     ri += 1
-                    reqs.append(self.recv_nb(op.peer, tmp, slot=op.slot))
+                    reqs.append(self.recv_nb(peer, tmp, slot=op.slot))
                     landings.append((op.chunk, tmp))
             if reqs:
                 yield from self.wait(*reqs)
             for chunk, tmp in landings:
                 acc = view(chunk)
                 reduce_arrays([acc, tmp], red_op, self.dt, out=acc)
+            for op, w, cnt in wire_landings:
+                if op.kind == OpKind.RECV:
+                    qp.codec.decode(w, cnt, qp.block, view(op.chunk))
+                else:
+                    t = dtmp[:cnt]
+                    qp.codec.decode(w, cnt, qp.block, t)
+                    acc = view(op.chunk)
+                    reduce_arrays([acc, t], red_op, _DT_F32, out=acc)
             for op in local:
                 view(op.chunk)[:] = view(op.src_chunk)
-        if self.op == ReductionOp.AVG:
-            dst[:] = reduce_arrays([dst], ReductionOp.SUM, self.dt,
+        if coll == CollType.ALLREDUCE and self.op == ReductionOp.AVG:
+            vec[:] = reduce_arrays([vec], ReductionOp.SUM, self.dt,
                                    alpha=1.0 / size)
+        if out_block is not None:
+            mine = self._owned_slice(vec)
+            if self.op == ReductionOp.AVG:
+                mine = reduce_arrays([mine], ReductionOp.SUM, self.dt,
+                                     alpha=1.0 / size)
+            out_block[:] = mine[:out_block.size]
 
     # ------------------------------------------------------------------
     def _run_wire(self):

@@ -37,7 +37,7 @@ Families (first targets from ROADMAP item 5):
 """
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..constants import CollType
 from .ir import Program, ProgramBuilder
@@ -128,7 +128,12 @@ def gen_rhd(n: int, radix: int = 2, wire: str = "") -> Program:
     r = int(radix)
     dists = _rhd_levels(n, r)
     family = "qdirect" if wire else "rhd"
-    name = f"gen_q{wire}_direct" if wire else f"gen_rhd_r{r}"
+    if wire:
+        # the search proposes quantized rhd at non-direct radices too;
+        # those need distinct names (the grid's qdirect stays r == n)
+        name = f"gen_q{wire}_direct" if r == n else f"gen_q{wire}_rhd_r{r}"
+    else:
+        name = f"gen_rhd_r{r}"
     b = ProgramBuilder(family, CollType.ALLREDUCE, n, n,
                        params={"radix": r}, wire=wire)
 
@@ -289,22 +294,350 @@ def gen_sra(n: int, radix: int = 2) -> Program:
 # sra_pipe(depth=d) — fragment program + pipeline metadata
 # ---------------------------------------------------------------------------
 
-def sra_pipe_fragment(n: int, depth: int) -> Program:
+def sra_pipe_fragment(n: int, depth: int,
+                      radix: Optional[int] = None) -> Program:
     """The per-fragment program of the pipelined SRA family: rhd at
     radix 2 when the team is a power of two (the canonical SRA halving
     instance), else the direct exchange. ``depth`` (>= 2) is pipeline
     metadata consumed by the compiler (PipelinedSchedule fragment
     count), not part of the dataflow itself — it is folded into the
-    program's params/name so each depth is a distinct tuner candidate."""
+    program's params/name so each depth is a distinct tuner candidate.
+    An explicit ``radix`` (the search's JOINT depth x radix space) runs
+    the SRA structure at that radix instead — applicable at any team
+    size via the extra/proxy fold — and names the variant
+    ``gen_sra_pipe_d{d}r{r}``."""
     d = int(depth)
     if d < 2:
         raise Inapplicable(f"pipeline depth must be >= 2 (got {d})")
-    radix = 2 if n >= 2 and (n & (n - 1)) == 0 else n
-    prog = gen_rhd(n, radix=radix)
+    if radix:
+        prog = gen_sra(n, radix=int(radix))
+        prog.family = "sra_pipe"
+        prog.params = {"depth": d, "radix": int(radix)}
+        prog.name = f"gen_sra_pipe_d{d}r{int(radix)}"
+        return prog
+    rdx = 2 if n >= 2 and (n & (n - 1)) == 0 else n
+    prog = gen_rhd(n, radix=rdx)
     prog.family = "sra_pipe"
-    prog.params = {"depth": d, "radix": radix}
+    prog.params = {"depth": d, "radix": rdx}
     prog.name = f"gen_sra_pipe_d{d}"
     return prog
+
+
+# ---------------------------------------------------------------------------
+# allgather families (ISSUE 14: IR beyond allreduce)
+# ---------------------------------------------------------------------------
+
+def gen_ag_ring(n: int, chunks: int = 1) -> Program:
+    """Allgather ring (the gen_ring phase-2 structure standalone):
+    block ``b`` of the vector is chunks ``[b*chunks, (b+1)*chunks)``,
+    owned by rank ``b`` at entry."""
+    m = int(chunks)
+    if n < 2:
+        raise Inapplicable(f"ag_ring needs >= 2 ranks (got {n})")
+    if m < 1:
+        raise Inapplicable(f"ag_ring chunking must be >= 1 (got {m})")
+    b = ProgramBuilder("ag_ring", CollType.ALLGATHER, n, n * m,
+                       params={"chunks": m})
+    for step in range(n - 1):
+        b.next_round()
+        for me in range(n):
+            right = (me + 1) % n
+            left = (me - 1) % n
+            sb = (me - step) % n
+            rb = (me - step - 1) % n
+            for c in range(sb * m, (sb + 1) * m):
+                b.send(me, c, to=right)
+            for c in range(rb * m, (rb + 1) * m):
+                b.recv(me, c, frm=left)
+    return b.build(f"gen_ag_ring_c{m}")
+
+
+def gen_ag_rd(n: int, radix: int = 2) -> Program:
+    """Recursive-doubling allgather at radix ``r`` (needs ``n == r^k``;
+    ``r == n`` degenerates to the one-round direct exchange, applicable
+    at every team size). At each level every rank trades its whole
+    accumulated block set with the ``r-1`` partners of its digit group —
+    ``n-1`` blocks received total, log_r(n) rounds."""
+    r = int(radix) or n
+    if n < 2:
+        raise Inapplicable(f"ag_rd needs >= 2 ranks (got {n})")
+    if r < 2 or r > n:
+        raise Inapplicable(f"radix {r} out of range [2, {n}]")
+    full = 1
+    while full < n:
+        full *= r
+    if full != n:
+        raise Inapplicable(f"team size {n} is not a power of radix {r}")
+    b = ProgramBuilder("ag_rd", CollType.ALLGATHER, n, n,
+                       params={"radix": r})
+    held: List[List[int]] = [[me] for me in range(n)]
+    d = 1
+    while d < n:
+        b.next_round()
+        nxt: List[List[int]] = [None] * n  # type: ignore[list-item]
+        for me in range(n):
+            digit = (me // d) % r
+            base = me - digit * d
+            acc = list(held[me])
+            for t in range(r):
+                if t == digit:
+                    continue
+                peer = base + t * d
+                for c in held[me]:
+                    b.send(me, c, to=peer)
+                for c in held[peer]:
+                    b.recv(me, c, frm=peer)
+                acc.extend(held[peer])
+            nxt[me] = sorted(acc)
+        held = nxt
+        d *= r
+    name = f"gen_ag_rd_r{r}" if r != n else "gen_ag_direct"
+    return b.build(name)
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter families
+# ---------------------------------------------------------------------------
+
+def gen_rs_ring(n: int, chunks: int = 1) -> Program:
+    """Reduce-scatter ring (the gen_ring phase-1 structure standalone):
+    after ``n-1`` rounds rank ``b`` holds the full reduction of block
+    ``b``."""
+    m = int(chunks)
+    if n < 2:
+        raise Inapplicable(f"rs_ring needs >= 2 ranks (got {n})")
+    if m < 1:
+        raise Inapplicable(f"rs_ring chunking must be >= 1 (got {m})")
+    b = ProgramBuilder("rs_ring", CollType.REDUCE_SCATTER, n, n * m,
+                       params={"chunks": m})
+    for step in range(n - 1):
+        b.next_round()
+        for me in range(n):
+            right = (me + 1) % n
+            left = (me - 1) % n
+            sb = (me - 1 - step) % n
+            rb = (me - 2 - step) % n
+            for c in range(sb * m, (sb + 1) * m):
+                b.send(me, c, to=right)
+            for c in range(rb * m, (rb + 1) * m):
+                b.reduce(me, c, frm=left)
+    return b.build(f"gen_rs_ring_c{m}")
+
+
+def gen_rs_direct(n: int) -> Program:
+    """Direct reduce-scatter: one round, every rank ships each foreign
+    block straight to its owner and reduces the ``n-1`` incoming copies
+    of its own block."""
+    if n < 2:
+        raise Inapplicable(f"rs_direct needs >= 2 ranks (got {n})")
+    b = ProgramBuilder("rs_direct", CollType.REDUCE_SCATTER, n, n,
+                       params={})
+    b.next_round()
+    for me in range(n):
+        for blk in range(n):
+            if blk == me:
+                continue
+            b.send(me, blk, to=blk)
+            b.reduce(me, me, frm=blk)
+    return b.build("gen_rs_direct")
+
+
+# ---------------------------------------------------------------------------
+# bcast families (root 0 — the compiler rotates ranks for other roots)
+# ---------------------------------------------------------------------------
+
+def gen_bc_kn(n: int, radix: int = 2) -> Program:
+    """K-nomial tree bcast at radix ``r`` (the BcastKnomial structure as
+    an IR program; ``radix == 0``/``n`` is the one-round linear fan-out).
+    Round ``t`` handles tree distance ``r^(k-1-t)``."""
+    r = int(radix) or n
+    if n < 2:
+        raise Inapplicable(f"bc_kn needs >= 2 ranks (got {n})")
+    if r < 2 or r > n:
+        raise Inapplicable(f"radix {r} out of range [2, {n}]")
+    k = 0
+    cap = 1
+    while cap < n:
+        cap *= r
+        k += 1
+
+    def tree_level(v: int) -> int:
+        f = 0
+        while v % (r ** (f + 1)) == 0:
+            f += 1
+        return f
+
+    b = ProgramBuilder("bc_kn", CollType.BCAST, n, 1, params={"radix": r})
+    for i in range(k - 1, -1, -1):       # round t = k-1-i, dist = r^i
+        b.next_round()
+        dist = r ** i
+        for v in range(n):
+            f = tree_level(v) if v != 0 else k
+            if v != 0 and i == f:
+                j = (v // dist) % r
+                b.recv(v, 0, frm=v - j * dist)
+            elif i < f:
+                for j in range(1, r):
+                    child = v + j * dist
+                    if child < n:
+                        b.send(v, 0, to=child)
+    name = f"gen_bc_kn_r{r}" if r != n else "gen_bc_linear"
+    return b.build(name)
+
+
+def gen_bc_chain(n: int, chunks: int = 2) -> Program:
+    """Chunk-pipelined chain bcast: rank ``i`` receives chunk ``c`` from
+    ``i-1`` in round ``i-1+c`` and forwards it to ``i+1`` in the next
+    round — ``n+chunks-2`` rounds total, wire-pipelined so the chain's
+    latency is paid once, not per byte."""
+    m = int(chunks)
+    if n < 2:
+        raise Inapplicable(f"bc_chain needs >= 2 ranks (got {n})")
+    if m < 1:
+        raise Inapplicable(f"bc_chain chunking must be >= 1 (got {m})")
+    b = ProgramBuilder("bc_chain", CollType.BCAST, n, m,
+                       params={"chunks": m})
+    n_rounds = n + m - 2
+    for t in range(n_rounds):
+        b.next_round()
+        for me in range(n):
+            if me + 1 < n:
+                c = t - me
+                if 0 <= c < m:
+                    b.send(me, c, to=me + 1)
+            if me > 0:
+                c = t - (me - 1)
+                if 0 <= c < m:
+                    b.recv(me, c, frm=me - 1)
+    return b.build(f"gen_bc_chain_c{m}")
+
+
+# ---------------------------------------------------------------------------
+# hier — composed hierarchical allreduce along a topology tree
+# ---------------------------------------------------------------------------
+
+def gen_hier(paths: List[tuple], top: int = 2, wire: str = "",
+             chunks: int = 1) -> Program:
+    """HiCCL-style composed hierarchical allreduce over a topology tree
+    (ISSUE 14 tentpole (d)): reduce up the tree level by level, run a
+    per-level allreduce program among the top leaders, broadcast the
+    result back down — one flat verified Program over the whole team.
+
+    ``paths`` is the per-rank attribute path list the PR-8
+    :class:`~...topo.topo.HierTree` is built from (e.g.
+    ``(pod_hash, host_hash)``); ``top`` picks the leaders' algorithm:
+    ``0`` = direct exchange, ``1`` = ring (with ``chunks`` wire chunks
+    per block), ``r >= 2`` = the SRA structure at radix ``r`` (any
+    leader count). ``wire`` quantizes the DCN-class edges — every edge
+    whose endpoints sit in different pods (different ``paths[..][0]``;
+    on podless 2-level trees, the inter-node leader edges) — while all
+    intra-node/intra-pod edges stay exact; senders re-decode their own
+    copy at every quantized edge, so all ranks still end bitwise
+    identical.
+    """
+    n = len(paths)
+    if n < 2:
+        raise Inapplicable(f"hier needs >= 2 ranks (got {n})")
+    from ..topo.topo import HierTree
+    tree = HierTree(list(paths), 0)
+    L = tree.n_levels
+    if len(tree.levels[0].groups) < 2:
+        raise Inapplicable("hier needs >= 2 level-0 groups (single-node "
+                           "teams are served by the flat families)")
+    T = tree.levels[L - 1].groups[0]
+    depth = len(paths[0])
+
+    def edge_wire(a: int, bb: int) -> str:
+        if not wire:
+            return ""
+        if depth >= 2:
+            return wire if paths[a][0] != paths[bb][0] else ""
+        # podless tree: the inter-NODE leader edges are the slow class;
+        # same-node edges (reduce-up/bcast-down inside a group) stay
+        # exact like every other ICI-class edge
+        return wire if paths[a] != paths[bb] else ""
+
+    top_code = int(top)
+    sub: Optional[Program] = None
+    if len(T) >= 2:
+        if top_code == 0:
+            sub = gen_rhd(len(T), radix=len(T))
+        elif top_code == 1:
+            sub = gen_ring(len(T), chunks=max(1, int(chunks)))
+        else:
+            sub = gen_sra(len(T), radix=top_code)
+    nch = sub.nchunks if sub is not None else 1
+    # canonicalize by the EFFECTIVE top structure: on a 2-leader top
+    # group, sra radix 4, sra radix 2 and the direct exchange all
+    # collapse to the same 2-rank program — one candidate, not three
+    # rotation slots whose measured differences are pure noise
+    if sub is not None:
+        if sub.family == "ring":
+            eff = {"top": 1, "chunks": int(sub.params["chunks"])}
+            eff_name = f"ring_c{sub.params['chunks']}"
+        elif sub.params.get("radix") == len(T):
+            eff = {"top": 0}
+            eff_name = "direct"
+        else:
+            eff = {"top": int(sub.params["radix"])}
+            eff_name = f"sra_r{sub.params['radix']}"
+    else:
+        eff = {"top": 0}
+        eff_name = "direct"
+    params: Dict[str, int] = dict(eff)
+    if wire:
+        params["wire"] = wire       # type: ignore[assignment]
+    b = ProgramBuilder("hier", CollType.ALLREDUCE, n, nch, params=params)
+
+    # phase 1: reduce up the tree (levels 0 .. L-2)
+    for lvl in range(L - 1):
+        groups = [g for g in tree.levels[lvl].groups if len(g) > 1]
+        if not groups:
+            continue
+        b.next_round()
+        for g in groups:
+            leader = g[0]
+            for mbr in g[1:]:
+                w = edge_wire(mbr, leader)
+                for c in range(nch):
+                    b.send(mbr, c, to=leader, wire=w)
+                    b.reduce(leader, c, frm=mbr, wire=w)
+    # phase 2: the top leaders' own allreduce, ranks translated
+    if sub is not None:
+        from .ir import OpKind
+        for k in range(sub.n_rounds):
+            b.next_round()
+            for i in range(sub.nranks):
+                me = T[i]
+                for op in sub.ranks[i].rounds[k]:
+                    if op.kind == OpKind.COPY:
+                        b.copy(me, op.chunk, op.src_chunk)
+                        continue
+                    peer = T[op.peer]
+                    w = edge_wire(me, peer)
+                    if op.kind == OpKind.SEND:
+                        b.send(me, op.chunk, to=peer, wire=w)
+                    elif op.kind == OpKind.RECV:
+                        b.recv(me, op.chunk, frm=peer, wire=w)
+                    else:
+                        b.reduce(me, op.chunk, frm=peer, wire=w)
+    # phase 3: broadcast back down (levels L-2 .. 0)
+    for lvl in range(L - 2, -1, -1):
+        groups = [g for g in tree.levels[lvl].groups if len(g) > 1]
+        if not groups:
+            continue
+        b.next_round()
+        for g in groups:
+            leader = g[0]
+            for mbr in g[1:]:
+                w = edge_wire(leader, mbr)
+                for c in range(nch):
+                    b.send(leader, c, to=mbr, wire=w)
+                    b.recv(mbr, c, frm=leader, wire=w)
+    name = f"gen_hier_{eff_name}"
+    if wire:
+        name += f"_q{wire}"
+    return b.build(name)
 
 
 # ---------------------------------------------------------------------------
@@ -316,6 +649,29 @@ DEFAULT_GRIDS: Dict[str, List[int]] = {
     "rhd": [2, 4, 8, 0],       # 0 = radix n (the direct exchange)
     "sra_pipe": [2, 4],
     "qdirect": [0],            # parameterized by UCC_QUANT, not a grid
+    "ag_ring": [1, 2],
+    "ag_rd": [2, 4, 0],        # 0 = radix n (the direct exchange)
+    "rs_ring": [1, 2],
+    "rs_direct": [0],
+    "bc_kn": [2, 4, 0],        # 0 = radix n (linear fan-out)
+    "bc_chain": [2, 4],
+    "hier": [2, 0],            # top algorithm: sra radix / 0 = direct
+}
+
+#: the collective each family serves (registration + search routing)
+FAMILY_COLL: Dict[str, CollType] = {
+    "ring": CollType.ALLREDUCE,
+    "rhd": CollType.ALLREDUCE,
+    "sra_pipe": CollType.ALLREDUCE,
+    "qdirect": CollType.ALLREDUCE,
+    "sra": CollType.ALLREDUCE,
+    "hier": CollType.ALLREDUCE,
+    "ag_ring": CollType.ALLGATHER,
+    "ag_rd": CollType.ALLGATHER,
+    "rs_ring": CollType.REDUCE_SCATTER,
+    "rs_direct": CollType.REDUCE_SCATTER,
+    "bc_kn": CollType.BCAST,
+    "bc_chain": CollType.BCAST,
 }
 
 FAMILY_NAMES = tuple(DEFAULT_GRIDS)

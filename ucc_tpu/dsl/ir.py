@@ -42,6 +42,13 @@ from typing import Dict, List, Optional
 
 from ..constants import CollType
 
+#: IR + verifier semantics version. Bumped whenever the meaning of a
+#: serialized Program changes (new op fields, new postcondition models,
+#: executor contract changes) — the on-disk verified-program cache
+#: (registry._disk_cache) keys every entry by this, so a stale cache
+#: can never replay a program under semantics it was not verified for.
+DSL_VERSION = 2
+
 
 class OpKind(enum.IntEnum):
     SEND = 0
@@ -53,20 +60,27 @@ class OpKind(enum.IntEnum):
 @dataclass(frozen=True)
 class Op:
     """One IR instruction. ``peer`` is the remote rank for wire ops and
-    unused (-1) for COPY; ``src_chunk`` is only meaningful for COPY."""
+    unused (-1) for COPY; ``src_chunk`` is only meaningful for COPY.
+    ``wire`` quantizes this single edge ("int8"/"fp8"; empty = exact) —
+    hierarchical programs use it to compress DCN-class edges while the
+    intra-node edges stay exact. Both sides of a matched edge must
+    declare the same wire precision (the verifier enforces it)."""
 
     kind: OpKind
     chunk: int
     peer: int = -1
     slot: int = 0
     src_chunk: int = -1
+    wire: str = ""
 
     def describe(self) -> str:
         k = self.kind.name.lower()
         if self.kind == OpKind.COPY:
             return f"copy(chunk {self.src_chunk} -> {self.chunk})"
         d = "to" if self.kind == OpKind.SEND else "from"
-        return f"{k}(chunk {self.chunk} {d} rank {self.peer}, slot {self.slot})"
+        q = f", q{self.wire}" if self.wire else ""
+        return (f"{k}(chunk {self.chunk} {d} rank {self.peer}, "
+                f"slot {self.slot}{q})")
 
 
 @dataclass
@@ -96,6 +110,37 @@ class Program:
     @property
     def n_rounds(self) -> int:
         return len(self.ranks[0].rounds) if self.ranks else 0
+
+    @property
+    def edge_wire_mode(self) -> str:
+        """The single per-edge wire precision used by this program's
+        quantized edges ("" = none). Mixed modes are rejected by the
+        verifier, so the first one found is THE one. Memoized: the scan
+        is O(all ops) and this sits on the per-collective init path
+        (GeneratedCollTask + plan.resolve)."""
+        v = self.__dict__.get("_edge_wire_mode")
+        if v is None:
+            v = ""
+            for rp in self.ranks:
+                for ops in rp.rounds:
+                    for op in ops:
+                        if op.wire:
+                            v = op.wire
+                            break
+                    if v:
+                        break
+                if v:
+                    break
+            self.__dict__["_edge_wire_mode"] = v
+        return v
+
+    def block_chunks(self, rank: int) -> range:
+        """Chunk indices of *rank*'s owned vector block (the standard
+        rank-block layout: nchunks = nranks * m, block b = chunks
+        [b*m, (b+1)*m)). Meaningful for allgather/reduce_scatter
+        programs, whose ownership is part of the collective contract."""
+        m = self.nchunks // self.nranks
+        return range(rank * m, (rank + 1) * m)
 
     @property
     def param_str(self) -> str:
@@ -174,25 +219,28 @@ class ProgramBuilder:
                 raise ValueError(f"rank {rank}: self-send/recv")
 
     def send(self, rank: int, chunk: int, to: int,
-             slot: Optional[int] = None) -> None:
+             slot: Optional[int] = None, wire: str = "") -> None:
         self._check(rank, chunk, to)
         self._rounds[self._round][rank].append(
             Op(OpKind.SEND, chunk, to,
-               self._auto_slot(chunk) if slot is None else slot))
+               self._auto_slot(chunk) if slot is None else slot,
+               wire=wire))
 
     def recv(self, rank: int, chunk: int, frm: int,
-             slot: Optional[int] = None) -> None:
+             slot: Optional[int] = None, wire: str = "") -> None:
         self._check(rank, chunk, frm)
         self._rounds[self._round][rank].append(
             Op(OpKind.RECV, chunk, frm,
-               self._auto_slot(chunk) if slot is None else slot))
+               self._auto_slot(chunk) if slot is None else slot,
+               wire=wire))
 
     def reduce(self, rank: int, chunk: int, frm: int,
-               slot: Optional[int] = None) -> None:
+               slot: Optional[int] = None, wire: str = "") -> None:
         self._check(rank, chunk, frm)
         self._rounds[self._round][rank].append(
             Op(OpKind.REDUCE, chunk, frm,
-               self._auto_slot(chunk) if slot is None else slot))
+               self._auto_slot(chunk) if slot is None else slot,
+               wire=wire))
 
     def copy(self, rank: int, dst_chunk: int, src_chunk: int) -> None:
         self._check(rank, dst_chunk, None)

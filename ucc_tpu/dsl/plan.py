@@ -187,6 +187,12 @@ def _fault_blocks_plans() -> bool:
 
 def resolve(task, team, program: Program) -> bool:
     """Final per-task eligibility (dtype/op known here)."""
+    from ..constants import CollType
+    if program.coll != CollType.ALLREDUCE or program.edge_wire_mode:
+        # the plan format encodes the allreduce contract only (ISSUE 14
+        # extended the IR to allgather/reduce_scatter/bcast and per-edge
+        # quantization — those interpret)
+        return False
     mode = native_mode(team)
     if mode == "n" or not team_plan_capable(team):
         return False

@@ -298,10 +298,120 @@ def run_plan_smoke(n: int = 4, count: int = 4096) -> dict:
     return rec
 
 
+def run_search_smoke(n: int = 4, size: int = 65536,
+                     budget: int = 6) -> dict:
+    """UCC_GATE_SEARCH probe (metric ``search_gate_smoke``): fit the
+    cost model from a ONE-POINT generated sweep, run a budgeted search
+    on a small mesh, and assert the whole loop:
+
+    1. the search produces a measured winner with predicted cost
+       provenance;
+    2. a searched program REGISTERS (origin "searched") on a fresh
+       team reading the search cache, and the tuner-cache round trip
+       DISPATCHES the winner when a searched program won the point;
+    3. predicted-cost ordering is sane: the best-PREDICTED finalist
+       lands in the measured top half (the pruning contract — the
+       model may not pick the winner, but it must not prune it).
+    """
+    tmp = tempfile.mkdtemp(prefix="ucc_search_gate_")
+    search_cache = os.path.join(tmp, "search.json")
+    tuner_cache = os.path.join(tmp, "tune.json")
+    # throwaway caches for the probe, SAVE/RESTORED — permanently
+    # repointing the process env was the exact bug the PR-12 review
+    # fixed in run_plan_smoke
+    saved = {k: os.environ.get(k)
+             for k in ("UCC_GEN_COST_CACHE", "UCC_GEN_SEARCH_CACHE")}
+    os.environ["UCC_GEN_COST_CACHE"] = os.path.join(tmp, "cost.json")
+    os.environ["UCC_GEN_SEARCH_CACHE"] = search_cache
+    rec: dict = {"metric": "search_gate_smoke", "ranks": n,
+                 "size_bytes": size, "budget": budget}
+    try:
+        return _run_search_smoke_body(rec, n, size, budget,
+                                      search_cache, tuner_cache)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _run_search_smoke_body(rec: dict, n: int, size: int, budget: int,
+                           search_cache: str, tuner_cache: str) -> dict:
+    from ucc_tpu.constants import (CollType, DataType, MemoryType,
+                                   ReductionOp)
+    from ucc_tpu.dsl.search import run_search
+    from ucc_tpu.score.tuner import sweep_candidates
+    from ucc_tpu.tools.perftest import make_args
+    from ucc_tpu.tools.tune import _Job
+
+    rep = run_search(n, ["allreduce"], [size], iters=4, budget=budget,
+                     search_cache=search_cache, tuner_cache=tuner_cache,
+                     verbose=False)
+    rec["cost_model"] = rep.get("cost_model")
+    res = (rep.get("results") or [{}])[0]
+    finalists = res.get("finalists") or []
+    rec["finalists"] = len(finalists)
+    rec["winner"] = res.get("winner")
+    rec["winner_predicted_us"] = res.get("winner_predicted_us")
+    rec["winner_measured_us"] = res.get("winner_measured_us")
+    if not res.get("winner"):
+        rec["error"] = "search produced no measured winner"
+        return rec
+    # prediction-sanity: best-predicted finalist within measured top
+    # half (finalists are already sorted by measured latency)
+    priced = [(f["predicted_us"], i) for i, f in enumerate(finalists)
+              if f.get("predicted_us") is not None]
+    if priced:
+        best_pred_rank = min(priced)[1]
+        rec["best_predicted_rank"] = best_pred_rank
+        rec["prediction_sane"] = \
+            best_pred_rank <= max(1, len(finalists) // 2)
+    searched_won = bool(rep.get("winners"))
+    rec["searched_won"] = searched_won
+    # registration + dispatch round trip on a FRESH job
+    job = _Job(n, {"GEN": "y", "GEN_SEARCH": "y", "TUNER": "offline",
+                   "TUNER_CACHE": tuner_cache})
+    try:
+        cands = sweep_candidates(job.teams[0], CollType.ALLREDUCE,
+                                 MemoryType.HOST, size)
+        rec["searched_registered"] = any(
+            c.origin == "searched" for c in cands)
+        argses = [make_args(CollType.ALLREDUCE, r, n, size // 4,
+                            DataType.FLOAT32, ReductionOp.SUM,
+                            MemoryType.HOST, False, 0, False, None)
+                  for r in range(n)]
+        reqs = [job.teams[r].collective_init(argses[r])
+                for r in range(n)]
+        rec["dispatch_alg"] = reqs[0].task.alg_name
+        for rq in reqs:
+            rq.post()
+        rec["dispatch_ok"] = bool(job.wait(reqs, timeout=60))
+        for rq in reqs:
+            try:
+                rq.finalize()
+            except Exception:  # noqa: BLE001 - smoke cleanup
+                pass
+        if searched_won:
+            rec["winner_dispatched"] = \
+                rec["dispatch_alg"] == res.get("winner")
+    finally:
+        job.destroy()
+    return rec
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     from ucc_tpu.utils.jaxshim import ensure_live_backend
     ensure_live_backend(virtual_cpu_devices=4)
+    if argv and argv[0] == "--search":
+        try:
+            rec = run_search_smoke()
+        except Exception as e:  # noqa: BLE001 - the gate wants a record
+            rec = {"metric": "search_gate_smoke",
+                   "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(rec), flush=True)
+        return 0
     if argv and argv[0] == "--plans-digest":
         n = int(argv[1]) if len(argv) > 1 else 4
         try:

@@ -16,6 +16,19 @@ twice (silent wrong answers for SUM/PROD). After the last round, every
 rank's every chunk must equal the collective's postcondition — for
 allreduce, the full set ``{0..n-1}``.
 
+The postcondition model covers four collectives (ISSUE 14): allreduce
+(every rank's every chunk ends as the full reduction), reduce_scatter
+(every rank's OWNED block ends as the full reduction; other chunks are
+unconstrained scratch), allgather (every chunk ends as exactly its
+owner's contribution, everywhere), and bcast (every chunk ends as rank
+0's contribution — programs are generated for root 0 and the compiler
+rotates ranks for other roots). Non-reducing collectives (allgather,
+bcast) reject REDUCE ops outright — there is no reduction operator to
+apply. Locations that start without data (allgather non-owned blocks,
+bcast non-roots) hold an "undefined" marker; reducing undefined data is
+an error, and a chunk still undefined at the end fails the
+postcondition.
+
 **Deadlock-freedom (round-ordered wait graph).** Execution is
 round-ordered per rank: round ``k`` posts all its wire ops, then waits
 for all of them. Completing round ``k`` on rank ``r`` therefore
@@ -208,32 +221,140 @@ def _check_round_hazards(prog: Program) -> None:
                     round_=k)
 
 
-def _postcondition(prog: Program) -> _Val:
-    if prog.coll != CollType.ALLREDUCE:
+#: collectives with a postcondition model; programs for anything else
+#: are rejected at verify time (they could never be proven)
+VERIFIABLE_COLLS = frozenset((CollType.ALLREDUCE, CollType.ALLGATHER,
+                              CollType.REDUCE_SCATTER, CollType.BCAST))
+
+#: collectives with no reduction operator: REDUCE ops are structurally
+#: invalid in their programs
+NON_REDUCING_COLLS = frozenset((CollType.ALLGATHER, CollType.BCAST))
+
+
+def _initial_state(prog: Program) -> List[List[Optional[_Val]]]:
+    """Per-(rank, chunk) symbolic start state; ``None`` = undefined
+    (no data there yet)."""
+    n, nch = prog.nranks, prog.nchunks
+    coll = prog.coll
+    if coll in (CollType.ALLREDUCE, CollType.REDUCE_SCATTER):
+        return [[frozenset((r,)) for _ in range(nch)] for r in range(n)]
+    if coll == CollType.ALLGATHER:
+        if nch % n != 0:
+            raise VerifyError(
+                f"allgather programs need nchunks divisible by nranks "
+                f"(got {nch} chunks for {n} ranks) — chunk ownership is "
+                f"part of the collective contract")
+        m = nch // n
+        return [[frozenset((r,)) if c // m == r else None
+                 for c in range(nch)] for r in range(n)]
+    if coll == CollType.BCAST:
+        # generated for root 0; the compiler rotates ranks per post
+        return [[frozenset((0,)) if r == 0 else None
+                 for _ in range(nch)] for r in range(n)]
+    raise VerifyError(
+        f"no postcondition model for {coll!r}: the verifier proves "
+        f"{sorted(c.name.lower() for c in VERIFIABLE_COLLS)} programs")
+
+
+def _check_postcondition(prog: Program,
+                         state: List[List[Optional[_Val]]]) -> None:
+    """Compare the final symbolic state against the collective's
+    contract; raises naming the first offending (rank, chunk)."""
+    n, nch = prog.nranks, prog.nchunks
+    full = frozenset(range(n))
+
+    def fail(r: int, c: int, want: _Val) -> None:
+        got = state[r][c]
+        if got is None:
+            raise VerifyError(
+                f"postcondition violated: final buffer is undefined "
+                f"(no data ever delivered), expected contribution(s) "
+                f"from rank(s) {sorted(want)}", rank=r, chunk=c)
+        missing = sorted(want - got)
+        extra = sorted(got - want)
+        detail = []
+        if missing:
+            detail.append(f"missing contributions from rank(s) {missing}")
+        if extra:
+            detail.append(f"unexpected contributions from rank(s) {extra}")
         raise VerifyError(
-            f"no postcondition model for {prog.coll!r}: the verifier "
-            f"currently proves allreduce programs only")
-    return frozenset(range(prog.nranks))
+            f"postcondition violated: final buffer holds {sorted(got)}, "
+            f"expected {sorted(want)} ({'; '.join(detail)})",
+            rank=r, chunk=c)
+
+    if prog.coll == CollType.ALLREDUCE:
+        for r in range(n):
+            for c in range(nch):
+                if state[r][c] != full:
+                    fail(r, c, full)
+    elif prog.coll == CollType.REDUCE_SCATTER:
+        # only the owned block is the contract; the rest is scratch
+        if nch % n != 0:
+            raise VerifyError(
+                f"reduce_scatter programs need nchunks divisible by "
+                f"nranks (got {nch} chunks for {n} ranks)")
+        for r in range(n):
+            for c in prog.block_chunks(r):
+                if state[r][c] != full:
+                    fail(r, c, full)
+    elif prog.coll == CollType.ALLGATHER:
+        m = nch // n
+        for r in range(n):
+            for c in range(nch):
+                want = frozenset((c // m,))
+                if state[r][c] != want:
+                    fail(r, c, want)
+    elif prog.coll == CollType.BCAST:
+        want = frozenset((0,))
+        for r in range(n):
+            for c in range(nch):
+                if state[r][c] != want:
+                    fail(r, c, want)
 
 
 def verify(prog: Program) -> None:
     """Verify *prog*; raises :class:`VerifyError` on the first failure.
 
-    Checks, in order: structural sanity (uniform rounds), 1:1 matching,
-    deadlock-freedom, chunk consistency (a wire op's chunk must equal
-    the matched side's — contributions are per-slice), reduce
-    disjointness, and the collective postcondition on every rank/chunk.
+    Checks, in order: structural sanity (uniform rounds, REDUCE bans
+    for non-reducing collectives, at most one edge-wire precision),
+    1:1 matching, deadlock-freedom, chunk + wire consistency (a wire
+    op's chunk and precision must equal the matched side's), reduce
+    disjointness/definedness, and the collective postcondition.
     """
-    want = _postcondition(prog)
     n, R = prog.nranks, prog.n_rounds
+    if prog.coll not in VERIFIABLE_COLLS:
+        raise VerifyError(
+            f"no postcondition model for {prog.coll!r}: the verifier "
+            f"proves {sorted(c.name.lower() for c in VERIFIABLE_COLLS)} "
+            f"programs")
     if len(prog.ranks) != n:
         raise VerifyError(f"program has {len(prog.ranks)} rank streams "
                           f"for nranks={n}")
+    wires = set()
     for r, rp in enumerate(prog.ranks):
         if len(rp.rounds) != R:
             raise VerifyError(
                 f"non-uniform round count ({len(rp.rounds)} != {R})",
                 rank=r)
+        for k, ops in enumerate(rp.rounds):
+            for op in ops:
+                if op.kind == OpKind.REDUCE and \
+                        prog.coll in NON_REDUCING_COLLS:
+                    raise VerifyError(
+                        f"{op.describe()} in a "
+                        f"{prog.coll.name.lower()} program — this "
+                        f"collective has no reduction operator",
+                        rank=r, chunk=op.chunk, round_=k)
+                if op.wire:
+                    wires.add(op.wire)
+    if len(wires) > 1:
+        raise VerifyError(
+            f"mixed per-edge wire precisions {sorted(wires)} — the "
+            f"executor runs one codec per program")
+    if wires and prog.wire:
+        raise VerifyError(
+            "program-level wire precision combined with per-edge wire "
+            "tags — use one or the other")
     _check_round_hazards(prog)
     matches = _match_ops(prog)
     for (sender, recver) in matches.values():
@@ -246,13 +367,19 @@ def verify(prog: Program) -> None:
                 f"— contributions are per-slice, so sender and receiver "
                 f"must name the same chunk", rank=q, chunk=rop.chunk,
                 round_=kr)
+        if sop.wire != rop.wire:
+            raise VerifyError(
+                f"wire-precision mismatch across the wire: "
+                f"{sop.describe()} on rank {p} (round {ks}) delivers "
+                f"into {rop.describe()} — sender and receiver must "
+                f"agree on the edge codec or the byte counts differ",
+                rank=q, chunk=rop.chunk, round_=kr)
     order = _topo_rounds(prog, matches)
 
     # ------------------------------------------------------------------
     # symbolic execution in wait-graph topological order
-    state: List[List[_Val]] = [[frozenset((r,)) for _ in range(prog.nchunks)]
-                               for r in range(n)]
-    sendval: Dict[Tuple[int, int, int], _Val] = {}   # (src, dst, slot)
+    state: List[List[Optional[_Val]]] = _initial_state(prog)
+    sendval: Dict[Tuple[int, int, int], Optional[_Val]] = {}  # (src,dst,slot)
 
     def snapshot_sends(r: int, k: int) -> None:
         """Record send values of round *k* of rank *r* (the state the
@@ -275,6 +402,13 @@ def verify(prog: Program) -> None:
             elif op.kind == OpKind.REDUCE:
                 incoming = sendval[(op.peer, r, op.slot)]
                 cur = state[r][op.chunk]
+                if incoming is None or cur is None:
+                    which = "incoming" if incoming is None else "local"
+                    raise VerifyError(
+                        f"{op.describe()} reduces UNDEFINED data (the "
+                        f"{which} chunk never received a value) — the "
+                        f"result would be garbage", rank=r,
+                        chunk=op.chunk, round_=k)
                 dup = incoming & cur
                 if dup:
                     raise VerifyError(
@@ -289,21 +423,4 @@ def verify(prog: Program) -> None:
                 state[r][op.chunk] = state[r][op.src_chunk]
         snapshot_sends(r, k + 1)
 
-    for r in range(n):
-        for c in range(prog.nchunks):
-            got = state[r][c]
-            if got != want:
-                missing = sorted(want - got)
-                extra = sorted(got - want)
-                detail = []
-                if missing:
-                    detail.append(f"missing contributions from rank(s) "
-                                  f"{missing}")
-                if extra:
-                    detail.append(f"unexpected contributions from "
-                                  f"rank(s) {extra}")
-                raise VerifyError(
-                    f"postcondition violated: final buffer holds "
-                    f"{sorted(got)}, expected the full reduction "
-                    f"{sorted(want)} ({'; '.join(detail)})",
-                    rank=r, chunk=c)
+    _check_postcondition(prog, state)

@@ -111,14 +111,20 @@ class ScoreMap:
     # autotuner recompile-in-place (score/tuner.py)
     def apply_learned(self, coll: CollType, mem: MemoryType, start: int,
                       end: int, alg: str, comp: Optional[str] = None,
-                      score: int = LEARNED_SCORE) -> bool:
+                      score: int = LEARNED_SCORE,
+                      origin: str = "learned") -> bool:
         """Promote the measured winner *alg* (optionally pinned to the
         serving component *comp*) to *score* over [start, end), splitting
         its existing ranges at the boundaries — the tuner's "recompile
         the ScoreMap in place" step. Other candidates keep their default
         scores and remain the fallback chain. Returns False when no
         range of that algorithm overlaps the window (e.g. a cache entry
-        learned on a build with a different algorithm set)."""
+        learned on a build with a different algorithm set).
+
+        ``origin`` stamps the promoted range's provenance: "learned"
+        for tuner measurements, "searched" for cost-model-guided search
+        winners (dsl/search.py) — so `ucc_info -s` distinguishes HOW a
+        window was decided."""
         if start >= end:
             return False
         key = (coll, mem)
@@ -139,7 +145,7 @@ class ScoreMap:
                 out.append(replace(r, end=lo))
             mid = replace(r, start=lo, end=hi)
             mid.score = score
-            mid.origin = "learned"
+            mid.origin = origin or "learned"
             out.append(mid)
             if hi < r.end:
                 out.append(replace(r, start=hi))

@@ -194,8 +194,16 @@ def store_entries(path: str, signature: str, entries: Sequence[dict],
 
 def apply_entries(score_map, entries: Sequence[dict]) -> List[Tuple]:
     """Compile cache *entries* into *score_map* (apply_learned per
-    entry). Returns the (coll, mem, start, end) windows that actually
-    applied — the keys online exploration must skip."""
+    entry, carrying the entry's origin — "learned" or "searched").
+    Returns the (coll, mem, start, end) windows that actually applied —
+    the keys online exploration must skip.
+
+    Staleness guard (ISSUE 14 satellite): an entry whose ``gen`` field
+    names a generated/searched algorithm that no longer registers on
+    this build (family grid changed, UCC_GEN off, search cache cleared)
+    is DROPPED with a warning + ``tuner_stale_entries_dropped`` metric
+    instead of silently compiling a dead candidate into the score map —
+    its window stays open for the static defaults and future tuning."""
     covered: List[Tuple] = []
     for e in entries:
         coll = _COLL_BY_NAME.get(str(e.get("coll", "")))
@@ -207,9 +215,22 @@ def apply_entries(score_map, entries: Sequence[dict]) -> List[Tuple]:
             start, end = int(e.get("start", 0)), int(e.get("end", 0))
         except (TypeError, ValueError):
             continue
+        origin = str(e.get("origin") or "learned")
         if score_map.apply_learned(coll, mem, start, end, alg,
-                                   comp=e.get("comp")):
+                                   comp=e.get("comp"), origin=origin):
             covered.append((coll, mem, start, end))
+        elif e.get("gen") or alg.startswith("gen_"):
+            logger.warning(
+                "tuner: dropping stale cache entry %s/%s [%d..%d) -> "
+                "%s (%s): the generated/searched algorithm no longer "
+                "registers on this build (UCC_GEN off? family grid "
+                "changed? search cache cleared?)",
+                str(e.get("coll")), str(e.get("mem")), start, end, alg,
+                e.get("gen") or "no gen params")
+            if metrics.ENABLED:
+                metrics.inc("tuner_stale_entries_dropped",
+                            component="tuner", coll=str(e.get("coll")),
+                            alg=alg)
         else:
             logger.debug("tuner: cache entry %s has no matching candidate "
                          "on this build; ignoring", e)
@@ -697,7 +718,8 @@ def forced_request(team, args, coll: CollType, mem: MemoryType,
 def measurement_record(coll_name: str, mem: MemoryType, ranks: int,
                        label: Label, size_bytes: int, count: int,
                        iters: int, stats: Dict[str, float],
-                       precision: str = "", gen: str = "") -> dict:
+                       precision: str = "", gen: str = "",
+                       predicted_us: Optional[float] = None) -> dict:
     """The one sweep measurement-record shape (`ucc_tune` and
     `ucc_perftest --sweep` both emit it; `compile_measurements` and
     `ucc_tune --from` consume it). Centralized so the producers cannot
@@ -716,6 +738,10 @@ def measurement_record(coll_name: str, mem: MemoryType, ranks: int,
         rec["precision"] = precision
     if gen:
         rec["gen"] = gen
+    if predicted_us is not None:
+        # the fitted cost model's price for this (program, size): sweep
+        # output doubles as model-calibration data (ISSUE 14 satellite)
+        rec["predicted_us"] = round(float(predicted_us), 2)
     return rec
 
 

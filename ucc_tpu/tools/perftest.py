@@ -330,8 +330,13 @@ def run_sweep_mode(args, job, coll, dt, op, mem, bmin, bmax, n,
     import json
 
     from ..api.types import coll_args_msgsize
+    from ..score import cost
     from ..score.tuner import (cand_label, measure_candidate,
                                measurement_record, sweep_candidates)
+    # a previously fitted cost model adds a predicted_us column to
+    # generated candidates' rows — sweep output doubles as
+    # model-calibration data (compare predicted vs p50 per row)
+    cost_model = cost.load_model()
     esz = dt_size(dt)
     size = max(bmin, esz)
     while size <= bmax:
@@ -354,7 +359,9 @@ def run_sweep_mode(args, job, coll, dt, op, mem, bmin, bmax, n,
             print(json.dumps(measurement_record(
                 args.coll, mem, n, (comp, alg), size, count, args.iters,
                 lat_stats(lats), precision=cands[idx].precision,
-                gen=cands[idx].gen)),
+                gen=cands[idx].gen,
+                predicted_us=cost.predict_for_record(
+                    cost_model, cands[idx].gen, n, size))),
                 flush=True)
         size *= 2
     return 0

@@ -56,8 +56,10 @@ class _Job(InProcJob):
     untouched static map — plus a bounded wait for full-dispatch
     measurement loops."""
 
-    def __init__(self, n: int, overrides: Optional[dict] = None):
-        super().__init__(n, lib_overrides=overrides)
+    def __init__(self, n: int, overrides: Optional[dict] = None,
+                 create_timeout: float = 120.0):
+        super().__init__(n, lib_overrides=overrides,
+                         create_timeout=create_timeout)
 
     def wait(self, reqs, timeout: float = 120.0) -> bool:
         deadline = time.monotonic() + timeout
@@ -90,6 +92,8 @@ def run_sweep(job: _Job, colls: List[str], sizes: List[int], iters: int,
     records: List[dict] = []
     n = job.n
     esz = dt_size(dt)
+    from ucc_tpu.score import cost as _cost
+    cost_model = _cost.load_model()
     for cname in colls:
         ct = COLLS[cname]
         for size in sizes:
@@ -115,7 +119,9 @@ def run_sweep(job: _Job, colls: List[str], sizes: List[int], iters: int,
                 st = lat_stats(lats)
                 records.append(measurement_record(
                     cname, mem, n, (comp, alg), size, count, iters, st,
-                    precision=cands[idx].precision, gen=cands[idx].gen))
+                    precision=cands[idx].precision, gen=cands[idx].gen,
+                    predicted_us=_cost.predict_for_record(
+                        cost_model, cands[idx].gen, n, size)))
                 if verbose:
                     print(f"# {cname:>12} {memunits_str(size):>8} "
                           f"{comp}/{alg:<20} p50 {st['p50_us']:>10.2f}us",
@@ -267,6 +273,22 @@ def main(argv=None) -> int:
                         "the tuning cache with their family/parameter "
                         "string, so a later UCC_TUNER=offline run with "
                         "UCC_GEN=y starts on the generated winner")
+    p.add_argument("--gen-search", action="store_true",
+                   help="cost-model-guided program SEARCH instead of "
+                        "grid enumeration (ISSUE 14): fit the "
+                        "alpha-beta model (from --from records when "
+                        "given, else a live probe), propose the joint "
+                        "family x radix x chunking x depth x "
+                        "quantization (x hierarchy, on multi-node "
+                        "topologies) space, prune to the "
+                        "UCC_GEN_SEARCH_BUDGET predicted-cheapest per "
+                        "grid point, refine by successive halving with "
+                        "interleaved measurement, and persist winners "
+                        "into the search cache AND the tuning cache "
+                        "with origin 'searched' + predicted-vs-measured "
+                        "provenance")
+    p.add_argument("--search-budget", type=int, default=0,
+                   help="override UCC_GEN_SEARCH_BUDGET for --gen-search")
     args = p.parse_args(argv)
 
     if args.quant:
@@ -292,6 +314,48 @@ def main(argv=None) -> int:
     for c in colls:
         if c not in COLLS:
             p.error(f"unknown collective '{c}'")
+
+    if args.gen_search:
+        import json as _json
+
+        from ucc_tpu.dsl.search import run_search
+        from ucc_tpu.score import cost as _cost
+        sizes = []
+        size = max(parse_memunits(args.begin), 4)
+        bmax = parse_memunits(args.end)
+        while size <= bmax:
+            sizes.append(size)
+            size *= 2
+        model = None
+        if args.from_file:
+            with open(args.from_file) as fh:
+                records = [_json.loads(ln) for ln in fh
+                           if ln.strip().startswith("{")]
+            model = _cost.fit_records(
+                [r for r in records if r.get("gen")])
+            if model is not None:
+                _cost.save_model(model)
+                print(f"# cost model fitted from {args.from_file}: "
+                      f"{model.source}")
+        rep = run_search(
+            # iters is the FIRST successive-halving rung; rungs double,
+            # so the finalists' confirmation lands near the user's -n
+            args.nprocs, colls, sizes, iters=max(3, args.iters // 4),
+            budget=args.search_budget or None,
+            quant_mode=os.environ.get("UCC_QUANT", "")
+            if args.quant else "",
+            tuner_cache=cache_path, model=model, verbose=True)
+        for res in rep.get("results") or []:
+            for f in res.get("finalists") or []:
+                print(f"#   {res['coll']:>10} "
+                      f"{memunits_str(res['size_bytes']):>8} "
+                      f"{f['alg']:<24} measured {f['measured_us']}us"
+                      + (f" predicted {f['predicted_us']}us"
+                         if f.get("predicted_us") is not None else ""))
+        print(f"# search winners: {rep.get('winners')} "
+              f"({rep.get('tuner_entries', 0)} tuning-cache entries -> "
+              f"{cache_path})")
+        return 0
 
     if args.from_file:
         with open(args.from_file) as fh:
