@@ -156,15 +156,23 @@ def _measure_point(coll: str, count: int, ctxs, teams, devices, mesh,
     alg = str(getattr(reqs[0].task, "alg_name", "") or "")
     prog = getattr(reqs[0].task, "prog", None)
     if prog is not None and alg:
-        origin = ""
+        origin = str(getattr(reqs[0].task, "gen_origin", "") or "")
         try:
             from ucc_tpu.constants import CollType as _CT
             from ucc_tpu.constants import MemoryType as _MT
             ct = {"allreduce": _CT.ALLREDUCE,
                   "alltoall": _CT.ALLTOALL}[coll]
             for cand in teams[0].score_map.lookup(ct, _MT.TPU, nbytes):
-                if cand.alg_name == alg:
+                if cand.alg_name != alg:
+                    continue
+                if not origin or origin == "tune-str":
+                    # a TUNE pin overlays the registered range: keep
+                    # walking for the registration origin (generated/
+                    # generated-device/searched) — "gen_dev_ring_c2
+                    # [generated-device ring(chunks=2)]" names how the
+                    # program came to exist, not how it was selected
                     origin = cand.origin
+                if origin and origin != "tune-str":
                     break
         except Exception:  # noqa: BLE001 - provenance is best-effort
             pass
@@ -287,11 +295,34 @@ def _quant_detail(teams, ctxs, devices, count: int, busbw: float) -> dict:
     return d
 
 
-def main(sweep: bool = False, quant: bool = False) -> None:
+def _enable_gen_device() -> None:
+    """--gen-device: arm UCC_GEN_DEVICE BEFORE lib/context creation and
+    pin the device allreduce to a generated-device ring (they register
+    at a low score, tuner-promoted in production — the bench mode
+    measures one explicitly; detail.alg then records the full
+    provenance, e.g. ``gen_dev_ring_c2[generated-device
+    ring(chunks=2)]``)."""
+    import os
+    os.environ["UCC_GEN_DEVICE"] = "y"
+    # pin only when generated-device candidates will actually register
+    # (2..MAX_DEVICE_RANKS devices): a TUNE string naming an
+    # unregistered algorithm fails team CREATE — a 1-chip box (the real
+    # TPU probe host) must fall back to the plain bench, not crash
+    import jax
+    from ucc_tpu.dsl.lower_device import MAX_DEVICE_RANKS
+    if 2 <= len(jax.devices()) <= MAX_DEVICE_RANKS:
+        os.environ.setdefault("UCC_TL_XLA_TUNE",
+                              "allreduce:@gen_dev_ring_c2:inf")
+
+
+def main(sweep: bool = False, quant: bool = False,
+         gen_device: bool = False) -> None:
     _force_cpu_if_requested()
     import os
     if quant:
         _enable_quant()
+    if gen_device:
+        _enable_gen_device()
     # detail.quant rides every allreduce record whenever a precision is
     # armed — bare UCC_QUANT=int8 records the registered-but-not-forced
     # state (selection stays honest per fabric; --quant pins the
@@ -418,12 +449,14 @@ def _run_guarded() -> None:
 
     sweep = "--sweep" in sys.argv
     quant = "--quant" in sys.argv
+    gen_device = "--gen-device" in sys.argv
     if os.environ.get("UCC_BENCH_CHILD"):
-        main(sweep=sweep, quant=quant)
+        main(sweep=sweep, quant=quant, gen_device=gen_device)
         return
     env = dict(os.environ, UCC_BENCH_CHILD="1")
     args = [sys.executable, os.path.abspath(__file__)] + \
-        (["--sweep"] if sweep else []) + (["--quant"] if quant else [])
+        (["--sweep"] if sweep else []) + (["--quant"] if quant else []) + \
+        (["--gen-device"] if gen_device else [])
     # UCC_BENCH_TIMEOUT overrides the accelerator-child budget (the
     # probe's real-chip sweep capture compiles ~10 fresh programs and
     # needs more than the driver default); UCC_BENCH_NO_FALLBACK=1

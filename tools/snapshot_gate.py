@@ -657,6 +657,73 @@ def _search_smoke(env) -> None:
           flush=True)
 
 
+def _devgen_smoke(env) -> None:
+    """WARN-ONLY device-side compiler-backend probe (ISSUE 15 CI
+    satellite): ``python -m ucc_tpu.dsl.smoke --device`` lowers +
+    verifies every device family, runs the TPU-memtype collective
+    matrix with a generated-device allreduce TUNE-pinned, and asserts
+    the lowered program's result is bitwise-identical to the host
+    interpreter running the same verified IR. Skip with
+    UCC_GATE_DEVGEN=0."""
+    import json
+    if os.environ.get("UCC_GATE_DEVGEN", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] devgen smoke: skipped (UCC_GATE_DEVGEN=0)",
+              flush=True)
+        return
+    print("[gate] device-backend smoke (warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE",
+                                      "UCC_GEN", "UCC_QUANT",
+                                      "UCC_TUNER"))}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ucc_tpu.dsl.smoke", "--device"],
+            cwd=REPO, env=smoke_env, capture_output=True, text=True,
+            timeout=600)
+    except subprocess.TimeoutExpired:
+        print("[gate] WARN: devgen smoke timed out (not a gate "
+              "failure)", flush=True)
+        return
+    rec = None
+    for ln in (r.stdout or "").splitlines():
+        if ln.startswith("{"):
+            try:
+                cand = json.loads(ln)
+            except ValueError:
+                continue
+            if cand.get("metric") == "devgen_gate_smoke":
+                rec = cand
+    dt = time.monotonic() - t0
+    if rec is None or rec.get("error"):
+        why = (rec or {}).get("error") or f"rc={r.returncode}, no record"
+        print(f"[gate] WARN: devgen smoke — {why} in {dt:.0f}s "
+              f"(not a gate failure)", flush=True)
+        return
+    problems = []
+    if int(rec.get("programs_lowered") or 0) < 6:
+        problems.append(f"only {rec.get('programs_lowered')} device "
+                        "programs lowered")
+    if len(rec.get("matrix") or []) < 4:
+        problems.append(f"TPU-memtype matrix incomplete with a "
+                        f"generated-device allreduce pinned: "
+                        f"{rec.get('matrix')}")
+    if not rec.get("pinned_engaged"):
+        problems.append("TUNE-pinned generated-device allreduce did "
+                        "not engage")
+    if not rec.get("bitwise_identical"):
+        problems.append("device-lowered result != host interpreter "
+                        "(bitwise)")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] devgen smoke: {rec.get('programs_lowered')} device "
+          f"programs lowered, matrix {len(rec.get('matrix') or [])}/4 "
+          f"with {rec.get('pinned_alg')} pinned, host-vs-device "
+          f"bitwise={'yes' if rec.get('bitwise_identical') else 'NO'} "
+          f"in {dt:.0f}s -> {verdict}", flush=True)
+
+
 def _plans_smoke(env) -> None:
     """WARN-ONLY native execution-plan probe (ISSUE 12 CI satellite):
     ``python -m ucc_tpu.dsl.smoke --plans`` builds one generated
@@ -864,6 +931,11 @@ def main(argv=None) -> int:
         # registers and dispatches a searched winner with sane
         # predicted-cost ordering (ISSUE 14)
         _search_smoke(env)
+        # warn-only: device-side compiler backend lowers + verifies all
+        # device families, runs the TPU-memtype matrix with a
+        # generated-device allreduce pinned, and matches the host
+        # interpreter bitwise (ISSUE 15)
+        _devgen_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

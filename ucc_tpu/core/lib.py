@@ -218,6 +218,27 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
                 "rounds (bf16, quantized wire) through plans; n = "
                 "always interpret. Plan-executed candidates show "
                 "'+plan' in ucc_info -s", parse_string),
+    ConfigField("GEN_DEVICE", "n", "device-side compiler backend "
+                "(ucc_tpu/dsl/lower_device): y = lower verified DSL "
+                "programs to generated DEVICE collectives on the xla "
+                "TL — ring/rhd/bcast families plus the fused quantized "
+                "direct exchange (under UCC_QUANT) register as "
+                "score-map candidates named gen_dev_* with origin "
+                "'generated-device' at a low score (tuner-explorable, "
+                "TUNE-addressable); n (default) keeps candidate lists "
+                "byte-identical", parse_string),
+    ConfigField("GEN_DEVICE_FAMILIES", "", "device families and "
+                "parameter grids (UCC_GEN_FAMILIES grammar, restricted "
+                "to the lowerable set), e.g. 'ring(1,2,4),rhd(2,0),"
+                "bc_kn(2,0),bc_chain(2),qdirect'; empty = that default "
+                "grid", parse_string),
+    ConfigField("GEN_DEVICE_BACKEND", "auto", "lowering backend: auto = "
+                "Pallas remote-DMA kernels on real TPU platforms "
+                "(VMEM-bounded; larger counts fall back to the XLA "
+                "variant), generated in-jit XLA (lax.ppermute layer "
+                "schedule) on the virtual CPU mesh; xla / pallas force "
+                "one backend (pallas on CPU runs interpret-mode — the "
+                "test path)", parse_string),
     ConfigField("CHECK_ASYMMETRIC_DT", "n", "validate datatype consistency "
                 "for gather(v)/scatter(v) via a service allreduce before "
                 "the collective (off by default for performance, matching "

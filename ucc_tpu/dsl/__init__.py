@@ -35,6 +35,12 @@ Layers:
   ``UCC_GEN_FAMILIES`` and produces the ``AlgSpec`` rows (origin tag
   ``generated``, low default score) the host TL merges into its
   algorithm table.
+- :mod:`lower_device` — the DEVICE back-end (ISSUE 15): a verified
+  program lowers to a generated device collective on the xla TL —
+  Pallas remote-DMA kernels on tl/ring_dma's primitive set on real
+  chips, a generated in-jit XLA ``lax.ppermute`` layer schedule on the
+  virtual CPU mesh — behind ``UCC_GEN_DEVICE`` with origin tag
+  ``generated-device``.
 """
 from __future__ import annotations
 

@@ -119,25 +119,58 @@ def _radix_grid(n: int) -> List[int]:
 
 
 def propose(coll: CollType, n: int, paths=None, quant_mode: str = "",
-            grid_names: Optional[set] = None) -> List[Candidate]:
+            grid_names: Optional[set] = None,
+            target: str = "host") -> List[Candidate]:
     """Every verified candidate of the joint space for (coll, n,
     topology). ``grid_names`` marks which names the fixed
     UCC_GEN_FAMILIES grids already reach (the acceptance criterion
-    cares whether a WINNER lies outside them)."""
+    cares whether a WINNER lies outside them). ``target="device"``
+    restricts the space to DEVICE-LOWERABLE programs (ISSUE 15: the
+    xla-TL generated collectives — ring chunkings, rhd radices, bcast
+    trees/chains, the fused quantized direct exchange) and drops
+    everything whose layer plan refuses to lower; price those with
+    :func:`~..score.cost.link_of_device` (the ICI link class)."""
     cands: List[Candidate] = []
     seen: set = set()
     grid_names = grid_names or set()
+    device = target == "device"
+    if device:
+        if coll not in (CollType.ALLREDUCE, CollType.BCAST):
+            # the device buffer contract covers full-vector collectives
+            # only (allgather/reduce_scatter stay host-side)
+            return []
+        from .lower_device import plan_rounds
 
     def add(family: str, params: Dict[str, Any], wire: str = "",
             hier: bool = False) -> None:
+        if device and (hier or family in ("sra", "sra_pipe")):
+            return
         p = build_named(family, params, n, wire=wire,
                         paths=paths if hier else None)
         if p is None or p.name in seen:
             return
+        if device:
+            try:
+                plan_rounds(p, n)
+            except fam.Inapplicable:
+                return
         seen.add(p.name)
         cands.append(Candidate(p, family, params, wire, hier,
                                from_grid=p.name in grid_names))
 
+    if device and coll == CollType.ALLREDUCE:
+        # power-of-two chunkings only: the device contract needs
+        # chunk-divisible counts (no near-equal split), and the sweep
+        # grid is power-of-two sizes — ring(chunks=3/6) would shortlist
+        # but always refuse dispatch, burning budget slots on None rows
+        for m in (1, 2, 4, 8):
+            add("ring", {"chunks": m})
+        for r in _radix_grid(n):
+            add("rhd", {"radix": r})
+        if quant_mode:
+            # the device codec serves the direct exchange (radix n)
+            add("qdirect", {"radix": n}, wire=quant_mode)
+        return cands
     if coll == CollType.ALLREDUCE:
         for m in (1, 2, 3, 4, 6, 8):
             add("ring", {"chunks": m})
@@ -737,6 +770,166 @@ def run_search(n: int, colls: Sequence[str], sizes: Sequence[int],
             os.environ["UCC_GEN_SEARCH_CACHE"] = saved_env
     report["results"] = results
     report["winners"] = [w.get("name") for w in winners]
+    report["signature"] = sig
+    return report
+
+
+def _device_family_spec(cands: List[Candidate], n: int) -> str:
+    """UCC_GEN_DEVICE_FAMILIES string registering exactly *cands* (the
+    measurement job's grid). Radix/param n maps to the grid's 0."""
+    by_fam: Dict[str, List[int]] = {}
+    key_of = {"ring": "chunks", "rhd": "radix", "bc_kn": "radix",
+              "bc_chain": "chunks", "qdirect": "radix"}
+    for c in cands:
+        pk = key_of.get(c.family)
+        if pk is None:
+            continue
+        v = int(c.params.get(pk, 0))
+        if c.family in ("rhd", "bc_kn", "qdirect") and v == n:
+            v = 0
+        lst = by_fam.setdefault(c.family, [])
+        if v not in lst:
+            lst.append(v)
+    return ",".join(
+        f"{famname}({','.join(str(v) for v in sorted(ps))})"
+        for famname, ps in sorted(by_fam.items()))
+
+
+def run_device_search(n: int, colls: Sequence[str],
+                      sizes: Sequence[int], iters: int = 3,
+                      budget: Optional[int] = None,
+                      quant_mode: str = "", tuner_cache: str = "",
+                      model=None, verbose: bool = True) -> dict:
+    """Cost-model-guided search over DEVICE programs (ISSUE 15): price
+    the device-lowerable space with the ICI link class, register the
+    predicted-cheapest shortlist on a TPU-memtype xla team
+    (UCC_GEN_DEVICE_FAMILIES carries exactly the shortlist), refine by
+    successive halving against the monolithic lax candidates, and
+    persist winning generated-device selections into the tuner cache
+    (mem "tpu", origin "searched"). On the virtual CPU mesh the
+    measured programs are the generated in-jit XLA variants — the same
+    schedule the compiled Pallas path runs on real chips."""
+    from ..api.types import coll_args_msgsize
+    from ..constants import DataType, MemoryType, ReductionOp
+    from ..score import cost
+    from ..score.tuner import (bucket_range, size_bucket, store_entries,
+                               sweep_candidates, topo_signature)
+    from ..tools.perftest import COLLS, make_args
+    from ..tools.tune import _Job
+
+    budget = budget or int(os.environ.get("UCC_GEN_SEARCH_BUDGET",
+                                          "10") or 10)
+    report: dict = {"metric": "gen_device_search", "ranks": n,
+                    "sizes": list(sizes), "budget": budget,
+                    "colls": list(colls)}
+    if model is None:
+        model = cost.load_model()
+    if model is None:
+        model = cost.CostModel()
+        report["cost_model"] = "seed"
+    else:
+        report["cost_model"] = model.source
+    if "ici" not in model.links:
+        # a persisted model fitted before the ici class existed would
+        # silently price every device edge with the shm fallback
+        # (20x the beta); derive ici coefficients from the model's shm
+        # scale factors instead, the same derived-class rule fit_records
+        # applies to unfitted classes
+        shm = model.links.get("shm")
+        sa, sb = cost.SEED_LINKS["shm"]
+        ia, ib = cost.SEED_LINKS["ici"]
+        fa = (shm.alpha_us / sa) if shm else 1.0
+        fb = (shm.beta_us_per_byte / sb) if shm else 1.0
+        model.links["ici"] = cost.LinkCoeffs(ia * fa, ib * fb)
+        report["cost_model"] += "+derived-ici"
+    link_of = cost.link_of_device()
+    shortlists: Dict[Tuple[str, int], List[Candidate]] = {}
+    space_cands: List[Candidate] = []
+    for cname in colls:
+        ct = COLLS[cname]
+        space = propose(ct, n, quant_mode=quant_mode, target="device")
+        report.setdefault("space", {})[cname] = len(space)
+        for size in sizes:
+            sl = shortlist(list(space), model, size, budget, link_of)
+            shortlists[(cname, size)] = sl
+            space_cands.extend(sl)
+    spec = _device_family_spec(space_cands, n)
+    report["device_families"] = spec
+    if not spec:
+        report["error"] = "no device-lowerable candidate survived"
+        return report
+
+    from .lower_device import dev_alg_name
+    overrides = {"TUNER": "off", "GEN_DEVICE": "y",
+                 "GEN_DEVICE_FAMILIES": spec}
+    if quant_mode:
+        overrides["QUANT"] = quant_mode
+    results: List[dict] = []
+    tuner_entries: List[dict] = []
+    job = _Job(n, overrides)
+    try:
+        sig = topo_signature(job.teams[0])
+        for (cname, size), sl in sorted(shortlists.items()):
+            ct = COLLS[cname]
+            count = max(4, size // 4)
+            argses = [make_args(ct, r, n, count, DataType.FLOAT32,
+                                ReductionOp.SUM, MemoryType.TPU, False,
+                                0, True, None) for r in range(n)]
+            msgsize = coll_args_msgsize(argses[0], n, 0)
+            cands = sweep_candidates(job.teams[0], ct, MemoryType.TPU,
+                                     msgsize)
+            by_name: Dict[str, int] = {}
+            for i, c in enumerate(cands):
+                if c.alg_name and c.alg_name not in by_name:
+                    by_name[c.alg_name] = i
+            want = {dev_alg_name(c.prog) for c in sl}
+            # the monolithic defaults are the floor the winner must beat
+            for i, c in enumerate(cands):
+                if c.origin != "generated-device":
+                    want.add(c.alg_name)
+            idxs = [by_name[nm] for nm in sorted(want) if nm in by_name]
+            meds, order = successive_halving(
+                job.teams, job.contexts, argses, ct, MemoryType.TPU,
+                msgsize, idxs, iters0=iters)
+            sl_by_name = {dev_alg_name(c.prog): c for c in sl}
+            finalists = [{
+                "alg": cands[i].alg_name, "origin": cands[i].origin,
+                "gen": cands[i].gen, "measured_us": round(meds[i], 2),
+                "predicted_us": round(
+                    sl_by_name[cands[i].alg_name].predicted_us, 2)
+                if cands[i].alg_name in sl_by_name and
+                sl_by_name[cands[i].alg_name].predicted_us is not None
+                else None,
+            } for i in sorted(meds, key=lambda i: meds[i])]
+            res = {"coll": cname, "size_bytes": size,
+                   "finalists": finalists}
+            if order:
+                win = cands[order[0]]
+                res["winner"] = win.alg_name
+                res["winner_origin"] = win.origin
+                res["winner_gen"] = win.gen
+                res["winner_measured_us"] = round(meds[order[0]], 2)
+                if win.origin == "generated-device":
+                    start, end = bucket_range(size_bucket(msgsize))
+                    tuner_entries.append(
+                        {"coll": cname, "mem": "tpu", "start": start,
+                         "end": end, "alg": win.alg_name,
+                         "comp": "xla", "origin": "searched",
+                         "gen": win.gen,
+                         "measured_us": res["winner_measured_us"]})
+            results.append(res)
+            if verbose:
+                print(f"# device search {cname} {size}B: winner "
+                      f"{res.get('winner')} "
+                      f"({res.get('winner_measured_us')}us, "
+                      f"{len(finalists)} finalists)", flush=True)
+    finally:
+        job.destroy()
+    if tuner_entries and tuner_cache:
+        store_entries(tuner_cache, sig, tuner_entries, source="searched")
+        report["tuner_entries"] = len(tuner_entries)
+    report["results"] = results
+    report["winners"] = [e["alg"] for e in tuner_entries]
     report["signature"] = sig
     return report
 

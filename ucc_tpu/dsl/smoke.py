@@ -298,6 +298,205 @@ def run_plan_smoke(n: int = 4, count: int = 4096) -> dict:
     return rec
 
 
+def _allreduce_digest(job, n: int, count: int, mem, srcs):
+    """One allreduce over *srcs* on *job*; returns (sha256 of the
+    concatenated result bytes or None on failure, dispatched alg name).
+    The alg matters: a TUNE-pinned candidate refusing in THIS job's
+    environment would silently fall back to the monolithic program,
+    whose digest could pass a bitwise gate the lowered program never
+    ran. ``mem`` picks HOST (numpy dst) or TPU (jax arrays) buffers."""
+    import hashlib
+
+    import numpy as np
+
+    from ucc_tpu.api.types import BufferInfo, CollArgs
+    from ucc_tpu.constants import (CollType, DataType, MemoryType,
+                                   ReductionOp)
+
+    argses = []
+    for r in range(n):
+        if mem == MemoryType.TPU:
+            import jax
+            dev = job.contexts[r].tl_contexts["xla"].obj.device
+            src = BufferInfo(jax.device_put(srcs[r], dev), count,
+                             DataType.FLOAT32, mem_type=MemoryType.TPU)
+            dst = BufferInfo(None, count, DataType.FLOAT32,
+                             mem_type=MemoryType.TPU)
+        else:
+            src = BufferInfo(srcs[r].copy(), count, DataType.FLOAT32)
+            dst = BufferInfo(np.zeros(count, np.float32), count,
+                             DataType.FLOAT32)
+        argses.append(CollArgs(coll_type=CollType.ALLREDUCE, src=src,
+                               dst=dst, op=ReductionOp.SUM))
+    reqs = [job.teams[r].collective_init(argses[r]) for r in range(n)]
+    alg = str(getattr(reqs[0].task, "alg_name", "") or
+              getattr(reqs[0].task, "alg", "") or "?")
+    for rq in reqs:
+        rq.post()
+    ok = job.wait(reqs, timeout=60)
+    for rq in reqs:
+        try:
+            rq.finalize()
+        except Exception:  # noqa: BLE001 - smoke cleanup
+            pass
+    if not ok:
+        return None, alg
+    h = hashlib.sha256()
+    for a in argses:
+        h.update(np.asarray(a.dst.buffer).tobytes())
+    return h.hexdigest(), alg
+
+
+def run_device_smoke(n: int = 4, count: int = 4096) -> dict:
+    """UCC_GATE_DEVGEN probe (metric ``devgen_gate_smoke``): (1) lower
+    + verify every device family (incl. the fused quantized direct
+    exchange), (2) run the TPU-memtype collective matrix with a
+    generated-device allreduce TUNE-pinned and check it actually
+    dispatched, (3) assert the device-lowered program's result is
+    BITWISE-identical to the host interpreter running the SAME verified
+    IR on the same inputs — the cross-backend contract the lowering's
+    receiver-ordered layer schedule exists to keep."""
+    import numpy as np
+
+    from ucc_tpu.constants import (CollType, DataType, MemoryType,
+                                   ReductionOp, coll_type_str)
+    from ucc_tpu.dsl.lower_device import dev_alg_name, device_programs
+    from ucc_tpu.score.tuner import sweep_candidates
+    from ucc_tpu.tools.perftest import make_args
+    from ucc_tpu.tools.tune import _Job
+
+    rec: dict = {"metric": "devgen_gate_smoke", "ranks": n,
+                 "size_bytes": count * 4}
+
+    progs = device_programs(n, quant_mode="int8")
+    rec["programs_lowered"] = len(progs)
+    rec["programs"] = sorted(p.name for p in progs)
+    if not progs:
+        rec["error"] = "no device program survived lower+verify"
+        return rec
+    ring = next((p for p in progs if p.family == "ring"), progs[0])
+    pin = dev_alg_name(ring)
+
+    saved = {k: os.environ.get(k)
+             for k in ("UCC_TL_XLA_TUNE", "UCC_TL_SHM_TUNE")}
+    os.environ["UCC_TL_XLA_TUNE"] = f"allreduce:@{pin}:inf"
+    try:
+        job = _Job(n, {"GEN_DEVICE": "y", "TUNER": "off",
+                       "QUANT": "int8"})
+        try:
+            matrix = [CollType.ALLREDUCE, CollType.ALLGATHER,
+                      CollType.BCAST, CollType.BARRIER]
+            ok = []
+            for ct in matrix:
+                argses = [make_args(ct, r, n, count, DataType.FLOAT32,
+                                    ReductionOp.SUM, MemoryType.TPU,
+                                    False, 0, False, None)
+                          for r in range(n)]
+                reqs = [job.teams[r].collective_init(argses[r])
+                        for r in range(n)]
+                if ct == CollType.ALLREDUCE:
+                    rec["pinned_dispatch_alg"] = \
+                        getattr(reqs[0].task, "alg", "?")
+                for rq in reqs:
+                    rq.post()
+                if job.wait(reqs, timeout=60):
+                    ok.append(coll_type_str(ct))
+                for rq in reqs:
+                    try:
+                        rq.finalize()
+                    except Exception:  # noqa: BLE001 - smoke cleanup
+                        pass
+            rec["matrix"] = ok
+            cands = sweep_candidates(job.teams[0], CollType.ALLREDUCE,
+                                     MemoryType.TPU, count * 4)
+            rec["pinned_alg"] = cands[0].alg_name if cands else "?"
+            rec["pinned_origin"] = cands[0].origin if cands else "?"
+            rec["pinned_engaged"] = bool(cands) and \
+                cands[0].alg_name == pin and \
+                rec.get("pinned_dispatch_alg") == pin
+        finally:
+            job.destroy()
+
+        # bitwise: device backend vs the host interpreter on the SAME
+        # verified IR and inputs
+        rng = np.random.default_rng(17)
+        srcs = [(rng.standard_normal(count) * 3).astype(np.float32)
+                for _ in range(n)]
+        dev_job = _Job(n, {"GEN_DEVICE": "y", "TUNER": "off"})
+        try:
+            d_dev, dev_alg = _allreduce_digest(dev_job, n, count,
+                                               MemoryType.TPU, srcs)
+        finally:
+            dev_job.destroy()
+        os.environ.pop("UCC_TL_XLA_TUNE", None)
+        os.environ["UCC_TL_SHM_TUNE"] = f"allreduce:@{ring.name}:inf"
+        host_job = _Job(n, {"GEN": "y", "TUNER": "off"})
+        try:
+            d_host, host_alg = _allreduce_digest(host_job, n, count,
+                                                 MemoryType.HOST, srcs)
+        finally:
+            host_job.destroy()
+        rec["device_digest"] = d_dev
+        rec["device_digest_alg"] = dev_alg
+        rec["host_digest"] = d_host
+        rec["host_digest_alg"] = host_alg
+        # a timed-out side yields None (two Nones must not pass), and
+        # BOTH sides must actually have run the verified IR — a
+        # fallback to the monolithic lax program would produce the
+        # right sum while exercising nothing this gate exists for
+        rec["bitwise_identical"] = bool(d_dev) and d_dev == d_host \
+            and dev_alg == pin and host_alg == ring.name
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return rec
+
+
+def run_device_bench(n: int = 8, sizes: Optional[List[int]] = None,
+                     iters: int = 12) -> dict:
+    """BENCH_r15 driver (``python -m ucc_tpu.dsl.smoke
+    --device-bench``): sweep every TPU-memtype allreduce candidate —
+    monolithic lax programs AND the generated-device variants — on the
+    virtual mesh through the tuner sweep engine, and report the
+    per-cell winners (the acceptance criterion: a generated-device
+    variant wins at least one cell)."""
+    from ucc_tpu.constants import MemoryType
+    from ucc_tpu.tools.tune import _Job, run_sweep
+
+    sizes = sizes or [1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22]
+    rec: dict = {"metric": "devgen_bench", "ranks": n,
+                 "sizes": sizes, "iters": iters}
+    job = _Job(n, {"GEN_DEVICE": "y", "TUNER": "off"})
+    try:
+        records = run_sweep(job, ["allreduce"], sizes, iters, 3,
+                            mem=MemoryType.TPU, verbose=False)
+    finally:
+        job.destroy()
+    rec["rows"] = len(records)
+    cells = {}
+    for r in records:
+        key = r["size_bytes"]
+        cur = cells.get(key)
+        if cur is None or r["p50_us"] < cur["p50_us"]:
+            cells[key] = r
+    rec["cells"] = [{
+        "size_bytes": k, "winner": v["alg"], "gen": v.get("gen", ""),
+        "p50_us": v["p50_us"],
+        "runner_up": sorted(
+            ({"alg": r["alg"], "p50_us": r["p50_us"]}
+             for r in records if r["size_bytes"] == k
+             and r["alg"] != v["alg"]),
+            key=lambda d: d["p50_us"])[:3],
+    } for k, v in sorted(cells.items())]
+    rec["gen_device_cells"] = [c["size_bytes"] for c in rec["cells"]
+                               if c["winner"].startswith("gen_dev_")]
+    rec["records"] = records
+    return rec
+
+
 def run_search_smoke(n: int = 4, size: int = 65536,
                      budget: int = 6) -> dict:
     """UCC_GATE_SEARCH probe (metric ``search_gate_smoke``): fit the
@@ -403,7 +602,10 @@ def _run_search_smoke_body(rec: dict, n: int, size: int, budget: int,
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     from ucc_tpu.utils.jaxshim import ensure_live_backend
-    ensure_live_backend(virtual_cpu_devices=4)
+    ndev = 4
+    if argv and argv[0] == "--device-bench":
+        ndev = max(int(argv[1]) if len(argv) > 1 else 8, 4)
+    ensure_live_backend(virtual_cpu_devices=ndev)
     if argv and argv[0] == "--search":
         try:
             rec = run_search_smoke()
@@ -419,6 +621,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         except Exception as e:  # noqa: BLE001 - caller reads the record
             out = {"error": f"{type(e).__name__}: {e}"}
         print(json.dumps(out), flush=True)
+        return 0
+    if argv and argv[0] == "--device":
+        try:
+            rec = run_device_smoke()
+        except Exception as e:  # noqa: BLE001 - the gate wants a record
+            rec = {"metric": "devgen_gate_smoke",
+                   "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(rec), flush=True)
+        return 0
+    if argv and argv[0] == "--device-bench":
+        n = int(argv[1]) if len(argv) > 1 else 8
+        try:
+            rec = run_device_bench(n)
+        except Exception as e:  # noqa: BLE001 - caller reads the record
+            rec = {"metric": "devgen_bench",
+                   "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(rec), flush=True)
         return 0
     if argv and argv[0] == "--plans":
         try:

@@ -112,8 +112,15 @@ EV_START = "start"
 EV_COMPLETE = "cmpl"
 EV_CANCEL = "cancel"
 EV_FENCE = "fence"
-# wire-ring kind codes (send transitions, transport.py _count_send)
-WIRE_KINDS = ("direct", "eager", "rndv", "fenced")
+# wire-ring kind codes (send transitions, transport.py _count_send,
+# plus the device-collective lifecycle pair: "dev_launch" = the
+# rendezvous dispatched the compiled program, "dev_ready" = device
+# completion observed — XLA/ring_dma collectives previously had no
+# wire-round visibility, so ucc_fr could not attribute device-side
+# stragglers; the per-rank launch timestamps share a (team, tag, slot)
+# key across ranks, which is exactly what the wire-lag signal joins on)
+WIRE_KINDS = ("direct", "eager", "rndv", "fenced", "dev_launch",
+              "dev_ready")
 
 
 def _pow2(n: int) -> int:
@@ -280,9 +287,11 @@ class WireRing:
         for j in range(n):
             i = (first + j) & self.mask
             tag = self.tag[i]
+            k = self.kind[i]
             out.append({
                 "t": self.ts[i], "ev": "snd",
-                "kind": WIRE_KINDS[self.kind[i] & 3],
+                "kind": WIRE_KINDS[k] if 0 <= k < len(WIRE_KINDS)
+                else "?",
                 "tkey": _keystr(objs.obj(self.tkey[i])),
                 "epoch": self.epoch[i],
                 "tag": tag if tag >= 0 else str(objs.obj(-tag - 1)),

@@ -61,10 +61,16 @@ SEED_LINKS: Dict[str, Tuple[float, float]] = {
     "shm": (2.0, 4.0e-4),
     "socket": (60.0, 1.2e-3),
     "dcn": (250.0, 8.0e-3),
+    # inter-chip ICI (the device-side compiler backend's fabric,
+    # ISSUE 15): ~1us kernel-step latency, ~50 GB/s per link — so
+    # `ucc_tune --gen-search` can price DEVICE programs (ring vs direct
+    # exchange trade latency against per-hop bytes on-chip exactly like
+    # host programs do on sockets)
+    "ici": (1.0, 2.0e-5),
 }
 
 #: slowest-first ordering for "which link bounds this round's latency"
-_LINK_RANK = {"dcn": 2, "socket": 1, "shm": 0}
+_LINK_RANK = {"dcn": 3, "socket": 2, "shm": 1, "ici": 0}
 
 
 @dataclass
@@ -186,6 +192,12 @@ class CostModel:
 # ---------------------------------------------------------------------------
 # topology -> link classification
 # ---------------------------------------------------------------------------
+
+def link_of_device() -> Callable[[int, int], str]:
+    """Edge classifier for DEVICE-lowered programs: every edge is an
+    inter-chip ICI hop (rank == chip on the xla/ring_dma team model)."""
+    return lambda a, b: "ici"
+
 
 def link_of_paths(paths) -> Callable[[int, int], str]:
     """Edge classifier from per-rank topology attribute paths (the

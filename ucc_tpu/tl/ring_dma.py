@@ -39,6 +39,16 @@ Kernels run compiled on real TPU meshes and in Pallas interpret mode on
 the virtual CPU mesh (tests); the rendezvous/dispatch machinery is shared
 with TL/XLA (same team model: rank == chip, deposits launch a shard_map
 program over the team mesh).
+
+This module's primitive set is also the substrate of the DEVICE-SIDE
+COMPILER BACKEND (``dsl/lower_device.py``, ISSUE 15): generated
+collectives lowered from verified DSL programs reuse
+``_make_step_dma`` (the 2-slot parity protocol + consumer-ack
+throttle), ``_neighbor_barrier``/``_all_rank_barrier``, ``_guarded``,
+``_accum``, ``_compiler_params`` and ``_warn_no_barrier`` — treat
+their signatures/semantics as shared API (collective_id 10 belongs to
+the generated kernels; see the id registry note at
+build_hbm_alltoall_program).
 """
 from __future__ import annotations
 

@@ -61,6 +61,12 @@ TL_XLA_CONFIG = register_table(ConfigTable(
                     "short-protocol analog). 'auto' = 128K on the CPU "
                     "platform, 4K on accelerators; 0 disables",
                     parse_string),
+        ConfigField("LAUNCH_CACHE_MAX", "64", "max cached persistent-"
+                    "launch entries and AOT-compiled executables per "
+                    "team (oldest evicted first); entries were "
+                    "previously never evicted, so long-lived multi-"
+                    "shape teams leaked compiled programs",
+                    parse_string),
     ]))
 
 
@@ -166,11 +172,17 @@ _SHARED_LOCK = threading.Lock()
 
 
 class XlaTeamShared:
-    def __init__(self, key, mesh, devices, n_local: int):
+    def __init__(self, key, mesh, devices, n_local: int,
+                 cache_max: int = 64):
         self.key = key
         self.mesh = mesh
         self.devices = devices          # team rank -> jax.Device
         self.n_local = n_local
+        #: per-team bound on launch_cache/aot_programs entries — both
+        #: pin compiled executables AND device-resident input arrays,
+        #: and were never evicted (ISSUE 15 satellite): a long-lived
+        #: team posting many tag/shape combinations leaked them all
+        self.cache_max = max(1, int(cache_max))
         self.lock = threading.Lock()
         self.programs: Dict[Any, Any] = {}
         #: tag -> {team_rank: (shard_np_or_jax, task)}
@@ -203,6 +215,23 @@ class XlaTeamShared:
             self.refcount -= 1
             if self.refcount <= 0:
                 _SHARED.pop(self.key, None)
+                # drop every cached executable + pinned device array at
+                # team destroy (the shared object may itself be kept
+                # alive by straggling task references)
+                self.launch_cache.clear()
+                self.aot_programs.clear()
+                self.programs.clear()
+                self.pending.clear()
+
+    def _cache_insert(self, cache: Dict, key, value) -> None:
+        """Bounded insert: evict oldest-inserted entries beyond
+        cache_max (dict preserves insertion order). Replacing an
+        existing key (persistent re-post with rebound buffers) must
+        not evict an unrelated entry."""
+        if key not in cache:
+            while len(cache) >= self.cache_max:
+                cache.pop(next(iter(cache)))
+        cache[key] = value
 
     # ------------------------------------------------------------------
     def deposit(self, tag, team_rank: int, shard, task: "XlaCollTask") -> None:
@@ -249,6 +278,11 @@ class XlaTeamShared:
                 # install), so the round pays one addressable_shards walk
                 # and no device->shard dict
                 _, garr, program, perm = cached
+                # LRU refresh: hot persistent tags must outlive a churn
+                # of short-lived ones under the cache_max bound (FIFO
+                # would evict exactly the entries doing the work)
+                self.launch_cache[proto.tag] = \
+                    self.launch_cache.pop(proto.tag)
                 out = program(garr)
                 if perm is None:
                     by_dev = {s.device: s.data
@@ -287,7 +321,8 @@ class XlaTeamShared:
                         launch_prog = program.lower(garr).compile()
                     except Exception:  # noqa: BLE001 - keep jit dispatch
                         launch_prog = program
-                    self.aot_programs[id(program)] = launch_prog
+                    self._cache_insert(self.aot_programs, id(program),
+                                       launch_prog)
                 # rank-position -> output-shard-index permutation for the
                 # cached re-post path (shard order is a property of the
                 # output sharding, stable across launches)
@@ -297,8 +332,8 @@ class XlaTeamShared:
                             for rank in sorted(slot)]
                 except ValueError:   # replicated/odd out sharding
                     perm = None
-                self.launch_cache[proto.tag] = (bufs, garr, launch_prog,
-                                                perm)
+                self._cache_insert(self.launch_cache, proto.tag,
+                                   (bufs, garr, launch_prog, perm))
             by_dev = {s.device: s.data for s in out.addressable_shards}
             for rank, (_, task) in slot.items():
                 task.set_result(out, by_dev)
@@ -697,10 +732,44 @@ class XlaCollTask(CollTask):
             raise UccError(Status.ERR_NOT_SUPPORTED,
                            "tl/xla scatter requires count % team_size == 0 "
                            "(use scatterv for uneven blocks)")
+        # flight recorder (PR-9 binding pattern: resolve once at init,
+        # one None-check per event when enabled, zero cost when off):
+        # device collectives previously emitted no wire-round events,
+        # so ucc_fr could not attribute device-side stragglers
+        self._flight = None
+        self._flight_nbytes = int(getattr(init_args, "msgsize", 0) or 0)
+        from ..obs import flight as _flight_mod
+        if _flight_mod.ENABLED:
+            self._flight = getattr(team.core_team.context, "flight",
+                                   None)
         # tag allocation LAST: a validation error above must not consume a
         # team tag, or this rank's tag sequence desyncs from its peers and
         # every later rendezvous deposits into mismatched slots
         self.tag = team.next_coll_tag()
+
+    def _flight_dev(self, kind: str, slot: int) -> None:
+        """One device-lifecycle wire event: ``dev_launch`` (slot 0, the
+        rendezvous dispatched the compiled program on this rank's view)
+        or ``dev_ready`` (slot 1, result delivery: for host-staged
+        destinations this marks OBSERVED device completion — the
+        progress loop polled readiness; for device-memory destinations
+        it marks the async result binding, which is stream-ordered
+        with the launch). The (team_key, tag, slot) key is shared
+        across ranks, so the flight diagnosis wire-lag signal joins
+        launches rank-to-rank exactly like host wire rounds.
+
+        Threading: dev_launch fires from set_result, which the LAST-
+        depositing rank's thread runs for every local task — so in
+        THREAD_MULTIPLE this ring sees a second producer alongside the
+        owner's transport events. That rides the flight recorder's
+        documented lossy-MT trade (a concurrent append may tear or
+        skip one slot); the rings are fixed-depth diagnostics, never a
+        correctness surface."""
+        fr = self._flight
+        if fr is None:
+            return
+        fr.wire.append(kind, (self.tl_team.team_key, 0, self.tag, slot,
+                              self.tl_team.rank), self._flight_nbytes)
 
     # -- launch plumbing -------------------------------------------------
     def local_src(self):
@@ -959,6 +1028,7 @@ class XlaCollTask(CollTask):
         self.result_array = None
 
     def set_result(self, out, by_dev=None, shard=None) -> None:
+        self._flight_dev("dev_launch", 0)
         self._out = out
         # per-launch device->shard map, computed once for all local tasks
         # (addressable_shards builds Shard objects per call — O(n) each);
@@ -975,6 +1045,10 @@ class XlaCollTask(CollTask):
             dst = self._fast_bind
             dst.buffer = shard
             self.result_array = shard
+            # the slim re-bind IS this round's result delivery: emit
+            # the dev_ready pair here too, or steady-state persistent
+            # collectives would log N launches against one ready
+            self._flight_dev("dev_ready", 1)
             self.status = Status.OK
             if self._fast_round:
                 self._fast_round = False
@@ -1051,6 +1125,7 @@ class XlaCollTask(CollTask):
         return shards[0].data
 
     def _copy_out(self) -> None:
+        self._flight_dev("dev_ready", 1)
         args = self.args
         coll = self.coll
         me = self.tl_team.rank
@@ -1267,8 +1342,13 @@ class TlXlaTeam(TlTeamBase):
         mesh = Mesh(np.array(devices), ("r",))
         n_local = sum(1 for gr in range(self.size)
                       if ctx_map.eval(gr) in _local_ctx_ranks(core_team))
+        try:
+            cache_max = int(getattr(ctx.config, "launch_cache_max", 64))
+        except (TypeError, ValueError):
+            cache_max = 64
         self.shared = XlaTeamShared.get_or_create(
-            key, lambda: XlaTeamShared(key, mesh, devices, n_local))
+            key, lambda: XlaTeamShared(key, mesh, devices, n_local,
+                                       cache_max))
 
     def next_coll_tag(self) -> int:
         self._coll_tag += 1
@@ -1324,6 +1404,14 @@ class TlXlaTeam(TlTeamBase):
             table[CollType.ALLGATHER].append(
                 spec(1, f"q{q_ag}", alg=f"q{q_ag}", precision=q_ag,
                      select=f"0-inf:{TlXla.DEFAULT_SCORE - 2}"))
+        # generated-device candidates (ucc_tpu/dsl/lower_device): a
+        # verified DSL program lowered to a Pallas/XLA collective —
+        # behind UCC_GEN_DEVICE (default off: candidate lists stay
+        # byte-identical), low default score, origin "generated-device"
+        # with the gen param string in every provenance surface
+        from ..dsl.lower_device import generated_device_alg_specs
+        for ct, specs in generated_device_alg_specs(self).items():
+            table.setdefault(ct, []).extend(specs)
         thr = self._short_msg_max()
         if thr > 0 and all_local and shared is not None:
             # latency algorithm for short messages: host-staged eager

@@ -705,6 +705,17 @@ def main(argv=None) -> int:
                         "--sweep, generated candidates are swept and "
                         "emitted in the same measurement-record format "
                         "(rows carry their gen family/parameter string)")
+    p.add_argument("--gen-device", nargs="?", const="all", default="",
+                   metavar="FAMILIES",
+                   help="register GENERATED-DEVICE candidates "
+                        "(ucc_tpu/dsl/lower_device) for this run: sets "
+                        "UCC_GEN_DEVICE=y before lib creation; an "
+                        "optional value restricts the device family "
+                        "grids (UCC_GEN_DEVICE_FAMILIES syntax). With "
+                        "--sweep -m tpu, gen_dev_* candidates are "
+                        "swept alongside the monolithic lax programs "
+                        "and their rows carry the gen param string + "
+                        "origin provenance")
     p.add_argument("-p", "--nprocs", type=int, default=0,
                    help="in-process ranks (default: one per device for tpu "
                         "mem, else 4)")
@@ -769,6 +780,16 @@ def main(argv=None) -> int:
             _os.environ["UCC_GEN_FAMILIES"] = args.gen
         if args.store:
             raise SystemExit("perftest: --gen requires in-process mode")
+
+    if args.gen_device:
+        # same register-before-lib-create contract as --gen/--quant
+        import os as _os
+        _os.environ["UCC_GEN_DEVICE"] = "y"
+        if args.gen_device != "all":
+            _os.environ["UCC_GEN_DEVICE_FAMILIES"] = args.gen_device
+        if args.store:
+            raise SystemExit("perftest: --gen-device requires "
+                             "in-process mode")
 
     global _TRAFFIC_MATRIX
     coll = COLLS[args.coll]

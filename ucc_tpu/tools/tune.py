@@ -289,6 +289,17 @@ def main(argv=None) -> int:
                         "provenance")
     p.add_argument("--search-budget", type=int, default=0,
                    help="override UCC_GEN_SEARCH_BUDGET for --gen-search")
+    p.add_argument("--device", action="store_true",
+                   help="with --gen-search: search DEVICE programs "
+                        "(ucc_tpu/dsl/lower_device) instead of host "
+                        "ones — the device-lowerable space priced over "
+                        "the ICI link class, the predicted-cheapest "
+                        "shortlist registered on a TPU-memtype xla "
+                        "team (UCC_GEN_DEVICE_FAMILIES), refined by "
+                        "successive halving against the monolithic lax "
+                        "candidates; winning generated-device "
+                        "selections land in the tuning cache with "
+                        "mem 'tpu' and origin 'searched'")
     args = p.parse_args(argv)
 
     if args.quant:
@@ -318,7 +329,7 @@ def main(argv=None) -> int:
     if args.gen_search:
         import json as _json
 
-        from ucc_tpu.dsl.search import run_search
+        from ucc_tpu.dsl.search import run_device_search, run_search
         from ucc_tpu.score import cost as _cost
         sizes = []
         size = max(parse_memunits(args.begin), 4)
@@ -332,12 +343,28 @@ def main(argv=None) -> int:
                 records = [_json.loads(ln) for ln in fh
                            if ln.strip().startswith("{")]
             model = _cost.fit_records(
-                [r for r in records if r.get("gen")])
+                [r for r in records if r.get("gen")],
+                link="ici" if args.device else "shm")
             if model is not None:
                 _cost.save_model(model)
                 print(f"# cost model fitted from {args.from_file}: "
                       f"{model.source}")
-        rep = run_search(
+        def print_report(rep, label):
+            for res in rep.get("results") or []:
+                for f in res.get("finalists") or []:
+                    print(f"#   {res['coll']:>10} "
+                          f"{memunits_str(res['size_bytes']):>8} "
+                          f"{f['alg']:<24} measured "
+                          f"{f['measured_us']}us"
+                          + (f" predicted {f['predicted_us']}us"
+                             if f.get("predicted_us") is not None
+                             else ""))
+            print(f"# {label} winners: {rep.get('winners')} "
+                  f"({rep.get('tuner_entries', 0)} tuning-cache "
+                  f"entries -> {cache_path})")
+
+        search_fn = run_device_search if args.device else run_search
+        rep = search_fn(
             # iters is the FIRST successive-halving rung; rungs double,
             # so the finalists' confirmation lands near the user's -n
             args.nprocs, colls, sizes, iters=max(3, args.iters // 4),
@@ -345,16 +372,7 @@ def main(argv=None) -> int:
             quant_mode=os.environ.get("UCC_QUANT", "")
             if args.quant else "",
             tuner_cache=cache_path, model=model, verbose=True)
-        for res in rep.get("results") or []:
-            for f in res.get("finalists") or []:
-                print(f"#   {res['coll']:>10} "
-                      f"{memunits_str(res['size_bytes']):>8} "
-                      f"{f['alg']:<24} measured {f['measured_us']}us"
-                      + (f" predicted {f['predicted_us']}us"
-                         if f.get("predicted_us") is not None else ""))
-        print(f"# search winners: {rep.get('winners')} "
-              f"({rep.get('tuner_entries', 0)} tuning-cache entries -> "
-              f"{cache_path})")
+        print_report(rep, "device-search" if args.device else "search")
         return 0
 
     if args.from_file:
