@@ -714,3 +714,103 @@ class TestGenDeviceRealChip:
         out = np.asarray(jax.block_until_ready(program(garr)))
         if prog.coll == CollType.ALLREDUCE:
             np.testing.assert_allclose(out[:count], float(n))
+
+
+# ---------------------------------------------------------------------------
+# device-side stragglers feed the continuous scorer (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+class TestDeviceStragglerScoring:
+    """dev_launch/dev_ready wire events share a (team, tag, slot) key
+    across ranks, so the wire-lag straggler signal — and therefore the
+    continuous collector's incremental StragglerScorer — attributes a
+    slow device rank even though XLA collectives post no host wire
+    rounds at all."""
+
+    @staticmethod
+    def _dev_window(n=4, lag_rank=1, lag_s=0.08, n_colls=3):
+        """One synthetic merged window: every rank launches the same
+        device collectives; *lag_rank*'s launches trail by *lag_s*."""
+        ranks = {}
+        for r in range(n):
+            off = lag_s if r == lag_rank else 0.0
+            wire = []
+            for c in range(n_colls):
+                t0 = 1.0 + 0.5 * c + off
+                wire.append({"t": t0, "ev": "snd", "kind": "dev_launch",
+                             "tkey": "xteam", "epoch": 0, "tag": 100 + c,
+                             "slot": 0, "nbytes": 4096})
+                wire.append({"t": t0 + 0.01, "ev": "snd",
+                             "kind": "dev_ready", "tkey": "xteam",
+                             "epoch": 0, "tag": 100 + c, "slot": 1,
+                             "nbytes": 4096})
+            ranks[r] = {"events": [], "wire": wire}
+        return {"ranks": {str(r): v for r, v in ranks.items()},
+                "absent_ranks": []}
+
+    def test_wire_lag_names_slow_device_rank(self):
+        from ucc_tpu.obs import diagnose
+        findings = diagnose.detect_stragglers(self._dev_window())
+        lag_f = [f for f in findings if f["signal"] == "wire_lag"]
+        assert lag_f and lag_f[0]["rank"] == 1
+        assert lag_f[0]["lag_s"] == pytest.approx(0.08, abs=0.02)
+
+    def test_scorer_flags_persistently_slow_device_rank(self):
+        from ucc_tpu.obs import diagnose
+        sc = diagnose.StragglerScorer(decay=0.5, flag_on=0.7,
+                                      flag_off=0.2, windows=2)
+        flagged = frozenset()
+        for _ in range(4):
+            flagged = sc.step(self._dev_window())
+        assert flagged == frozenset({1})
+        # symmetric launches never flag
+        sc2 = diagnose.StragglerScorer(windows=2)
+        for _ in range(4):
+            assert sc2.step(self._dev_window(lag_s=0.0)) == frozenset()
+
+    def test_live_dev_events_flow_into_scorer(self):
+        """A real generated-device allreduce leaves dev_launch/dev_ready
+        wire events that survive cross-rank merge and feed the scorer
+        without tripping it on a healthy run. Own job: the shared module
+        teams carry abandoned-init tag skew from the fallback tests."""
+        from ucc_tpu.obs import diagnose, flight
+        if not flight.ENABLED:
+            pytest.skip("flight recorder disabled")
+        if len(jax.devices()) < N:
+            pytest.skip("needs >= 4 virtual devices")
+        had = os.environ.get("UCC_GEN_DEVICE")
+        os.environ["UCC_GEN_DEVICE"] = "y"
+        j = UccJob(N)
+        try:
+            tms = j.create_team()
+            count = 96
+            srcs = [np.ones(count, np.float32) * (r + 1)
+                    for r in range(N)]
+
+            def mk(r):
+                return CollArgs(coll_type=CollType.ALLREDUCE,
+                                src=dev_buf(j, r, srcs[r],
+                                            DataType.FLOAT32),
+                                dst=BufferInfo(None, count,
+                                               DataType.FLOAT32,
+                                               mem_type=MemoryType.TPU),
+                                op=ReductionOp.SUM)
+            reqs, _ = run_forced(j, tms, "gen_dev_ring_c1", mk)
+            for rq in reqs:
+                rq.finalize()
+            merged = flight.collect_process(j.contexts[0], "test")
+        finally:
+            j.cleanup()
+            if had is None:
+                os.environ.pop("UCC_GEN_DEVICE", None)
+            else:
+                os.environ["UCC_GEN_DEVICE"] = had
+        kinds = {w.get("kind")
+                 for snap in merged["ranks"].values()
+                 for w in snap.get("wire", ())}
+        assert "dev_launch" in kinds and "dev_ready" in kinds
+        sc = diagnose.StragglerScorer(windows=2)
+        # first window can never flag (streak < windows); the call must
+        # digest device-kind wire events without raising
+        assert sc.step(merged) == frozenset()
+        assert sc.windows_seen == 1

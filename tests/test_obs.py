@@ -800,3 +800,361 @@ class TestWatchdogFlightFoldIn:
             assert "mailbox_posted_recvs" in snap["gauges"]
         finally:
             job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# continuous telemetry pipeline (ISSUE 16): scorer, RankBias, trace
+# store, bootstrap spans, mid-collection death, end-to-end feedback
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def collector_knobs():
+    """Snapshot + restore the collector module knobs around a test."""
+    from ucc_tpu.obs import collector
+    names = ("enabled", "interval", "sample", "dir", "segment_bytes",
+             "segments", "bias", "decay", "flag_on", "flag_off",
+             "windows", "penalty", "slack", "slow_mult")
+    prev = {n: getattr(collector.KNOBS, n) for n in names}
+    yield collector
+    collector.configure(**prev)
+
+
+class TestStragglerScorer:
+    def _scorer(self, **kw):
+        kw.setdefault("decay", 0.5)
+        kw.setdefault("flag_on", 0.7)
+        kw.setdefault("flag_off", 0.2)
+        kw.setdefault("windows", 2)
+        return diagnose.StragglerScorer(**kw)
+
+    def test_one_window_spike_never_flags(self):
+        sc = self._scorer()
+        assert sc.update({1: 1.0}, ranks=range(4)) == frozenset()
+        # the spike decays, streak resets on the clean window
+        assert sc.update({2: 1.0}, ranks=range(4)) == frozenset()
+
+    def test_streak_plus_threshold_flags(self):
+        sc = self._scorer()
+        flagged = frozenset()
+        for _ in range(4):
+            flagged = sc.update({1: 1.0}, ranks=range(4))
+        assert flagged == frozenset({1})
+        assert sc.scores[1] >= sc.flag_on
+
+    def test_hysteresis_band_unflags_low(self):
+        sc = self._scorer()
+        for _ in range(4):
+            sc.update({1: 1.0}, ranks=range(4))
+        assert 1 in sc.flagged
+        # a few clean-but-informative windows: still flagged while the
+        # score sits inside the hysteresis band
+        sc.update({2: 0.4}, ranks=range(4))
+        assert 1 in sc.flagged
+        flagged = None
+        for _ in range(8):
+            flagged = sc.update({2: 0.4}, ranks=range(4))
+        assert 1 not in flagged
+        assert sc.scores[1] <= sc.flag_off
+
+    def test_uninformative_windows_keep_streaks(self):
+        """REGRESSION: a straggler on a team that posts slower than the
+        collection cadence sees severity only every OTHER window. Empty
+        windows must decay at quarter weight and keep streaks, or the
+        score oscillates forever just under flag_on (the 2/3 fixed
+        point) and the rank never flags."""
+        sc = self._scorer()
+        flagged = frozenset()
+        for _ in range(8):
+            flagged = sc.update({1: 1.0}, ranks=range(4))
+            if 1 in flagged:
+                break
+            flagged = sc.update({}, ranks=range(4))   # sampled-out
+            if 1 in flagged:
+                break
+        assert 1 in flagged
+
+    def test_uninformative_window_decays_into_unflag(self):
+        sc = self._scorer()
+        for _ in range(4):
+            sc.update({1: 1.0}, ranks=range(4))
+        assert 1 in sc.flagged
+        for _ in range(40):
+            sc.update({}, ranks=range(4))
+        assert 1 not in sc.flagged
+
+
+class TestRankBias:
+    def _bias(self):
+        from ucc_tpu.obs.collector import RankBias
+        return RankBias(penalty=4096, slow_mult=4.0)
+
+    def test_staged_promotion_is_deterministic(self):
+        b = self._bias()
+        b.publish({1}, {1: 0.9}, window=0, apply_at=10)
+        assert b.flagged == frozenset()        # staged, not applied
+        b.tick(9)
+        assert b.flagged == frozenset()
+        b.tick(10)
+        assert b.flagged == frozenset({1})
+        assert b.first_flag_window == 0
+
+    def test_republish_same_set_keeps_apply_at(self):
+        """REGRESSION: re-publishing the same flagged set every window
+        must NOT push apply_at forward, or a team posting fewer than
+        `slack` collectives per window never reaches the switch index
+        and the table never takes effect."""
+        b = self._bias()
+        b.publish({1}, {1: 0.8}, window=0, apply_at=10)
+        b.publish({1}, {1: 0.9}, window=1, apply_at=50)
+        b.publish({1}, {1: 0.95}, window=2, apply_at=90)
+        b.tick(10)
+        assert b.flagged == frozenset({1})
+        assert b.scores[1] == pytest.approx(0.95)  # freshest scores won
+        assert b.window == 2
+
+    def test_changed_set_restages(self):
+        b = self._bias()
+        b.publish({1}, {1: 0.9}, window=0, apply_at=10)
+        b.tick(10)
+        b.publish({1, 2}, {1: 0.9, 2: 0.8}, window=3, apply_at=20)
+        assert b.flagged == frozenset({1})      # old table until switch
+        b.tick(20)
+        assert b.flagged == frozenset({1, 2})
+
+    def test_scores_fold_in_place_when_set_unchanged(self):
+        b = self._bias()
+        b.publish({1}, {1: 0.9}, window=0, apply_at=5)
+        b.tick(5)
+        b.publish({1}, {1: 0.72}, window=4, apply_at=99)
+        # same applied set: no re-staging, fresh scores visible now
+        assert b._pending is None
+        assert b.flagged == frozenset({1})
+        assert b.scores[1] == pytest.approx(0.72)
+
+    def test_reorder_demotes_ring_family_only(self):
+        class C:
+            def __init__(self, alg, score, gen=""):
+                self.alg_name, self.score, self.gen = alg, score, gen
+        b = self._bias()
+        b.publish({2}, {2: 0.9}, window=0, apply_at=0)
+        b.tick(0)
+        cands = [C("ring", 100), C("knomial", 90), C("sra_knomial", 80),
+                 C("dbt", 10)]
+        out = [c.alg_name for c in b.reorder(cands)]
+        # every non-ring candidate outranks every penalized one,
+        # original score order preserved within each tier
+        assert out == ["knomial", "dbt", "ring", "sra_knomial"]
+        # no flags -> identity
+        assert self._bias().reorder(cands) == cands
+
+    def test_user_forced_inf_outranks_feedback(self):
+        from ucc_tpu.score.score import SCORE_MAX
+
+        class C:
+            def __init__(self, alg, score):
+                self.alg_name, self.score, self.gen = alg, score, ""
+        b = self._bias()
+        b.publish({0}, {0: 0.9}, window=0, apply_at=0)
+        b.tick(0)
+        out = b.reorder([C("ring", SCORE_MAX), C("knomial", 50)])
+        assert [c.alg_name for c in out] == ["ring", "knomial"]
+
+    def test_time_multiplier_and_slow_map(self):
+        b = self._bias()
+        b.publish({1, 3}, {1: 0.9, 3: 0.8}, window=0, apply_at=0)
+        b.tick(0)
+        assert b.time_multiplier("ring") == pytest.approx(7.0)
+        assert b.time_multiplier("knomial") == 1.0
+        assert b.slow_map() == {1: 4.0, 3: 4.0}
+
+    def test_is_ring_family_tokens(self):
+        from ucc_tpu.obs.collector import is_ring_family
+        assert is_ring_family("ring")
+        assert is_ring_family("sra_knomial")
+        assert is_ring_family("sliding_window")
+        assert is_ring_family("gen_dev_ring_c2", "ring(chunks=2)")
+        assert not is_ring_family("knomial")
+        assert not is_ring_family("dbt")
+
+
+class TestTraceStore:
+    def test_rotation_keeps_bounded_segments(self, tmp_path):
+        from ucc_tpu.obs.collector import TraceStore, load_dir_records
+        st = TraceStore(str(tmp_path), segment_bytes=200, max_segments=3)
+        for i in range(60):
+            st.append({"kind": "collect_summary", "i": i,
+                       "pad": "x" * 50})
+        segs = [n for n in tmp_path.iterdir() if n.suffix == ".jsonl"]
+        assert 0 < len(segs) <= 3
+        recs = load_dir_records(str(tmp_path))
+        # oldest segments were deleted; the freshest records survive
+        assert recs[-1]["i"] == 59
+        assert all(r["kind"] == "collect_summary" for r in recs)
+
+    def test_load_dir_tail_and_garbage(self, tmp_path):
+        from ucc_tpu.obs.collector import TraceStore, load_dir_records
+        st = TraceStore(str(tmp_path), segment_bytes=100, max_segments=8)
+        for i in range(20):
+            st.append({"i": i, "pad": "y" * 40})
+        (tmp_path / "fr-junk-000001.jsonl").write_text(
+            "not json\n{\"i\": 999}\n")
+        all_recs = load_dir_records(str(tmp_path))
+        assert any(r.get("i") == 999 for r in all_recs)   # salvages
+        tailed = load_dir_records(str(tmp_path), tail=1)
+        assert 0 < len(tailed) < len(all_recs)
+        assert load_dir_records(str(tmp_path / "nope")) == []
+
+
+class TestBootstrapSpans:
+    def test_context_and_team_spans_on_ring(self, capsys):
+        """Team/context lifecycle leaves completed bootstrap stage spans
+        on the flight ring, so `ucc_fr` can attribute team-create walls
+        per state instead of showing one opaque gap."""
+        job = UccJob(2)
+        try:
+            job.create_team()
+            spans = []
+            for r in range(2):
+                snap = job.contexts[r].flight.snapshot()
+                spans.extend(e for e in snap["events"]
+                             if e.get("coll") == "bootstrap")
+            assert spans
+            stages = {e.get("stage") for e in spans}
+            assert "boot:ctx_addr_exchange" in stages
+            # at least one team state-machine dwell span per rank
+            team_stages = {s for s in stages
+                           if s and s not in ("boot:ctx_addr_exchange",)}
+            assert team_stages, stages
+            assert all(e.get("dur_s") is not None and e["dur_s"] >= 0.0
+                       for e in spans)
+            # the report section renders them
+            from ucc_tpu.obs import flight as fl
+            from ucc_tpu.tools.fr import print_report
+            merged = fl.collect_process(job.contexts[0], "test")
+            print_report(merged, diagnose.diagnose(merged))
+            out = capsys.readouterr().out
+            assert "bootstrap spans" in out
+            assert "boot:ctx_addr_exchange" in out
+        finally:
+            job.cleanup()
+
+
+class TestMidCollectionDeath:
+    def test_fresh_death_evidence_returns_partial_promptly(self):
+        """REGRESSION: a rank dying AFTER the collection exchange
+        started must surface as fresh evidence in the wait loop — the
+        survivors return a partial dump naming it immediately instead
+        of degrading through the full collection deadline."""
+        from ucc_tpu.fault import inject as fault
+        n = 4
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            srcs = [np.full(8, r + 1.0) for r in range(n)]
+            dsts = [np.zeros(8) for _ in range(n)]
+            job.run_coll(teams, _allreduce_args(srcs, dsts, 8))
+            # survivors post the collection while rank 3 is still
+            # believed healthy (it is a member of the exchange)...
+            reqs = [flight.collect_team_post(teams[r], reason="middeath",
+                                             timeout=60.0)
+                    for r in range(3)]
+            # ...then rank 3 dies before ever serving its part: the
+            # kill is FRESH evidence the wait loop must fold in
+            fault.configure("kill=3", seed=0)
+            try:
+                t0 = time.monotonic()
+                deadline = t0 + 30.0
+                while not all(reqs[r].test() != Status.IN_PROGRESS
+                              for r in range(3)):
+                    for c in job.contexts[:3]:
+                        c.progress()
+                    assert time.monotonic() < deadline, \
+                        "mid-collection death was not folded in"
+                elapsed = time.monotonic() - t0
+            finally:
+                fault.reset()
+            # fresh evidence short-circuits: far below the 60s deadline
+            assert elapsed < 20.0
+            merged = reqs[0].result
+            assert merged.get("partial")
+            assert 3 in merged["absent_ranks"]
+            assert merged.get("mid_collection_dead") == [3]
+        finally:
+            job.cleanup()
+
+
+class TestCollectorPipeline:
+    def test_disabled_is_zero_cost_shape(self, collector_knobs):
+        collector_knobs.configure(enabled=False)
+        job = UccJob(2)
+        try:
+            teams = job.create_team()
+            assert job.contexts[0].collector is None
+            assert teams[0].rank_bias is None
+            srcs = [np.full(4, 1.0) for _ in range(2)]
+            dsts = [np.zeros(4) for _ in range(2)]
+            job.run_coll(teams, _allreduce_args(srcs, dsts, 4))
+        finally:
+            job.cleanup()
+
+    def test_unknown_knob_rejected(self, collector_knobs):
+        with pytest.raises(AttributeError):
+            collector_knobs.configure(intervall=5)
+
+    def test_closed_loop_flags_delayed_rank(self, collector_knobs,
+                                            tmp_path):
+        """End-to-end drill: continuous windows over the flight rings
+        flag a fault-delayed rank WITHOUT any manual dump trigger, the
+        published RankBias reaches the team, store records land on
+        disk, and bias-aware lookup demotes the ring family."""
+        from ucc_tpu.fault import inject as fault
+        from ucc_tpu.obs.collector import load_dir_records
+        from ucc_tpu import CollType, MemoryType
+        collector_knobs.configure(enabled=True, interval=0.25,
+                                  dir=str(tmp_path), slack=2, windows=2)
+        fault.configure("delay=1.0:0.12,delay_rank=1", seed=0)
+        n, count = 4, 256
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            assert job.contexts[0].collector is not None
+            assert teams[0].rank_bias is not None
+            srcs = [np.full(count, r + 1.0) for r in range(n)]
+            dsts = [np.zeros(count) for _ in range(n)]
+            flagged = frozenset()
+            for _ in range(60):
+                job.run_coll(teams, _allreduce_args(srcs, dsts, count))
+                flagged = teams[0].rank_bias.flagged
+                if flagged:
+                    break
+            assert 1 in flagged, \
+                f"delayed rank never flagged (got {set(flagged)})"
+            fault.reset()
+            # the applied table demotes the serialized families
+            nbytes = count * 8
+            plain = teams[0].score_map.lookup(CollType.ALLREDUCE,
+                                              MemoryType.HOST, nbytes)
+            biased = teams[0].score_map.lookup(
+                CollType.ALLREDUCE, MemoryType.HOST, nbytes,
+                bias=teams[0].rank_bias)
+            from ucc_tpu.obs.collector import is_ring_family
+            n_plain = len(plain)
+            first_ring_biased = next(
+                (i for i, c in enumerate(biased)
+                 if is_ring_family(c.alg_name or "")), n_plain)
+            last_clean_biased = max(
+                (i for i, c in enumerate(biased)
+                 if not is_ring_family(c.alg_name or "")), default=0)
+            assert first_ring_biased > last_clean_biased
+            # pod records reached the rolling store
+            recs = load_dir_records(str(tmp_path))
+            kinds = {r.get("kind") for r in recs}
+            assert "flight_merged" in kinds
+            assert "collect_summary" in kinds
+            sev_recs = [r for r in recs
+                        if r.get("kind") == "collect_summary"
+                        and r.get("sev")]
+            assert any("1" in r["sev"] for r in sev_recs)
+        finally:
+            fault.reset()
+            job.cleanup()

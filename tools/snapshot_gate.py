@@ -848,6 +848,79 @@ def _fr_smoke(env) -> None:
           flush=True)
 
 
+def _feedback_smoke(env) -> None:
+    """WARN-ONLY closed-loop telemetry probe (ISSUE 16 CI satellite):
+    `ucc_fr --feedback-smoke` runs an 8-rank job with a ring allreduce
+    pinned and UCC_FAULT=delay_rank on ONE rank while the continuous
+    collector (UCC_COLLECT) windows the rings. The collector must flag
+    the pinned rank within 2 collection windows WITHOUT any manual dump
+    trigger, the published RankBias must move selection off the
+    through-the-straggler ring, and post-feedback p99 must beat
+    pre-feedback. Skip with UCC_GATE_FEEDBACK=0."""
+    import json
+    if os.environ.get("UCC_GATE_FEEDBACK", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] feedback smoke: skipped (UCC_GATE_FEEDBACK=0)",
+              flush=True)
+        return
+    print("[gate] telemetry-feedback smoke (warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    # the drill arms its own fault/collector/TUNE knobs; strip the
+    # gate's instrumentation plus any ambient collector config so the
+    # probe measures the drill's configuration, not the caller's
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE",
+                                      "UCC_COLLECT", "UCC_RANK_BIAS",
+                                      "UCC_TL_SHM_TUNE"))}
+    smoke_env["UCC_FLIGHT"] = "y"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ucc_tpu.tools.fr",
+             "--feedback-smoke"],
+            cwd=REPO, env=smoke_env, capture_output=True, text=True,
+            timeout=600)
+    except subprocess.TimeoutExpired:
+        print("[gate] WARN: feedback smoke timed out (not a gate "
+              "failure)", flush=True)
+        return
+    rec = None
+    for ln in (r.stdout or "").splitlines():
+        if ln.startswith("{"):
+            try:
+                cand = json.loads(ln)
+            except ValueError:
+                continue
+            if cand.get("metric") == "feedback_smoke":
+                rec = cand
+    dt = time.monotonic() - t0
+    if rec is None or rec.get("error"):
+        why = (rec or {}).get("error") or f"rc={r.returncode}, no record"
+        print(f"[gate] WARN: feedback smoke — {why} in {dt:.0f}s "
+              f"(not a gate failure)", flush=True)
+        return
+    problems = []
+    if rec.get("pinned_rank") not in (rec.get("flagged") or []):
+        problems.append(f"collector flagged {rec.get('flagged')} but "
+                        f"not the pinned rank {rec.get('pinned_rank')}")
+    if not rec.get("windows_to_flag") or rec["windows_to_flag"] > 2:
+        problems.append(f"flag took {rec.get('windows_to_flag')} "
+                        f"windows (budget 2)")
+    if rec.get("post_alg") == rec.get("pre_alg"):
+        problems.append(f"selection stayed on {rec.get('pre_alg')} "
+                        f"after the flag")
+    if not rec.get("post_p99_ms") or not rec.get("pre_p99_ms") or \
+            rec["post_p99_ms"] >= rec["pre_p99_ms"]:
+        problems.append(f"post-feedback p99 {rec.get('post_p99_ms')}ms "
+                        f"did not beat pre {rec.get('pre_p99_ms')}ms")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] feedback smoke: flagged {rec.get('flagged')} in "
+          f"{rec.get('windows_to_flag')} window(s), selection "
+          f"{rec.get('pre_alg')} -> {rec.get('post_alg')}, p99 "
+          f"{rec.get('pre_p99_ms')}ms -> {rec.get('post_p99_ms')}ms "
+          f"in {dt:.0f}s -> {verdict}", flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -936,6 +1009,10 @@ def main(argv=None) -> int:
         # generated-device allreduce pinned, and matches the host
         # interpreter bitwise (ISSUE 15)
         _devgen_smoke(env)
+        # warn-only: continuous collector flags a fault-injected
+        # straggler within 2 windows, RankBias moves selection off the
+        # ring, and post-feedback p99 beats pre-feedback (ISSUE 16)
+        _feedback_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

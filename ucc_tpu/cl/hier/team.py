@@ -158,7 +158,26 @@ class ClHierTeam(BaseTeam):
                     cap = max(2, int(lv))
             except (KeyError, ValueError):
                 logger.warning("bad UCC_CL_HIER_LEVELS value; using auto")
-        self.tree = topo.hier_tree(cap)
+        # straggler-feedback leader demotion (obs/collector.py): CONTEXT
+        # ranks every member's collector flagged during the team's
+        # bootstrap exchange are pushed out of leader positions at every
+        # tree level — a flagged rank still participates in its level-0
+        # unit, it just stops being the rank the funnel/fanout chain
+        # serializes through. boot_flagged_ctx is the agreed UNION of
+        # per-member views (core/team.py ADDR_EXCHANGE), so the tree
+        # stays identical on every rank; on a shrink-rebuild the new
+        # team re-runs this with fresh evidence.
+        demote = set()
+        flagged_ctx = getattr(core_team, "boot_flagged_ctx", None)
+        if flagged_ctx:
+            demote = {tr for tr in range(core_team.size)
+                      if int(core_team.ctx_map.eval(tr)) in flagged_ctx}
+            if demote:
+                logger.info(
+                    "cl/hier team %s: demoting flagged rank(s) %s from "
+                    "leader positions", core_team.id,
+                    ",".join(str(r) for r in sorted(demote)))
+        self.tree = topo.hier_tree(cap, demote=demote)
         self.level_units: List[Optional[HierSbgp]] = []
         self._extra_units: List[HierSbgp] = []
         from ...topo.sbgp import Sbgp

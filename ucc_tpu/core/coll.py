@@ -542,7 +542,15 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
     init_args = InitArgs(args=args, team=team, mem_type=mem_type,
                          msgsize=msgsize)
     assert team.score_map is not None
-    candidates = team.score_map.lookup(ct, mem_type, msgsize)
+    bias = team.rank_bias
+    if bias is not None:
+        # promote any staged straggler-feedback table at its
+        # deterministic switch index: every rank ticks here in program
+        # order with an identical flight_seq sequence, so the flagged
+        # set (and the reordered candidate list below) changes on the
+        # same post everywhere — the tuner-switch divergence argument
+        bias.tick(team.flight_seq)
+    candidates = team.score_map.lookup(ct, mem_type, msgsize, bias=bias)
     task, chosen = team.score_map.init_coll(ct, mem_type, msgsize, init_args,
                                             candidates)
     # observability labels: metrics key the (collective, algorithm) pair

@@ -124,9 +124,19 @@ class Context:
                        for name, h in self.tl_contexts.items()},
             }
             self._packed_addr = pickle.dumps(payload)
+            import time as _time
+            t0 = _time.monotonic()
             req = oob.allgather(pickle.dumps(payload))
             peers = req.wait()
             req.free()
+            # bootstrap span: the blocking context address exchange is
+            # the other historically-opaque create-time wall (next to
+            # the team state machine) — recorded on the flight ring so
+            # `ucc_fr` attributes it
+            if self.flight is not None:
+                self.flight.complete(None, 0, -1, "bootstrap", "context",
+                                     "boot:ctx_addr_exchange",
+                                     _time.monotonic() - t0, "OK")
             self.addr_storage = [pickle.loads(p) for p in peers]
             self.topo = ContextTopo([a["proc"] for a in self.addr_storage])
             for name, h in self.tl_contexts.items():
@@ -145,6 +155,13 @@ class Context:
 
         for h in self.tl_contexts.values():
             h.obj.create_epilog()
+
+        # continuous telemetry collector (obs/collector.py,
+        # UCC_COLLECT — off by default): owns the window timer thread;
+        # its transport work runs from progress(). None when disabled —
+        # progress()/destroy() guard with one attribute check.
+        from ..obs import collector as _collector
+        self.collector = _collector.maybe_create(self)
 
         self._team_id_counter = 1
         self._mem_maps = {}
@@ -174,7 +191,13 @@ class Context:
 
     def progress(self) -> int:
         """ucc_context_progress (ucc_context.c:1062)."""
-        return self.progress_queue.progress()
+        n = self.progress_queue.progress()
+        col = self.collector
+        if col is not None:
+            # collection exchanges run HERE, single-threaded with the
+            # transport — the collector thread only marks windows due
+            col.step()
+        return n
 
     def create_team_post(self, params) -> "Any":
         from .team import Team
@@ -247,6 +270,8 @@ class Context:
     def destroy(self) -> Status:
         if self._destroyed:
             return Status.OK
+        if self.collector is not None:
+            self.collector.stop()
         for h in self.tl_contexts.values():
             h.obj.destroy()
         if self._mem_maps:

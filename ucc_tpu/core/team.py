@@ -75,6 +75,15 @@ class Team:
     #: activation when UCC_TUNER=online; None (class attr, zero cost)
     #: otherwise — core dispatch checks it once per collective INIT
     tuner = None
+    #: straggler-feedback table (obs/collector.RankBias), attached when
+    #: the continuous collector watches this team; None (class attr,
+    #: zero cost) otherwise — dispatch ticks + consults it per INIT
+    rank_bias = None
+    #: CONTEXT ranks flagged slow at team-create time (union of every
+    #: member's collector view, agreed over the ADDR_EXCHANGE round):
+    #: cl/hier demotes them from hier-tree leader positions. Class attr:
+    #: empty for ep_map/no-OOB teams, which skip the exchange.
+    boot_flagged_ctx = frozenset()
 
     def __init__(self, context: Context, params: Optional[TeamParams] = None):
         self.context = context
@@ -136,10 +145,21 @@ class Team:
     def state(self, new_state: "TeamState") -> None:
         now = time.monotonic()
         old = getattr(self, "_state", None)
-        if old is not None and old != new_state and metrics.ENABLED:
-            metrics.observe("team_state_dwell_us",
-                            (now - self.state_since) * 1e6,
-                            component="core/team", coll=old.name)
+        if old is not None and old != new_state:
+            dwell = now - self.state_since
+            if metrics.ENABLED:
+                metrics.observe("team_state_dwell_us", dwell * 1e6,
+                                component="core/team", coll=old.name)
+            # bootstrap span: each left state becomes a completed stage
+            # event on the flight ring, so a slow team create (the
+            # BENCH_r14 324s wall) is attributable per state — oob
+            # rounds, service-team build, TUNER_SYNC — in `ucc_fr`
+            # output instead of reading as one opaque gap
+            fr = getattr(self.context, "flight", None)
+            if fr is not None:
+                fr.complete(self.id, self.epoch, -1, "bootstrap",
+                            "team_create", f"boot:{old.name.lower()}",
+                            dwell, "OK")
         self._state = new_state
         self.state_since = now
 
@@ -151,8 +171,17 @@ class Team:
             if self.rank == 0:
                 leader_counter = self.context._team_id_counter
                 self.context._team_id_counter += 1
+            # piggyback this member's collector straggler view (flagged
+            # CONTEXT ranks) on the round the team already pays for: the
+            # union is agreed by construction (everyone sees the same
+            # entries), so cl/hier can demote flagged ranks from leader
+            # positions without divergence risk
+            flagged = ()
+            col = getattr(self.context, "collector", None)
+            if col is not None:
+                flagged = tuple(sorted(col.flagged_ctx()))
             payload = pickle.dumps((self.context.rank, leader_counter,
-                                    self.context.proc_info.pid))
+                                    self.context.proc_info.pid, flagged))
             self._pending_req = self.oob.allgather(payload)
         else:
             # no per-team OOB: the ep_map alone defines membership
@@ -195,8 +224,18 @@ class Team:
                 self._pending_req = None
                 self.ctx_map = EpMap.from_array([e[0] for e in entries])
                 leader = entries[0]
+                # the team key stays (members, counter, pid) — the
+                # flagged piggyback must NOT leak into tag-space
+                # identity, or two creates bracketing a flag change
+                # would key differently across ranks
                 self.team_key = (tuple(int(e[0]) for e in entries),
                                  leader[1], leader[2])
+                flagged = set()
+                for e in entries:
+                    if len(e) > 3:
+                        flagged.update(int(r) for r in e[3])
+                if flagged:
+                    self.boot_flagged_ctx = frozenset(flagged)
             self.state = TeamState.SERVICE_TEAM
 
         if self.state == TeamState.SERVICE_TEAM:
@@ -270,6 +309,17 @@ class Team:
                         logger.info("team %s %s topology:\n%s",
                                     self.id, cl.name, describe())
             self.state = TeamState.ACTIVE
+            # continuous telemetry: register with the context's
+            # collector (None unless UCC_COLLECT=y) — windows start
+            # only once the team can actually carry the exchange
+            col = getattr(self.context, "collector", None)
+            if col is not None:
+                try:
+                    col.watch(self)
+                except Exception:  # noqa: BLE001 - telemetry must never
+                    # fail an otherwise-activated team
+                    logger.exception("collector watch failed; team %s "
+                                     "continues unwatched", self.id)
 
         if self.state == TeamState.ACTIVE:
             return Status.OK

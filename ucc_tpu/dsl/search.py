@@ -226,18 +226,26 @@ def grid_program_names(coll: CollType, n: int, paths=None,
 
 def shortlist(cands: Sequence[Candidate], model, nbytes: int,
               budget: int,
-              link_of: Optional[Callable[[int, int], str]] = None
+              link_of: Optional[Callable[[int, int], str]] = None,
+              slow: Optional[Dict[int, float]] = None
               ) -> List[Candidate]:
     """Price every candidate at THIS message size and keep the
     ``budget`` cheapest (stable order by predicted cost, then name for
     determinism). Returns per-size Candidate copies — the same program
     prices differently at different sizes, so shortlists must not
-    share mutable prediction state."""
+    share mutable prediction state.
+
+    ``slow`` is a {rank: slowness multiplier} map (the continuous
+    collector's RankBias.slow_map, obs/collector.py): the cost model
+    weights a flagged rank's link terms by its multiplier, so a search
+    re-run under a live straggler shortlists programs that route around
+    it instead of through it."""
     import dataclasses
     priced = []
     for c in cands:
         cc = dataclasses.replace(c)
-        cc.predicted_us = model.predict_us(c.prog, nbytes, link_of)
+        cc.predicted_us = model.predict_us(c.prog, nbytes, link_of,
+                                           slow=slow)
         priced.append(cc)
     priced.sort(key=lambda c: (c.predicted_us, c.name))
     return priced[:max(1, int(budget))]

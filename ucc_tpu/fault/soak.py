@@ -138,17 +138,32 @@ def run_soak(n_ranks: int = 4, iterations: int = 200,
              spec: str = _DEFAULT_SPEC, seed: int = 0,
              coll_timeout_s: float = 0.5, iter_deadline_s: float = 10.0,
              count: int = 64,
-             matrix=DEFAULT_MATRIX) -> Dict:
+             matrix=DEFAULT_MATRIX, collect: bool = False) -> Dict:
     """Run the drill; returns a report dict:
 
     ``iterations`` run, per-outcome ``outcomes`` counts (terminal
     statuses by name), ``hangs`` (iterations where some rank was still
     IN_PROGRESS at the deadline — MUST be empty), ``injected`` decision
-    counts, ``teams_recreated``.
+    counts, ``teams_recreated``. With ``collect`` the continuous
+    telemetry collector runs alongside the fault drill (soaking the
+    window exchange against injected drops/delays/errors too) and the
+    report gains a ``collector`` section: windows that closed and the
+    union of context ranks the straggler scorer flagged.
     """
     from ucc_tpu import Status
 
     inject.reset()
+    prev_knobs = None
+    if collect:
+        # arm the telemetry pipeline BEFORE context creation (the
+        # service is created from Context.__init__); no on-disk store —
+        # the soak only wants the scorer/bias path under fire
+        from ..obs import collector as _collector
+        from ..obs import flight as _flight
+        prev_knobs = (_collector.KNOBS.enabled, _collector.KNOBS.interval,
+                      _collector.KNOBS.dir, _flight.ENABLED)
+        _flight.configure(enabled=True)
+        _collector.configure(enabled=True, interval=0.25, dir="")
     ctxs = _make_job(n_ranks)
     teams = _make_team(ctxs)
     report: Dict = {"iterations": 0, "outcomes": {}, "hangs": [],
@@ -212,6 +227,20 @@ def run_soak(n_ranks: int = 4, iterations: int = 200,
     finally:
         report["injected"] = dict(inject.COUNTS)   # before reset zeroes it
         inject.reset()
+        if collect:
+            flagged: set = set()
+            windows = 0
+            for c in ctxs:
+                col = getattr(c, "collector", None)
+                if col is None:
+                    continue
+                try:
+                    flagged |= set(col.flagged_ctx())
+                    windows = max(windows, col.windows_run())
+                except Exception:  # noqa: BLE001 - reporting only
+                    pass
+            report["collector"] = {"windows": windows,
+                                   "flagged_ctx": sorted(flagged)}
         for t in teams:
             try:
                 t.destroy()
@@ -222,6 +251,12 @@ def run_soak(n_ranks: int = 4, iterations: int = 200,
                 c.destroy()
             except Exception:  # noqa: BLE001
                 pass
+        if prev_knobs is not None:
+            from ..obs import collector as _collector
+            from ..obs import flight as _flight
+            _collector.configure(enabled=prev_knobs[0],
+                                 interval=prev_knobs[1], dir=prev_knobs[2])
+            _flight.configure(enabled=prev_knobs[3])
     return report
 
 
@@ -551,6 +586,10 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--coll-timeout", type=float, default=0.5)
     ap.add_argument("--iter-deadline", type=float, default=10.0)
+    ap.add_argument("--collect", action="store_true",
+                    help="run the continuous telemetry collector during "
+                    "the soak; the report gains a 'collector' section "
+                    "(windows closed, flagged context ranks)")
     ap.add_argument("--kill-shrink", action="store_true",
                     help="run the kill+shrink recovery drill instead of "
                     "the probabilistic soak (UCC_FT=shrink pipeline)")
@@ -570,7 +609,8 @@ def main(argv=None) -> int:
         print(json.dumps(report, indent=1))
         return 1 if report["violations"] else 0
     report = run_soak(args.ranks, args.iterations, args.spec, args.seed,
-                      args.coll_timeout, args.iter_deadline)
+                      args.coll_timeout, args.iter_deadline,
+                      collect=args.collect)
     print(json.dumps(report, indent=1))
     return 1 if report["hangs"] else 0
 

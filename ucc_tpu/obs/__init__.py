@@ -23,13 +23,23 @@ exactly this telemetry + hang-diagnostics pairing):
   straggler, missing-participant naming) with Chrome-trace/Perfetto
   export.
 
+- ``obs.collector`` — CONTINUOUS telemetry (``UCC_COLLECT``, default
+  n): a background service that periodically gathers flight-recorder
+  ring windows cross-rank over the service team, merges them per-pod
+  along the hier tree, maintains a rolling on-disk trace store, scores
+  per-rank slowness incrementally (``obs.diagnose.StragglerScorer``),
+  and publishes a per-team RankBias table that algorithm selection
+  (score map / tuner / cost model / hier-tree leader placement)
+  consults — the flight recorder graduated from post-mortem tool to
+  control loop.
+
 Every optional pillar is zero-cost when its env knob is unset: hot
 paths guard with module-level booleans (``metrics.ENABLED`` /
-``watchdog.ENABLED`` / ``profiling.ENABLED``) before any formatting or
-locking. The flight recorder is the deliberate exception — on by
-default, sized so the steady-state cost is one wait-free ring append
-per event (``UCC_FLIGHT=n`` removes even that).
+``watchdog.ENABLED`` / ``profiling.ENABLED`` / ``collector.ENABLED``)
+before any formatting or locking. The flight recorder is the deliberate
+exception — on by default, sized so the steady-state cost is one
+wait-free ring append per event (``UCC_FLIGHT=n`` removes even that).
 """
-from . import flight, metrics, watchdog  # noqa: F401
+from . import collector, flight, metrics, watchdog  # noqa: F401
 
-__all__ = ["flight", "metrics", "watchdog"]
+__all__ = ["collector", "flight", "metrics", "watchdog"]

@@ -1,0 +1,837 @@
+"""Continuous telemetry collector — the flight recorder as a control loop.
+
+PR 9's flight recorder answers *what happened* only when something
+triggers a dump: diagnosis is forensic. This module closes the loop the
+100k+ GPUs paper (PAPERS.md) describes as the production regime:
+
+- a background **collection service** (``UCC_COLLECT=y``, owned by the
+  context lifecycle) that periodically snapshots every watched team's
+  ring *window* (events since the previous window) and gathers it
+  cross-rank over the service-team transport — the same PR-8 k-ary
+  ``TransportOob`` tree on-demand collection rides;
+- **per-pod merge before forwarding up**: window snapshots are
+  exchanged inside each HierTree level-0 group, each group reduces its
+  raw rings to a compact severity summary, and only the summaries
+  travel between group leaders — no rank ever holds O(world) raw
+  rings;
+- a rolling **on-disk trace store** (bounded JSON-line segments,
+  ``UCC_COLLECT_DIR``) that ``ucc_fr`` can merge and tail;
+- an incremental **straggler scorer** (obs/diagnose.StragglerScorer):
+  per-rank EWMA slowness fed by the three window-scoped straggler
+  signals, with hysteresis so a rank must *stay* slow to stay flagged;
+- the **feedback edge**: a per-team :class:`RankBias` table that
+  selection consults — ScoreMap candidate ordering demotes ring-family
+  algorithms whose critical path serializes through a flagged rank,
+  the online tuner weights rank-0 medians, the cost model scales a
+  flagged rank's link terms, and the hier tree demotes flagged ranks
+  from leader positions at (re)build.
+
+Divergence safety — the part that makes feedback *safe* to wire into
+selection: every rank derives the flagged set from the SAME global
+summary (stage-3 rebroadcast), and a new table only takes effect at a
+deterministic flight-sequence index (``apply_at`` = the window's max
+observed ``flight_seq`` + ``UCC_RANK_BIAS_SLACK``) — the same
+switch-at-a-post-index design the tuner's decision bcast uses, because
+any cross-rank divergence in candidate order deadlocks the team
+(score/score_map._cand_order).
+
+Threading model: the collector THREAD only marks windows due on a
+timer; all transport work (posting/polling the window exchanges) runs
+from ``Context.progress()`` on the application's progress thread, so
+the collector never races the cooperative progress loop.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+import time
+import weakref
+from typing import Any, Dict, FrozenSet, List, Optional
+
+from ..status import Status
+from ..utils.config import (ConfigField, ConfigTable, parse_bool,
+                            parse_double, parse_string, parse_uint,
+                            register_table)
+from ..utils.log import get_logger
+
+logger = get_logger("obs")
+
+_COLLECT_CONFIG = register_table(ConfigTable(
+    prefix="", name="obs/collector", fields=[
+        ConfigField("COLLECT", "n",
+                    "continuous telemetry collection: a background "
+                    "service gathers flight-recorder ring windows "
+                    "cross-rank over the service team, merges them "
+                    "per-pod along the hier tree, scores per-rank "
+                    "slowness, and publishes a RankBias table that "
+                    "algorithm selection consults. n = forensic-only "
+                    "flight recorder (dump-triggered collection)",
+                    parse_string),
+        ConfigField("COLLECT_INTERVAL", "30.0",
+                    "seconds between collection windows (the timer that "
+                    "marks a window due; exchanges run on the progress "
+                    "thread)", parse_double),
+        ConfigField("COLLECT_SAMPLE", "1",
+                    "collect every Nth window: window indices not "
+                    "divisible by N are skipped without any exchange "
+                    "(deterministic across ranks). 1 = every window",
+                    parse_uint),
+        ConfigField("COLLECT_DIR", "ucc_traces",
+                    "rolling on-disk trace store: per-pod merged window "
+                    "dumps and global severity summaries appended as "
+                    "JSON lines into bounded segment files; read with "
+                    "`ucc_fr <dir>` / `ucc_fr <dir> --tail N`. Empty "
+                    "disables the store", parse_string),
+        ConfigField("COLLECT_SEGMENT_BYTES", "4194304",
+                    "trace-store segment rotation threshold (bytes)",
+                    parse_uint),
+        ConfigField("COLLECT_SEGMENTS", "8",
+                    "trace-store segments kept per process; the oldest "
+                    "is deleted on rotation", parse_uint),
+        ConfigField("RANK_BIAS", "y",
+                    "feed collector straggler findings back into "
+                    "algorithm selection: flagged ranks demote "
+                    "ring-family candidates in the score map, weight "
+                    "tuner medians, scale cost-model link terms, and "
+                    "are demoted from hier-tree leader positions at "
+                    "team (re)build. n = observe-only collection",
+                    parse_string),
+        ConfigField("RANK_BIAS_DECAY", "0.5",
+                    "EWMA weight of the newest window's severity in a "
+                    "rank's slowness score (0..1; higher reacts faster)",
+                    parse_double),
+        ConfigField("RANK_BIAS_FLAG_ON", "0.7",
+                    "slowness score a rank must reach (with "
+                    "UCC_RANK_BIAS_WINDOWS consecutive slow windows) to "
+                    "be flagged", parse_double),
+        ConfigField("RANK_BIAS_FLAG_OFF", "0.2",
+                    "hysteresis: a flagged rank unflags only once its "
+                    "score decays below this", parse_double),
+        ConfigField("RANK_BIAS_WINDOWS", "2",
+                    "consecutive slow windows required before a rank "
+                    "can be flagged (transient spikes never flag)",
+                    parse_uint),
+        ConfigField("RANK_BIAS_PENALTY", "4096",
+                    "score-map penalty per flagged member on the "
+                    "critical path of a ring-family candidate; any "
+                    "penalized candidate orders after every unpenalized "
+                    "one (user-forced `inf` scores are exempt)",
+                    parse_uint),
+        ConfigField("RANK_BIAS_SLACK", "16",
+                    "flight-sequence posts between a window's global "
+                    "summary and the deterministic index at which every "
+                    "rank applies the new RankBias to selection (the "
+                    "tuner-style divergence-free switch point)",
+                    parse_uint),
+        ConfigField("RANK_BIAS_SLOW_MULT", "4.0",
+                    "slowness multiplier on a flagged rank's cost-model "
+                    "link terms (and the tuner's ring-family medians): "
+                    "searched/tuned programs price traffic through a "
+                    "flagged rank this many times slower",
+                    parse_double),
+    ]))
+
+
+class _Knobs:
+    """Resolved collector knobs; module-level so tests can override via
+    :func:`configure` without touching the environment."""
+
+    def __init__(self):
+        from ..utils.config import Config
+        self.enabled = False
+        self.interval = 30.0
+        self.sample = 1
+        self.dir = "ucc_traces"
+        self.segment_bytes = 4 << 20
+        self.segments = 8
+        self.bias = True
+        self.decay = 0.5
+        self.flag_on = 0.7
+        self.flag_off = 0.2
+        self.windows = 2
+        self.penalty = 4096
+        self.slack = 16
+        self.slow_mult = 4.0
+        try:
+            cfg = Config(_COLLECT_CONFIG)
+            try:
+                self.enabled = parse_bool(str(cfg.collect))
+            except ValueError:
+                self.enabled = False
+            self.interval = max(0.05, float(cfg.collect_interval))
+            self.sample = max(1, int(cfg.collect_sample))
+            self.dir = str(cfg.collect_dir)
+            self.segment_bytes = max(4096, int(cfg.collect_segment_bytes))
+            self.segments = max(1, int(cfg.collect_segments))
+            try:
+                self.bias = parse_bool(str(cfg.rank_bias))
+            except ValueError:
+                self.bias = True
+            self.decay = min(1.0, max(0.01, float(cfg.rank_bias_decay)))
+            self.flag_on = float(cfg.rank_bias_flag_on)
+            self.flag_off = float(cfg.rank_bias_flag_off)
+            self.windows = max(1, int(cfg.rank_bias_windows))
+            self.penalty = int(cfg.rank_bias_penalty)
+            self.slack = max(1, int(cfg.rank_bias_slack))
+            self.slow_mult = max(1.0, float(cfg.rank_bias_slow_mult))
+        except Exception:  # noqa: BLE001 - knob resolution never breaks import
+            pass
+
+
+KNOBS = _Knobs()
+ENABLED = KNOBS.enabled
+
+
+def configure(**kw) -> None:
+    """Runtime (re)configuration (tests/embedders; env read at import).
+    Keyword names match :class:`_Knobs` attributes plus ``enabled``."""
+    global ENABLED
+    for k, v in kw.items():
+        if not hasattr(KNOBS, k):
+            raise AttributeError(f"unknown collector knob {k!r}")
+        setattr(KNOBS, k, v)
+    ENABLED = KNOBS.enabled
+
+
+# ---------------------------------------------------------------------------
+# RankBias — the feedback table selection consults
+# ---------------------------------------------------------------------------
+
+#: algorithm-name tokens whose critical path serializes through EVERY
+#: team member (one slow rank stalls each round): the candidates a
+#: flagged rank demotes. Tree/knomial families route around a slow leaf.
+_RING_TOKENS = ("ring", "sliding", "sra")
+
+
+def is_ring_family(alg_name: str, gen: str = "") -> bool:
+    s = f"{alg_name or ''} {gen or ''}".lower()
+    return any(tok in s for tok in _RING_TOKENS)
+
+
+class RankBias:
+    """Per-team straggler feedback table published by the collector.
+
+    ``flagged`` holds TEAM ranks currently scored slow (hysteresis in
+    the scorer keeps it stable); ``scores`` the underlying EWMA values.
+    A new table is staged by :meth:`publish` and only promoted by
+    :meth:`tick` once the team's flight sequence reaches the staged
+    ``apply_at`` — every rank ticks at the same program-order points, so
+    the flagged set (and therefore candidate order) can never diverge
+    across ranks mid-stream.
+    """
+
+    __slots__ = ("penalty", "slow_mult", "flagged", "scores", "window",
+                 "_pending", "first_flag_window")
+
+    def __init__(self, penalty: Optional[int] = None,
+                 slow_mult: Optional[float] = None):
+        self.penalty = KNOBS.penalty if penalty is None else int(penalty)
+        self.slow_mult = KNOBS.slow_mult if slow_mult is None \
+            else float(slow_mult)
+        self.flagged: FrozenSet[int] = frozenset()
+        self.scores: Dict[int, float] = {}
+        self.window = -1
+        self._pending = None
+        #: window index of the first nonempty flagged set ever published
+        #: (drill/accounting: "flagged within N windows")
+        self.first_flag_window: Optional[int] = None
+
+    # -- collector side -------------------------------------------------
+    def publish(self, flagged, scores: Dict[int, float], window: int,
+                apply_at: int) -> None:
+        flagged = frozenset(flagged)
+        if flagged and self.first_flag_window is None:
+            self.first_flag_window = int(window)
+        p = self._pending
+        if p is not None and p[1] == flagged:
+            # same flagged set re-published: refresh the observations
+            # but KEEP the original switch index — re-staging with a
+            # fresh apply_at every window would forever push the switch
+            # past the post frontier of a team that posts fewer than
+            # `slack` collectives per window, and the table would never
+            # take effect
+            self._pending = (p[0], flagged, dict(scores), int(window))
+            return
+        if p is None and flagged == self.flagged:
+            # no candidate-order change: fold fresh scores in place
+            # (selection only reads `flagged`, so this cannot diverge)
+            self.scores = dict(scores)
+            self.window = int(window)
+            return
+        self._pending = (int(apply_at), flagged, dict(scores),
+                         int(window))
+
+    # -- dispatch side --------------------------------------------------
+    def tick(self, flight_seq: int) -> None:
+        """Promote a staged table once the deterministic switch index is
+        reached. Called from dispatch in program order on every rank."""
+        p = self._pending
+        if p is not None and flight_seq >= p[0]:
+            self._pending = None
+            _, self.flagged, self.scores, self.window = p
+
+    def penalty_units(self, cand) -> int:
+        """Flagged members on *cand*'s critical path: ring-family
+        candidates serialize through every member, so they pay one unit
+        per flagged rank; tree-family candidates pay none."""
+        if not self.flagged:
+            return 0
+        if is_ring_family(getattr(cand, "alg_name", "") or "",
+                          getattr(cand, "gen", "") or ""):
+            return len(self.flagged)
+        return 0
+
+    def reorder(self, cands: List[Any]) -> List[Any]:
+        """Bias-aware candidate order (ScoreMap.lookup): any candidate
+        paying a penalty sorts after every unpenalized candidate
+        (user-forced SCORE_MAX entries are exempt — an explicit `inf`
+        still outranks feedback), and penalized candidates order among
+        themselves by score minus ``penalty`` per flagged member.
+        Deterministic: the input order and the flagged set are identical
+        on every rank, so the output is too."""
+        if not self.flagged:
+            return cands
+        from ..score.score import SCORE_MAX
+
+        def key(p):
+            i, r = p
+            u = 0 if r.score >= SCORE_MAX else self.penalty_units(r)
+            return (1 if u else 0, -(r.score - u * self.penalty), i)
+
+        return [r for _, r in sorted(enumerate(cands), key=key)]
+
+    def time_multiplier(self, alg_name: str, gen: str = "") -> float:
+        """Measured-time weight the tuner's rank-0 decision applies: a
+        ring-family candidate's median is inflated per flagged member,
+        so a straggler-serialized winner must beat the alternatives by
+        the slowness factor to stay the winner."""
+        if not self.flagged or not is_ring_family(alg_name, gen):
+            return 1.0
+        return 1.0 + (self.slow_mult - 1.0) * len(self.flagged)
+
+    def slow_map(self) -> Dict[int, float]:
+        """{team rank: multiplier} for the cost model's per-rank
+        slowness scaling (score/cost.CostModel.features)."""
+        return {r: self.slow_mult for r in self.flagged}
+
+    def describe(self) -> str:
+        if not self.flagged and not self.scores:
+            return "rank bias: clean"
+        segs = [f"rank bias (window {self.window}):"]
+        for r in sorted(self.scores):
+            mark = " FLAGGED" if r in self.flagged else ""
+            segs.append(f" r{r}={self.scores[r]:.2f}{mark}")
+        return "".join(segs)
+
+
+# ---------------------------------------------------------------------------
+# rolling on-disk trace store
+# ---------------------------------------------------------------------------
+
+class TraceStore:
+    """Bounded JSON-line segment files under one directory. Rotation is
+    size-based; at most ``max_segments`` segments are kept per process
+    (older ones deleted oldest-first). Segment names embed the pid so
+    multi-process jobs sharing a directory never interleave writes."""
+
+    def __init__(self, dirpath: str, segment_bytes: int,
+                 max_segments: int):
+        self.dir = dirpath
+        self.segment_bytes = int(segment_bytes)
+        self.max_segments = max(1, int(max_segments))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._cur: Optional[str] = None
+        self._cur_bytes = 0
+
+    def _segment_name(self, seq: int) -> str:
+        return os.path.join(self.dir,
+                            f"fr-{os.getpid()}-{seq:06d}.jsonl")
+
+    def _my_segments(self) -> List[str]:
+        try:
+            names = sorted(n for n in os.listdir(self.dir)
+                           if n.startswith(f"fr-{os.getpid()}-")
+                           and n.endswith(".jsonl"))
+        except OSError:
+            return []
+        return [os.path.join(self.dir, n) for n in names]
+
+    def append(self, rec: Dict[str, Any]) -> Optional[str]:
+        """Append one record; returns the segment path written (None on
+        store failure — telemetry must never raise into the caller)."""
+        line = json.dumps(rec, default=str) + "\n"
+        with self._lock:
+            try:
+                os.makedirs(self.dir, exist_ok=True)
+                if self._cur is None or \
+                        self._cur_bytes >= self.segment_bytes:
+                    self._rotate()
+                with open(self._cur, "a") as fh:
+                    fh.write(line)
+                self._cur_bytes += len(line)
+                return self._cur
+            except OSError:
+                logger.exception("trace store append failed")
+                return None
+
+    def _rotate(self) -> None:
+        self._seq += 1
+        self._cur = self._segment_name(self._seq)
+        self._cur_bytes = 0
+        segs = self._my_segments()
+        # the new segment doesn't exist yet; +1 accounts for it
+        excess = len(segs) + 1 - self.max_segments
+        for path in segs[:max(0, excess)]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+def load_dir_records(dirpath: str,
+                     tail: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Read trace-store records from *dirpath* (all processes'
+    segments, oldest-first by mtime then name). ``tail`` keeps only the
+    N freshest segments — the `ucc_fr --tail` view of a long-running
+    store."""
+    try:
+        names = [n for n in os.listdir(dirpath) if n.endswith(".jsonl")]
+    except OSError:
+        return []
+    paths = [os.path.join(dirpath, n) for n in names]
+
+    def order(p):
+        try:
+            return (os.stat(p).st_mtime, p)
+        except OSError:
+            return (0.0, p)
+
+    paths.sort(key=order)
+    if tail is not None:
+        paths = paths[-max(1, int(tail)):]
+    recs: List[Dict[str, Any]] = []
+    for p in paths:
+        try:
+            with open(p) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        recs.append(rec)
+        except OSError:
+            continue
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# per-team window state machine
+# ---------------------------------------------------------------------------
+
+def _window_events(events: List[dict], cut: float) -> List[dict]:
+    """Events newer than *cut*, PLUS the post events of any completion
+    inside the window (the scorer's duration join needs the post even
+    when it predates the window)."""
+    if cut <= 0.0:
+        return list(events)
+    out = [ev for ev in events if (ev.get("t") or 0.0) > cut]
+    need = {ev.get("seq") for ev in out
+            if ev.get("ev") == "cmpl" and ev.get("seq") is not None}
+    if need:
+        have = {ev.get("seq") for ev in out if ev.get("ev") == "post"}
+        for ev in events:
+            if ev.get("ev") == "post" and ev.get("seq") in need and \
+                    ev.get("seq") not in have and \
+                    (ev.get("t") or 0.0) <= cut:
+                out.append(ev)
+        # restore ring (time) order: the cmpl->post join walks events in
+        # sequence and a post appended AFTER its cmpl never joins
+        out.sort(key=lambda ev: ev.get("t") or 0.0)
+    return out
+
+
+class _TeamWatch:
+    """One watched team's continuous-collection state: window counters,
+    the 3-stage hierarchical exchange in flight (if any), the
+    incremental scorer, and the published RankBias."""
+
+    # exchange stages of one sampled window
+    ST_GATHER = 1      # intra-group allgather of raw window snapshots
+    ST_LEADERS = 2     # leaders-only allgather of pod summaries
+    ST_BCAST = 3       # intra-group rebroadcast of the global summary
+
+    def __init__(self, service: "CollectorService", team):
+        from . import diagnose
+        self.service = service
+        self.team_ref = weakref.ref(team)
+        self.window = 0            # next window index to run
+        self.due = 0               # windows the timer has marked due
+        self.stage = 0             # 0 = idle
+        self.cut_t = 0.0           # ring high-water mark (monotonic)
+        self._req = None
+        self._deadline = 0.0
+        self._pod_summary: Optional[dict] = None
+        self._global: Optional[dict] = None
+        # level-0 group (team ranks) + group leaders from the hier tree:
+        # the per-pod merge domain. Flat/single-node teams collapse to
+        # one group covering the team (stage 2/3 skipped).
+        tree = None
+        try:
+            if team.topo is not None and team.size > 1:
+                tree = team.topo.hier_tree()
+        except Exception:  # noqa: BLE001 - a topology quirk must not
+            logger.exception("collector: hier tree build failed; "
+                             "using a flat group")
+        if tree is not None and len(tree.level(0).groups) > 1:
+            self.group = list(tree.group(0, team.rank))
+            self.leaders = [g[0] for g in tree.level(0).groups]
+        else:
+            self.group = list(range(team.size))
+            self.leaders = [self.group[0]]
+        self.is_leader = team.rank == self.group[0]
+        self.is_top = team.rank == self.leaders[0]
+        k = KNOBS
+        self.scorer = diagnose.StragglerScorer(
+            decay=k.decay, flag_on=k.flag_on, flag_off=k.flag_off,
+            windows=k.windows)
+        self.bias = RankBias() if k.bias else None
+        if self.bias is not None:
+            team.rank_bias = self.bias
+
+    # ------------------------------------------------------------------
+    def _oob(self, team, members: List[int], stage: int):
+        from ..core.oob import TransportOob
+        svc = team.service_team
+        member_ctx = [int(team.ctx_map.eval(r)) for r in members]
+        return TransportOob(
+            svc.comp_context, svc.transport, member_ctx,
+            team.context.rank,
+            ("fcw", team.team_key, self.window, stage), team.epoch)
+
+    def _snapshot_window(self, team) -> dict:
+        rec = getattr(team.context, "flight", None)
+        snap = rec.snapshot() if rec is not None else {
+            "rank": team.rank, "uid": "", "pid": os.getpid(),
+            "events": [], "wire": [], "dropped": 0}
+        cut = self.cut_t
+        snap["events"] = _window_events(snap.get("events") or [], cut)
+        # drop the collector's OWN exchange traffic ("fcw" space keys):
+        # self-observation would otherwise dominate quiet windows and
+        # feed the wire-lag detector rounds the app never ran
+        snap["wire"] = [w for w in (snap.get("wire") or [])
+                        if (w.get("t") or 0.0) > cut
+                        and "fcw" not in str(w.get("tkey"))]
+        snap["window"] = self.window
+        return snap
+
+    def step(self) -> None:
+        team = self.team_ref()
+        if team is None or team._destroyed or team._shrunk:
+            self.service.unwatch(self)
+            return
+        if self.stage == 0:
+            if self.due <= self.window:
+                return
+            if self.window % KNOBS.sample:
+                self.window += 1        # unsampled window: no exchange
+                return
+            self._start(team)
+            return
+        req = self._req
+        if req is None:
+            return
+        try:
+            st = req.test()
+        except Exception as e:  # noqa: BLE001 - a torn-down transport
+            # mid-window degrades to an abandoned window, never a raise
+            logger.warning("collector window %d exchange failed: %s",
+                           self.window, e)
+            self._abandon()
+            return
+        if st == Status.IN_PROGRESS:
+            if time.monotonic() > self._deadline:
+                logger.warning(
+                    "collector window %d stage %d timed out; abandoning",
+                    self.window, self.stage)
+                self._abandon()
+            return
+        try:
+            self._advance(team, req.result)
+        except Exception:  # noqa: BLE001 - telemetry must never take
+            # down the progress loop
+            logger.exception("collector window %d stage %d failed",
+                             self.window, self.stage)
+            self._abandon()
+
+    def _start(self, team) -> None:
+        svc = team.service_team
+        if svc is None or getattr(svc, "transport", None) is None or \
+                team.size <= 1:
+            # no exchange channel: local-only scoring (size-1 teams) —
+            # a window over this rank alone carries no peer comparison,
+            # so just advance the high-water mark
+            self.cut_t = time.monotonic()
+            self.window += 1
+            return
+        snap = self._snapshot_window(team)
+        payload = pickle.dumps({"fseq": team.flight_seq, "snap": snap})
+        self._req = self._oob(team, self.group, self.ST_GATHER)\
+            .allgather(payload)
+        self.stage = self.ST_GATHER
+        self._deadline = time.monotonic() + max(30.0, KNOBS.interval * 2)
+        # the next window's events start where this snapshot ended
+        self.cut_t = time.monotonic()
+
+    def _advance(self, team, result) -> None:
+        from . import diagnose
+        if self.stage == self.ST_GATHER:
+            msgs = [pickle.loads(b) for b in result]
+            pod = {"version": 1, "kind": "flight_merged",
+                   "reason": "collect", "ts": time.time(),
+                   "pid": os.getpid(), "window": self.window,
+                   "team": team.id, "team_size": team.size,
+                   "absent_ranks": [],
+                   "ranks": {str(r): m["snap"]
+                             for r, m in zip(self.group, msgs)}}
+            idx = diagnose._index(pod)
+            sev = self.scorer.observe(pod, _idx=idx)
+            self._pod_summary = {
+                "ranks": list(self.group),
+                "sev": {int(r): float(s) for r, s in sev.items()},
+                "max_fseq": max(int(m.get("fseq") or 0) for m in msgs),
+            }
+            if len(self.leaders) > 1:
+                # compact per-collective durations ride up with the
+                # summary so leaders can run CROSS-pod outlier detection
+                # (the >=3-rank duration signal is blind inside a small
+                # pod). Only interval features cross the pod boundary:
+                # durations compare across hosts, raw monotonic wire
+                # timestamps do not.
+                durs: Dict[Any, Dict[int, float]] = {}
+                for r, ri in idx.items():
+                    for key, d in ri.durs.items():
+                        durs.setdefault(key, {})[int(r)] = float(d)
+                self._pod_summary["durs"] = durs
+            if self.is_leader:
+                self.service.store_append(pod)
+            if len(self.leaders) > 1:
+                if self.is_leader:
+                    self._req = self._oob(team, self.leaders,
+                                          self.ST_LEADERS).allgather(
+                        pickle.dumps(self._pod_summary))
+                    self.stage = self.ST_LEADERS
+                else:
+                    # non-leaders park until the leader rebroadcasts
+                    self._req = self._oob(team, self.group,
+                                          self.ST_BCAST).allgather(b"")
+                    self.stage = self.ST_BCAST
+                return
+            # single group: the pod summary IS the global summary
+            self._apply(team, self._merge_summaries([self._pod_summary]))
+            return
+        if self.stage == self.ST_LEADERS:
+            summaries = [pickle.loads(b) for b in result]
+            self._global = self._merge_summaries(summaries)
+            if len(self.group) > 1:
+                self._req = self._oob(team, self.group,
+                                      self.ST_BCAST).allgather(
+                    pickle.dumps(self._global))
+                self.stage = self.ST_BCAST
+                return
+            self._apply(team, self._global)
+            return
+        if self.stage == self.ST_BCAST:
+            # the leader's entry (group position 0) carries the global
+            # summary; everyone else contributed b""
+            data = result[0]
+            if not data and self._global is not None:
+                g = self._global
+            else:
+                g = pickle.loads(data) if data else None
+            if g is None:
+                logger.warning("collector window %d: empty global "
+                               "summary; abandoning", self.window)
+                self._abandon()
+                return
+            self._apply(team, g)
+
+    def _merge_summaries(self, summaries: List[dict]) -> dict:
+        ranks: List[int] = []
+        sev: Dict[int, float] = {}
+        max_fseq = 0
+        durs: Dict[Any, Dict[int, float]] = {}
+        for s in summaries:
+            ranks.extend(int(r) for r in s.get("ranks") or ())
+            for r, v in (s.get("sev") or {}).items():
+                sev[int(r)] = sev.get(int(r), 0.0) + float(v)
+            max_fseq = max(max_fseq, int(s.get("max_fseq") or 0))
+            for key, per in (s.get("durs") or {}).items():
+                dst = durs.setdefault(key, {})
+                for r, d in per.items():
+                    dst[int(r)] = float(d)
+        # cross-pod duration outliers: every leader merges the same
+        # summary list, so this runs identically on each — no extra
+        # exchange needed for the verdict to agree
+        slow: Dict[int, int] = {}
+        factor, min_s = self.scorer.factor, self.scorer.min_s
+        for per in durs.values():
+            if len(per) < 3:
+                continue
+            vals = sorted(per.values())
+            n = len(vals)
+            med = vals[n // 2] if n % 2 else \
+                0.5 * (vals[n // 2 - 1] + vals[n // 2])
+            r_max = max(per, key=lambda r: per[r])
+            if per[r_max] > max(med * factor, med + min_s):
+                slow[r_max] = slow.get(r_max, 0) + 1
+        for r in slow:
+            sev[r] = sev.get(r, 0.0) + 1.0
+        return {"ranks": sorted(set(ranks)), "sev": sev,
+                "max_fseq": max_fseq}
+
+    def _apply(self, team, g: dict) -> None:
+        flagged = self.scorer.update(g.get("sev") or {},
+                                     g.get("ranks") or ())
+        if self.bias is not None:
+            apply_at = int(g.get("max_fseq") or 0) + KNOBS.slack
+            self.bias.publish(flagged, self.scorer.scores, self.window,
+                              apply_at)
+        if self.is_top:
+            self.service.store_append({
+                "version": 1, "kind": "collect_summary",
+                "ts": time.time(), "team": team.id,
+                "window": self.window,
+                "sev": {str(r): round(v, 4)
+                        for r, v in (g.get("sev") or {}).items()},
+                "scores": {str(r): round(v, 4)
+                           for r, v in self.scorer.scores.items()},
+                "flagged": sorted(flagged),
+                "apply_at": int(g.get("max_fseq") or 0) + KNOBS.slack,
+            })
+        if flagged:
+            logger.info("collector: team %s window %d flagged rank(s) "
+                        "%s", team.id, self.window,
+                        ",".join(str(r) for r in sorted(flagged)))
+        self._finish_window()
+
+    def _abandon(self) -> None:
+        self._finish_window()
+
+    def _finish_window(self) -> None:
+        self._req = None
+        self._pod_summary = None
+        self._global = None
+        self.stage = 0
+        self.window += 1
+
+
+# ---------------------------------------------------------------------------
+# per-context service
+# ---------------------------------------------------------------------------
+
+class CollectorService:
+    """Per-context collection service: owns the window timer thread and
+    drives every watched team's window state machine from the progress
+    path (``Context.progress`` calls :meth:`step`)."""
+
+    def __init__(self, context):
+        self.context_ref = weakref.ref(context)
+        self._watches: List[_TeamWatch] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.store: Optional[TraceStore] = None
+        if KNOBS.dir:
+            self.store = TraceStore(KNOBS.dir, KNOBS.segment_bytes,
+                                    KNOBS.segments)
+        self._thread = threading.Thread(
+            target=self._timer_loop, daemon=True,
+            name=f"ucc-collector-{getattr(context, 'rank', 0)}")
+        self._thread.start()
+
+    # -- team registry --------------------------------------------------
+    def watch(self, team) -> Optional[_TeamWatch]:
+        """Start continuous collection for *team* (called at team
+        activation). Returns the watch, or None for unwatchable teams."""
+        if team.size <= 1:
+            return None
+        w = _TeamWatch(self, team)
+        with self._lock:
+            self._watches.append(w)
+        return w
+
+    def unwatch(self, watch: _TeamWatch) -> None:
+        with self._lock:
+            try:
+                self._watches.remove(watch)
+            except ValueError:
+                pass
+
+    def flagged_ctx(self) -> FrozenSet[int]:
+        """Union of flagged ranks across watched teams, as CONTEXT
+        ranks — the view a NEW team's bootstrap exchange publishes so
+        its hier tree can demote stragglers from leader positions."""
+        out = set()
+        with self._lock:
+            watches = list(self._watches)
+        for w in watches:
+            team = w.team_ref()
+            if team is None or w.bias is None:
+                continue
+            for tr in w.bias.flagged:
+                try:
+                    out.add(int(team.ctx_map.eval(tr)))
+                except Exception:  # noqa: BLE001 - a torn-down map
+                    continue
+        return frozenset(out)
+
+    def watch_for(self, team) -> Optional[_TeamWatch]:
+        """The watch driving *team*'s windows, if any (tools/drills)."""
+        with self._lock:
+            for w in self._watches:
+                if w.team_ref() is team:
+                    return w
+        return None
+
+    def windows_run(self) -> int:
+        """Highest completed window index across watched teams — how
+        many collection windows actually closed (soak/tool reporting)."""
+        with self._lock:
+            return max((w.window for w in self._watches), default=0)
+
+    def store_append(self, rec: Dict[str, Any]) -> None:
+        if self.store is not None:
+            self.store.append(rec)
+
+    # -- progress-path driver -------------------------------------------
+    def step(self) -> None:
+        with self._lock:
+            watches = list(self._watches)
+        for w in watches:
+            w.step()
+
+    # -- timer thread ---------------------------------------------------
+    def _timer_loop(self) -> None:
+        while not self._stop.wait(KNOBS.interval):
+            with self._lock:
+                watches = list(self._watches)
+            for w in watches:
+                w.due += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def maybe_create(context) -> Optional[CollectorService]:
+    """Context.__init__ hook: a service when UCC_COLLECT is on, else
+    None (the zero-cost default — dispatch and progress guard on the
+    attribute)."""
+    if not ENABLED:
+        return None
+    return CollectorService(context)

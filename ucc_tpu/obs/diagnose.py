@@ -261,36 +261,56 @@ def detect_stragglers(merged: Dict[str, Any],
                          "rank": r, "outlier_colls": slow_count[r],
                          **w})
 
-    # (2) wire-send lag per source rank: group sends by round
-    rounds: Dict[Tuple, Dict[int, float]] = {}
+    # (2) wire-send lag per source rank: group sends by round — at slot
+    # granularity (knomial-style algorithms share a slot per round) AND
+    # at tag granularity (first send per rank per collective). The two
+    # granularities are scored SEPARATELY: in a pipelined ring a single
+    # delayed sender serializes every downstream hop, so slot groups
+    # show every rank a multiple of the delay behind the group min and
+    # a pooled median blames nobody (base * 4 swallows the signal). A
+    # collective's first sends are posted independently on every rank —
+    # the one point where a delayed sender lags without dragging its
+    # neighbors — so the tag granularity stays clean there, while the
+    # slot granularity carries the signal for round-synchronous
+    # algorithms (knomial exchanges, device launch/ready pairs).
+    grans: Dict[str, Dict[Tuple, Dict[int, float]]] = {
+        "slot": {}, "tag": {}}
     for r, ri in idx.items():
         for w in ri.wire:
-            k = (w.get("tkey"), w.get("epoch"), w.get("tag"),
-                 w.get("slot"))
             t = w.get("t") or 0.0
-            per = rounds.setdefault(k, {})
-            if r not in per or t < per[r]:
-                per[r] = t
-    deltas: Dict[int, List[float]] = {}
-    for per in rounds.values():
-        if len(per) < 2:
+            tkey, epoch, tag = w.get("tkey"), w.get("epoch"), w.get("tag")
+            for gran, k in (("slot", (tkey, epoch, tag, w.get("slot"))),
+                            ("tag", (tkey, epoch, tag))):
+                per = grans[gran].setdefault(k, {})
+                if r not in per or t < per[r]:
+                    per[r] = t
+    wire_best: Dict[int, Dict[str, Any]] = {}
+    for gran, rounds in grans.items():
+        deltas: Dict[int, List[float]] = {}
+        for per in rounds.values():
+            if len(per) < 2:
+                continue
+            t0 = min(per.values())
+            for r, t in per.items():
+                deltas.setdefault(r, []).append(t - t0)
+        if len(deltas) < 2:
             continue
-        t0 = min(per.values())
-        for r, t in per.items():
-            deltas.setdefault(r, []).append(t - t0)
-    if len(deltas) >= 2:
         lag = {r: _median(v) for r, v in deltas.items()}
         for r in sorted(lag):
             others = [v for rr, v in lag.items() if rr != r]
             base = _median(others)
             if lag[r] > max(WIRE_LAG_MIN_S, base * 4 + 1e-6):
-                findings.append({
+                cand = {
                     "kind": "straggler", "signal": "wire_lag", "rank": r,
                     "lag_s": round(lag[r], 6),
                     "peer_lag_s": round(base, 6),
-                    "rounds": len(deltas[r]),
+                    "rounds": len(deltas[r]), "gran": gran,
                     "seqs": _lagged_seqs(idx.get(r), lag[r] / 2),
-                })
+                }
+                if r not in wire_best or cand["lag_s"] > \
+                        wire_best[r]["lag_s"]:
+                    wire_best[r] = cand
+    findings.extend(wire_best[r] for r in sorted(wire_best))
 
     # (3) stage-duration outliers (hier phase tasks name the tree level)
     stages: Dict[Tuple[str, int], Dict[int, float]] = {}
@@ -454,17 +474,158 @@ def _sig_str(sig: Dict[str, Any]) -> str:
 
 
 # ---------------------------------------------------------------------------
+# incremental scoring (continuous collection — obs/collector.py)
+# ---------------------------------------------------------------------------
+
+class StragglerScorer:
+    """Per-rank EWMA slowness over collection windows, with hysteresis.
+
+    The dump-triggered detectors above answer "who was slow in THIS
+    dump"; the continuous collector needs "who has been slow LATELY,
+    with enough persistence to act on". This scorer turns per-window
+    findings from the same three straggler signals (wire-send lag —
+    including the PR-15 dev_launch/dev_ready device-side events, which
+    ride the wire ring and group into rounds like any send — completion-
+    duration outliers, and hier stage-duration outliers) into a rolling
+    per-rank score:
+
+    - :meth:`observe` is the pure half: one (pod-)merged window dump in,
+      raw severity per rank out (one unit per straggler finding naming
+      that rank). Every group member runs it identically over the pod
+      merge, so pod summaries agree without another exchange.
+    - :meth:`update` is the stateful half, fed the GLOBAL severity map
+      (pod summaries merged across leaders): EWMA
+      ``s += decay * (raw - s)``, a consecutive-slow-window streak, and
+      two thresholds. A rank flags only once its streak reaches
+      ``windows`` AND its score reaches ``flag_on`` (a one-window spike
+      never flags); a flagged rank unflags only when its score decays
+      below ``flag_off`` — the hysteresis band that keeps the published
+      RankBias stable while selection acts on it.
+    """
+
+    def __init__(self, decay: float = 0.5, flag_on: float = 0.7,
+                 flag_off: float = 0.2, windows: int = 2,
+                 factor: float = STRAGGLER_FACTOR,
+                 min_s: float = STRAGGLER_MIN_S):
+        self.decay = min(1.0, max(0.01, float(decay)))
+        self.flag_on = float(flag_on)
+        self.flag_off = float(flag_off)
+        self.windows = max(1, int(windows))
+        self.factor = float(factor)
+        self.min_s = float(min_s)
+        self.scores: Dict[int, float] = {}
+        self.streaks: Dict[int, int] = {}
+        self.flagged: set = set()
+        self.windows_seen = 0
+        #: 1-based windows_seen index of the first window with any
+        #: severity / the first flag — "flagged within N windows" is
+        #: measured between these (windows before the straggler's
+        #: traffic even existed don't count against the budget)
+        self.first_sev_index: Optional[int] = None
+        self.first_flag_index: Optional[int] = None
+
+    def observe(self, merged: Dict[str, Any],
+                _idx=None) -> Dict[int, float]:
+        """Raw window severity per rank from one merged window dump
+        (pure — no scorer state touched). *_idx* lets the collector
+        reuse one decoded index for observe + summary features."""
+        sev: Dict[int, float] = {}
+        for f in detect_stragglers(merged, self.factor, self.min_s,
+                                   _idx=_idx):
+            r = f.get("rank")
+            if r is None:
+                continue
+            sev[int(r)] = sev.get(int(r), 0.0) + 1.0
+        return sev
+
+    def update(self, sev: Dict[Any, float], ranks=()) -> frozenset:
+        """Fold one window's global severity into the rolling scores;
+        returns the current flagged set. *ranks* lists every rank the
+        window covered, so clean ranks decay toward zero.
+
+        A window in which NO rank shows severity is *uninformative* —
+        an idle team, a sampled-out window, or a collection cadence out
+        of phase with the collective rate. Such a window decays scores
+        at quarter weight and keeps streaks: "nothing was compared" must
+        not read as "everyone was fast", or any straggler whose team
+        posts slower than the window interval oscillates forever just
+        under the flag threshold."""
+        self.windows_seen += 1
+        universe = {int(r) for r in ranks}
+        norm = {int(r): float(v) for r, v in sev.items()}
+        universe.update(norm)
+        if not any(v > 0.0 for v in norm.values()):
+            for r in list(self.scores):
+                s = self.scores[r] * (1.0 - self.decay / 4.0)
+                self.scores[r] = s
+                if r in self.flagged and s <= self.flag_off:
+                    self.flagged.discard(r)
+            return frozenset(self.flagged)
+        if self.first_sev_index is None:
+            self.first_sev_index = self.windows_seen
+        for r in sorted(universe):
+            raw = norm.get(r, 0.0)
+            s = self.scores.get(r, 0.0)
+            s += self.decay * (raw - s)
+            self.scores[r] = s
+            self.streaks[r] = self.streaks.get(r, 0) + 1 if raw > 0 else 0
+            if r in self.flagged:
+                if s <= self.flag_off:
+                    self.flagged.discard(r)
+            elif self.streaks[r] >= self.windows and s >= self.flag_on:
+                self.flagged.add(r)
+        if self.flagged and self.first_flag_index is None:
+            self.first_flag_index = self.windows_seen
+        return frozenset(self.flagged)
+
+    def step(self, merged: Dict[str, Any]) -> frozenset:
+        """observe + update in one call, for single-group/offline use
+        where the window dump already covers the whole team."""
+        sev = self.observe(merged)
+        ranks = [int(r) for r in (merged.get("ranks") or {})]
+        return self.update(sev, ranks)
+
+    def describe(self) -> str:
+        if not self.scores:
+            return "scorer: no windows observed"
+        segs = [f"scorer ({self.windows_seen} windows):"]
+        for r in sorted(self.scores):
+            mark = " FLAGGED" if r in self.flagged else ""
+            segs.append(f" r{r}={self.scores[r]:.2f}{mark}")
+        return "".join(segs)
+
+
+# ---------------------------------------------------------------------------
 # offline merge (ucc_fr over dump files)
 # ---------------------------------------------------------------------------
 
 def merge_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Combine parsed flight-dump JSON lines into one merged dump. A
     ``flight_merged`` record (cross-rank collection output) wins — the
-    LAST one in the file is the freshest; otherwise per-rank
-    ``flight_local`` lines are merged (latest line per rank)."""
+    LAST one in the file is the freshest. Continuous-collection stores
+    write one *pod-scoped* merged record per group per window, all
+    stamped with the window index: every merged record sharing the last
+    record's window (and team) is unioned rank-wise, so ``ucc_fr`` over
+    a trace-store directory reconstructs the full-team view no single
+    rank ever held. Otherwise per-rank ``flight_local`` lines are merged
+    (latest line per rank)."""
     merged_recs = [r for r in records if r.get("kind") == "flight_merged"]
     if merged_recs:
-        return merged_recs[-1]
+        last = merged_recs[-1]
+        win = last.get("window")
+        if win is None:
+            return last
+        out = dict(last)
+        out["ranks"] = dict(last.get("ranks") or {})
+        absent = set(last.get("absent_ranks") or [])
+        for rec in merged_recs[:-1]:
+            if rec.get("window") == win and \
+                    rec.get("team") == last.get("team"):
+                for r, snap in (rec.get("ranks") or {}).items():
+                    out["ranks"].setdefault(r, snap)
+                absent.update(rec.get("absent_ranks") or [])
+        out["absent_ranks"] = sorted(int(a) for a in absent)
+        return out
     out = {"version": 1, "kind": "flight_merged", "reason": "offline",
            "ranks": {}, "absent_ranks": []}
     for rec in records:

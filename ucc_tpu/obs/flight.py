@@ -505,6 +505,10 @@ class FlightCollection:
         seq = getattr(team, "_flight_collect_seq", 0)
         team._flight_collect_seq = seq + 1
         member_ctx = [int(team.ctx_map.eval(r)) for r in members]
+        # kept for the wait loop: a member that dies MID-collection shows
+        # up as fresh health/fault evidence against these ctx ranks
+        self._member_ctx = member_ctx
+        self._dead_ctx0 = set(dead_ctx)
         oob = TransportOob(svc.comp_context, svc.transport, member_ctx,
                            ctx.rank, ("flight", team.team_key, seq),
                            team.epoch)
@@ -531,6 +535,14 @@ class FlightCollection:
             self._finish(None)
             return self.status
         if st == Status.IN_PROGRESS:
+            died = self._died_mid_collection()
+            if died:
+                logger.warning(
+                    "flight collection (%s): member rank(s) %s died "
+                    "mid-collection; returning the partial dump now",
+                    self.reason, ",".join(str(r) for r in died))
+                self._finish(None, dead_now=died)
+                return self.status
             if time.monotonic() > self._deadline:
                 logger.warning(
                     "flight collection (%s) timed out after %.1fs; "
@@ -542,17 +554,42 @@ class FlightCollection:
         self._finish([pickle.loads(b) for b in self._req.result])
         return self.status
 
-    def _finish(self, snaps) -> None:
+    def _died_mid_collection(self) -> List[int]:
+        """Team ranks among the exchange members with FRESH death
+        evidence (health registry / fault kills) that arrived after the
+        exchange started. The up-front exclusion in ``__init__`` only
+        sees deaths known at post time; without this check a rank dying
+        mid-collection degrades the whole dump via the full deadline."""
+        from ..fault import inject as fault
+        ctx = self.team.context
+        dead_ctx = set()
+        reg = getattr(ctx, "health", None)
+        if reg is not None:
+            dead_ctx |= reg.dead_set()
+        if fault.ENABLED:
+            dead_ctx |= {r for r in fault.SPEC.kill}
+        fresh = dead_ctx - self._dead_ctx0 - {ctx.rank}
+        if not fresh:
+            return []
+        return sorted(tr for tr, cr in zip(self._members,
+                                           self._member_ctx)
+                      if cr in fresh)
+
+    def _finish(self, snaps, dead_now: Optional[List[int]] = None
+                ) -> None:
         team = self.team
         merged = _merged_skeleton(self.reason)
         if snaps is None:
-            # timeout/failure fallback: whatever this process can see
+            # timeout/failure/mid-death fallback: whatever this process
+            # can see
             proc = collect_process(team.context, self.reason)
             merged["ranks"] = proc["ranks"]
             merged["partial"] = True
             present = {int(r) for r in merged["ranks"]}
             merged["absent_ranks"] = sorted(
-                set(range(team.size)) - present)
+                (set(range(team.size)) - present) | set(dead_now or ()))
+            if dead_now:
+                merged["mid_collection_dead"] = sorted(dead_now)
         else:
             for tr, snap in zip(self._members, snaps):
                 merged["ranks"][str(tr)] = snap

@@ -107,16 +107,28 @@ class CostModel:
     # ------------------------------------------------------------------
     def features(self, prog, nbytes: int,
                  link_of: Optional[Callable[[int, int], str]] = None,
-                 quant_block: int = 256) -> Dict[str, List[float]]:
+                 quant_block: int = 256,
+                 slow: Optional[Dict[int, float]] = None
+                 ) -> Dict[str, List[float]]:
         """Per-link-class feature vector of *prog* moving an
         ``nbytes``-byte vector: {link: [rounds_bounded, critical_bytes]}.
         Linear in (alpha, beta), so the same function serves prediction
-        and least-squares fitting."""
+        and least-squares fitting.
+
+        ``slow`` is the collector's {rank: slowness multiplier} map
+        (obs/collector.RankBias.slow_map): a flagged rank's send bytes
+        are weighted by its multiplier both when electing the round's
+        critical rank and when accumulating that rank's byte features —
+        so a program whose critical path runs through a straggler prices
+        proportionally worse, and the search front-end routes around it."""
         from ..dsl.ir import OpKind
         feats: Dict[str, List[float]] = {}
 
         def feat(link: str) -> List[float]:
             return feats.setdefault(link, [0.0, 0.0])
+
+        def w(r: int) -> float:
+            return slow.get(r, 1.0) if slow else 1.0
 
         nch = prog.nchunks
         for k in range(prog.n_rounds):
@@ -135,31 +147,34 @@ class CostModel:
                     round_links.add(link)
             if not round_links:
                 continue            # local-only round: no wire latency
-            slow = max(round_links, key=lambda l: _LINK_RANK.get(l, 0))
-            feat(slow)[0] += 1.0
+            slow_link = max(round_links,
+                            key=lambda l: _LINK_RANK.get(l, 0))
+            feat(slow_link)[0] += 1.0
             crit = max(per_rank,
-                       key=lambda r: sum(per_rank[r].values()))
+                       key=lambda r: w(r) * sum(per_rank[r].values()))
             for link, byts in per_rank[crit].items():
-                feat(link)[1] += float(byts)
+                feat(link)[1] += float(byts) * w(crit)
         return feats
 
     def predict_us(self, prog, nbytes: int,
                    link_of: Optional[Callable[[int, int], str]] = None,
-                   quant_block: int = 256) -> float:
+                   quant_block: int = 256,
+                   slow: Optional[Dict[int, float]] = None) -> float:
         """Critical-path price of *prog* in microseconds. Pipelined
         families (sra_pipe) price one fragment at ``nbytes/depth`` and
         scale by the 2-stage-overlap factor ``(depth+1)/2``."""
         depth = int((prog.params or {}).get("depth", 0) or 0)
         if prog.family == "sra_pipe" and depth >= 2:
             frag = max(1, nbytes // depth)
-            base = self._price(prog, frag, link_of, quant_block)
+            base = self._price(prog, frag, link_of, quant_block, slow)
             return base * (depth + 1) / 2.0
-        return self._price(prog, nbytes, link_of, quant_block)
+        return self._price(prog, nbytes, link_of, quant_block, slow)
 
-    def _price(self, prog, nbytes, link_of, quant_block) -> float:
+    def _price(self, prog, nbytes, link_of, quant_block,
+               slow=None) -> float:
         total = 0.0
         for link, (rounds, byts) in self.features(
-                prog, nbytes, link_of, quant_block).items():
+                prog, nbytes, link_of, quant_block, slow).items():
             c = self.links.get(link) or self.links.get("shm") or \
                 LinkCoeffs(*SEED_LINKS["shm"])
             total += c.alpha_us * rounds + c.beta_us_per_byte * byts

@@ -59,12 +59,24 @@ class ScoreMap:
         }
 
     def lookup(self, coll: CollType, mem: MemoryType,
-               msgsize: int) -> List[MsgRange]:
-        """All candidates whose range contains msgsize, best score first."""
+               msgsize: int, bias=None) -> List[MsgRange]:
+        """All candidates whose range contains msgsize, best score first.
+
+        ``bias`` is the team's RankBias table (obs/collector.py) when
+        the continuous collector has flagged stragglers: candidates
+        whose critical path serializes through a flagged rank (ring-
+        family) are demoted behind every unpenalized candidate. The
+        reorder is a pure function of the sorted list and the flagged
+        set, both identical on every rank at the bias's deterministic
+        switch index, so cross-rank candidate order stays aligned (the
+        _cand_order deadlock invariant)."""
         lst = self._sorted.get((coll, mem), [])
         # score 0 disables a candidate (reference: `alltoall:0` tune disables
         # the coll for that component)
-        return [r for r in lst if r.contains(msgsize) and r.score > 0]
+        out = [r for r in lst if r.contains(msgsize) and r.score > 0]
+        if bias is not None and getattr(bias, "flagged", None):
+            out = bias.reorder(out)
+        return out
 
     def init_coll(self, coll: CollType, mem: MemoryType, msgsize: int,
                   init_args,

@@ -452,12 +452,22 @@ class OnlineTuner:
     # -- decision -------------------------------------------------------
     def _local_winner(self, st: _KeyState
                       ) -> Tuple[Optional[Label], Optional[float]]:
+        # straggler feedback (obs/collector.RankBias): weight each
+        # candidate's median by its flagged-rank multiplier, so a
+        # ring-family winner measured BEFORE a straggler emerged (or
+        # measured while its victims smeared the medians) must beat the
+        # alternatives by the slowness factor to be frozen. Only rank
+        # 0's winner is broadcast, so consulting local state here is
+        # divergence-safe by construction.
+        bias = getattr(self.team, "rank_bias", None)
         best, best_t = None, None
         for label in sorted(st.samples):       # sorted: deterministic ties
             ts = sorted(st.samples[label])
             if not ts:
                 continue
             med = ts[len(ts) // 2]
+            if bias is not None and med != float("inf"):
+                med *= bias.time_multiplier(label[1])
             if med != float("inf") and (best_t is None or med < best_t):
                 best, best_t = label, med
         return best, best_t

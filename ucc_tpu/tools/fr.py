@@ -6,6 +6,9 @@ obs/diagnose.py):
     ucc_fr ucc_flight.json                   # merge + diagnose dumps
     ucc_fr ucc_flight.json --json            # machine-readable findings
     ucc_fr ucc_flight.json --perfetto t.json # Chrome-trace export
+    ucc_fr ucc_traces/                       # merge a collector trace
+                                             # store (UCC_COLLECT_DIR)
+    ucc_fr ucc_traces/ --tail 50             # freshest 50 records only
     ucc_fr --pid 12345                       # trigger a live dump
                                              # (SIGUSR2 -> every rank's
                                              # ring appended to its
@@ -13,6 +16,12 @@ obs/diagnose.py):
     ucc_fr --smoke                           # self-contained diagnosis
                                              # drill (snapshot_gate's
                                              # UCC_GATE_FR probe)
+    ucc_fr --feedback-smoke                  # closed-loop drill: the
+                                             # continuous collector flags
+                                             # a pinned straggler and
+                                             # selection moves off the
+                                             # through-it ring (the
+                                             # UCC_GATE_FEEDBACK probe)
 
 Input files hold one JSON record per line — ``flight_local`` (one
 rank's ring, written on SIGUSR2 or by embedders) and/or
@@ -70,6 +79,21 @@ def print_report(merged: Dict[str, Any], diag: Dict[str, Any],
         w(f"#   rank {r}: {len(ev)} events, "
           f"{len(snap.get('wire') or [])} wire, "
           f"dropped {snap.get('dropped', 0)}\n")
+    # bootstrap spans (core/team.py state dwells, core/context.py OOB
+    # exchange): the create-time wall, attributed per phase
+    boot: Dict[str, List] = {}
+    for r in ranks:
+        for ev in ranks[r].get("events") or []:
+            if ev.get("coll") == "bootstrap" and ev.get("stage"):
+                boot.setdefault(ev["stage"], []).append(
+                    (r, float(ev.get("dur_s") or 0.0)))
+    if boot:
+        w("# bootstrap spans:\n")
+        for stage in sorted(boot):
+            per = boot[stage]
+            r_max, d_max = max(per, key=lambda x: x[1])
+            w(f"#   {stage}: n={len(per)} max={d_max:.3f}s "
+              f"(rank {r_max}) total={sum(d for _, d in per):.3f}s\n")
     summary = diag.get("summary") or []
     if not summary:
         w("clean: no desync, stragglers, missing participants, or "
@@ -145,13 +169,134 @@ def _smoke(args) -> int:
     return 0 if rec.get("ok") else 1
 
 
+def _feedback_smoke(args) -> int:
+    """Closed-loop telemetry drill (see module doc). An 8-rank flat job
+    pins a ring allreduce via a TUNE overlay (high but finite score, so
+    the RankBias tier demotion can act), injects per-send delays on ONE
+    rank, and runs collectives while the continuous collector
+    (obs/collector.py) windows the rings, scores slowness, and publishes
+    the RankBias. Passes when the collector flags a rank without any
+    manual dump trigger within the window budget, selection demonstrably
+    moves off the ring, and post-feedback p99 beats pre-feedback.
+    Prints one JSON record the gate parses:
+    ``{"metric": "feedback_smoke", "pinned_rank": R, "flagged": [...],
+    "windows_to_flag": W, "pre_alg": "...", "post_alg": "...",
+    "pre_p99_ms": ..., "post_p99_ms": ..., "ok": bool}``."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # pin ring BEFORE lib/context creation: identical on every in-process
+    # rank, and 2e9 < SCORE_MAX keeps it demotable (inf would be exempt)
+    os.environ["UCC_TL_SHM_TUNE"] = "allreduce:@ring:2000000000"
+    rec: Dict[str, Any] = {"metric": "feedback_smoke",
+                           "pinned_rank": args.smoke_rank}
+    try:
+        import time
+
+        import numpy as np
+
+        from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType,
+                             ReductionOp)
+        from ucc_tpu.constants import MemoryType
+        from ucc_tpu.fault import inject as fault
+        from ucc_tpu.obs import collector, flight
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), "tests"))
+        from harness import UccJob
+
+        flight.configure(enabled=True)
+        # interval comfortably > one delayed ring iteration
+        # (~2*(n-1)*delay), so every window contains at least one
+        # collective start — the point where the wire-lag signal
+        # isolates the delayed sender
+        collector.configure(enabled=True, interval=2.5, slack=2,
+                            dir="", windows=2)
+        n, count = 8, 4096
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            fault.configure(
+                f"delay=1.0:{args.smoke_delay},"
+                f"delay_rank={args.smoke_rank}", seed=0)
+            try:
+                srcs = [np.full(count, r + 1.0) for r in range(n)]
+                dsts = [np.zeros(count) for _ in range(n)]
+
+                def one_iter():
+                    t0 = time.monotonic()
+                    job.run_coll(teams, lambda r: CollArgs(
+                        coll_type=CollType.ALLREDUCE,
+                        src=BufferInfo(srcs[r], count, DataType.FLOAT64),
+                        dst=BufferInfo(dsts[r], count, DataType.FLOAT64),
+                        op=ReductionOp.SUM), timeout=120)
+                    return time.monotonic() - t0
+
+                mem, nbytes = MemoryType.HOST, count * 8
+                pre_alg = teams[0].score_map.lookup(
+                    CollType.ALLREDUCE, mem, nbytes)[0].alg_name
+                rec["pre_alg"] = pre_alg
+                pre, post = [], []
+                for _ in range(args.smoke_iters * 10):
+                    pre.append(one_iter())
+                    if teams[0].rank_bias is not None and \
+                            teams[0].rank_bias.flagged:
+                        break
+                bias = teams[0].rank_bias
+                rec["flagged"] = sorted(bias.flagged) if bias else []
+                # budget counts from the first window that SAW the
+                # straggler's traffic — windows elapsed during team
+                # create / before the fault armed don't charge it
+                rec["windows_to_flag"] = None
+                col = getattr(job.contexts[0], "collector", None)
+                watch = col.watch_for(teams[0]) if col else None
+                sc = watch.scorer if watch is not None else None
+                if sc is not None and sc.first_flag_index is not None \
+                        and sc.first_sev_index is not None:
+                    rec["windows_to_flag"] = \
+                        sc.first_flag_index - sc.first_sev_index + 1
+                elif bias is not None and \
+                        bias.first_flag_window is not None:
+                    rec["windows_to_flag"] = bias.first_flag_window + 1
+                post_alg = teams[0].score_map.lookup(
+                    CollType.ALLREDUCE, mem, nbytes,
+                    bias=bias)[0].alg_name
+                rec["post_alg"] = post_alg
+                for _ in range(max(4, args.smoke_iters)):
+                    post.append(one_iter())
+            finally:
+                fault.reset()
+        finally:
+            job.cleanup()
+
+        def p99(xs):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+        rec["pre_iters"], rec["post_iters"] = len(pre), len(post)
+        rec["pre_p99_ms"] = round(p99(pre) * 1e3, 1)
+        rec["post_p99_ms"] = round(p99(post) * 1e3, 1)
+        rec["ok"] = args.smoke_rank in set(rec["flagged"]) and \
+            rec["windows_to_flag"] is not None and \
+            rec["windows_to_flag"] <= 2 and \
+            pre_alg == "ring" and post_alg != "ring" and \
+            rec["post_p99_ms"] < rec["pre_p99_ms"]
+    except Exception as e:  # noqa: BLE001 - the gate reports, not raises
+        rec["error"] = f"{type(e).__name__}: {e}"
+        rec["ok"] = False
+    print(json.dumps(rec))
+    return 0 if rec.get("ok") else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ucc_fr",
         description="flight-recorder merge / diagnose / export")
     ap.add_argument("files", nargs="*",
                     help="flight dump file(s) (JSON lines; "
-                         "UCC_FLIGHT_FILE)")
+                         "UCC_FLIGHT_FILE) and/or collector trace-store "
+                         "directories (UCC_COLLECT_DIR)")
+    ap.add_argument("--tail", type=int, metavar="N",
+                    help="with a trace-store directory: merge only the "
+                         "N freshest records")
     ap.add_argument("--json", action="store_true",
                     help="print the merged diagnosis as JSON")
     ap.add_argument("--perfetto", metavar="OUT",
@@ -165,6 +310,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="run the self-contained diagnosis drill "
                          "(4-rank job, delay pinned to one rank; exit 0 "
                          "iff the diagnosis names it)")
+    ap.add_argument("--feedback-smoke", action="store_true",
+                    help="run the closed-loop collector drill (8-rank "
+                         "job, ring pinned, delay on one rank; exit 0 "
+                         "iff the collector flags it within 2 windows, "
+                         "selection moves off the ring, and p99 "
+                         "improves)")
     ap.add_argument("--smoke-rank", type=int, default=1,
                     help="ctx rank the smoke pins the delay to")
     ap.add_argument("--smoke-delay", type=float, default=0.05,
@@ -175,6 +326,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.smoke:
         return _smoke(args)
+    if args.feedback_smoke:
+        return _feedback_smoke(args)
     if args.pid is not None:
         try:
             os.kill(args.pid, signal.SIGUSR2)
@@ -192,7 +345,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     records: List[Dict[str, Any]] = []
     for path in args.files:
         try:
-            records.extend(load_records(path))
+            if os.path.isdir(path):
+                from ucc_tpu.obs import collector
+                records.extend(
+                    r for r in collector.load_dir_records(
+                        path, tail=args.tail)
+                    if str(r.get("kind", "")).startswith("flight"))
+            else:
+                records.extend(load_records(path))
         except OSError as e:
             print(f"ucc_fr: {e}", file=sys.stderr)
             return 1

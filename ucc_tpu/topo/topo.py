@@ -130,10 +130,14 @@ class TeamTopo:
         pods = {self._proc(r).pod_hash for r in range(self.team_size)}
         return len(pods) > 1
 
-    def hier_tree(self, max_levels: Optional[int] = None) -> "HierTree":
+    def hier_tree(self, max_levels: Optional[int] = None,
+                  demote=()) -> "HierTree":
         """Build the team's hierarchy tree. ``max_levels`` caps the number
         of unit levels (2 = classic node/leaders split even when pods
-        exist); None/oversized = full depth."""
+        exist); None/oversized = full depth. ``demote`` lists team ranks
+        the continuous collector has flagged slow: they are pushed out of
+        leader positions wherever a non-flagged group member exists (see
+        HierTree)."""
         with_pods = self.pods_active()
         if max_levels is not None and max_levels < 3:
             # a 2-level cap collapses the pod attribute: groups form by
@@ -141,7 +145,7 @@ class TeamTopo:
             with_pods = False
         paths = [self.rank_path(r, with_pods)
                  for r in range(self.team_size)]
-        return HierTree(paths, self.my_rank)
+        return HierTree(paths, self.my_rank, demote=demote)
 
     def node_layout(self) -> tuple:
         """Per-node member counts of THIS team, sorted — the node-shape
@@ -173,7 +177,8 @@ class HierTreeLevel:
     into unit groups. Level 0 partitions ALL team ranks into nodes; level
     l >= 1 partitions the level-(l-1) group leaders by shrinking path
     prefix; the top level is a single group. Within a group members are
-    in ascending team-rank order, so ``group[0]`` is the group's leader;
+    in ascending team-rank order — except ranks demoted by straggler
+    feedback, which sort last — so ``group[0]`` is the group's leader;
     groups are in hierarchical (parent-subtree-contiguous) order."""
 
     name: str
@@ -198,12 +203,20 @@ class HierTree:
       unit (``rep(l, r) == r``). Every rank is a member at level 0.
     """
 
-    def __init__(self, paths: List[tuple], my_rank: int):
+    def __init__(self, paths: List[tuple], my_rank: int, demote=()):
         if not paths:
             raise ValueError("empty team")
         self.my_rank = my_rank
         self.team_size = n = len(paths)
         self.paths = list(paths)
+        #: team ranks demoted from leader positions (collector RankBias
+        #: feedback): within a group they order AFTER every non-demoted
+        #: member, so ``group[0]`` — the leader every funnel/fanout
+        #: serializes through — is a demoted rank only when its whole
+        #: group is flagged. The set must be identical on every rank
+        #: (it is agreed during team bootstrap, core/team.py) or the
+        #: resulting trees diverge and hier collectives deadlock.
+        self.demoted = frozenset(demote)
         depth = len(paths[0])
         if any(len(p) != depth for p in paths):
             raise ValueError("inconsistent path depths")
@@ -237,7 +250,7 @@ class HierTree:
                     groups.append([])
                 groups[gi].append(r)
             for g in groups:
-                g.sort()
+                g.sort(key=lambda r: (r in self.demoted, r))
             name = ("node" if l == 0 else
                     "top" if plen == 0 else f"tier{l}")
             self.levels.append(HierTreeLevel(name, groups, plen))
@@ -288,7 +301,9 @@ class HierTree:
         """One line per level: sizes and leader ranks (truncated), the
         team-activation log / ucc_info -s rendering."""
         out = [f"hier tree: {self.n_levels} levels over "
-               f"{self.team_size} ranks"]
+               f"{self.team_size} ranks"
+               + (f", demoted [{','.join(str(r) for r in sorted(self.demoted))}]"
+                  if self.demoted else "")]
         for l, lvl in enumerate(self.levels):
             sizes = [len(g) for g in lvl.groups]
             leaders = [g[0] for g in lvl.groups]
