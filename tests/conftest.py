@@ -53,3 +53,11 @@ if not _REAL_CHIP and "jax" in sys.modules:
         jax.config.update("jax_platforms", "cpu")
     except Exception:  # noqa: BLE001 - backend already initialized
         pass
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; long-running acceptance drills (the
+    # churn soak) opt out of it with this marker
+    config.addinivalue_line(
+        "markers", "slow: long-running acceptance drill, excluded from "
+        "the tier-1 sweep")

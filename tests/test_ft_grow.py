@@ -1,0 +1,438 @@
+"""Elastic membership (ISSUE 17): Team.grow / Team.join, the grow-side
+epoch fence, rollback when a joiner never arrives, the fresh-heartbeat
+agreement race fix, re-admission of a falsely-suspected survivor, and
+collector/flight continuity across growth."""
+import time
+
+import numpy as np
+import pytest
+
+from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType,
+                     RankFailedError, ReductionOp, Status)
+from ucc_tpu.core.team import Team
+from ucc_tpu.fault import health, inject
+from ucc_tpu.tl.host.transport import Mailbox, RecvReq
+
+from harness import UccJob
+
+
+@pytest.fixture(autouse=True)
+def _clean_ft():
+    inject.reset()
+    health.reset()
+    yield
+    inject.reset()
+    health.reset()
+
+
+def _ft_on(interval=0.02, timeout=0.3):
+    health.configure("shrink", interval=interval, timeout=timeout)
+
+
+def _ar_args(rank, count=16):
+    dst = np.zeros(count, np.float64)
+    args = CollArgs(coll_type=CollType.ALLREDUCE,
+                    src=BufferInfo(np.full(count, rank + 1.0), count,
+                                   DataType.FLOAT64),
+                    dst=BufferInfo(dst, count, DataType.FLOAT64),
+                    op=ReductionOp.SUM)
+    return args, dst
+
+
+def _drive(ctxs, cond, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for c in ctxs:
+            c.progress()
+        if cond():
+            return True
+    return False
+
+
+def _grow_to_full(job, teams, joiner_idx, timeout=20.0):
+    """Drive grow_post on every *teams* member + join_post on the
+    joiner; returns (grows dict, join request). NOTE the list
+    comprehension in the condition: every membership request must be
+    polled each pass — test() drives the rebuild rounds."""
+    joiner_ctx = job.contexts[joiner_idx].rank
+    grows = {r: t.grow_post([joiner_ctx]) for r, t in teams.items()}
+    jn = Team.join_post(job.contexts[joiner_idx])
+    assert _drive(job.contexts, lambda: all(
+        [g.test() != Status.IN_PROGRESS for g in grows.values()]
+        + [jn.test() != Status.IN_PROGRESS]), timeout)
+    return grows, jn
+
+
+# ---------------------------------------------------------------------------
+# grow basics
+# ---------------------------------------------------------------------------
+
+class TestGrowBasic:
+    def test_grow_admits_rank_and_retires_old_team(self):
+        """Survivors grow_post + the joiner join_post converge on one
+        epoch; the old team refuses new posts (naming the grow) and the
+        grown team serves a correct allreduce including the joiner."""
+        job = UccJob(4)
+        try:
+            teams = dict(enumerate(job.create_team(ranks=[0, 1, 2])))
+            grows, jn = _grow_to_full(job, teams, 3)
+            for g in grows.values():
+                assert g.test() == Status.OK, g.test()
+            assert jn.test() == Status.OK
+            epochs = {g.epoch for g in grows.values()} | {jn.epoch}
+            assert epochs == {1}, epochs
+            new_teams = [grows[r].new_team for r in sorted(grows)] \
+                + [jn.new_team]
+            for t in new_teams:
+                assert t.size == 4 and t.epoch == 1
+            with pytest.raises(RankFailedError, match="grow"):
+                teams[0].collective_init(_ar_args(0)[0])
+            reqs = []
+            for g, t in enumerate(new_teams):
+                args, dst = _ar_args(g)
+                rq = t.collective_init(args)
+                rq.post()
+                reqs.append((rq, dst))
+            assert _drive(job.contexts, lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq, _ in reqs), 10)
+            for rq, dst in reqs:
+                assert rq.test() == Status.OK, rq.test()
+                assert np.allclose(dst, sum(g + 1.0 for g in range(4)))
+                rq.finalize()
+            for t in new_teams:
+                t.destroy()
+        finally:
+            job.cleanup()
+
+    def test_grow_validates_inputs(self):
+        job = UccJob(3)
+        try:
+            teams = job.create_team()
+            # admitting a current member is a caller error
+            with pytest.raises(Exception):
+                teams[0].grow_post([job.contexts[1].rank])
+            with pytest.raises(Exception):
+                teams[0].grow_post([])
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# the grow-side epoch fence (satellite c)
+# ---------------------------------------------------------------------------
+
+TEAM_KEY = (("unit",), "cl")
+
+
+class TestGrowFence:
+    def test_stale_pre_grow_send_cannot_match_post_grow_recv(self):
+        """Mailbox unit: after the grow fence (epoch 1 -> 2), a send
+        still keyed to the pre-grow epoch is discarded at the matching
+        boundary; it can never land in a recv posted under the grown
+        epoch."""
+        mb = Mailbox()
+        mb.fence(TEAM_KEY, 2)        # team grew: epochs < 2 are dead
+        new_dst = np.zeros(8, np.uint8)
+        new_recv = RecvReq(new_dst)
+        mb.post_recv((TEAM_KEY, 2, 1, 0, 0), new_recv)
+        # identical (tag, slot, src) but the pre-grow epoch: no match
+        sreq, kind = mb.send((TEAM_KEY, 1, 1, 0, 0),
+                             np.full(8, 0xAB, np.uint8), 8192)
+        assert kind == "fenced" and sreq.done
+        assert not new_recv.done and not new_dst.any()
+        sreq2, kind2 = mb.send((TEAM_KEY, 2, 1, 0, 0),
+                               np.full(8, 0xCD, np.uint8), 8192)
+        assert kind2 == "direct" and new_recv.done
+        assert (new_dst == 0xCD).all()
+
+    def test_grow_fences_old_tl_teams(self):
+        """Integration: after Team.grow, a late send keyed to the OLD
+        team's tag space is discarded by the transport (n_fenced ticks)
+        on whichever matcher the endpoint uses — native included."""
+        from ucc_tpu.tl.host.transport import InProcTransport
+        job = UccJob(4)
+        try:
+            teams = dict(enumerate(job.create_team(ranks=[0, 1, 2])))
+            grows, jn = _grow_to_full(job, teams, 3)
+            assert all(g.test() == Status.OK for g in grows.values())
+            assert jn.test() == Status.OK
+            probed = False
+            for team_key, tr in teams[0]._tl_tag_spaces():
+                if not isinstance(tr, InProcTransport):
+                    continue
+                before = tr.n_fenced
+                key = (team_key, 0, (1 << 20) + 1, 999, 0)
+                req = tr.send_nb(tr, key, np.ones(8, np.uint8))
+                assert req.test()          # sender never parks
+                assert tr.n_fenced == before + 1
+                probed = True
+                break
+            assert probed, "no loopback transport to probe"
+            for t in [g.new_team for g in grows.values()] + [jn.new_team]:
+                t.destroy()
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# rollback: a joiner that never shows up (satellite b)
+# ---------------------------------------------------------------------------
+
+class TestGrowRollback:
+    def test_absent_joiner_times_out_and_old_team_survives(self):
+        """A grow whose joiner never bootstraps fails ERR_TIMED_OUT
+        naming the absent joiner; the pre-grow team stays fully usable,
+        and a retried grow (joiner present this time) succeeds."""
+        job = UccJob(4)
+        try:
+            teams = dict(enumerate(job.create_team(ranks=[0, 1, 2])))
+            joiner_ctx = job.contexts[3].rank
+            grows = {r: t.grow_post([joiner_ctx], timeout_s=2.0)
+                     for r, t in teams.items()}
+            assert _drive(job.contexts, lambda: all(
+                [g.test() != Status.IN_PROGRESS
+                 for g in grows.values()]), 20)
+            for g in grows.values():
+                assert g.test() == Status.ERR_TIMED_OUT, g.test()
+                assert g.absent_joiners == [joiner_ctx]
+                assert g.new_team is None
+            assert not teams[0]._shrunk
+            # the old team still serves correct collectives
+            reqs = []
+            for g, t in teams.items():
+                args, dst = _ar_args(g)
+                rq = t.collective_init(args)
+                rq.post()
+                reqs.append((rq, dst))
+            assert _drive(job.contexts, lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq, _ in reqs), 10)
+            for rq, dst in reqs:
+                assert rq.test() == Status.OK, rq.test()
+                assert np.allclose(dst, 1.0 + 2.0 + 3.0)
+                rq.finalize()
+            # retry with the joiner present: the per-attempt agreement
+            # tag and the invite-supersede join protocol make the stale
+            # first-attempt invite harmless
+            grows2, jn = _grow_to_full(job, teams, 3)
+            sts = [g.test() for g in grows2.values()] + [jn.test()]
+            assert all(s == Status.OK for s in sts), sts
+            for t in [g.new_team for g in grows2.values()] \
+                    + [jn.new_team]:
+                t.destroy()
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# the PR-4 agreement race (satellite a)
+# ---------------------------------------------------------------------------
+
+class TestAgreeRace:
+    def _run_agreement(self, job, round_timeout_s):
+        """All ranks enter agreement with EMPTY views while ctx rank 1's
+        sends are deterministically delayed past the round timeout."""
+        from ucc_tpu.fault.agree import FtAgreement
+        teams = job.create_team()
+        delayed_ctx = job.contexts[1].rank
+        inject.configure(f"delay=1.0:0.6,delay_rank={delayed_ctx}",
+                         seed=0)
+        tasks = {}
+        for r in range(len(teams)):
+            t = FtAgreement(teams[r].service_team, set(), epoch=0,
+                            round_timeout_s=round_timeout_s)
+            t.progress_queue = job.contexts[r].progress_queue
+            tasks[r] = t
+            t.post()
+        assert _drive(job.contexts, lambda: all(
+            t.is_completed() for t in tasks.values()), 20)
+        return tasks
+
+    def test_fresh_heartbeat_rank_survives_slow_agreement(self):
+        """Regression (PR-4 race): a live rank whose agreement messages
+        are slower than the round timeout but whose heartbeat is FRESH
+        must NOT be suspected — the deadline folds against health
+        evidence and extends instead of condemning."""
+        _ft_on(interval=0.02, timeout=5.0)
+        job = UccJob(3)
+        try:
+            # round timeout 0.25s < the 0.6s send delay: without the
+            # freshness fold every peer would condemn rank 1 at the
+            # first deadline
+            tasks = self._run_agreement(job, round_timeout_s=0.25)
+            views = {(frozenset(t.result_dead), t.result_epoch)
+                     for t in tasks.values()}
+            assert views == {(frozenset(), 1)}, views
+        finally:
+            job.cleanup()
+
+    def test_grace_zero_documents_the_old_race(self, monkeypatch):
+        """Control: with the freshness grace disabled the identical
+        drill condemns the slow-but-alive rank — the behaviour the
+        UCC_FT_AGREE_GRACE fold exists to prevent."""
+        monkeypatch.setenv("UCC_FT_AGREE_GRACE", "0")
+        _ft_on(interval=0.02, timeout=5.0)
+        job = UccJob(3)
+        try:
+            tasks = self._run_agreement(job, round_timeout_s=0.25)
+            dead_views = [t.result_dead for r, t in tasks.items()
+                          if r != 1]
+            assert any(1 in d for d in dead_views), dead_views
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# re-admission after false suspicion (closes the PR-4 limit)
+# ---------------------------------------------------------------------------
+
+class TestRejoinAfterFalseExclusion:
+    def test_falsely_excluded_live_rank_rejoins(self):
+        """Survivors shrink a LIVE rank out (bad hint); the victim —
+        which never took part — tears down its stale team and re-enters
+        through the join path: revived out of every survivor's dead
+        set, serving correct collectives on the new epoch."""
+        _ft_on()
+        job = UccJob(4)
+        try:
+            teams = job.create_team()
+            victim = 3
+            victim_ctx = job.contexts[victim].rank
+            shrinks = {r: teams[r].shrink_post(dead_hint=[victim])
+                       for r in range(4) if r != victim}
+            assert _drive(job.contexts, lambda: all(
+                [s.test() != Status.IN_PROGRESS
+                 for s in shrinks.values()]), 20)
+            for s in shrinks.values():
+                assert s.test() == Status.OK, s.test()
+            for r in shrinks:
+                assert victim_ctx in job.contexts[r].health.dead_set()
+            teams[victim].destroy()
+            small = {r: shrinks[r].new_team for r in shrinks}
+            grows, jn = _grow_to_full(job, small, victim)
+            assert all(g.test() == Status.OK for g in grows.values())
+            assert jn.test() == Status.OK
+            # demonstrably re-admitted: revived everywhere ...
+            for r in shrinks:
+                assert victim_ctx not in job.contexts[r].health.dead_set()
+            # ... and serving collectives on the post-rejoin epoch
+            new_teams = [grows[r].new_team for r in sorted(grows)] \
+                + [jn.new_team]
+            assert {t.epoch for t in new_teams} == {2}
+            reqs = []
+            for g, t in enumerate(new_teams):
+                args, dst = _ar_args(g)
+                rq = t.collective_init(args)
+                rq.post()
+                reqs.append((rq, dst))
+            assert _drive(job.contexts, lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq, _ in reqs), 10)
+            for rq, dst in reqs:
+                assert rq.test() == Status.OK, rq.test()
+                assert np.allclose(dst, sum(g + 1.0 for g in range(4)))
+                rq.finalize()
+            for t in new_teams:
+                t.destroy()
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# collector / flight continuity across growth (satellite f)
+# ---------------------------------------------------------------------------
+
+class TestObsContinuity:
+    def test_collector_state_survives_grow(self):
+        """The straggler scorer's learned state rides the handoff into
+        the grown team's watch (remapped through ctx ranks — the rank
+        set is not monotone under growth), the retired team stops being
+        watched, and the joiner's boot:* flight spans exist under the
+        new epoch for the merged trace."""
+        from ucc_tpu.obs import collector as obs_collector
+        from ucc_tpu.obs import flight as obs_flight
+        prev = (obs_collector.KNOBS.enabled, obs_collector.KNOBS.interval,
+                obs_collector.KNOBS.dir, obs_flight.ENABLED)
+        obs_flight.configure(enabled=True)
+        obs_collector.configure(enabled=True, interval=0.25, dir="")
+        job = UccJob(4)
+        try:
+            teams = dict(enumerate(job.create_team(ranks=[0, 1, 2])))
+            col = job.contexts[0].collector
+            assert col is not None
+            old_w = col.watch_for(teams[0])
+            assert old_w is not None
+            # learned straggler state on the pre-grow watch
+            old_w.scorer.scores = {1: 2.5}
+            old_w.scorer.streaks = {1: 3}
+            old_w.scorer.flagged = {1}
+            old_w.scorer.windows_seen = 7
+            grows, jn = _grow_to_full(job, teams, 3)
+            assert all(g.test() == Status.OK for g in grows.values())
+            assert jn.test() == Status.OK
+            new_team = grows[0].new_team
+            assert col.watch_for(teams[0]) is None   # retired: unwatched
+            new_w = col.watch_for(new_team)
+            assert new_w is not None
+            # ctx 1 was old rank 1 and is new rank 1 (joiner appended)
+            assert new_w.scorer.scores == {1: 2.5}
+            assert new_w.scorer.streaks == {1: 3}
+            assert new_w.scorer.flagged == {1}
+            assert new_w.scorer.windows_seen == 7
+            assert new_w.window == 0   # window index restarts by design
+            # the joiner's flight ring carries boot spans for the grown
+            # team under the new epoch — they land in a merged trace
+            jfr = job.contexts[3].flight
+            assert jfr is not None
+            evs = jfr.snapshot()["events"]
+            boots = [e for e in evs
+                     if str(e.get("stage", "")).startswith("boot:")
+                     and e.get("epoch") == 1]
+            assert boots, evs
+            # survivors recorded the grow membership marker inline
+            sfr = job.contexts[0].flight
+            marks = [e for e in sfr.snapshot()["events"]
+                     if e.get("coll") == "membership"]
+            assert any(e.get("alg") == "grow" for e in marks), marks
+            for t in [g.new_team for g in grows.values()] + [jn.new_team]:
+                t.destroy()
+        finally:
+            job.cleanup()
+            obs_collector.configure(enabled=prev[0], interval=prev[1],
+                                    dir=prev[2])
+            obs_flight.configure(enabled=prev[3])
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: churn (kill -> shrink -> grow cycles)
+# ---------------------------------------------------------------------------
+
+class TestChurn:
+    def test_mini_churn_cycle(self):
+        """One full kill -> shrink -> grow(rejoin) cycle plus the
+        false-suspicion round, collectives in flight on every epoch,
+        fences tripped in both directions."""
+        from ucc_tpu.fault.soak import run_churn_soak
+        report = run_churn_soak(n_ranks=4, cycles=1, iters_per_epoch=2,
+                                post_iters=6)
+        assert report["violations"] == [], report
+        assert report["cycles"] == 1
+        assert report["fenced"]["shrink"] > 0
+        assert report["fenced"]["grow"] > 0
+        assert report["readmitted"] is True
+        assert report["post_churn_ok"] == 6
+
+    @pytest.mark.slow
+    def test_churn_acceptance(self):
+        """ISSUE-17 acceptance: >= 2 interleaved cycles, no hang,
+        n_fenced > 0 both directions, >= 50 correct post-churn
+        collectives, the falsely-excluded survivor re-admitted and
+        serving on the new epoch — on the native matcher."""
+        from ucc_tpu.fault.soak import run_churn_soak
+        report = run_churn_soak(n_ranks=4, cycles=2, post_iters=54,
+                                plans=True)
+        assert report["violations"] == [], report
+        assert report["cycles"] >= 2
+        assert report["fenced"]["shrink"] >= 2
+        assert report["fenced"]["grow"] >= 2
+        assert report["post_churn_ok"] >= 50
+        assert report["readmitted"] is True
+        assert report["matcher"] == "native"

@@ -23,6 +23,17 @@ COUNT = 8192                       # 32 KiB f32: the bandwidth-alg regime
 NBYTES = COUNT * 4
 
 
+@pytest.fixture(autouse=True)
+def _fresh_session_cache():
+    # decisions frozen by one test must not warm-start the next — each
+    # test owns its tmp_path file cache, so the in-process session cache
+    # (membership-change warm-start, PR 17) is cleared around each test
+    from ucc_tpu.score import tuner
+    tuner.session_reset()
+    yield
+    tuner.session_reset()
+
+
 def _persistent_allreduce(teams, srcs, dsts):
     argses = [CollArgs(coll_type=CollType.ALLREDUCE, op=ReductionOp.SUM,
                        src=BufferInfo(srcs[r], COUNT, DataType.FLOAT32),

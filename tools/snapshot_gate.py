@@ -921,6 +921,82 @@ def _feedback_smoke(env) -> None:
           f"in {dt:.0f}s -> {verdict}", flush=True)
 
 
+def _churn_smoke(env) -> None:
+    """WARN-ONLY elastic-membership probe (ISSUE 17 CI satellite):
+    ``python -m ucc_tpu.fault.soak --churn --cycles 2 --collect`` runs
+    interleaved kill -> shrink -> grow(rejoin) cycles with collectives
+    in flight on every epoch plus the false-suspicion re-admission
+    round, and classifies any breakage (hang vs rank_failed vs
+    grow-timeout) from the report. Skip with UCC_GATE_CHURN=0."""
+    import json
+    if os.environ.get("UCC_GATE_CHURN", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] churn smoke: skipped (UCC_GATE_CHURN=0)",
+              flush=True)
+        return
+    print("[gate] membership-churn smoke (warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    # the drill arms its own fault/health/collector knobs; strip the
+    # gate watchdog so escalation doesn't cancel mid-membership-change
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE",
+                                      "UCC_COLLECT", "UCC_FT"))}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ucc_tpu.fault.soak", "--churn",
+             "--cycles", "2", "--collect"],
+            cwd=REPO, env=smoke_env, capture_output=True, text=True,
+            timeout=600)
+    except subprocess.TimeoutExpired:
+        # a gate-level timeout here IS the hang class: the drill's own
+        # deadlines should have classified anything slower first
+        print("[gate] WARN: churn smoke timed out — HANG class "
+              "(not a gate failure)", flush=True)
+        return
+    rec = None
+    try:
+        rec = json.loads(r.stdout or "")
+    except ValueError:
+        for ln in (r.stdout or "").splitlines():
+            if ln.startswith("{"):
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue
+    dt = time.monotonic() - t0
+    if rec is None:
+        print(f"[gate] WARN: churn smoke — rc={r.returncode}, no report "
+              f"in {dt:.0f}s (not a gate failure)", flush=True)
+        return
+    problems = []
+    # classify violations so the gate log names the failure mode
+    for v in rec.get("violations") or []:
+        if "IN_PROGRESS" in v or "hung" in v:
+            problems.append(f"hang: {v}")
+        elif "ERR_RANK_FAILED" in v or "rank" in v.lower():
+            problems.append(f"rank_failed: {v}")
+        elif "timed out" in v.lower() or "TIMED_OUT" in v:
+            problems.append(f"grow-timeout: {v}")
+        else:
+            problems.append(v)
+    if rec.get("cycles", 0) < 2:
+        problems.append(f"only {rec.get('cycles')} cycle(s) completed")
+    fenced = rec.get("fenced") or {}
+    if not fenced.get("shrink"):
+        problems.append("no pre-shrink send fenced")
+    if not fenced.get("grow"):
+        problems.append("no pre-grow send fenced")
+    if not rec.get("readmitted"):
+        problems.append("falsely-suspected rank was not re-admitted")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] churn smoke: cycles={rec.get('cycles')}, "
+          f"epochs={rec.get('epochs')}, fenced={fenced}, "
+          f"readmitted={rec.get('readmitted')}, post_churn_ok="
+          f"{rec.get('post_churn_ok')}, matcher={rec.get('matcher')} "
+          f"in {dt:.0f}s -> {verdict}", flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -1013,6 +1089,10 @@ def main(argv=None) -> int:
         # straggler within 2 windows, RankBias moves selection off the
         # ring, and post-feedback p99 beats pre-feedback (ISSUE 16)
         _feedback_smoke(env)
+        # warn-only: >= 2 kill->shrink->grow(rejoin) churn cycles with
+        # collectives on every epoch, fences tripped both directions,
+        # and the falsely-suspected survivor re-admitted (ISSUE 17)
+        _churn_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

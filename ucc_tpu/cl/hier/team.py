@@ -174,8 +174,9 @@ class ClHierTeam(BaseTeam):
                       if int(core_team.ctx_map.eval(tr)) in flagged_ctx}
             if demote:
                 logger.info(
-                    "cl/hier team %s: demoting flagged rank(s) %s from "
-                    "leader positions", core_team.id,
+                    "cl/hier team %s (epoch %d): demoting flagged "
+                    "rank(s) %s from leader positions", core_team.id,
+                    getattr(core_team, "epoch", 0),
                     ",".join(str(r) for r in sorted(demote)))
         self.tree = topo.hier_tree(cap, demote=demote)
         self.level_units: List[Optional[HierSbgp]] = []
@@ -264,7 +265,14 @@ class ClHierTeam(BaseTeam):
         ``ucc_info -s``: the tree plus, per level this rank serves, the
         TLs its unit team actually created — a mis-detected topology
         shows up here instead of silently degrading to flat."""
-        lines = [self.tree.describe()]
+        ep = int(getattr(self.core_team, "epoch", 0))
+        head = self.tree.describe()
+        if ep:
+            # membership changes (shrink/grow) rebuild the hierarchy on a
+            # new epoch — name it so operators can match topology dumps
+            # to the membership timeline
+            head = f"{head} [epoch {ep}]"
+        lines = [head]
         for lvl, unit in enumerate(self.level_units):
             if unit is None:
                 lines.append(f"  L{lvl}: (not a participant)")

@@ -494,10 +494,11 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
     """ucc_collective_init (ucc_coll.c:172)."""
     if team._shrunk:
         # the old epoch's tag space is fenced; collectives must move to
-        # the successor team ShrinkRequest.new_team returned
+        # the successor team the Shrink/Grow request returned
+        how = team._retired_by or "shrunk"
         raise RankFailedError(
-            f"team {team.id} was shrunk after rank failure; post on the "
-            "successor team")
+            f"team {team.id} was retired by a membership {how}; post on "
+            "the successor team")
     if team.score_map is None:
         raise UccError(Status.ERR_INVALID_PARAM, "team is not active")
     ct = args.coll_type

@@ -83,6 +83,15 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
                 "in-flight collectives depending on it are cancelled "
                 "with ERR_RANK_FAILED (UCC_FT=shrink only)",
                 parse_string),
+    ConfigField("FT_GROW_TIMEOUT", "30.0", "seconds a Team.grow waits for "
+                "every invited joiner to bootstrap before rolling back "
+                "(ERR_TIMED_OUT naming the absent joiner; the pre-grow "
+                "team stays fully usable)", parse_string),
+    ConfigField("FT_AGREE_GRACE", "3", "bounded deadline extensions a "
+                "fault-agreement round grants a pending peer whose "
+                "heartbeat is still FRESH — slow-but-alive ranks are not "
+                "condemned by the round timer alone (0 restores the "
+                "timer-only PR-4 behavior)", parse_string),
     ConfigField("OOB_CONNECT_BACKOFF_BASE", "0.05", "initial TCP-store OOB "
                 "connect retry backoff in seconds (exponential, full "
                 "jitter)", parse_string),

@@ -27,16 +27,29 @@ Protocol (rounds in lockstep, slot = round):
 4. The agreed epoch is ``max(all exchanged epochs) + 1`` — identical
    everywhere because the exchanged views are identical.
 
-Known limitation (documented, not hidden): a rank that dies *between* a
-peer's termination and another peer's round-deadline can make the
-late peer suspect the already-terminated one. Full ULFM agreement
-(ERA) layers a coordinator to close this; here the round deadline is
-sized well above the heartbeat timeout so detection almost always
-precedes agreement, and a mis-suspected survivor is excluded (shrunk
-away), never deadlocked — the bounded-outcome invariant holds.
+Elastic extension (PR 17): views carry an *admit* proposal alongside the
+dead set — ``(dead set, admit set, epoch)`` — so the same protocol that
+agrees on who left also agrees on who JOINS (``Team.grow``). Admit sets
+union exactly like dead sets and termination requires all-equal on both,
+so every survivor adopts the same (dead, admit, epoch) triple.
+
+The PR-4 mis-suspicion race — a slow-but-alive survivor whose agreement
+sends land after a peer's round deadline was condemned and excluded —
+is now folded against fresh health evidence: at deadline expiry a
+pending peer whose heartbeat stamp is FRESH (``HealthRegistry.is_fresh``)
+is granted up to ``UCC_FT_AGREE_GRACE`` deadline extensions instead of
+being suspected; only heartbeat-stale peers are condemned immediately.
+Suspicion stays monotone (a rank once added to the dead view is never
+removed — un-suspecting would break the all-equal convergence
+argument), so the fix is purely about *not adding* a rank the local
+failure detector can still vouch for. When exclusion happens anyway
+(grace exhausted, cross-process peer with no board stamp), the recovery
+path is grow-based re-admission: the excluded survivor rejoins through
+``Team.join`` on the next epoch.
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Iterable, Optional, Set
 
@@ -54,11 +67,30 @@ logger = get_logger("fault")
 #: collide with service-collective traffic on the same team
 _AGREE_SLOT_BASE = 7000
 
+#: wire-format capacity for admit proposals: a fixed slab so every
+#: participant computes the same buffer size without negotiating it
+#: (grow batches are small — a handful of joiners per epoch, never a
+#: team's worth)
+_ADMIT_CAP = 32
+
+
+def _agree_grace() -> int:
+    """Max round-deadline extensions granted to a heartbeat-fresh peer
+    before the last-resort suspicion fires anyway (``UCC_FT_AGREE_GRACE``,
+    bounded so a wedged-but-beating process cannot stall agreement
+    forever)."""
+    try:
+        return max(0, int(os.environ.get("UCC_FT_AGREE_GRACE", "") or 3))
+    except ValueError:
+        return 3
+
 
 class FtAgreement(HostCollTask):
     """Agreement task posted on the (old) team's service TL team by every
     survivor. On success, ``result_dead`` holds the agreed failed set in
-    TEAM ranks and ``result_epoch`` the agreed next epoch."""
+    TEAM ranks, ``result_admit`` the agreed joiner set in CONTEXT ranks
+    (empty for plain shrink agreement), and ``result_epoch`` the agreed
+    next epoch."""
 
     coll_name = "ft_agree"
     alg_name = "flood"
@@ -69,49 +101,89 @@ class FtAgreement(HostCollTask):
     _ft_exempt = True
 
     def __init__(self, service_team, local_dead: Iterable[int],
-                 epoch: int, round_timeout_s: float = 0.0):
+                 epoch: int, round_timeout_s: float = 0.0,
+                 proposal: Optional[Iterable[int]] = None,
+                 kind: str = "shrink"):
         super().__init__(None, service_team)
         self.local_dead: Set[int] = {int(r) for r in local_dead}
+        #: ctx ranks proposed for admission (grow); capped by the wire
+        #: format — a batch this large is a topology change, not a grow
+        self.local_admit: Set[int] = {int(r) for r in (proposal or ())}
+        if len(self.local_admit) > _ADMIT_CAP:
+            raise UccError(
+                Status.ERR_NOT_SUPPORTED,
+                f"grow proposal of {len(self.local_admit)} joiners "
+                f"exceeds the agreement wire capacity ({_ADMIT_CAP})")
+        self.kind = kind
         self.base_epoch = int(epoch)
         # the round deadline is the last-resort failure detector for
         # peers dying mid-agreement; default: comfortably above the
         # heartbeat timeout so ordinary detection wins
         self.round_timeout_s = round_timeout_s or max(
             1.0, 4 * health.HEARTBEAT_TIMEOUT)
-        self.tag = ("ftagree", self.base_epoch)
+        # kind scopes the tag so a shrink and a grow agreement on the
+        # same base epoch can never cross-match
+        self.tag = ("ftagree", kind, self.base_epoch)
         self.result_dead: Optional[Set[int]] = None
+        self.result_admit: Optional[Set[int]] = None
         self.result_epoch: Optional[int] = None
 
     # ------------------------------------------------------------------
-    def _pack(self, dead: Set[int], epoch: int) -> np.ndarray:
-        buf = np.full(self.gsize + 2, -1, dtype=np.int64)
+    # wire format (int64): [n_dead, epoch, dead padded to gsize,
+    #                       n_admit, admit padded to _ADMIT_CAP]
+    def _buf_len(self) -> int:
+        return self.gsize + 3 + _ADMIT_CAP
+
+    def _pack(self, dead: Set[int], admit: Set[int],
+              epoch: int) -> np.ndarray:
+        buf = np.full(self._buf_len(), -1, dtype=np.int64)
         buf[0] = len(dead)
         buf[1] = epoch
         for i, r in enumerate(sorted(dead)):
             buf[2 + i] = r
+        base = 2 + self.gsize
+        buf[base] = len(admit)
+        for i, r in enumerate(sorted(admit)):
+            buf[base + 1 + i] = r
         return buf
 
-    @staticmethod
-    def _unpack(buf: np.ndarray):
+    def _unpack(self, buf: np.ndarray):
         n = int(buf[0])
-        return {int(r) for r in buf[2:2 + n]}, int(buf[1])
+        base = 2 + self.gsize
+        na = int(buf[base])
+        dead = {int(r) for r in buf[2:2 + n]}
+        admit = {int(r) for r in buf[base + 1:base + 1 + na]}
+        return dead, admit, int(buf[1])
+
+    def _is_fresh(self, peer_grank: int) -> bool:
+        """Fresh-heartbeat check for the round-deadline race fix; False
+        when no registry is wired (UCC_FT off) or no evidence exists."""
+        reg = self._health_registry()
+        if reg is None:
+            return False
+        try:
+            return reg.is_fresh(self._ctx_of(peer_grank))
+        except Exception:  # noqa: BLE001 - liveness lookup is best-effort
+            return False
 
     def run(self):
         size, me = self.gsize, self.grank
         my: Set[int] = set(self.local_dead)
         my.discard(me)
+        admit: Set[int] = set(self.local_admit)
         epoch = self.base_epoch
+        grace = _agree_grace()
         for rnd in range(size + 2):
-            sent = frozenset(my)
+            sent = (frozenset(my), frozenset(admit))
             alive = [p for p in range(size) if p != me and p not in my]
             if not alive:
                 break   # sole survivor: my view is the agreement
-            payload = self._pack(my, epoch)
+            payload = self._pack(my, admit, epoch)
             rbufs = {}
             rreqs = {}
             for p in list(alive):
                 try:
-                    rbufs[p] = np.full(size + 2, -1, dtype=np.int64)
+                    rbufs[p] = np.full(self._buf_len(), -1, dtype=np.int64)
                     rreqs[p] = self.recv_nb(p, rbufs[p],
                                             slot=_AGREE_SLOT_BASE + rnd)
                     self.send_nb(p, payload, slot=_AGREE_SLOT_BASE + rnd)
@@ -126,6 +198,7 @@ class FtAgreement(HostCollTask):
                     rbufs.pop(p, None)
             got = {}
             deadline = time.monotonic() + self.round_timeout_s
+            extensions = grace
             while rreqs:
                 yield
                 for p, rq in list(rreqs.items()):
@@ -140,33 +213,53 @@ class FtAgreement(HostCollTask):
                     if getattr(rq, "error", None):
                         my.add(p)   # errored delivery = failed peer
                         continue
-                    peer_dead, peer_epoch = self._unpack(rbufs[p])
-                    got[p] = peer_dead
+                    peer_dead, peer_admit, peer_epoch = \
+                        self._unpack(rbufs[p])
+                    got[p] = (peer_dead, peer_admit)
                     epoch = max(epoch, peer_epoch)
                     my |= peer_dead
                     my.discard(me)
+                    admit |= peer_admit
                 if rreqs and time.monotonic() > deadline:
-                    # last-resort detector: unresponsive peers are
-                    # suspected dead (see module docstring limitation)
+                    # last-resort detector, folded against fresh health
+                    # evidence (the PR-4 race fix): a pending peer whose
+                    # heartbeat is still fresh is granted a bounded
+                    # deadline extension instead of being condemned —
+                    # only heartbeat-stale peers are suspected outright
+                    fresh = [p for p in rreqs if self._is_fresh(p)]
                     for p, rq in list(rreqs.items()):
+                        if p in fresh and extensions > 0:
+                            continue
                         logger.warning(
                             "ft agreement round %d: rank %d unresponsive "
-                            "past %.1fs; suspecting it failed", rnd, p,
-                            self.round_timeout_s)
+                            "past %.1fs%s; suspecting it failed", rnd, p,
+                            self.round_timeout_s,
+                            " (grace exhausted)" if p in fresh else "")
                         my.add(p)
                         rq.cancel()
                         del rreqs[p]
-            if my == sent and all(v == sent for p, v in got.items()
-                                  if p not in my):
+                    if rreqs and extensions > 0:
+                        extensions -= 1
+                        deadline = time.monotonic() + self.round_timeout_s
+                        logger.info(
+                            "ft agreement round %d: extending deadline "
+                            "for heartbeat-fresh rank(s) %s (%d grace "
+                            "extension(s) left)", rnd, sorted(rreqs),
+                            extensions)
+            if sent == (frozenset(my), frozenset(admit)) and all(
+                    v == sent for p, v in got.items() if p not in my):
                 self.result_dead = set(my)
+                self.result_admit = set(admit)
                 self.result_epoch = epoch + 1
                 logger.info(
                     "ft agreement converged in %d round(s): dead=%s "
-                    "epoch=%d", rnd + 1, sorted(my), self.result_epoch)
+                    "admit=%s epoch=%d", rnd + 1, sorted(my),
+                    sorted(admit), self.result_epoch)
                 return
         if len(my) >= size - 1:
             # everyone else is (believed) dead; trivially agreed
             self.result_dead = set(my)
+            self.result_admit = set(admit)
             self.result_epoch = epoch + 1
             return
         raise UccError(Status.ERR_TIMED_OUT,

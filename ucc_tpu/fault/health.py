@@ -260,6 +260,48 @@ class HealthRegistry:
     def dead_set(self) -> Set[int]:
         return set(self.dead)
 
+    def is_fresh(self, ctx_rank: int, now: Optional[float] = None) -> bool:
+        """Positive liveness evidence: the peer's heartbeat stamp is
+        within ``HEARTBEAT_TIMEOUT``. Used by the agreement's round
+        deadline to avoid mis-suspecting a slow-but-alive survivor
+        (the PR-4 race). A peer that never beat on THIS process's board
+        yields False — absence of evidence, not evidence of life."""
+        uid = self._peer_uids.get(int(ctx_rank))
+        if not uid:
+            return False
+        with _BOARD_LOCK:
+            last = _BOARD.get(uid)
+        if last is None:
+            return False
+        now = now if now is not None else time.monotonic()
+        return now - last <= HEARTBEAT_TIMEOUT
+
+    # -- elastic membership --------------------------------------------
+    def revive(self, ctx_rank: int, source: str = "grow",
+               detail: str = "") -> bool:
+        """Re-admit *ctx_rank*: clear it from the failed/suspected sets
+        and refresh its board stamp (a grace period so the next poll
+        scan does not instantly re-condemn a joiner whose progress loop
+        has not beaten yet). The reverse transition of
+        ``report_failure``; used by ``Team.grow`` / ``Team.join`` when
+        membership agreement admits the rank back. Returns True when
+        the rank was previously marked dead."""
+        ctx_rank = int(ctx_rank)
+        with self._lock:
+            was = self.dead.pop(ctx_rank, None)
+            self.suspected.pop(ctx_rank, None)
+        _STANDALONE_NOTED.discard(ctx_rank)
+        uid = self._peer_uids.get(ctx_rank)
+        if uid:
+            with _BOARD_LOCK:
+                _BOARD[uid] = time.monotonic()
+        if was is not None:
+            logger.warning(
+                "ctx rank %d re-admitted (source=%s%s; was dead via %s)",
+                ctx_rank, source, f": {detail}" if detail else "",
+                was.get("source", "?"))
+        return was is not None
+
     # -- progress hook -------------------------------------------------
     def check(self, queue, now: Optional[float] = None) -> None:
         """Called from the owning context's progress loop (under

@@ -471,6 +471,356 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
     return report
 
 
+# ---------------------------------------------------------------------------
+# churn scenario: interleaved kill -> shrink -> grow cycles (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _drive_requests(ctxs, reqs, deadline_s: float) -> bool:
+    """Poll *reqs* (membership requests: shrink/grow/join) to terminal.
+    Every request is polled each pass — their ``test()`` is what drives
+    the OOB rebuild rounds, so a short-circuiting ``all()`` deadlocks."""
+    from ucc_tpu import Status
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        for c in ctxs:
+            c.progress()
+        sts = [rq.test() for rq in reqs]
+        if all(st != Status.IN_PROGRESS for st in sts):
+            return True
+    return False
+
+
+def run_churn_soak(n_ranks: int = 4, cycles: int = 2,
+                   iters_per_epoch: int = 4, post_iters: int = 60,
+                   hb_interval: float = 0.02, hb_timeout: float = 0.3,
+                   iter_deadline_s: float = 15.0,
+                   membership_deadline_s: float = 30.0,
+                   count: int = 64, matrix=DEFAULT_MATRIX,
+                   plans: bool = False, collect: bool = False) -> Dict:
+    """The elastic-membership drill: *cycles* interleaved
+    kill -> detect -> shrink -> grow(rejoin) rounds with matrix
+    collectives in flight on EVERY epoch, followed by a false-suspicion
+    round (a live rank is excluded by hint, then re-admitted through the
+    join path) and >= *post_iters* checked collectives on the final
+    team.
+
+    Asserted invariants (anything else lands in ``violations``):
+
+    - no rank is ever parked IN_PROGRESS past a deadline (no-hang);
+    - every survivor observes ERR_RANK_FAILED naming the killed rank;
+    - shrink and grow converge to identical (membership, epoch) views;
+    - the epoch fence discards stale traffic in BOTH directions
+      (``fenced`` counts a pre-shrink send killed by the shrink fence
+      and a pre-grow send killed by the grow fence, per cycle);
+    - the falsely-suspected rank is demonstrably re-admitted: revived
+      out of the survivors' dead sets and serving checked collectives
+      on the new epoch (``readmitted``);
+    - the final membership equals the initial one and *post_iters*
+      collectives complete correctly on it (``post_churn_ok``).
+    """
+    import os
+
+    from ucc_tpu import Status, TeamParams, ThreadOobWorld
+    from ucc_tpu.core.team import Team
+
+    from . import health
+
+    inject.reset()
+    prev_mode, prev_int, prev_to = (health.MODE, health.HEARTBEAT_INTERVAL,
+                                    health.HEARTBEAT_TIMEOUT)
+    health.configure("shrink", interval=hb_interval, timeout=hb_timeout)
+    plan_env = None
+    if plans:
+        # native-matcher mode: allreduces ride the generated native plan
+        # path, so both fence directions are drilled against the C v2
+        # matcher rather than the python mailbox
+        plan_env = {k: os.environ.get(k)
+                    for k in ("UCC_GEN_NATIVE", "UCC_TL_SHM_TUNE")}
+        os.environ["UCC_GEN_NATIVE"] = "y"
+        os.environ["UCC_TL_SHM_TUNE"] = "allreduce:@ring:inf"
+    prev_knobs = None
+    if collect:
+        from ..obs import collector as _collector
+        from ..obs import flight as _flight
+        prev_knobs = (_collector.KNOBS.enabled, _collector.KNOBS.interval,
+                      _collector.KNOBS.dir, _flight.ENABLED)
+        _flight.configure(enabled=True)
+        _collector.configure(enabled=True, interval=0.25, dir="")
+    ctxs = _make_job(n_ranks)
+    teams = _make_team(ctxs)
+    report: Dict = {"cycles": 0, "violations": [], "outcomes": {},
+                    "fenced": {"shrink": 0, "grow": 0},
+                    "epochs": [], "post_churn_ok": 0,
+                    "readmitted": False, "matcher": None,
+                    "injected": {}}
+    bufs: Dict = {}
+    all_teams: List = list(teams)    # every team ever built, for teardown
+
+    def _note_injected():
+        for k, v in dict(inject.COUNTS).items():
+            report["injected"][k] = report["injected"].get(k, 0) + v
+
+    def _probe(old_team, direction: str):
+        # reuse the shrink probe: it posts into epoch 0 — the pre-change
+        # tag space — so it regression-tests the fence whichever
+        # direction retired the team
+        sub: Dict = {"violations": [], "stale_send_fenced": None,
+                     "matcher": None}
+        _probe_stale_send_fence(old_team, sub)
+        if sub["matcher"] is not None:
+            report["matcher"] = sub["matcher"]
+        if sub["stale_send_fenced"]:
+            report["fenced"][direction] += 1
+        for v in sub["violations"]:
+            report["violations"].append(f"{direction} fence: {v}")
+
+    def _membership_change(cur, dead_team_rank, dead_ctx, hint=False):
+        """One shrink(+probe) -> iters -> grow(rejoin)(+probe) -> iters
+        round. *cur* maps ctx index -> its current Team; returns the
+        next such map (full membership again) or None on failure."""
+        survivors = sorted(i for i in cur if i != dead_team_rank)
+        shrinks = {}
+        for i in survivors:
+            try:
+                # dead_hint is in TEAM ranks; after the first grow the
+                # joiner sits at the tail, so team rank != ctx rank
+                t = cur[i]
+                hint_ranks = [r for r in range(t.size)
+                              if int(t.ctx_map.eval(r)) == dead_ctx] \
+                    if hint else None
+                shrinks[i] = t.shrink_post(dead_hint=hint_ranks)
+            except Exception as e:  # noqa: BLE001
+                report["violations"].append(
+                    f"ctx {i} shrink_post raised {type(e).__name__}: {e}")
+                return None
+        sctxs = [ctxs[i] for i in survivors]
+        if not _drive_requests(sctxs, list(shrinks.values()),
+                               membership_deadline_s):
+            report["violations"].append(
+                f"shrink (dead ctx {dead_ctx}) hung past "
+                f"{membership_deadline_s}s")
+            return None
+        views = set()
+        for i, s in shrinks.items():
+            st = s.test()
+            if st != Status.OK:
+                report["violations"].append(
+                    f"ctx {i} shrink failed: {st.name}")
+                return None
+            views.add((tuple(s.failed_ranks or ()), s.epoch))
+        if len(views) > 1:
+            report["violations"].append(
+                f"shrink views diverged: {views}")
+            return None
+        report["epochs"].append(next(iter(views))[1])
+        _probe(cur[survivors[0]], "shrink")
+        shrunk = {i: shrinks[i].new_team for i in survivors}
+        nbufs: Dict = {}
+        for it in range(iters_per_epoch):
+            _drive_iter(sctxs, [shrunk[i] for i in survivors],
+                        matrix[it % len(matrix)], len(survivors), count,
+                        nbufs, iter_deadline_s, report,
+                        f"shrunk-e{report['epochs'][-1]}", survivors)
+        all_teams.extend(shrunk.values())
+        # the excluded rank comes back: clear the drill fault, retire its
+        # stale pre-shrink team, and re-admit it through the join path
+        _note_injected()
+        inject.reset()
+        try:
+            cur[dead_team_rank].destroy()
+        except Exception:  # noqa: BLE001
+            pass
+        grows = {}
+        for i in survivors:
+            try:
+                grows[i] = shrunk[i].grow_post([dead_ctx])
+            except Exception as e:  # noqa: BLE001
+                report["violations"].append(
+                    f"ctx {i} grow_post raised {type(e).__name__}: {e}")
+                return None
+        try:
+            join = Team.join_post(ctxs[dead_team_rank])
+        except Exception as e:  # noqa: BLE001
+            report["violations"].append(
+                f"ctx {dead_team_rank} join_post raised "
+                f"{type(e).__name__}: {e}")
+            return None
+        if not _drive_requests(ctxs, list(grows.values()) + [join],
+                               membership_deadline_s):
+            report["violations"].append(
+                f"grow (rejoin ctx {dead_ctx}) hung past "
+                f"{membership_deadline_s}s")
+            return None
+        gviews = set()
+        for i, g in grows.items():
+            st = g.test()
+            if st != Status.OK:
+                report["violations"].append(
+                    f"ctx {i} grow failed: {st.name}")
+                return None
+            gviews.add(g.epoch)
+        if join.test() != Status.OK:
+            report["violations"].append(
+                f"ctx {dead_team_rank} join failed: {join.test().name}")
+            return None
+        gviews.add(join.epoch)
+        if len(gviews) > 1:
+            report["violations"].append(
+                f"grow epochs diverged: {gviews}")
+            return None
+        report["epochs"].append(next(iter(gviews)))
+        _probe(shrunk[survivors[0]], "grow")
+        nxt = {i: grows[i].new_team for i in survivors}
+        nxt[dead_team_rank] = join.new_team
+        all_teams.extend(nxt.values())
+        gbufs: Dict = {}
+        order = sorted(nxt)
+        for it in range(iters_per_epoch):
+            _drive_iter([ctxs[i] for i in order], [nxt[i] for i in order],
+                        matrix[it % len(matrix)], len(order), count,
+                        gbufs, iter_deadline_s, report,
+                        f"grown-e{report['epochs'][-1]}", order)
+        return nxt
+
+    cur = {i: teams[i] for i in range(n_ranks)}
+    try:
+        # -- kill -> shrink -> grow cycles ----------------------------
+        for cyc in range(cycles):
+            kill_team_rank = 1 + (cyc % (n_ranks - 1))
+            killed_ctx = ctxs[kill_team_rank].rank
+            inject.configure(f"kill={killed_ctx}", seed=cyc)
+            survivors = sorted(i for i in cur if i != kill_team_rank)
+            # collective across the kill: every survivor must reach
+            # ERR_RANK_FAILED naming the dead rank, nobody parks
+            reqs = {}
+            for i in survivors:
+                try:
+                    reqs[i] = cur[i].collective_init(
+                        _coll_args("allreduce", i, n_ranks, count, bufs,
+                                   0.0))
+                    reqs[i].post()
+                except Exception as e:  # noqa: BLE001
+                    report["violations"].append(
+                        f"cycle {cyc}: survivor {i} post raised "
+                        f"{type(e).__name__}: {e}")
+            deadline = time.monotonic() + iter_deadline_s
+            while time.monotonic() < deadline:
+                for i in survivors:
+                    ctxs[i].progress()
+                if all(rq.test() != Status.IN_PROGRESS
+                       for rq in reqs.values()):
+                    break
+            for i, rq in reqs.items():
+                st = rq.test()
+                if st == Status.IN_PROGRESS:
+                    report["violations"].append(
+                        f"cycle {cyc}: survivor {i} IN_PROGRESS after "
+                        "kill")
+                    rq.task.cancel(Status.ERR_TIMED_OUT)
+                elif st != Status.ERR_RANK_FAILED:
+                    report["violations"].append(
+                        f"cycle {cyc}: survivor {i} saw {st.name}, not "
+                        "ERR_RANK_FAILED")
+                elif killed_ctx not in (rq.failed_ranks or []):
+                    report["violations"].append(
+                        f"cycle {cyc}: survivor {i} attribution "
+                        f"{rq.failed_ranks} misses ctx {killed_ctx}")
+                try:
+                    rq.finalize()
+                except Exception:  # noqa: BLE001
+                    pass
+            nxt = _membership_change(cur, kill_team_rank, killed_ctx)
+            if nxt is None:
+                return report
+            cur = nxt
+            report["cycles"] += 1
+
+        # -- false suspicion: exclude a LIVE rank, re-admit it --------
+        victim = n_ranks - 1
+        victim_ctx = ctxs[victim].rank
+        nxt = _membership_change(cur, victim, victim_ctx, hint=True)
+        if nxt is None:
+            return report
+        cur = nxt
+        readmitted = True
+        for i in cur:
+            if i == victim:
+                continue
+            reg = getattr(ctxs[i], "health", None)
+            if reg is not None and victim_ctx in reg.dead_set():
+                readmitted = False
+        if not readmitted:
+            report["violations"].append(
+                f"falsely-suspected ctx {victim_ctx} still in a "
+                "survivor dead set after rejoin")
+        report["readmitted"] = readmitted
+
+        # -- post-churn: checked collectives on the final epoch -------
+        if sorted(cur) != list(range(n_ranks)):
+            report["violations"].append(
+                f"post-churn membership {sorted(cur)} != full "
+                f"{list(range(n_ranks))}")
+            return report
+        pbufs: Dict = {}
+        order = sorted(cur)
+        for it in range(post_iters):
+            before = len(report["violations"])
+            _drive_iter([ctxs[i] for i in order], [cur[i] for i in order],
+                        matrix[it % len(matrix)], n_ranks, count, pbufs,
+                        iter_deadline_s, report, "post-churn", order,
+                        check=True)
+            if len(report["violations"]) == before:
+                report["post_churn_ok"] += 1
+    finally:
+        _note_injected()
+        inject.reset()
+        health.configure(prev_mode, interval=prev_int, timeout=prev_to)
+        if plan_env is not None:
+            for k, v in plan_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if collect:
+            flagged: set = set()
+            windows = 0
+            for c in ctxs:
+                col = getattr(c, "collector", None)
+                if col is None:
+                    continue
+                try:
+                    flagged |= set(col.flagged_ctx())
+                    windows = max(windows, col.windows_run())
+                except Exception:  # noqa: BLE001 - reporting only
+                    pass
+            report["collector"] = {"windows": windows,
+                                   "flagged_ctx": sorted(flagged)}
+        if report["fenced"]["shrink"] == 0 and report["cycles"]:
+            report["violations"].append(
+                "no pre-shrink send was fenced across the whole churn")
+        if report["fenced"]["grow"] == 0 and report["cycles"]:
+            report["violations"].append(
+                "no pre-grow send was fenced across the whole churn")
+        for t in all_teams:
+            try:
+                t.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+        for c in ctxs:
+            try:
+                c.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+        if prev_knobs is not None:
+            from ..obs import collector as _collector
+            from ..obs import flight as _flight
+            _collector.configure(enabled=prev_knobs[0],
+                                 interval=prev_knobs[1],
+                                 dir=prev_knobs[2])
+            _flight.configure(enabled=prev_knobs[3])
+    return report
+
+
 def _probe_stale_plan_fence(old_team, report) -> None:
     """Native-plan twin of ``_probe_stale_send_fence``: build a one-op
     plan keyed to the OLD (fenced) epoch and post it — the C executor's
@@ -595,6 +945,14 @@ def main(argv=None) -> int:
                     "the probabilistic soak (UCC_FT=shrink pipeline)")
     ap.add_argument("--kill-rank", type=int, default=2)
     ap.add_argument("--post-iters", type=int, default=60)
+    ap.add_argument("--churn", action="store_true",
+                    help="run the elastic-membership churn drill: "
+                    "interleaved kill->shrink->grow(rejoin) cycles with "
+                    "collectives in flight on every epoch, a false-"
+                    "suspicion re-admission round, and checked post-"
+                    "churn collectives (UCC_FT=shrink + Team.grow)")
+    ap.add_argument("--cycles", type=int, default=2,
+                    help="with --churn: kill->shrink->grow cycles to run")
     ap.add_argument("--plans", action="store_true",
                     help="with --kill-shrink: run the drill with the "
                     "allreduces forced onto NATIVE EXECUTION PLANS "
@@ -602,6 +960,12 @@ def main(argv=None) -> int:
                     "ucc_plan_cancel withdrew posted recvs and a "
                     "pre-shrink plan send is fenced")
     args = ap.parse_args(argv)
+    if args.churn:
+        report = run_churn_soak(args.ranks, cycles=args.cycles,
+                                post_iters=args.post_iters,
+                                plans=args.plans, collect=args.collect)
+        print(json.dumps(report, indent=1))
+        return 1 if report["violations"] else 0
     if args.kill_shrink:
         report = run_kill_shrink_soak(args.ranks, args.kill_rank,
                                       post_iters=args.post_iters,

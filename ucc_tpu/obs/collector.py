@@ -597,6 +597,10 @@ class _TeamWatch:
                    "reason": "collect", "ts": time.time(),
                    "pid": os.getpid(), "window": self.window,
                    "team": team.id, "team_size": team.size,
+                   # membership epoch: pre- and post-change windows of
+                   # the same logical job merge cleanly in the trace
+                   # store (readers key on (team, epoch, window))
+                   "epoch": int(getattr(team, "epoch", 0)),
                    "absent_ranks": [],
                    "ranks": {str(r): m["snap"]
                              for r, m in zip(self.group, msgs)}}
@@ -707,6 +711,7 @@ class _TeamWatch:
             self.service.store_append({
                 "version": 1, "kind": "collect_summary",
                 "ts": time.time(), "team": team.id,
+                "epoch": int(getattr(team, "epoch", 0)),
                 "window": self.window,
                 "sev": {str(r): round(v, 4)
                         for r, v in (g.get("sev") or {}).items()},
@@ -798,6 +803,65 @@ class CollectorService:
                 if w.team_ref() is team:
                     return w
         return None
+
+    def handoff(self, old_team, new_team) -> None:
+        """Membership-change telemetry continuity (Team.shrink / grow):
+        carry the retired team's straggler-learning state into the
+        successor's watch so the new epoch does not relearn flags from
+        scratch. Rank-keyed state is remapped THROUGH context ranks
+        (old team rank -> ctx -> new team rank) — the rank set is no
+        longer monotone once teams can grow. The successor's window
+        index deliberately restarts at 0: exchange keys embed the
+        window index, and a joiner's watch has no pre-grow count to
+        agree with — epoch stamps in the records keep the pre-/post-
+        change windows mergeable instead. Survivors inherit the ring
+        high-water mark (no event re-reported across the change);
+        joiners keep cut 0, so their ``boot:*`` spans land in the
+        merged first window."""
+        old_w = self.watch_for(old_team)
+        new_w = self.watch_for(new_team)
+        if old_w is not None:
+            self.unwatch(old_w)   # retired teams stop exchanging NOW
+        if old_w is None or new_w is None:
+            return
+        ctx_to_new = {}
+        for i in range(new_team.size):
+            try:
+                ctx_to_new[int(new_team.ctx_map.eval(i))] = i
+            except Exception:  # noqa: BLE001 - torn-down map: no carry
+                return
+
+        def remap(d):
+            out = {}
+            for r, v in d.items():
+                try:
+                    c = int(old_team.ctx_map.eval(int(r)))
+                except Exception:  # noqa: BLE001 - rank gone from map
+                    continue
+                nr = ctx_to_new.get(c)
+                if nr is not None:
+                    out[nr] = v
+            return out
+
+        sc_old, sc_new = old_w.scorer, new_w.scorer
+        sc_new.scores = remap(sc_old.scores)
+        sc_new.streaks = remap(sc_old.streaks)
+        sc_new.flagged = set(remap({r: r for r in sc_old.flagged}))
+        sc_new.windows_seen = sc_old.windows_seen
+        new_w.cut_t = old_w.cut_t if new_w.cut_t == 0.0 else new_w.cut_t
+        if old_w.bias is not None and new_w.bias is not None:
+            # promoted state only: a table still staged on the retired
+            # team applied at a flight index of the OLD epoch's program
+            # order, which does not exist on the successor — it will be
+            # re-learned within a window if still true
+            new_w.bias.flagged = frozenset(
+                remap({r: r for r in old_w.bias.flagged}))
+            new_w.bias.scores = remap(old_w.bias.scores)
+        logger.info(
+            "collector handoff: team %s -> %s (epoch %s): carried "
+            "%d score(s), flagged %s", old_team.id, new_team.id,
+            getattr(new_team, "epoch", "?"), len(sc_new.scores),
+            sorted(sc_new.flagged) or "none")
 
     def windows_run(self) -> int:
         """Highest completed window index across watched teams — how

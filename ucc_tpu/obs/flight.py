@@ -357,6 +357,15 @@ class FlightRecorder:
         self.coll.append(5, -code - 2, min_epoch, -1, -1, 0, 0, 0,
                          0.0, purged)
 
+    def membership(self, team_id, epoch: int, kind: str,
+                   detail: str) -> None:
+        """Membership-change marker (shrink / grow / join): rides the
+        coll ring as a completed ``membership`` event, so a merged trace
+        shows each epoch boundary inline with the collectives it fences
+        — including on a JOINER whose ring has no pre-change history."""
+        self.complete(team_id, epoch, -1, "membership", kind, detail,
+                      0.0, "OK")
+
     # ------------------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         """JSON-safe decode of both rings (cold path)."""

@@ -192,6 +192,39 @@ def store_entries(path: str, signature: str, entries: Sequence[dict],
         os.replace(tmp, path)
 
 
+# in-process session cache: decisions frozen by ANY team this process
+# has run, keyed like the file cache. Successor teams after a membership
+# shrink/grow warm-start from it even when the file cache is disabled or
+# the decision has not hit disk yet — re-exploring an identical topology
+# mid-churn would stall recovering collectives behind tuning rounds
+_SESSION_CACHE: Dict[str, Dict[Tuple, dict]] = {}
+
+
+def _entry_key(e: dict) -> Tuple:
+    return (e.get("coll"), e.get("mem"), e.get("start"), e.get("end"))
+
+
+def session_record(signature: str, entries: Sequence[dict]) -> None:
+    slot = _SESSION_CACHE.setdefault(signature, {})
+    for e in entries:
+        if isinstance(e, dict):
+            slot[_entry_key(e)] = dict(e)
+
+
+def session_merged_entries(signature: str,
+                           file_entries: Sequence[dict]) -> List[dict]:
+    """File-cache entries overlaid with this process's session decisions
+    (session wins: it is at least as new as anything on disk)."""
+    merged = {_entry_key(e): e for e in file_entries
+              if isinstance(e, dict)}
+    merged.update(_SESSION_CACHE.get(signature) or {})
+    return list(merged.values())
+
+
+def session_reset() -> None:
+    _SESSION_CACHE.clear()
+
+
 def apply_entries(score_map, entries: Sequence[dict]) -> List[Tuple]:
     """Compile cache *entries* into *score_map* (apply_learned per
     entry, carrying the entry's origin — "learned" or "searched").
@@ -578,7 +611,7 @@ class OnlineTuner:
         logger.info("tuner: %s/%s [%d..%d) frozen to %s/%s (team %s)",
                     coll_type_str(coll), mem.name.lower(), start, end,
                     comp, alg, self.team.id)
-        if ok and self.team.rank == 0 and self.cache_path:
+        if ok and self.team.rank == 0:
             entry = {"coll": coll_type_str(coll), "mem": mem.name.lower(),
                      "start": start, "end": end, "alg": alg, "comp": comp}
             # record the winner's wire-precision tag (quantized
@@ -591,12 +624,17 @@ class OnlineTuner:
                     if r.gen:
                         entry["gen"] = r.gen
                     break
-            try:
-                store_entries(self.cache_path, self.signature, [entry],
-                              source="online")
-            except OSError as e:
-                logger.warning("tuner: cache write to %s failed: %s",
-                               self.cache_path, e)
+            # session cache first: a successor team built by a membership
+            # shrink/grow warm-starts from this even if the disk write
+            # below fails or is disabled
+            session_record(self.signature, [entry])
+            if self.cache_path:
+                try:
+                    store_entries(self.cache_path, self.signature,
+                                  [entry], source="online")
+                except OSError as e:
+                    logger.warning("tuner: cache write to %s failed: %s",
+                                   self.cache_path, e)
 
 
 # ---------------------------------------------------------------------------
@@ -634,8 +672,9 @@ def activation_begin(team):
         return None
     payload = None
     if team.rank == 0:
-        entries = cache_entries(load_cache(_team_cache_path(team)),
-                                topo_signature(team))
+        sig = topo_signature(team)
+        entries = session_merged_entries(
+            sig, cache_entries(load_cache(_team_cache_path(team)), sig))
         payload = pickle.dumps({"entries": entries})
     task = svc.service_bcast(payload, 0)
     task.post()
@@ -673,7 +712,8 @@ def activation_end(team, sync_task) -> None:
                 logger.exception("tuner: undecodable cache-sync payload")
                 return
     elif team.size <= 1:
-        entries = cache_entries(load_cache(path), sig)
+        entries = session_merged_entries(
+            sig, cache_entries(load_cache(path), sig))
     else:
         # multi-rank team without a bcast-capable service team: per-rank
         # cache reads could diverge across nodes — tuning stays off
