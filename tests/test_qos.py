@@ -1,0 +1,488 @@
+"""Multi-tenant service tests: priority-lane progress queue,
+small-collective coalescing, per-team QoS accounting.
+
+Queue-level tests drive a bare ProgressQueue with counter tasks owned
+by fake teams (only ``priority`` matters for lane placement).
+Harness-level tests run real in-process jobs with UCC_COALESCE on and
+check the fused batches bitwise against independent posts.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import ucc_tpu
+from ucc_tpu import BufferInfo, CollArgs, Status, TeamParams, ThreadOobWorld
+from ucc_tpu.constants import CollArgsFlags, CollType, DataType, ReductionOp
+from ucc_tpu.core import coalesce
+from ucc_tpu.schedule import progress as pg
+from ucc_tpu.schedule.progress import ProgressQueue
+
+from harness import UccJob
+
+
+class _FakeTeam:
+    def __init__(self, priority, tid=7):
+        self.priority = priority
+        self.id = tid
+        self.context = None
+
+
+class LaneTask(pg.CollTask):
+    """Counts service passes; completes after n_steps."""
+
+    def __init__(self, priority, trace=None, n_steps=1, name=""):
+        super().__init__(team=_FakeTeam(priority))
+        self.trace = trace if trace is not None else []
+        self.n_steps = n_steps
+        self.name = name
+        self.steps = 0
+
+    def post_fn(self):
+        return Status.OK
+
+    def progress_fn(self):
+        self.steps += 1
+        self.trace.append(self.name)
+        if self.steps >= self.n_steps:
+            self.status = Status.OK
+
+
+def _enqueue(pq, *tasks):
+    for t in tasks:
+        t.status = t.super_status = Status.IN_PROGRESS
+        t.steps = 0
+        pq._lanes[pg._task_lane(t)].append(t)
+        t._pq_enq = t._pq_last = time.monotonic()
+        t._pq_low_snap = sum(pq._svc_count[:pg._task_lane(t)])
+        t.progress_queue = pq
+
+
+@pytest.fixture
+def qos_knobs():
+    """Restore module QoS/coalescing knobs mutated by a test."""
+    w, a = pg._WEIGHTS, pg._AGE_S
+    c = (coalesce.ENABLED, coalesce.LIMIT_BYTES, coalesce.WINDOW_S,
+         coalesce.MAX_BATCH)
+    yield
+    pg._WEIGHTS, pg._AGE_S = w, a
+    (coalesce.ENABLED, coalesce.LIMIT_BYTES, coalesce.WINDOW_S,
+     coalesce.MAX_BATCH) = c
+
+
+class TestPriorityLanes:
+    def test_high_lane_served_first_and_bulk_capped(self, qos_knobs):
+        pg.configure(weights="1,2,4,8", age_ms=10_000)
+        pq = ProgressQueue()
+        trace = []
+        bulk = [LaneTask(0, trace, n_steps=99, name=f"b{i}")
+                for i in range(4)]
+        hot = LaneTask(3, trace, n_steps=99, name="hot")
+        _enqueue(pq, *bulk, hot)
+        pq.progress()
+        # latency lane first; bulk lane capped to weight 1 while a
+        # higher lane is non-empty
+        assert trace[0] == "hot"
+        assert sum(1 for n in trace if n.startswith("b")) == 1
+
+    def test_single_lane_drains_uncapped(self, qos_knobs):
+        pg.configure(weights="1,2,4,8", age_ms=10_000)
+        pq = ProgressQueue()
+        trace = []
+        tasks = [LaneTask(1, trace, n_steps=99, name=f"t{i}")
+                 for i in range(8)]
+        _enqueue(pq, *tasks)
+        pq.progress()
+        # no higher lane occupied -> the WRR cap never engages and the
+        # pass services every queued task (pre-lane behavior)
+        assert len(trace) == 8
+
+    def test_starved_task_ages_into_service(self, qos_knobs):
+        # the progress-fairness regression: a bulk task beyond the WRR
+        # cap must be serviced once it waits past the aging bound, even
+        # under a saturating latency-lane stream
+        pg.configure(weights="1,2,4,8", age_ms=5)
+        pq = ProgressQueue()
+        hot = LaneTask(3, n_steps=10**9, name="hot")
+        bulk = [LaneTask(0, n_steps=10**9, name=f"b{i}") for i in range(3)]
+        _enqueue(pq, hot, *bulk)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and \
+                not all(b.steps > 0 for b in bulk):
+            pq.progress()
+            time.sleep(0.002)
+        assert all(b.steps > 0 for b in bulk), \
+            "bulk tasks starved behind the latency lane"
+        # the aging promotion is what served them, and it was measured
+        assert pq.starvation_max_s > 0.0
+        snap = pq.qos_snapshot()
+        assert snap["starvation_max_ms"] > 0.0
+        assert pq.starvation_max_s == 0.0  # reset=True
+
+    def test_priority_inversion_counter(self, qos_knobs):
+        pg.configure(weights="1,2,4,8", age_ms=1)
+        pq = ProgressQueue()
+        hot = LaneTask(2, n_steps=1, name="hot")
+        _enqueue(pq, hot)
+        # lower-lane services advance after hot's enqueue snapshot,
+        # while hot waits past the aging bound
+        pq._svc_count[0] += 5
+        hot._pq_enq -= 0.05
+        pq.progress()
+        assert pq.inversions == 1
+        assert pq.qos_snapshot()["inversions"] == 1
+
+    def test_flat_q_compat_surface(self, qos_knobs):
+        # watchdog dumps and the FT cancel sweep duck-type on queue._q
+        pq = ProgressQueue()
+        b = LaneTask(0, n_steps=99, name="b")
+        h = LaneTask(3, n_steps=99, name="h")
+        _enqueue(pq, b, h)
+        flat = pq._q
+        assert flat == (h, b)      # highest lane first
+        assert len(pq) == 2
+
+    def test_qos_snapshot_team_wait(self, qos_knobs):
+        pg.configure(weights="1,2,4,8", age_ms=10_000)
+        pq = ProgressQueue()
+        t = LaneTask(1, n_steps=2, name="t")
+        t.team.id = 42
+        _enqueue(pq, t)
+        t._pq_enq -= 0.010
+        pq.progress()
+        snap = pq.qos_snapshot()
+        assert 42 in snap["team_wait_ms"]
+        w = snap["team_wait_ms"][42]
+        assert w["n"] == 1 and w["max"] >= 10.0
+        assert pq.qos_snapshot()["team_wait_ms"] == {}  # reset
+
+    def test_clamp_priority(self):
+        assert pg.clamp_priority(-3) == 0
+        assert pg.clamp_priority(99) == pg.NUM_LANES - 1
+        assert pg.clamp_priority("2") == 2
+        assert pg.clamp_priority("bogus") == pg.DEFAULT_PRIORITY
+        assert pg.clamp_priority(None) == pg.DEFAULT_PRIORITY
+
+
+# ---------------------------------------------------------------------------
+def _team_with_priority(job, priority):
+    """Create one full team with an explicit TeamParams.priority."""
+    world = ThreadOobWorld(job.n)
+    teams = [job.contexts[r].create_team_post(
+        TeamParams(oob=world.endpoint(r), priority=priority))
+        for r in range(job.n)]
+    # create_test must be called on EVERY member each round (no
+    # short-circuit) or the laggards' state machines never step
+    job.progress_until(lambda: all(
+        [t.create_test() == Status.OK for t in teams]), 30)
+    job.teams.append(teams)
+    return teams
+
+
+def _ar_args(src, dst, op=ReductionOp.SUM, dt=DataType.FLOAT32,
+             inplace=False):
+    cnt = dst.size
+    flags = CollArgsFlags.IN_PLACE if inplace else CollArgsFlags(0)
+    return CollArgs(coll_type=CollType.ALLREDUCE,
+                    src=None if inplace else BufferInfo(src, cnt, dt),
+                    dst=BufferInfo(dst, cnt, dt), op=op, flags=flags)
+
+
+def _wait_reqs(job, reqs, timeout=30.0):
+    job.progress_until(lambda: all(
+        rq.test() != Status.IN_PROGRESS for per in reqs for rq in per),
+        timeout)
+
+
+class TestCoalescing:
+    N = 4
+
+    def _job(self, **knobs):
+        coalesce.configure(**knobs)
+        return UccJob(self.N)
+
+    def test_team_priority_resolution(self, qos_knobs, monkeypatch):
+        job = UccJob(2)
+        try:
+            teams = _team_with_priority(job, 3)
+            assert all(t.priority == 3 for t in teams)
+            monkeypatch.setenv("UCC_TEAM_PRIORITY", "2")
+            teams2 = job.create_team()
+            assert all(t.priority == 2 for t in teams2)
+        finally:
+            job.cleanup()
+
+    def test_coalesced_bitwise_vs_independent(self, qos_knobs):
+        """The acceptance bitwise claim: a coalesced batch delivers
+        byte-identical results to the same collectives posted
+        independently with coalescing off. Integer-valued payloads so
+        every reduction order is exact; AVG over a power-of-two team is
+        exact too. Covers SUM, AVG, an inplace member, and bf16."""
+        N = self.N
+        cases = [  # (op, dtype, inplace)
+            (ReductionOp.SUM, DataType.FLOAT32, False),
+            (ReductionOp.SUM, DataType.FLOAT32, True),
+            (ReductionOp.AVG, DataType.FLOAT32, False),
+            (ReductionOp.SUM, DataType.BFLOAT16, False),
+        ]
+        cnt = 16
+
+        def payload(r, k, np_dt):
+            return (np.arange(cnt) % 5 + r + k).astype(np_dt)
+
+        results = {}
+        for enabled in (False, True):
+            coalesce.configure(enabled=enabled, limit=8192, window_us=5e4,
+                               max_batch=16)
+            job = UccJob(N)
+            try:
+                teams = job.create_team()
+                if enabled:
+                    assert all(t.coalescer is not None for t in teams)
+                else:
+                    assert all(t.coalescer is None for t in teams)
+                from ucc_tpu.constants import dt_numpy
+                dsts = []
+                reqs = [[] for _ in range(N)]
+                # two members per signature so every sealed batch
+                # actually fuses (>= 2 members)
+                for ci, (op, dt, inplace) in enumerate(cases):
+                    np_dt = dt_numpy(dt)
+                    for j in range(2):
+                        k = 2 * ci + j
+                        per = []
+                        for r, t in enumerate(teams):
+                            if inplace:
+                                dst = payload(r, k, np_dt)
+                                args = _ar_args(None, dst, op, dt,
+                                                inplace=True)
+                            else:
+                                src = payload(r, k, np_dt)
+                                dst = np.zeros(cnt, dtype=np_dt)
+                                args = _ar_args(src, dst, op, dt)
+                            rq = t.collective_init(args)
+                            rq.post()
+                            reqs[r].append(rq)
+                            per.append(dst)
+                        dsts.append(per)
+                if enabled:
+                    held = [len(t.coalescer.pending) for t in teams]
+                    assert all(h == 2 for h in held), held
+                _wait_reqs(job, reqs)
+                for per in reqs:
+                    for rq in per:
+                        assert rq.test() == Status.OK
+                if enabled:
+                    # cases 0+1 share a signature (one 4-member batch),
+                    # AVG and bf16 sealed their own pair batches
+                    assert all(t.coalescer._fused_seq >= 3 for t in teams)
+                results[enabled] = [[d.copy() for d in per] for per in dsts]
+            finally:
+                job.cleanup()
+        for k in range(2 * len(cases)):
+            for r in range(N):
+                a, b = results[False][k][r], results[True][k][r]
+                assert a.dtype == b.dtype
+                assert np.array_equal(a, b), \
+                    f"case {k} rank {r}: {a} != {b}"
+
+    def test_mixed_signature_seals_batch(self, qos_knobs):
+        # a post with a different (op, dtype) signature is a
+        # program-order closure point: the open batch seals, both
+        # batches complete correctly
+        coalesce.configure(enabled=True, limit=8192, window_us=5e4,
+                           max_batch=16)
+        job = UccJob(self.N)
+        try:
+            teams = job.create_team()
+            cnt = 8
+            srcs, dsts, reqs = [], [], [[] for _ in range(self.N)]
+            for k, op in enumerate((ReductionOp.SUM, ReductionOp.SUM,
+                                    ReductionOp.MAX)):
+                per_d = []
+                for r, t in enumerate(teams):
+                    src = (np.arange(cnt) + r + k).astype(np.float32)
+                    dst = np.zeros(cnt, dtype=np.float32)
+                    rq = t.collective_init(_ar_args(src, dst, op))
+                    rq.post()
+                    reqs[r].append(rq)
+                    per_d.append(dst)
+                dsts.append(per_d)
+            # MAX arrived with a different signature -> SUM batch sealed
+            assert all(len(t.coalescer.pending) == 1 for t in teams)
+            _wait_reqs(job, reqs)
+            base = np.arange(cnt).astype(np.float32)
+            for r in range(self.N):
+                assert np.array_equal(
+                    dsts[0][r], sum(base + q for q in range(self.N)))
+                assert np.array_equal(dsts[2][r], base + self.N - 1 + 2)
+        finally:
+            job.cleanup()
+
+    def test_cancel_one_of_batch(self, qos_knobs):
+        # cancelling one held member is rank-local: its segment stays in
+        # the sealed batch (membership symmetry) but delivery and
+        # completion are skipped for it alone
+        coalesce.configure(enabled=True, limit=8192, window_us=5e4,
+                           max_batch=16)
+        job = UccJob(self.N)
+        try:
+            teams = job.create_team()
+            cnt = 8
+            dsts, reqs = [], [[] for _ in range(self.N)]
+            for k in range(3):
+                per_d = []
+                for r, t in enumerate(teams):
+                    src = (np.arange(cnt) + r + 10 * k).astype(np.float32)
+                    dst = np.full(cnt, -1.0, dtype=np.float32)
+                    rq = t.collective_init(_ar_args(src, dst))
+                    rq.post()
+                    reqs[r].append(rq)
+                    per_d.append(dst)
+                dsts.append(per_d)
+            # rank 0 cancels its member k=1 while held
+            reqs[0][1].task.cancel()
+            assert reqs[0][1].test() == Status.ERR_CANCELED
+            others = [[rq for i, rq in enumerate(per) if (r, i) != (0, 1)]
+                      for r, per in enumerate(reqs)]
+            _wait_reqs(job, others)
+            base = np.arange(cnt).astype(np.float32)
+            for k in (0, 1, 2):
+                expect = sum(base + q + 10 * k for q in range(self.N))
+                for r in range(self.N):
+                    if (r, k) == (0, 1):
+                        # no delivery into a cancelled member's dst
+                        assert np.all(dsts[k][r] == -1.0)
+                        continue
+                    assert reqs[r][k].test() == Status.OK
+                    # rank 0's contribution still participated
+                    assert np.array_equal(dsts[k][r], expect)
+        finally:
+            job.cleanup()
+
+    def test_destroy_mid_batch_aborts_members(self, qos_knobs):
+        # fence/epoch contract: team teardown with a held batch fails
+        # the members terminally instead of leaking them
+        coalesce.configure(enabled=True, limit=8192, window_us=1e6,
+                           max_batch=16)
+        job = UccJob(2)
+        try:
+            teams = job.create_team()
+            cnt = 8
+            reqs = []
+            for r, t in enumerate(teams):
+                src = np.ones(cnt, dtype=np.float32)
+                dst = np.zeros(cnt, dtype=np.float32)
+                rq = t.collective_init(_ar_args(src, dst))
+                rq.post()
+                reqs.append(rq)
+            assert all(len(t.coalescer.pending) == 1 for t in teams)
+            for t in teams:
+                t.destroy()
+            for rq in reqs:
+                st = rq.task.super_status
+                assert st == Status.ERR_CANCELED, st
+        finally:
+            job.cleanup()
+
+    def test_window_flush_without_test(self, qos_knobs):
+        # quiescent-rank valve: nobody tests the requests; the window
+        # expiry (driven from Context.progress) seals and completes them
+        coalesce.configure(enabled=True, limit=8192, window_us=2e3,
+                           max_batch=16)
+        job = UccJob(self.N)
+        try:
+            teams = job.create_team()
+            cnt = 8
+            reqs, dsts = [], []
+            for r, t in enumerate(teams):
+                src = (np.arange(cnt) + r).astype(np.float32)
+                dst = np.zeros(cnt, dtype=np.float32)
+                rq = t.collective_init(_ar_args(src, dst))
+                rq.post()
+                reqs.append(rq)
+                dsts.append(dst)
+            # progress WITHOUT touching req.test (which would flush)
+            deadline = time.monotonic() + 10.0
+            while not all(rq.task.is_completed() for rq in reqs):
+                for ctx in job.contexts:
+                    ctx.progress()
+                assert time.monotonic() < deadline, "window never flushed"
+            expect = sum(np.arange(cnt).astype(np.float32) + q
+                         for q in range(self.N))
+            for dst in dsts:
+                assert np.array_equal(dst, expect)
+        finally:
+            job.cleanup()
+
+    def test_priority_post_flushes_bulk_window(self, qos_knobs):
+        # the cross-team latency valve: a latency-class team's post
+        # seals every open bulk batch in the context immediately
+        coalesce.configure(enabled=True, limit=8192, window_us=1e6,
+                           max_batch=16)
+        job = UccJob(2)
+        try:
+            bulk = job.create_team()
+            hot = _team_with_priority(job, 3)
+            assert all(t.coalescer is None for t in hot)
+            cnt = 8
+            held = []
+            for r, t in enumerate(bulk):
+                src = np.ones(cnt, dtype=np.float32)
+                dst = np.zeros(cnt, dtype=np.float32)
+                rq = t.collective_init(_ar_args(src, dst))
+                rq.post()
+                held.append(rq)
+            assert all(len(t.coalescer.pending) == 1 for t in bulk)
+            hot_reqs = [t.collective_init(CollArgs(
+                coll_type=CollType.BARRIER)) for t in hot]
+            for rq in hot_reqs:
+                rq.post()
+            # the priority post flushed the bulk batches at post time
+            assert all(len(t.coalescer.pending) == 0 for t in bulk)
+            _wait_reqs(job, [held + hot_reqs])
+        finally:
+            job.cleanup()
+
+    def test_disabled_dispatch_identical(self, qos_knobs):
+        # UCC_COALESCE off (the default): no coalescer attached, no
+        # request binding, and the candidate walk picks the same
+        # algorithm it always picked
+        coalesce.configure(enabled=True, limit=8192, window_us=5e4,
+                           max_batch=16)
+        job_on = UccJob(2)
+        t_on = job_on.create_team()   # attach happens at activation
+        coalesce.configure(enabled=False)
+        job_off = UccJob(2)
+        try:
+            t_off = job_off.create_team()
+            assert all(t.coalescer is not None for t in t_on)
+            assert all(t.coalescer is None for t in t_off)
+            cnt = 8
+            algs = {}
+            for label, job, teams in (("on", job_on, t_on),
+                                      ("off", job_off, t_off)):
+                reqs = []
+                for r, t in enumerate(teams):
+                    src = np.ones(cnt, dtype=np.float32)
+                    dst = np.zeros(cnt, dtype=np.float32)
+                    rq = t.collective_init(_ar_args(src, dst))
+                    reqs.append(rq)
+                algs[label] = [rq.task.alg_name for rq in reqs]
+                from ucc_tpu.constants import MemoryType
+                cands = teams[0].score_map.lookup(
+                    CollType.ALLREDUCE, MemoryType.HOST, cnt * 4)
+                algs[label + "_cands"] = [str(c.alg_name) for c in cands]
+                if label == "off":
+                    assert all(rq._coalesce is None for rq in reqs)
+                else:
+                    assert all(rq._coalesce is not None for rq in reqs)
+                for rq in reqs:
+                    rq.post()
+                job.progress_until(lambda: all(
+                    rq.test() != Status.IN_PROGRESS for rq in reqs), 30)
+            assert algs["on"] == algs["off"]
+            assert algs["on_cands"] == algs["off_cands"]
+        finally:
+            job_on.cleanup()
+            job_off.cleanup()

@@ -997,6 +997,73 @@ def _churn_smoke(env) -> None:
           f"in {dt:.0f}s -> {verdict}", flush=True)
 
 
+def _mt_smoke(env) -> None:
+    """WARN-ONLY multi-tenant service probe (ISSUE 18 CI satellite):
+    ``python -m ucc_tpu.fault.soak --multi`` shares one progress engine
+    between a latency-class team and coalescing bulk tenants, kills a
+    rank mid-traffic (held/fused members must abort, not hang), shrinks
+    and grows every team, and probes the priority-lane counters —
+    starvation past 1s or any hang is a violation. Skip with
+    UCC_GATE_MT=0."""
+    import json
+    if os.environ.get("UCC_GATE_MT", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] mt smoke: skipped (UCC_GATE_MT=0)", flush=True)
+        return
+    print("[gate] multi-tenant smoke (warn-only) ...", flush=True)
+    t0 = time.monotonic()
+    # the drill arms its own fault/health/coalesce knobs; strip the gate
+    # watchdog so escalation doesn't cancel mid-membership-change
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE",
+                                      "UCC_COALESCE", "UCC_FT"))}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ucc_tpu.fault.soak", "--multi"],
+            cwd=REPO, env=smoke_env, capture_output=True, text=True,
+            timeout=600)
+    except subprocess.TimeoutExpired:
+        print("[gate] WARN: mt smoke timed out — HANG class "
+              "(not a gate failure)", flush=True)
+        return
+    rec = None
+    try:
+        rec = json.loads(r.stdout or "")
+    except ValueError:
+        for ln in (r.stdout or "").splitlines():
+            if ln.startswith("{"):
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue
+    dt = time.monotonic() - t0
+    if rec is None:
+        print(f"[gate] WARN: mt smoke — rc={r.returncode}, no report "
+              f"in {dt:.0f}s (not a gate failure)", flush=True)
+        return
+    problems = []
+    for v in rec.get("violations") or []:
+        if "IN_PROGRESS" in v or "hung" in v:
+            problems.append(f"hang: {v}")
+        elif "starved" in v:
+            problems.append(f"starvation: {v}")
+        else:
+            problems.append(v)
+    if not rec.get("post_rounds_ok"):
+        problems.append("no checked post-recovery round completed")
+    if not rec.get("fused_batches"):
+        problems.append("bulk tenants dispatched no fused batches")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] mt smoke: teams={rec.get('teams')}, "
+          f"rounds={rec.get('rounds')}, post_ok={rec.get('post_rounds_ok')}, "
+          f"fused={rec.get('fused_batches')}, "
+          f"inversions={rec.get('priority_inversions')}, "
+          f"starvation_max={rec.get('starvation_max_ms')}ms, "
+          f"hi_probe={rec.get('hi_probe_ms')} in {dt:.0f}s -> {verdict}",
+          flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -1093,6 +1160,11 @@ def main(argv=None) -> int:
         # collectives on every epoch, fences tripped both directions,
         # and the falsely-suspected survivor re-admitted (ISSUE 17)
         _churn_smoke(env)
+        # warn-only: mixed-priority tenant teams share one progress
+        # engine through kill -> shrink -> grow with coalesced bulk
+        # traffic, and the priority-inversion / starvation counters
+        # stay clean (ISSUE 18)
+        _mt_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

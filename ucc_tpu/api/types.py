@@ -135,6 +135,11 @@ class TeamParams:
     ordered: bool = True                     # EP_RANGE contig / ordering flag
     id: Optional[int] = None                 # user-provided team id
     epoch: int = 0                           # recovery epoch (Team.shrink)
+    #: QoS priority class for the multi-tenant service mode: 0 = bulk
+    #: (lowest) .. 3 = latency (highest); None resolves from the
+    #: UCC_TEAM_PRIORITY env at team create (default 1). Selects the
+    #: progress-queue lane for every collective this team posts.
+    priority: Optional[int] = None
 
 
 @dataclass

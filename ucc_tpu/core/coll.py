@@ -107,6 +107,15 @@ class CollRequest:
     #: path pays exactly one branch
     _flight = None
     _flight_msgsize = 0
+    #: small-collective coalescer (core/coalesce.py): bound at init for
+    #: eligible members of a UCC_COALESCE team — post() hands the task
+    #: to the batcher instead of the wire. Class-attr None keeps the
+    #: off path at one branch (the _flight pattern).
+    _coalesce = None
+    #: latency-valve hook bound on priority>=2 teams' requests while any
+    #: coalescer is attached in the context: posting flushes open
+    #: batches so this collective never waits out a bulk gather window
+    _coal_flush = None
 
     def __init__(self, task: CollTask, team: Team, args: CollArgs):
         self.task = task
@@ -198,6 +207,12 @@ class CollRequest:
             logger.info("coll post: %s team %s seq %d",
                         coll_type_str(self.args.coll_type), self.team.id,
                         self.task.seq_num)
+        if self._coalesce is not None:
+            # hand the fully-accounted post (metrics/flight/trace above
+            # keep per-request attribution) to the team's batcher
+            return self._coalesce.add(self)
+        if self._coal_flush is not None:
+            self._coal_flush()
         return self.task.post()
 
     def _flight_post(self, task: CollTask) -> None:
@@ -574,6 +589,14 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
     req = CollRequest(task, team, args)
     req._flight_msgsize = msgsize
     tuner = team.tuner
+    coal = team.coalescer
+    if coal is None and team.priority >= 2 and \
+            getattr(team.context, "_open_coalescers", None):
+        # latency-class tenant while bulk teams batch: posting this
+        # request seals their open windows (core/coalesce.py valve)
+        from .coalesce import flush_open
+        req._coal_flush = (lambda ctx=team.context:
+                           flush_open(ctx, "priority-post"))
     if tuner is not None and task is inner and args.active_set is None \
             and tuner.wants(ct, mem_type, msgsize, candidates):
         # autotuner probe lane (UCC_TUNER=online, score/tuner.py): the
@@ -585,6 +608,16 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
         # probe lane owns task identity while bound.
         req._bind_tuner(tuner, tuner.key_for(ct, mem_type, msgsize),
                         init_args, candidates, chosen)
+    elif coal is not None and task is inner and \
+            coal.eligible(args, mem_type, msgsize):
+        # small-collective coalescing (UCC_COALESCE, core/coalesce.py):
+        # post() hands this member to the team batcher. Bound AFTER the
+        # candidate walk so candidate lists and the chosen algorithm are
+        # byte-identical with the knob off, and mutually exclusive with
+        # the tuner/runtime-fallback lanes (both re-post task identity
+        # at rank-local times, which would skew wire-tag parity for a
+        # held member).
+        req._coalesce = coal
     elif task is inner and not args.is_persistent:
         # retain the fallback-chain tail for RUNTIME fallback (see
         # CollRequest._try_runtime_fallback). Wrapped (dt-check) and
@@ -596,6 +629,11 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
             rest = []
         if rest:
             req._fallback = (init_args, rest)
+    if coal is not None and req._coalesce is None and coal.pending:
+        # a same-team post that cannot join the open batch is a
+        # program-order closure point — seal it (every rank inits this
+        # collective at the same point by the ordered-issue contract)
+        coal.flush("ineligible")
     return req
 
 

@@ -11,6 +11,7 @@ callbacks with empty-queue throttling (:1062-1088).
 from __future__ import annotations
 
 import pickle
+import time
 from typing import Any, Dict, List, Optional
 
 from ..api.types import ContextParams
@@ -163,6 +164,11 @@ class Context:
         from ..obs import collector as _collector
         self.collector = _collector.maybe_create(self)
 
+        # small-collective coalescers attached in this context
+        # (core/coalesce.py maybe_attach; None until the first attach so
+        # the UCC_COALESCE=off progress loop pays one attribute check)
+        self._open_coalescers = None
+
         self._team_id_counter = 1
         self._mem_maps = {}
         # itertools.count: next() is atomic under the GIL, so concurrent
@@ -191,6 +197,14 @@ class Context:
 
     def progress(self) -> int:
         """ucc_context_progress (ucc_context.c:1062)."""
+        oc = self._open_coalescers
+        if oc:
+            # window-expiry valve: a quiescent rank's open batches seal
+            # after UCC_COALESCE_WINDOW (core/coalesce.py determinism
+            # contract)
+            now = time.monotonic()
+            for coal in oc:
+                coal.step(now)
         n = self.progress_queue.progress()
         col = self.collector
         if col is not None:

@@ -248,6 +248,44 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
                 "schedule) on the virtual CPU mesh; xla / pallas force "
                 "one backend (pallas on CPU runs interpret-mode — the "
                 "test path)", parse_string),
+    # multi-tenant service knobs (ISSUE 18): read from the environment at
+    # import by schedule/progress.py, core/team.py, and core/coalesce.py
+    # (same zero-cost pattern as the obs knobs); listed here so
+    # `ucc_info -cf` documents them
+    ConfigField("TEAM_PRIORITY", "1", "default QoS priority class for teams "
+                "created without an explicit TeamParams.priority: 0 = bulk "
+                "(lowest) .. 3 = latency (highest); selects the "
+                "progress-queue lane every task of the team drains from",
+                parse_string),
+    ConfigField("QOS_WEIGHTS", "1,2,4,8", "per-lane weighted-round-robin "
+                "caps (services per progress pass while a higher lane is "
+                "non-empty, lane 0 first); the top non-empty lane is never "
+                "capped", parse_string),
+    ConfigField("QOS_AGE_MS", "10", "anti-starvation bound in milliseconds: "
+                "a queued task older than this is serviced regardless of "
+                "its lane's WRR cap, and deferrable bulk work (coalesced "
+                "dispatch) stops yielding to latency traffic",
+                parse_string),
+    ConfigField("COALESCE", "n", "small-collective coalescing: same-team "
+                "eligible allreduces (contiguous, same op/dtype, <= "
+                "COALESCE_LIMIT bytes each) posted within a window are "
+                "packed into ONE fused native plan — one ffi crossing for "
+                "the whole batch — and unpacked to per-request statuses on "
+                "completion; n (default) = zero cost, posts unchanged",
+                parse_bool),
+    ConfigField("COALESCE_LIMIT", "4096", "per-member payload ceiling in "
+                "bytes for coalescing; above it a collective is "
+                "bandwidth-bound and batching only adds a copy",
+                parse_string),
+    ConfigField("COALESCE_WINDOW", "200", "gather window in microseconds "
+                "before a non-full batch flushes (any closure trigger — "
+                "batch full, ineligible post, test() on a held member — "
+                "flushes earlier; this is only the quiescent-rank valve)",
+                parse_string),
+    ConfigField("COALESCE_MAX_BATCH", "16", "deterministic batch-size cap, "
+                "the primary closure trigger: every rank flushes on the "
+                "Nth eligible post, keeping fused membership identical "
+                "across ranks in program order", parse_string),
     ConfigField("CHECK_ASYMMETRIC_DT", "n", "validate datatype consistency "
                 "for gather(v)/scatter(v) via a service allreduce before "
                 "the collective (off by default for performance, matching "

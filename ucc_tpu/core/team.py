@@ -87,6 +87,11 @@ class Team:
     #: the continuous collector watches this team; None (class attr,
     #: zero cost) otherwise — dispatch ticks + consults it per INIT
     rank_bias = None
+    #: small-collective coalescer (core/coalesce.TeamCoalescer), attached
+    #: at activation when UCC_COALESCE=y; None (class attr, zero cost)
+    #: otherwise — core dispatch checks it once per collective INIT, so
+    #: the disabled path is byte-identical to pre-coalescing dispatch
+    coalescer = None
     #: CONTEXT ranks flagged slow at team-create time (union of every
     #: member's collector view, agreed over the ADDR_EXCHANGE round):
     #: cl/hier demotes them from hier-tree leader positions. Class attr:
@@ -125,6 +130,15 @@ class Team:
         #: stamped into every host-transport match key (epoch fencing)
         self.epoch: int = int(getattr(p, "epoch", 0) or 0)
         self.state = TeamState.ADDR_EXCHANGE
+        #: QoS priority class (progress-queue lane): explicit create
+        #: param wins, else the UCC_TEAM_PRIORITY env, else the default
+        #: middle class. Resolved once here (cold path); the progress
+        #: queue caches the lane on each task.
+        from ..schedule.progress import DEFAULT_PRIORITY, clamp_priority
+        pr = getattr(p, "priority", None)
+        if pr is None:
+            pr = os.environ.get("UCC_TEAM_PRIORITY", DEFAULT_PRIORITY)
+        self.priority = clamp_priority(pr)
         # the watchdog enumerates live teams so a create-time hang names
         # its state-machine position (WeakSet; no lifetime extension)
         watchdog.register_team(self)
@@ -317,6 +331,15 @@ class Team:
                         logger.info("team %s %s topology:\n%s",
                                     self.id, cl.name, describe())
             self.state = TeamState.ACTIVE
+            # small-collective coalescer (UCC_COALESCE=y): attached only
+            # once the score map exists — eligibility and the fused
+            # dispatch both ride it. Must never fail activation.
+            from .coalesce import maybe_attach as _coalesce_attach
+            try:
+                _coalesce_attach(self)
+            except Exception:  # noqa: BLE001
+                logger.exception("coalescer attach failed; team %s "
+                                 "continues uncoalesced", self.id)
             # continuous telemetry: register with the context's
             # collector (None unless UCC_COLLECT=y) — windows start
             # only once the team can actually carry the exchange
@@ -531,6 +554,11 @@ class Team:
         if self._destroyed:
             return Status.OK
         self._destroyed = True
+        if self.coalescer is not None:
+            # held members must reach a terminal state before their
+            # transport goes away (per-request contract)
+            self.coalescer.abort(Status.ERR_CANCELED)
+            self.coalescer.detach()
         task, self._pending_task = self._pending_task, None
         if task is not None and not task.is_completed():
             task.cancel(Status.ERR_CANCELED)   # never raises (contract)
@@ -625,6 +653,10 @@ class Team:
         PR 3 scratch leases are tainted (dropped at finalize, not
         recycled)."""
         from ..fault.health import cancel_queued_tasks
+        if self.coalescer is not None:
+            # members held in an open batch never reached the progress
+            # queue — cancel them here or the sweep below misses them
+            self.coalescer.abort(status, failed_ctx_ranks)
         failed = set(failed_ctx_ranks)
 
         def failed_for(task):
@@ -1170,6 +1202,9 @@ class GrowRequest:
         """Bound collectives still riding the retired epoch with
         ``ERR_CANCELED`` (no rank failed — membership changed under
         them; recovery traffic is exempt as everywhere else)."""
+        if self.team.coalescer is not None:
+            # batch-held members never reached the progress queue
+            self.team.coalescer.abort(Status.ERR_CANCELED)
         queue = self.team.context.progress_queue
         n = 0
         for task in list(getattr(queue, "_q", ())):

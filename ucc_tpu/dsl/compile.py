@@ -53,8 +53,13 @@ _EXACT_OPS = frozenset((ReductionOp.SUM, ReductionOp.AVG, ReductionOp.PROD,
 class GeneratedCollTask(HostCollTask):
     """Interpreter for one rank of a verified collective program."""
 
-    def __init__(self, init_args, team, program: Program, subset=None):
-        super().__init__(init_args, team, subset)
+    def __init__(self, init_args, team, program: Program, subset=None,
+                 tag=None):
+        # ``tag``: explicit wire tag override (the coalescer's fused
+        # batches allocate from their own deterministic tag range so a
+        # rank-local flush point cannot skew the organic per-team
+        # counter); None = the normal next_coll_tag() allocation
+        super().__init__(init_args, team, subset, tag=tag)
         args = init_args.args
         if args.coll_type != program.coll:
             raise UccError(Status.ERR_NOT_SUPPORTED,

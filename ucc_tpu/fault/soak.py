@@ -821,6 +821,345 @@ def run_churn_soak(n_ranks: int = 4, cycles: int = 2,
     return report
 
 
+# ---------------------------------------------------------------------------
+# multi-tenant scenario: N teams x kill x grow x priority-inversion probe
+# ---------------------------------------------------------------------------
+
+def _make_teams_mt(ctxs, priority=None, deadline_s: float = 60.0):
+    """One team across *ctxs* with an explicit priority class."""
+    from ucc_tpu import Status, TeamParams, ThreadOobWorld, UccError
+    world = ThreadOobWorld(len(ctxs))
+    teams = [c.create_team_post(TeamParams(oob=world.endpoint(i),
+                                           priority=priority))
+             for i, c in enumerate(ctxs)]
+    deadline = time.monotonic() + deadline_s
+    while True:
+        # list comp, not a generator: every rank's create state machine
+        # must step each pass or the OOB exchange deadlocks
+        sts = [t.create_test() for t in teams]
+        for c in ctxs:
+            c.progress()
+        if all(s == Status.OK for s in sts):
+            return teams
+        bad = [s for s in sts if s.is_error]
+        if bad:
+            raise UccError(bad[0], "mt soak team create failed")
+        if time.monotonic() > deadline:
+            raise TimeoutError("mt soak team create timed out")
+
+
+def run_multi_tenant_soak(n_ranks: int = 4, n_teams: int = 3,
+                          rounds: int = 5, burst: int = 6,
+                          post_rounds: int = 5, kill_rank: int = 2,
+                          hb_interval: float = 0.02,
+                          hb_timeout: float = 0.3,
+                          iter_deadline_s: float = 15.0,
+                          membership_deadline_s: float = 30.0,
+                          count: int = 32) -> Dict:
+    """The multi-tenant service drill: *n_teams* teams share one
+    progress engine per rank — team 0 is the latency class (priority 3),
+    the rest are bulk (priority 0) with small-collective coalescing ON.
+    Phases:
+
+    1. mixed traffic: every round the bulk teams post a *burst* of
+       coalesce-eligible allreduces, then the latency team posts a
+       probe per rank (completion-callback timed);
+    2. kill one rank mid-traffic: every surviving tenant's in-flight
+       work — including members HELD by a coalescer and batches already
+       sealed into fused carriers — must reach a terminal status within
+       the deadline (the no-hang invariant extended to the batching
+       layer), with the failure attributed;
+    3. recovery: every team shrinks among the survivors, then grows the
+       revived rank back in (sequential join per team);
+    4. post-recovery mixed traffic with checked statuses, and the
+       priority-inversion probe: per-context ``qos_snapshot`` counters
+       (inversions, starvation gauge) recorded in the report —
+       starvation past 1s is a violation.
+
+    Returns a report dict; ``report["violations"]`` MUST be empty.
+    """
+    from ucc_tpu import BufferInfo, CollArgs, CollType, DataType, Status
+    from ucc_tpu.constants import ReductionOp
+    from ucc_tpu.core import coalesce as _coal
+    from ucc_tpu.core.team import Team
+
+    from . import health
+
+    inject.reset()
+    prev_mode, prev_int, prev_to = (health.MODE, health.HEARTBEAT_INTERVAL,
+                                    health.HEARTBEAT_TIMEOUT)
+    health.configure("shrink", interval=hb_interval, timeout=hb_timeout)
+    prev_coal = (_coal.ENABLED, _coal.LIMIT_BYTES,
+                 round(_coal.WINDOW_S * 1e6), _coal.MAX_BATCH)
+    _coal.configure(enabled=True)
+    report: Dict = {"teams": n_teams, "ranks": n_ranks, "rounds": 0,
+                    "post_rounds_ok": 0, "violations": [], "outcomes": {},
+                    "detected": {}, "shrunk_epochs": {}, "grown_epochs": {},
+                    "hi_probe_ms": {}, "qos": {}, "fused_batches": 0}
+    ctxs = _make_job(n_ranks)
+    # team 0 = latency class; teams 1.. = bulk tenants (coalesced)
+    cur: List[Dict] = []
+    for t in range(n_teams):
+        per = _make_teams_mt(ctxs, priority=(3 if t == 0 else 0))
+        cur.append({i: per[i] for i in range(n_ranks)})
+    all_teams: List = [tm for per in cur for tm in per.values()]
+
+    def _ar_args(cb=None):
+        a = CollArgs(coll_type=CollType.ALLREDUCE, op=ReductionOp.SUM,
+                     src=BufferInfo(np.ones(count, np.float32), count,
+                                    DataType.FLOAT32),
+                     dst=BufferInfo(np.zeros(count, np.float32), count,
+                                    DataType.FLOAT32))
+        a.cb = cb
+        return a
+
+    def _mixed_round(members, phase, check=False):
+        """One bulk-burst + latency-probe round over *members* (ctx
+        index -> per-team Team maps). Returns hi-probe latencies (ms)."""
+        order = sorted(members[0])
+        reqs, lats = [], []
+        for per in members[1:]:
+            for _ in range(burst):
+                for i in order:
+                    rq = per[i].collective_init(_ar_args())
+                    rq.post()
+                    reqs.append(rq)
+        done = {}
+
+        def _stamp(i):
+            def _cb(_t, _st):
+                done[i] = time.perf_counter()
+            return _cb
+
+        t0 = {}
+        hi = []
+        for i in order:
+            t0[i] = time.perf_counter()
+            rq = members[0][i].collective_init(_ar_args(cb=_stamp(i)))
+            rq.post()
+            hi.append(rq)
+            reqs.append(rq)
+        deadline = time.monotonic() + iter_deadline_s
+        while time.monotonic() < deadline:
+            for i in order:
+                ctxs[i].progress()
+            if all(rq.test() != Status.IN_PROGRESS for rq in reqs):
+                break
+        sts = [rq.test() for rq in reqs]
+        for s in sts:
+            key = f"{phase}:{s.name}"
+            report["outcomes"][key] = report["outcomes"].get(key, 0) + 1
+        stuck = sum(1 for s in sts if s == Status.IN_PROGRESS)
+        if stuck:
+            report["violations"].append(
+                f"{phase}: {stuck} request(s) IN_PROGRESS past deadline")
+            for rq in reqs:
+                if rq.test() == Status.IN_PROGRESS:
+                    rq.task.cancel(Status.ERR_TIMED_OUT)
+        elif check and any(s != Status.OK for s in sts):
+            bad = sorted({s.name for s in sts if s != Status.OK})
+            report["violations"].append(f"{phase}: failures {bad}")
+        for i in order:
+            if i in done:
+                lats.append((done[i] - t0[i]) * 1e3)
+        for rq in reqs:
+            try:
+                rq.finalize()
+            except Exception:  # noqa: BLE001
+                pass
+        return lats
+
+    try:
+        # -- phase 1: healthy mixed traffic ---------------------------
+        hi_lats: List[float] = []
+        for _ in range(rounds):
+            hi_lats.extend(_mixed_round(cur, "mixed", check=True))
+            report["rounds"] += 1
+
+        # -- phase 2: kill one rank mid-traffic -----------------------
+        killed_ctx = ctxs[kill_rank].rank
+        survivors = [i for i in range(n_ranks) if i != kill_rank]
+        report["killed"] = {"team_rank": kill_rank, "ctx_rank": killed_ctx}
+        inject.configure(f"kill={killed_ctx}", seed=0)
+        reqs = {}
+        for t, per in enumerate(cur):
+            for i in survivors:
+                try:
+                    rq = per[i].collective_init(_ar_args())
+                    rq.post()
+                    reqs[(t, i)] = rq
+                except Exception as e:  # noqa: BLE001
+                    report["violations"].append(
+                        f"kill: team {t} rank {i} post raised "
+                        f"{type(e).__name__}: {e}")
+        deadline = time.monotonic() + iter_deadline_s
+        while time.monotonic() < deadline:
+            for i in survivors:
+                ctxs[i].progress()
+            if all(rq.test() != Status.IN_PROGRESS
+                   for rq in reqs.values()):
+                break
+        attributed = 0
+        for (t, i), rq in reqs.items():
+            st = rq.test()
+            report["detected"][f"t{t}r{i}"] = st.name
+            if st == Status.IN_PROGRESS:
+                report["violations"].append(
+                    f"kill: team {t} rank {i} IN_PROGRESS after kill "
+                    "(held/fused member not aborted?)")
+                rq.task.cancel(Status.ERR_TIMED_OUT)
+            elif not st.is_error:
+                report["violations"].append(
+                    f"kill: team {t} rank {i} saw {st.name}, expected "
+                    "an error")
+            if killed_ctx in (rq.failed_ranks or []):
+                attributed += 1
+            try:
+                rq.finalize()
+            except Exception:  # noqa: BLE001
+                pass
+        if reqs and not attributed:
+            report["violations"].append(
+                f"kill: no survivor attributed the failure to ctx "
+                f"{killed_ctx}")
+
+        # -- phase 3: shrink every tenant among the survivors ---------
+        shrunk: List[Dict] = []
+        for t, per in enumerate(cur):
+            shrinks = {}
+            for i in survivors:
+                try:
+                    shrinks[i] = per[i].shrink_post()
+                except Exception as e:  # noqa: BLE001
+                    report["violations"].append(
+                        f"shrink: team {t} rank {i} raised "
+                        f"{type(e).__name__}: {e}")
+                    return report
+            if not _drive_requests([ctxs[i] for i in survivors],
+                                   list(shrinks.values()),
+                                   membership_deadline_s):
+                report["violations"].append(f"shrink: team {t} hung")
+                return report
+            views = set()
+            for i, s in shrinks.items():
+                if s.test() != Status.OK:
+                    report["violations"].append(
+                        f"shrink: team {t} rank {i} failed "
+                        f"{s.test().name}")
+                    return report
+                views.add((tuple(s.failed_ranks or ()), s.epoch))
+            if len(views) > 1:
+                report["violations"].append(
+                    f"shrink: team {t} views diverged {views}")
+                return report
+            report["shrunk_epochs"][f"t{t}"] = next(iter(views))[1]
+            shrunk.append({i: shrinks[i].new_team for i in survivors})
+            all_teams.extend(shrunk[-1].values())
+        # traffic must flow for every tenant on the shrunk epoch
+        _mixed_round(shrunk, "shrunk", check=True)
+
+        # -- phase 4: grow the revived rank back into every team ------
+        inject.reset()
+        for per in cur:
+            try:
+                per[kill_rank].destroy()
+            except Exception:  # noqa: BLE001
+                pass
+        grown: List[Dict] = []
+        for t, per in enumerate(shrunk):
+            grows = {}
+            for i in survivors:
+                try:
+                    grows[i] = per[i].grow_post([killed_ctx])
+                except Exception as e:  # noqa: BLE001
+                    report["violations"].append(
+                        f"grow: team {t} rank {i} raised "
+                        f"{type(e).__name__}: {e}")
+                    return report
+            try:
+                join = Team.join_post(ctxs[kill_rank])
+            except Exception as e:  # noqa: BLE001
+                report["violations"].append(
+                    f"grow: team {t} join raised {type(e).__name__}: {e}")
+                return report
+            if not _drive_requests(ctxs, list(grows.values()) + [join],
+                                   membership_deadline_s):
+                report["violations"].append(f"grow: team {t} hung")
+                return report
+            epochs = set()
+            for i, g in grows.items():
+                if g.test() != Status.OK:
+                    report["violations"].append(
+                        f"grow: team {t} rank {i} failed {g.test().name}")
+                    return report
+                epochs.add(g.epoch)
+            if join.test() != Status.OK:
+                report["violations"].append(
+                    f"grow: team {t} join failed {join.test().name}")
+                return report
+            epochs.add(join.epoch)
+            if len(epochs) > 1:
+                report["violations"].append(
+                    f"grow: team {t} epochs diverged {epochs}")
+                return report
+            report["grown_epochs"][f"t{t}"] = next(iter(epochs))
+            nxt = {i: grows[i].new_team for i in survivors}
+            nxt[kill_rank] = join.new_team
+            grown.append(nxt)
+            all_teams.extend(nxt.values())
+
+        # -- phase 5: post-recovery traffic + inversion probe ---------
+        for _ in range(post_rounds):
+            before = len(report["violations"])
+            hi_lats.extend(_mixed_round(grown, "post", check=True))
+            if len(report["violations"]) == before:
+                report["post_rounds_ok"] += 1
+        if hi_lats:
+            arr = sorted(hi_lats)
+            report["hi_probe_ms"] = {
+                "n": len(arr),
+                "p50": round(arr[len(arr) // 2], 3),
+                "max": round(arr[-1], 3)}
+        report["fused_batches"] = sum(
+            getattr(tm.coalescer, "_fused_seq", 0)
+            for per in grown for tm in per.values()
+            if getattr(tm, "coalescer", None) is not None)
+        # priority-inversion probe: the lanes' own counters. Inversions
+        # are recorded (timing-dependent, not a hard failure); actual
+        # starvation — a queued task aged past 1s — is a violation.
+        inv, starve = 0, 0.0
+        for i, c in enumerate(ctxs):
+            try:
+                snap = c.progress_queue.qos_snapshot()
+            except Exception:  # noqa: BLE001 - probe is observational
+                continue
+            report["qos"][f"ctx{i}"] = snap
+            inv += snap.get("inversions", 0)
+            starve = max(starve, snap.get("starvation_max_ms", 0.0))
+        report["priority_inversions"] = inv
+        report["starvation_max_ms"] = round(starve, 3)
+        if starve > 1000.0:
+            report["violations"].append(
+                f"priority lanes starved a task for {starve:.0f}ms")
+    finally:
+        report["injected"] = dict(inject.COUNTS)
+        inject.reset()
+        health.configure(prev_mode, interval=prev_int, timeout=prev_to)
+        _coal.configure(enabled=prev_coal[0], limit=prev_coal[1],
+                        window_us=prev_coal[2], max_batch=prev_coal[3])
+        for tm in all_teams:
+            try:
+                tm.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+        for c in ctxs:
+            try:
+                c.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+    return report
+
+
 def _probe_stale_plan_fence(old_team, report) -> None:
     """Native-plan twin of ``_probe_stale_send_fence``: build a one-op
     plan keyed to the OLD (fenced) epoch and post it — the C executor's
@@ -953,6 +1292,20 @@ def main(argv=None) -> int:
                     "churn collectives (UCC_FT=shrink + Team.grow)")
     ap.add_argument("--cycles", type=int, default=2,
                     help="with --churn: kill->shrink->grow cycles to run")
+    ap.add_argument("--multi", action="store_true",
+                    help="run the multi-tenant drill: N teams of mixed "
+                    "priority share one progress engine (bulk tenants "
+                    "coalescing), a rank is killed mid-traffic, every "
+                    "team shrinks and grows the rank back, and the "
+                    "priority-inversion/starvation counters are probed")
+    ap.add_argument("--mt-teams", type=int, default=3,
+                    help="with --multi: tenant teams (first is the "
+                    "latency class)")
+    ap.add_argument("--mt-rounds", type=int, default=5,
+                    help="with --multi: mixed-traffic rounds per phase")
+    ap.add_argument("--mt-burst", type=int, default=6,
+                    help="with --multi: bulk posts per team-rank per "
+                    "round")
     ap.add_argument("--plans", action="store_true",
                     help="with --kill-shrink: run the drill with the "
                     "allreduces forced onto NATIVE EXECUTION PLANS "
@@ -960,6 +1313,14 @@ def main(argv=None) -> int:
                     "ucc_plan_cancel withdrew posted recvs and a "
                     "pre-shrink plan send is fenced")
     args = ap.parse_args(argv)
+    if args.multi:
+        report = run_multi_tenant_soak(args.ranks, n_teams=args.mt_teams,
+                                       rounds=args.mt_rounds,
+                                       burst=args.mt_burst,
+                                       post_rounds=args.mt_rounds,
+                                       kill_rank=args.kill_rank)
+        print(json.dumps(report, indent=1))
+        return 1 if report["violations"] else 0
     if args.churn:
         report = run_churn_soak(args.ranks, cycles=args.cycles,
                                 post_iters=args.post_iters,

@@ -529,6 +529,16 @@ class _TeamWatch:
                         if (w.get("t") or 0.0) > cut
                         and "fcw" not in str(w.get("tkey"))]
         snap["window"] = self.window
+        # per-tenant QoS counters ride with the window: queue-wait per
+        # team, lane depths, inversion/starvation counters since the
+        # last window (schedule/progress.qos_snapshot). Observational —
+        # persisted in the pod record for ucc_fr/offline analysis.
+        try:
+            snap["qos"] = team.context.progress_queue.qos_snapshot(
+                reset=True)
+        except Exception:  # noqa: BLE001 - telemetry must never take
+            # down the window exchange
+            pass
         return snap
 
     def step(self) -> None:

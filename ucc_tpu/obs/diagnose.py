@@ -25,6 +25,10 @@ per-message send records. Output: findings that name culprits:
 - **failed** — absent ranks (excluded from collection as dead) and
   ranks whose ring ends in error completions, each with what was in
   flight when it died.
+- **queue_wait** — per-tenant scheduling contention: ``qos:qwait:pN``
+  stage completions (priority-lane progress queue, waits past the
+  anti-starvation bound) grouped per (team, lane), naming the team and
+  priority class whose traffic sat queued behind other tenants.
 
 Everything here is a cold path operating on plain dicts, so it is
 equally usable in-process (watchdog fold-in), from the ``ucc_fr`` CLI
@@ -312,10 +316,14 @@ def detect_stragglers(merged: Dict[str, Any],
                     wire_best[r] = cand
     findings.extend(wire_best[r] for r in sorted(wire_best))
 
-    # (3) stage-duration outliers (hier phase tasks name the tree level)
+    # (3) stage-duration outliers (hier phase tasks name the tree level).
+    # qos:* stages are scheduling contention, not rank slowness — they
+    # have their own detector (detect_queue_wait)
     stages: Dict[Tuple[str, int], Dict[int, float]] = {}
     for r, ri in idx.items():
         for stage, durs in ri.stage_durs.items():
+            if stage.startswith("qos:"):
+                continue
             for i, d in enumerate(durs):
                 stages.setdefault((stage, i), {})[r] = d
     stage_slow: Dict[Tuple[int, str], Tuple[int, float, float]] = {}
@@ -373,6 +381,48 @@ def _lagged_seqs(ri: Optional[_RankIndex],
     return out[:16]
 
 
+def detect_queue_wait(merged: Dict[str, Any], _idx=None
+                      ) -> List[Dict[str, Any]]:
+    """Per-tenant queue-wait outliers: the priority-lane progress queue
+    (schedule/progress.py) records enqueue -> first-service waits past
+    the anti-starvation aging bound as ``qos:qwait:pN`` stage
+    completions. Grouped per (team, lane), each finding names the team
+    and priority lane whose traffic sat queued behind other tenants,
+    with the ranks that saw it and the worst wait."""
+    idx = _index(merged, _idx)
+    groups: Dict[Tuple, Dict[str, Any]] = {}
+    for r, ri in idx.items():
+        for ev in ri.events:
+            if ev.get("ev") != "cmpl":
+                continue
+            stage = ev.get("stage") or ""
+            if not stage.startswith("qos:qwait:p"):
+                continue
+            try:
+                lane = int(stage[len("qos:qwait:p"):])
+            except ValueError:
+                continue
+            key = (ev.get("team"), lane)
+            g = groups.setdefault(key, {"count": 0, "max_wait_s": 0.0,
+                                        "ranks": set(), "coll": None})
+            g["count"] += 1
+            w = float(ev.get("dur_s") or 0.0)
+            if w >= g["max_wait_s"]:
+                g["max_wait_s"] = w
+                g["coll"] = ev.get("coll")
+            g["ranks"].add(r)
+    findings = []
+    for (team, lane) in sorted(groups, key=str):
+        g = groups[(team, lane)]
+        findings.append({
+            "kind": "queue_wait", "team": team, "lane": lane,
+            "count": g["count"],
+            "max_wait_ms": round(g["max_wait_s"] * 1e3, 3),
+            "worst_coll": g["coll"],
+            "ranks": sorted(g["ranks"])})
+    return findings
+
+
 def detect_failed(merged: Dict[str, Any], _idx=None
                   ) -> List[Dict[str, Any]]:
     """Dead/failed ranks: collection-time absentees (excluded as dead —
@@ -417,6 +467,7 @@ def diagnose(merged: Dict[str, Any]) -> Dict[str, Any]:
     stragglers = detect_stragglers(merged, _idx=idx)
     missing = detect_missing(merged, _idx=idx)
     failed = detect_failed(merged, _idx=idx)
+    queue_wait = detect_queue_wait(merged, _idx=idx)
     summary: List[str] = []
     for f in desync:
         summary.append(
@@ -465,8 +516,16 @@ def diagnose(merged: Dict[str, Any]) -> Dict[str, Any]:
             summary.append(f"FAILED rank {f['rank']}: "
                            f"{f.get('error_colls', 0)} error "
                            f"completion(s){tail}")
+    for f in queue_wait:
+        ranks = ",".join(str(r) for r in f["ranks"])
+        summary.append(
+            f"QUEUE-WAIT team {f['team']} lane p{f['lane']}: "
+            f"{f['count']} wait(s) past the aging bound on rank(s) "
+            f"{ranks}, worst {f['max_wait_ms']:.1f}ms"
+            + (f" ({f['worst_coll']})" if f.get("worst_coll") else ""))
     return {"desync": desync, "stragglers": stragglers,
-            "missing": missing, "failed": failed, "summary": summary}
+            "missing": missing, "failed": failed,
+            "queue_wait": queue_wait, "summary": summary}
 
 
 def _sig_str(sig: Dict[str, Any]) -> str:

@@ -122,6 +122,52 @@ def print_snapshot(snap: Dict[str, Any], out=None,
                     w(f"  {'':<28} {'':<40} {bs}\n")
 
 
+def print_qos(snap: Dict[str, Any], out=None) -> None:
+    """Focused multi-tenant QoS view (``--qos``): per-team/lane
+    queue-wait percentiles, coalesce batch sizes per flush reason, and
+    the inversion/starvation counters — the ``qos_*`` series the
+    priority-lane progress queue and the coalescer emit."""
+    w = (out or sys.stdout).write
+    w(f"# qos view: pid {snap.get('pid')} uptime "
+      f"{snap.get('uptime_s')}s\n")
+    hists = snap.get("histograms") or {}
+    waits = hists.get("qos_queue_wait_us") or {}
+    if waits:
+        w("\n[queue wait, us]  (per team/lane; enqueue -> first "
+          "service)\n")
+        for k, slot in sorted(waits.items()):
+            count = slot.get("count", 0)
+            avg = (slot.get("sum", 0) / count) if count else 0
+            w(f"  {_fmt_key(k):<40} count={count} avg={avg:.1f} "
+              f"p50={hist_percentile(slot, 0.50):.1f} "
+              f"p99={hist_percentile(slot, 0.99):.1f} "
+              f"max={float(slot.get('max', 0)):.1f}\n")
+    batches = hists.get("qos_coalesce_batch") or {}
+    if batches:
+        w("\n[coalesce batch size]  (per flush reason)\n")
+        for k, slot in sorted(batches.items()):
+            count = slot.get("count", 0)
+            avg = (slot.get("sum", 0) / count) if count else 0
+            w(f"  {_fmt_key(k):<40} flushes={count} avg={avg:.1f} "
+              f"max={slot.get('max', 0)}\n")
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    rows = []
+    for name in ("qos_priority_inversions", "qos_coalesce_fused"):
+        for k, v in sorted((counters.get(name) or {}).items()):
+            rows.append((name, k, v))
+    for name in ("progress_starvation_max_ms", "qos_lane_depth"):
+        for k, v in sorted((gauges.get(name) or {}).items()):
+            rows.append((name, k, v))
+    if rows:
+        w("\n[contention]\n")
+        for name, k, v in rows:
+            w(f"  {name:<28} {_fmt_key(k):<40} {_fmt_val(v)}\n")
+    if not (waits or batches or rows):
+        w("  no qos_* series in this snapshot (priority lanes idle "
+          "and coalescing off?)\n")
+
+
 def diff_snapshots(old: Dict[str, Any], new: Dict[str, Any],
                    out=None) -> None:
     w = (out or sys.stdout).write
@@ -206,6 +252,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--buckets", action="store_true",
                     help="also print raw log2 bucket counts under each "
                          "histogram (default shows derived p50/p99 only)")
+    ap.add_argument("--qos", action="store_true",
+                    help="print only the multi-tenant QoS view: queue-"
+                         "wait histogram, coalesce batch sizes, "
+                         "contention counters")
     ap.add_argument("--watch", type=float, metavar="SECS", default=None,
                     help="live mode: re-read the file every SECS seconds "
                          "and print the per-interval delta")
@@ -231,7 +281,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         snapsets.append(snaps)
 
     try:
-        if len(snapsets) == 2:
+        if args.qos:
+            print_qos(snapsets[0][0 if args.first else -1])
+        elif len(snapsets) == 2:
             diff_snapshots(snapsets[0][-1], snapsets[1][-1])
         elif args.self_diff:
             diff_snapshots(snapsets[0][0], snapsets[0][-1])
