@@ -66,8 +66,10 @@ namespace {
 // visible to BOTH artifacts: the loader's ABI gate compares the ext's
 // compiled-in value (py_abi_version) against the core's ucc_abi_version()
 // (4: native execution plans — ucc_plan_build/post/test/cancel retire a
-// verified DSL program's whole round schedule against the mailbox in C++)
-constexpr uint64_t kAbiVersion = 4;
+// verified DSL program's whole round schedule against the mailbox in C++;
+// 5: wire integrity — per-entry crc32 word, kCorrupt completion state,
+// ucc_mailbox_set_integrity / ucc_mailbox_push2)
+constexpr uint64_t kAbiVersion = 5;
 }  // namespace
 
 // The thin extension build (-DUCC_TPU_EXT_THIN) compiles ONLY the CPython
@@ -98,6 +100,8 @@ enum State : uint32_t {
     kFenced = 3,      // stale team epoch at the match boundary
     kCanceled = 4,    // withdrawn by ucc_req_cancel
     kAssist = 5,      // plan state word only: python assist callback due
+    kCorrupt = 6,     // wire crc32 mismatch at delivery; the pub word's
+                      // nbytes field carries the SENDER's ctx rank
 };
 
 // push() return kinds, packed into the low 3 bits of the return word
@@ -143,6 +147,7 @@ struct Unexp {
     uint64_t len = 0;
     uint64_t sreq = 0;              // rndv send request id (0 = eager)
     void* src_plan = nullptr;       // sending plan (nudged at delivery)
+    uint64_t crc = 0;               // checksum word: (1<<32)|crc32, 0=none
 };
 
 struct Shard {
@@ -160,6 +165,12 @@ struct Shard {
 
 struct Mailbox {
     Shard shards[kShards];
+
+    // wire-integrity arming (UCC_INTEGRITY=wire|verify): when nonzero,
+    // pushes without a caller-supplied checksum compute a crc32 over the
+    // payload and every delivery verifies it. Cold default: the single
+    // relaxed load in push_impl is the entire off-mode cost.
+    std::atomic<uint32_t> integrity{0};
 
     // request table: chunked slots + flat pub array (Python maps pub once)
     std::atomic<Slot*> chunks[kMaxChunks];
@@ -261,6 +272,30 @@ struct Mailbox {
                static_cast<uint32_t>(k.a) < it->second;
     }
 };
+
+// software crc32 (reflected, polynomial 0xEDB88320) — bit-identical to
+// zlib.crc32, so checksums computed here interoperate with the python
+// matcher's and with injector-supplied clean checksums.
+struct Crc32Table {
+    uint32_t t[256];
+    Crc32Table() {
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; ++k)
+                c = (c & 1u) ? (0xEDB88320u ^ (c >> 1)) : (c >> 1);
+            t[i] = c;
+        }
+    }
+};
+
+uint32_t crc32_of(const void* data, uint64_t len) {
+    static const Crc32Table tab;
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    uint32_t crc = 0xFFFFFFFFu;
+    for (uint64_t i = 0; i < len; ++i)
+        crc = tab.t[(crc ^ p[i]) & 0xFFu] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
 
 // poll word relative to *rid*: 0 = pending; else (nbytes<<3)|state, with
 // a freed/reused slot reading as plain done-OK (only non-owners — rndv
@@ -377,7 +412,8 @@ struct Plan {
     bool parked = false;
     // accounting, mapped read-only by python after an acquire-ordered
     // confirm of the state word: [0..3] send kinds direct/eager/rndv/
-    // fenced, [4] rounds completed, [5] recvs withdrawn by cancel
+    // fenced, [4] rounds completed, [5] recvs withdrawn by cancel,
+    // [6] corrupt deliveries, [7] first corrupt sender's ctx rank + 1
     uint64_t ctr[8] = {0};
 };
 
@@ -418,11 +454,22 @@ void plan_ready(void* pv) {
 // shared matcher core of ucc_mailbox_push and the plan executor's send
 // pass: *nudge is set to the receiving plan on a direct delivery into a
 // plan-posted recv; *src_plan* rides parked rndv entries so the sender's
-// plan is nudged when a later recv lands the message.
+// plan is nudged when a later recv lands the message. *crcw* is the
+// checksum word ((1<<32)|crc32 of the payload, 0 = unchecked): when the
+// receiving mailbox has integrity armed and the caller supplied none,
+// one is computed here — that single path covers python pushes AND every
+// plan-executor round. Verification happens at delivery (direct here,
+// parked entries in post_recv_impl); a mismatch publishes kCorrupt with
+// the sender's ctx rank (low word of key c) in the nbytes field, and the
+// SEND still completes normally — corruption is the receiver's error,
+// exactly like the python matcher.
 uint64_t push_impl(Mailbox* mb, const Key& k, const void* data,
-                   uint64_t len, uint64_t eager_limit, void* src_plan,
-                   void** nudge) {
+                   uint64_t len, uint64_t eager_limit, uint64_t crcw,
+                   void* src_plan, void** nudge) {
     *nudge = nullptr;
+    if ((crcw >> 32) == 0 &&
+        mb->integrity.load(std::memory_order_relaxed))
+        crcw = (1ull << 32) | crc32_of(data, len);
     uint32_t shard_idx;
     Shard& sh = mb->shard_for(k, &shard_idx);
     std::lock_guard<std::mutex> g(sh.mu);
@@ -448,6 +495,13 @@ uint64_t push_impl(Mailbox* mb, const Key& k, const void* data,
             s->nbytes = n;
             s->sent = len;
             *nudge = s->plan;
+            if ((crcw >> 32) && len <= s->cap &&
+                crc32_of(s->dst, n) != static_cast<uint32_t>(crcw)) {
+                uint64_t src = static_cast<uint32_t>(k.c);
+                s->nbytes = src;
+                mb->publish(rid, src, kCorrupt);
+                return kKindDirect;
+            }
             mb->publish(rid, n, len > s->cap ? kTruncated : kOk);
             return kKindDirect;
         }
@@ -459,6 +513,7 @@ uint64_t push_impl(Mailbox* mb, const Key& k, const void* data,
     if (sid == 0) {
         Unexp u;
         u.len = len;
+        u.crc = crcw;
         if (len)
             u.owned.assign(static_cast<const uint8_t*>(data),
                            static_cast<const uint8_t*>(data) + len);
@@ -471,6 +526,7 @@ uint64_t push_impl(Mailbox* mb, const Key& k, const void* data,
     u.len = len;
     u.sreq = sid;
     u.src_plan = src_plan;
+    u.crc = crcw;
     sh.unexpected[k].push_back(std::move(u));
     return (sid << 3) | kKindRndv;
 }
@@ -506,7 +562,14 @@ uint64_t post_recv_impl(Mailbox* mb, const Key& k, void* dst, uint64_t cap,
             std::memcpy(dst, u.ptr != nullptr ? u.ptr : u.owned.data(), n);
         s->nbytes = n;
         s->sent = u.len;
-        mb->publish(rid, n, u.len > cap ? kTruncated : kOk);
+        if ((u.crc >> 32) && u.len <= cap &&
+            crc32_of(dst, n) != static_cast<uint32_t>(u.crc)) {
+            uint64_t src = static_cast<uint32_t>(k.c);
+            s->nbytes = src;
+            mb->publish(rid, src, kCorrupt);
+        } else {
+            mb->publish(rid, n, u.len > cap ? kTruncated : kOk);
+        }
         // send requests are freed AT DELIVERY: the bumped generation
         // reads as complete on the sender's side, and the C-side Request
         // no longer outlives its message (the v1 leak)
@@ -622,7 +685,7 @@ void plan_advance(Plan* p) {
                 Mailbox* peer = p->peers[w.peer];
                 uint64_t ret = push_impl(
                     peer, k, plan_base(p, w.region) + w.off, w.nbytes,
-                    p->eager_limit, p, &nudge);
+                    p->eager_limit, 0, p, &nudge);
                 plan_enqueue(nudge);
                 uint32_t kind = ret & 7u;
                 ++p->ctr[kind & 3u];
@@ -650,6 +713,13 @@ void plan_advance(Plan* p) {
                 if (st == kPending) {
                     all = false;
                     break;
+                }
+                if (st == kCorrupt) {
+                    // harvest the sender attribution the delivery parked
+                    // in the nbytes field before the rid is freed below
+                    ++p->ctr[6];
+                    if (p->ctr[7] == 0)
+                        p->ctr[7] = ((v >> 3) & kNbMax) + 1;
                 }
                 if (st != kOk && err == 0) err = st;
             }
@@ -727,6 +797,8 @@ void* ucc_mailbox_create() {
         // it before the new owner can post a recv. Generations carry
         // over, so old-life rids keep reading as mismatched/complete.
         ucc_mailbox_purge(mb);
+        // integrity arming does NOT carry over from the previous life
+        mb->integrity.store(0, std::memory_order_relaxed);
         return mb;
     }
     return new Mailbox();
@@ -758,11 +830,37 @@ uint64_t ucc_mailbox_push(void* mbp, uint64_t a, uint64_t b, uint64_t c,
                           uint64_t eager_limit) {
     void* nudge = nullptr;
     uint64_t ret = push_impl(static_cast<Mailbox*>(mbp), Key{a, b, c},
-                             data, len, eager_limit, nullptr, &nudge);
+                             data, len, eager_limit, 0, nullptr, &nudge);
     // a delivery into a plan-posted recv advances that plan HERE, on the
     // delivering thread (no locks held: plan_ready drains a worklist)
     plan_ready(nudge);
     return ret;
+}
+
+// ABI 5: push with an explicit checksum word ((1<<32)|crc32 of *data* as
+// the SENDER computed it, 0 = none). The fault injector uses this to
+// hand the matcher a clean pre-corruption checksum — exactly what a
+// wire-corrupted message looks like. Semantics otherwise identical to
+// ucc_mailbox_push; delivery verifies and publishes kCorrupt on
+// mismatch, naming the sender from the key's src word.
+uint64_t ucc_mailbox_push2(void* mbp, uint64_t a, uint64_t b, uint64_t c,
+                           const void* data, uint64_t len,
+                           uint64_t eager_limit, uint64_t crcw) {
+    void* nudge = nullptr;
+    uint64_t ret = push_impl(static_cast<Mailbox*>(mbp), Key{a, b, c},
+                             data, len, eager_limit, crcw, nullptr,
+                             &nudge);
+    plan_ready(nudge);
+    return ret;
+}
+
+// ABI 5: arm (on != 0) or disarm wire integrity for this endpoint:
+// armed mailboxes checksum every push lacking a caller word and verify
+// every delivery — including plan-executor rounds, which never cross
+// back into python.
+void ucc_mailbox_set_integrity(void* mbp, uint64_t on) {
+    static_cast<Mailbox*>(mbp)->integrity.store(
+        on ? 1u : 0u, std::memory_order_relaxed);
 }
 
 // Post a receive into dst (capacity cap bytes). Returns the request id

@@ -1,0 +1,570 @@
+"""End-to-end data integrity (UCC_INTEGRITY; ISSUE 19).
+
+Wire crc32 at the match boundary in BOTH matchers and BOTH match
+orders (posted-recv-first direct delivery, unexpected eager and rndv),
+end-to-end detection with sender attribution through the collective
+stack (classic algorithms and native execution plans), sampled result
+attestation with minority attribution on 4- and 8-rank teams, strike
+escalation into quarantine + shrink via the corruption-storm drill,
+rejoin-after-quarantine with a clean strike slate, the off-mode
+zero-cost contract, and UCC_QUANT composition.
+"""
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from ucc_tpu import (BufferInfo, CollArgs, CollArgsFlags, CollType,
+                     DataType, MemoryType, ReductionOp, Status)
+from ucc_tpu import integrity
+from ucc_tpu.fault import health, inject
+from ucc_tpu.status import DataCorruptedError
+from ucc_tpu.tl.host.transport import Mailbox, RecvReq
+
+from harness import UccJob
+
+native_available = False
+try:
+    from ucc_tpu.native import NativeMailbox, available
+    native_available = available()
+except Exception:  # noqa: BLE001 - toolchain-less machines
+    pass
+
+needs_native = pytest.mark.skipif(not native_available,
+                                  reason="native core unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    inject.reset()
+    integrity.reset()
+    yield
+    inject.reset()
+    integrity.reset()
+    health.reset()
+
+
+def _key(src=3, tag=7):
+    # (team_key, epoch, tag, slot, sender ctx rank) — the 5-tuple both
+    # matchers key on; key[4] is the attribution the verifier reads
+    return ("itest", 0, (1 << 20) + tag, 5, src)
+
+
+def _corrupted(n=64):
+    clean = np.arange(n, dtype=np.uint8)
+    crc = zlib.crc32(clean) & 0xFFFFFFFF
+    bad = clean.copy()
+    bad[n // 2] ^= 0xFF
+    return bad, crc
+
+
+# ---------------------------------------------------------------------------
+# wire checksum at the match boundary: python matcher, both orders
+# ---------------------------------------------------------------------------
+
+class TestWireMatchBoundaryPython:
+    def test_recv_first_direct_delivery(self):
+        integrity.configure(mode="wire")
+        mb = Mailbox()
+        rq = RecvReq(np.zeros(64, np.uint8))
+        mb.post_recv(_key(), rq)
+        bad, crc = _corrupted()
+        sreq, kind = mb.send(_key(), bad, 8192, crc=crc)
+        assert kind == "direct" and rq.done
+        assert "crc32 mismatch" in rq.error
+        assert rq.corrupt_src == 3
+
+    def test_send_first_unexpected_eager(self):
+        integrity.configure(mode="wire")
+        mb = Mailbox()
+        bad, crc = _corrupted()
+        sreq, kind = mb.send(_key(src=2), bad, 8192, crc=crc)
+        assert kind == "eager"
+        rq = RecvReq(np.zeros(64, np.uint8))
+        mb.post_recv(_key(src=2), rq)
+        assert rq.done and "crc32 mismatch" in rq.error
+        assert rq.corrupt_src == 2
+
+    def test_send_first_unexpected_rndv(self):
+        integrity.configure(mode="wire")
+        mb = Mailbox()
+        bad, crc = _corrupted(4096)
+        sreq, kind = mb.send(_key(src=1), bad, 64, crc=crc)  # > eager cap
+        assert kind == "rndv"
+        rq = RecvReq(np.zeros(4096, np.uint8))
+        mb.post_recv(_key(src=1), rq)
+        assert rq.done and "crc32 mismatch" in rq.error
+        assert rq.corrupt_src == 1
+
+    def test_clean_payload_passes(self):
+        # wire mode computes the crc at send when the caller passes none
+        integrity.configure(mode="wire")
+        mb = Mailbox()
+        rq = RecvReq(np.zeros(64, np.uint8))
+        mb.post_recv(_key(), rq)
+        mb.send(_key(), np.arange(64, dtype=np.uint8), 8192)
+        assert rq.done and rq.error is None and rq.corrupt_src is None
+
+    def test_off_mode_unchecked_and_uncosted(self):
+        # the off-mode contract: no checksum is computed (the parked
+        # metadata stays None) and a corrupted frame is NOT flagged —
+        # zero cost means zero checking, by design
+        assert not integrity.ENABLED
+        mb = Mailbox()
+        bad, _ = _corrupted()
+        mb.send(_key(src=9), bad, 8192)
+        assert mb.unexpected[_key(src=9)][0].crc is None
+        rq = RecvReq(np.zeros(64, np.uint8))
+        mb.post_recv(_key(src=9), rq)
+        assert rq.done and rq.error is None
+
+
+# ---------------------------------------------------------------------------
+# wire checksum at the match boundary: native (C) matcher, both orders
+# ---------------------------------------------------------------------------
+
+@needs_native
+class TestWireMatchBoundaryNative:
+    def _mb(self):
+        mb = NativeMailbox()
+        return mb
+
+    def test_recv_first_direct_delivery(self):
+        integrity.configure(mode="wire")
+        mb = self._mb()
+        try:
+            rq = mb.post_recv_native(_key(), np.zeros(64, np.uint8))
+            bad, crc = _corrupted()
+            mb.push_native(_key(), bad, crc=crc)
+            assert rq.test()
+            assert rq.error and "crc32 mismatch" in rq.error
+            assert rq.corrupt_src == 3
+        finally:
+            mb.destroy()
+
+    def test_send_first_unexpected_eager(self):
+        integrity.configure(mode="wire")
+        mb = self._mb()
+        try:
+            bad, crc = _corrupted()
+            mb.push_native(_key(src=2), bad, crc=crc)
+            rq = mb.post_recv_native(_key(src=2), np.zeros(64, np.uint8))
+            assert rq.test()
+            assert rq.error and "crc32 mismatch" in rq.error
+            assert rq.corrupt_src == 2
+        finally:
+            mb.destroy()
+
+    def test_send_first_unexpected_rndv(self):
+        integrity.configure(mode="wire")
+        mb = self._mb()
+        try:
+            bad, crc = _corrupted(1 << 16)   # > eager cap: rndv park
+            mb.push_native(_key(src=1), bad, crc=crc)
+            rq = mb.post_recv_native(_key(src=1),
+                                     np.zeros(1 << 16, np.uint8))
+            assert rq.test()
+            assert rq.error and "crc32 mismatch" in rq.error
+            assert rq.corrupt_src == 1
+        finally:
+            mb.destroy()
+
+    def test_clean_payload_computed_c_side(self):
+        # armed mailbox + no caller crc: the C push computes the
+        # checksum itself and the verify at delivery passes
+        integrity.configure(mode="wire")
+        mb = self._mb()
+        try:
+            rq = mb.post_recv_native(_key(), np.zeros(64, np.uint8))
+            mb.push_native(_key(), np.arange(64, dtype=np.uint8))
+            assert rq.test() and rq.error is None
+            assert rq.corrupt_src is None
+        finally:
+            mb.destroy()
+
+    def test_off_mode_unchecked(self):
+        assert not integrity.ENABLED
+        mb = self._mb()   # created with integrity off: never armed
+        try:
+            bad, _ = _corrupted()
+            mb.push_native(_key(src=9), bad)
+            rq = mb.post_recv_native(_key(src=9), np.zeros(64, np.uint8))
+            assert rq.test() and rq.error is None
+        finally:
+            mb.destroy()
+
+    def test_python_and_c_crc_agree(self):
+        # the C table must be bit-identical to zlib.crc32, or mixed
+        # python-sender/native-receiver paths would false-positive
+        integrity.configure(mode="wire")
+        mb = self._mb()
+        try:
+            data = np.frombuffer(bytes(range(256)) * 5, dtype=np.uint8)
+            rq = mb.post_recv_native(_key(), np.zeros(data.size, np.uint8))
+            mb.push_native(_key(), data.copy(),
+                           crc=zlib.crc32(data) & 0xFFFFFFFF)
+            assert rq.test() and rq.error is None
+        finally:
+            mb.destroy()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: corrupted collective fails with attribution, both matchers
+# ---------------------------------------------------------------------------
+
+def _drive_classify(job, rqs, deadline_s=10.0):
+    """Drive requests to terminal; returns per-rank (status, ranks)
+    where ranks is the corruption attribution (wire errors RETURN the
+    status with task.corrupt_ranks set; attestation RAISES)."""
+    done = [None] * len(rqs)
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline and any(d is None for d in done):
+        for c in job.contexts:
+            c.progress()
+        for i, rq in enumerate(rqs):
+            if done[i] is not None:
+                continue
+            try:
+                st = rq.test()
+            except DataCorruptedError as e:
+                done[i] = (Status.ERR_DATA_CORRUPTED, sorted(e.ranks))
+                continue
+            if st != Status.IN_PROGRESS:
+                done[i] = (st, sorted(getattr(rq.task, "corrupt_ranks",
+                                              ()) or ()))
+    for i, rq in enumerate(rqs):
+        if done[i] is None:
+            rq.task.cancel(Status.ERR_TIMED_OUT)
+            done[i] = (Status.IN_PROGRESS, [])
+    return done
+
+
+def _allreduce_args(rank, count, src, dst, timeout=2.0):
+    return CollArgs(coll_type=CollType.ALLREDUCE,
+                    src=BufferInfo(src, count, DataType.FLOAT32,
+                                   MemoryType.HOST),
+                    dst=BufferInfo(dst, count, DataType.FLOAT32,
+                                   MemoryType.HOST),
+                    op=ReductionOp.SUM, flags=CollArgsFlags.TIMEOUT,
+                    timeout=timeout)
+
+
+class TestWireCollective:
+    @pytest.mark.parametrize("matcher", [
+        pytest.param("native", marks=needs_native), "python"])
+    def test_corruptor_detected_and_attributed(self, matcher, monkeypatch):
+        if matcher == "python":
+            monkeypatch.setenv("UCC_TL_SHM_NATIVE", "0")
+        integrity.configure(mode="wire")
+        n, count = 4, 1003
+        job = UccJob(n)
+        rqs = []
+        try:
+            teams = job.create_team()
+            # armed only after team create: service colls stay clean
+            inject.configure("corrupt=1.0,corrupt_rank=1", seed=3)
+            ins = [np.full(count, i + 1.0, np.float32) for i in range(n)]
+            outs = [np.zeros(count, np.float32) for _ in range(n)]
+            for i, t in enumerate(teams):
+                rq = t.collective_init(
+                    _allreduce_args(i, count, ins[i], outs[i]))
+                rq.post()
+                rqs.append(rq)
+            done = _drive_classify(job, rqs)
+            hits = [d for d in done if d[0] == Status.ERR_DATA_CORRUPTED]
+            assert hits, f"no rank detected the corruption: {done}"
+            assert all(d[1] == [1] for d in hits), done
+            # nobody may park: timeouts are acceptable collateral for
+            # ranks starved of the corrupted contribution, hangs are not
+            assert all(d[0] != Status.IN_PROGRESS for d in done), done
+        finally:
+            for rq in rqs:
+                try:
+                    rq.task.cancel()
+                except Exception:  # noqa: BLE001
+                    pass
+            inject.reset()
+            job.cleanup()
+
+
+@needs_native
+class TestPlanWireDetection:
+    def test_native_plan_round_carries_checksums(self, monkeypatch):
+        """The C executor's rounds never re-enter python — the entry-
+        header checksum word must cover them: peers keep NATIVE PLANS
+        (the pinned corruptor interprets, which is wire-compatible),
+        the plan terminates ST_CORRUPT, and the harvested counter
+        attributes the sender."""
+        monkeypatch.setenv("UCC_GEN_NATIVE", "y")
+        monkeypatch.setenv("UCC_TL_SHM_TUNE", "allreduce:@ring:inf")
+        integrity.configure(mode="wire")
+        n, count = 4, 1003
+        job = UccJob(n)
+        rqs = []
+        try:
+            teams = job.create_team()
+            inject.configure("corrupt=1.0,corrupt_rank=1", seed=7)
+            ins = [np.full(count, i + 1.0, np.float32) for i in range(n)]
+            outs = [np.zeros(count, np.float32) for _ in range(n)]
+            for i, t in enumerate(teams):
+                rq = t.collective_init(
+                    _allreduce_args(i, count, ins[i], outs[i]))
+                rq.post()
+                rqs.append(rq)
+            done = _drive_classify(job, rqs)
+            # probe BEFORE finalize releases the plans
+            plans = [getattr(rq.task, "_plan", None) is not None
+                     for rq in rqs]
+            hits = [d for d in done if d[0] == Status.ERR_DATA_CORRUPTED]
+            assert hits and all(d[1] == [1] for d in hits), done
+            # candidate selection stayed rank-invariant: the corruptor
+            # interpreted, at least one detector ran the C plan
+            assert plans[1] is False
+            assert any(plans[i] for i in (0, 2, 3)), plans
+        finally:
+            for rq in rqs:
+                try:
+                    rq.task.cancel()
+                except Exception:  # noqa: BLE001
+                    pass
+            inject.reset()
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# verify mode: sampled cross-rank result attestation
+# ---------------------------------------------------------------------------
+
+def _complete_then_scribble(job, teams, n, count, victim):
+    """Run an allreduce to task completion WITHOUT calling test() (so
+    attestation has not started), then scribble *victim*'s result —
+    modeling corruption past the wire (local reduce / memory)."""
+    ins = [np.full(count, i + 1.0, np.float32) for i in range(n)]
+    outs = [np.zeros(count, np.float32) for _ in range(n)]
+    rqs = []
+    for i, t in enumerate(teams):
+        rq = t.collective_init(_allreduce_args(i, count, ins[i], outs[i],
+                                               timeout=10.0))
+        rq.post()
+        rqs.append(rq)
+    job.progress_until(lambda: all(
+        rq.task.super_status != Status.IN_PROGRESS for rq in rqs))
+    assert all(rq.task.super_status == Status.OK for rq in rqs)
+    outs[victim][count // 2] = 999.0
+    return rqs
+
+
+class TestAttestation:
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_minority_digest_names_corruptor(self, n):
+        integrity.configure(mode="verify", sample=1, strikes=99)
+        count = 256
+        victim = n - 2
+        job = UccJob(n)
+        rqs = []
+        try:
+            teams = job.create_team()
+            victim_ctx = teams[victim].context.rank
+            rqs = _complete_then_scribble(job, teams, n, count, victim)
+            done = _drive_classify(job, rqs)
+            hits = [d for d in done if d[0] == Status.ERR_DATA_CORRUPTED]
+            # every member compares digests; the minority (1 vs n-1)
+            # names the corruptor on all of them, including itself
+            assert len(hits) == n, done
+            assert all(d[1] == [victim_ctx] for d in hits), done
+            # each context charged one strike against the offender
+            for t in teams:
+                assert integrity.strikes(t.context, victim_ctx) == 1
+        finally:
+            for rq in rqs:
+                try:
+                    rq.task.cancel()
+                except Exception:  # noqa: BLE001
+                    pass
+            job.cleanup()
+
+    def test_strikes_escalate_to_quarantine(self):
+        # strike budget 1: the first attested mismatch quarantines the
+        # offender in every member's health registry
+        health.configure("shrink", interval=0.05, timeout=2.0)
+        integrity.configure(mode="verify", sample=1, strikes=1)
+        n, count, victim = 4, 256, 2
+        job = UccJob(n)
+        rqs = []
+        try:
+            teams = job.create_team()
+            victim_ctx = teams[victim].context.rank
+            rqs = _complete_then_scribble(job, teams, n, count, victim)
+            _drive_classify(job, rqs)
+            for i, t in enumerate(teams):
+                if i == victim:
+                    continue   # the corruptor never quarantines itself
+                assert victim_ctx in t.context.health.dead_set(), \
+                    f"rank {i} did not quarantine ctx {victim_ctx}"
+        finally:
+            for rq in rqs:
+                try:
+                    rq.task.cancel()
+                except Exception:  # noqa: BLE001
+                    pass
+            job.cleanup()
+            health.configure("none")
+
+    def test_clean_results_attest_ok(self):
+        # the happy path: digests agree, every rank reaches OK through
+        # the attestation hook (poll-every-request exchange drives it)
+        integrity.configure(mode="verify", sample=1, strikes=3)
+        n, count = 4, 256
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            ins = [np.full(count, i + 1.0, np.float32) for i in range(n)]
+            outs = [np.zeros(count, np.float32) for _ in range(n)]
+            rqs = []
+            for i, t in enumerate(teams):
+                rq = t.collective_init(
+                    _allreduce_args(i, count, ins[i], outs[i],
+                                    timeout=10.0))
+                rq.post()
+                rqs.append(rq)
+            done = _drive_classify(job, rqs)
+            assert all(d[0] == Status.OK for d in done), done
+            expected = sum(i + 1.0 for i in range(n))
+            for o in outs:
+                assert np.allclose(o, expected)
+        finally:
+            job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# the full pipeline: storm -> strikes -> quarantine -> shrink -> resume
+# ---------------------------------------------------------------------------
+
+@needs_native
+class TestCorruptionStormDrill:
+    def test_drill_report_clean(self):
+        from ucc_tpu.fault.soak import run_corrupt_soak
+        report = run_corrupt_soak(n_ranks=4, corrupt_rank=1, strikes=2,
+                                  pre_iters=2, post_iters=8,
+                                  storm_rounds_max=6, count=128)
+        assert report["violations"] == [], report
+        assert report["quarantined"]
+        assert report["rounds_to_quarantine"] == 2
+        assert report["detections"] == report["storm_rounds"]
+        assert report["plan_mode"]
+        assert report["post_iters"] == 8
+        # survivors converged on the corruptor as the dead set
+        deads = {tuple(v["dead"]) for v in report["agreed"].values()}
+        assert deads == {(report["corruptor"]["ctx_rank"],)}
+
+
+# ---------------------------------------------------------------------------
+# rejoin after quarantine (PR-17 membership path)
+# ---------------------------------------------------------------------------
+
+class TestRejoinAfterQuarantine:
+    def test_quarantined_rank_rejoins_with_clean_slate(self):
+        from ucc_tpu.core.team import Team
+        health.configure("shrink", interval=0.05, timeout=2.0)
+        integrity.configure(mode="verify", sample=1, strikes=2)
+        n, count = 4, 64
+        offender = 1
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            offender_ctx = teams[offender].context.rank
+            # trip the quarantine from rank 0's evidence (two wire
+            # strikes at the verify-mode budget)
+            ctx0 = teams[0].context
+            integrity.note_wire_mismatch(ctx0, offender_ctx, "drill")
+            integrity.note_wire_mismatch(ctx0, offender_ctx, "drill")
+            assert offender_ctx in ctx0.health.dead_set()
+            assert integrity.strikes(ctx0, offender_ctx) == 2
+
+            # shrink it out (agreement floods rank 0's view)
+            survivors = [r for r in range(n) if r != offender]
+            shrinks = {r: teams[r].shrink_post() for r in survivors}
+            # poll EVERY request each pass (membership test() drives
+            # the OOB rebuild rounds; a short-circuit would deadlock)
+            job.progress_until(lambda: all(
+                st != Status.IN_PROGRESS
+                for st in [shrinks[r].test() for r in survivors]),
+                timeout=20.0)
+            assert all(shrinks[r].test() == Status.OK for r in survivors)
+            shrunk = {r: shrinks[r].new_team for r in survivors}
+
+            # re-admit through grow + join; revive clears the ledger
+            grows = {r: shrunk[r].grow_post([offender_ctx])
+                     for r in survivors}
+            join = Team.join_post(job.contexts[offender])
+            reqs = list(grows.values()) + [join]
+            job.progress_until(lambda: all(
+                st != Status.IN_PROGRESS
+                for st in [rq.test() for rq in reqs]), timeout=30.0)
+            assert all(rq.test() == Status.OK for rq in reqs)
+            assert offender_ctx not in ctx0.health.dead_set()
+            assert integrity.strikes(ctx0, offender_ctx) == 0
+
+            # the rebuilt full team passes a checked allreduce
+            grown = [grows[r].new_team for r in survivors]
+            order = sorted(survivors) + [offender]
+            full = {r: (grown[survivors.index(r)] if r in survivors
+                        else join.new_team) for r in order}
+            ins = [np.full(count, r + 1.0, np.float32) for r in range(n)]
+            outs = [np.zeros(count, np.float32) for _ in range(n)]
+            rqs = []
+            for r in order:
+                rq = full[r].collective_init(_allreduce_args(
+                    full[r].rank, count, ins[r], outs[r], timeout=10.0))
+                rq.post()
+                rqs.append(rq)
+            done = _drive_classify(job, rqs)
+            assert all(d[0] == Status.OK for d in done), done
+            expected = sum(r + 1.0 for r in range(n))
+            for o in outs:
+                assert np.allclose(o, expected)
+            for t in list(full.values()):
+                t.destroy()
+            for t in shrunk.values():
+                t.destroy()
+        finally:
+            job.cleanup()
+            health.configure("none")
+
+
+# ---------------------------------------------------------------------------
+# composition: UCC_QUANT + UCC_INTEGRITY
+# ---------------------------------------------------------------------------
+
+class TestQuantCompose:
+    def test_quantized_allreduce_under_verify(self, monkeypatch):
+        """Quantized wire traffic checksums the ENCODED bytes and the
+        deterministic codec yields bit-identical dequantized results on
+        every rank — so verify-mode attestation agrees and the
+        collective lands OK within the quant error budget."""
+        monkeypatch.setenv("UCC_QUANT", "int8")
+        integrity.configure(mode="verify", sample=1, strikes=3)
+        n, count = 4, 32 << 10   # >=64k payload range: quant engages
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            rng = np.random.default_rng(5)
+            ins = [rng.standard_normal(count).astype(np.float32)
+                   for _ in range(n)]
+            outs = [np.zeros(count, np.float32) for _ in range(n)]
+            rqs = []
+            for i, t in enumerate(teams):
+                rq = t.collective_init(
+                    _allreduce_args(i, count, ins[i], outs[i],
+                                    timeout=20.0))
+                rq.post()
+                rqs.append(rq)
+            done = _drive_classify(job, rqs, deadline_s=30.0)
+            assert all(d[0] == Status.OK for d in done), done
+            exact = np.sum(ins, axis=0)
+            scale = np.max(np.abs(exact)) or 1.0
+            for o in outs:
+                assert np.max(np.abs(o - exact)) / scale < 0.05
+        finally:
+            job.cleanup()

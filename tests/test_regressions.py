@@ -511,3 +511,78 @@ class TestPeerTimeoutTerminal:
                 rq.finalize()       # terminal => finalizable
         finally:
             job.cleanup()
+
+
+class TestIntegrityOffModeFree:
+    """UCC_INTEGRITY=off must be measurably free: the send path computes
+    NO checksum (the parked match metadata stays None, no zlib.crc32
+    call) and collective_init binds no attestation state — the hot
+    paths are byte-identical to a build without the subsystem."""
+
+    def test_send_path_computes_no_checksum(self, monkeypatch):
+        from ucc_tpu import integrity
+        from ucc_tpu.tl.host import transport as tmod
+        integrity.reset()
+        assert not integrity.ENABLED
+        calls = []
+        real = tmod.zlib.crc32
+
+        class _Probe:
+            crc32 = staticmethod(lambda *a: calls.append(1) or real(*a))
+
+        monkeypatch.setattr(tmod, "zlib", _Probe)
+        mb = tmod.Mailbox()
+        key = ("off", 0, (1 << 20) + 1, 0, 0)
+        mb.send(key, np.arange(64, dtype=np.uint8), 8192)
+        assert not calls, "off-mode send computed a checksum"
+        assert mb.unexpected[key][0].crc is None
+
+    def test_no_attest_bound_when_off(self):
+        from ucc_tpu import integrity
+        integrity.reset()
+        n = 2
+        job = UccJob(n)
+        try:
+            teams = job.create_team()
+            dsts = [np.zeros(8, np.float64) for _ in range(n)]
+            reqs = [teams[r].collective_init(CollArgs(
+                coll_type=CollType.ALLREDUCE,
+                src=BufferInfo(np.ones(8), 8, DataType.FLOAT64),
+                dst=BufferInfo(dsts[r], 8, DataType.FLOAT64),
+                op=ReductionOp.SUM)) for r in range(n)]
+            assert all(rq._attest is None for rq in reqs)
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs))
+        finally:
+            job.cleanup()
+
+
+class TestCorruptPinnedPlanEligibility:
+    """A pinned UCC_FAULT=corrupt spec makes plan-engagement rank-
+    variant (only the corruptor interprets) — but CANDIDATE selection
+    must stay rank-invariant, or the corruptor falls back to a classic
+    algorithm with a different slot scheme and deadlocks the team (the
+    interpreted plan IR is wire-compatible with peer plans; a classic
+    algorithm is not)."""
+
+    def test_candidate_selection_is_rank_invariant(self):
+        from ucc_tpu.dsl.plan import _fault_blocks_plans
+        from ucc_tpu.fault import inject
+        inject.reset()
+        try:
+            inject.configure("corrupt=0.5,corrupt_rank=1", seed=0)
+            # invariant probe (candidate selection): same answer on
+            # every rank — the generated task survives everywhere
+            assert _fault_blocks_plans(None, invariant=True) is False
+            # rank-variant probe (plan engage): with the team unknown,
+            # conservatively interpret
+            assert _fault_blocks_plans(None) is True
+            # an UNPINNED corrupt spec can strike any sender: plans
+            # off everywhere, invariantly
+            inject.configure("corrupt=0.5", seed=0)
+            assert _fault_blocks_plans(None, invariant=True) is True
+            assert _fault_blocks_plans(None) is True
+        finally:
+            inject.reset()

@@ -762,7 +762,7 @@ class TestReaderDesyncHardening:
         try:
             # header claiming a 2.4 GB key: must be rejected BEFORE any
             # recv/allocation of that size
-            bad = struct.pack("!IQ", 0x912CE0A1, 7) + b"x" * 32
+            bad = struct.pack("!IQIQ", 0x912CE0A1, 7, 0, 0) + b"x" * 32
             c = self._send_raw(tr, bad)
             t0 = time.monotonic()
             while time.monotonic() - t0 < 10:
@@ -787,8 +787,13 @@ class TestReaderDesyncHardening:
         h = self._capture()
         tr = self._transport()
         try:
+            import zlib
+            # a well-formed header with a CORRECT key crc over bytes that
+            # are not a pickle: the frame survives the crc gate and blows
+            # up inside unpickling — the deepest point of the blast radius
             kb = b"\x00garbage-not-pickle"
-            bad = struct.pack("!IQ", len(kb), 4) + kb + b"DATA"
+            bad = struct.pack("!IQIQ", len(kb), 4,
+                              zlib.crc32(kb) & 0xFFFFFFFF, 0) + kb + b"DATA"
             c = self._send_raw(tr, bad)
             t0 = time.monotonic()
             while time.monotonic() - t0 < 10:
@@ -807,7 +812,8 @@ class TestReaderDesyncHardening:
             key = ("team", 1, 0, 0)
             kb2 = pickle.dumps(key)
             payload = b"\x01\x02\x03\x04"
-            good = struct.pack("!IQ", len(kb2), len(payload)) + kb2 + payload
+            good = struct.pack("!IQIQ", len(kb2), len(payload),
+                               zlib.crc32(kb2) & 0xFFFFFFFF, 0) + kb2 + payload
             c2 = self._send_raw(tr, good)
             dst = np.zeros(4, np.uint8)
             from ucc_tpu.tl.host.transport import RecvReq

@@ -1064,6 +1064,84 @@ def _mt_smoke(env) -> None:
           flush=True)
 
 
+def _integrity_smoke(env) -> None:
+    """WARN-ONLY data-integrity probe (ISSUE 19 CI satellite):
+    ``python -m ucc_tpu.fault.soak --corrupt`` runs the corruption
+    storm — a pinned rank corrupts every send under
+    ``UCC_INTEGRITY=verify`` — and classifies the failure mode that
+    matters for integrity: SILENT (corruption reached a result without
+    any rank reporting ERR_DATA_CORRUPTED — the worst class), DETECTED-
+    BUT-NOT-QUARANTINED (the strike ledger did not escalate), and HANG
+    (a rank parked instead of reaching a terminal status). Skip with
+    UCC_GATE_INTEGRITY=0."""
+    import json
+    if os.environ.get("UCC_GATE_INTEGRITY", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] integrity smoke: skipped (UCC_GATE_INTEGRITY=0)",
+              flush=True)
+        return
+    print("[gate] corruption-storm integrity smoke (warn-only) ...",
+          flush=True)
+    t0 = time.monotonic()
+    # the drill arms its own integrity/fault/health knobs; strip the
+    # gate watchdog so escalation doesn't cancel mid-quarantine
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE",
+                                      "UCC_COLLECT", "UCC_FT",
+                                      "UCC_INTEGRITY"))}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ucc_tpu.fault.soak", "--corrupt"],
+            cwd=REPO, env=smoke_env, capture_output=True, text=True,
+            timeout=600)
+    except subprocess.TimeoutExpired:
+        print("[gate] WARN: integrity smoke timed out — HANG class "
+              "(not a gate failure)", flush=True)
+        return
+    rec = None
+    try:
+        rec = json.loads(r.stdout or "")
+    except ValueError:
+        for ln in (r.stdout or "").splitlines():
+            if ln.startswith("{"):
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue
+    dt = time.monotonic() - t0
+    if rec is None:
+        print(f"[gate] WARN: integrity smoke — rc={r.returncode}, no "
+              f"report in {dt:.0f}s (not a gate failure)", flush=True)
+        return
+    problems = []
+    for v in rec.get("violations") or []:
+        if "SILENT" in v or "undetected" in v:
+            problems.append(f"silent-corruption: {v}")
+        elif "IN_PROGRESS" in v or "hung" in v:
+            problems.append(f"hang: {v}")
+        elif "quarantin" in v.lower():
+            problems.append(f"no-quarantine: {v}")
+        else:
+            problems.append(v)
+    if rec.get("storm_rounds", 0) and \
+            rec.get("detections", 0) < rec["storm_rounds"]:
+        problems.append(f"detected {rec.get('detections')}/"
+                        f"{rec.get('storm_rounds')} storm rounds "
+                        f"(must be 100%)")
+    if rec.get("post_iters", 0) < 50:
+        problems.append(f"only {rec.get('post_iters')} checked "
+                        f"post-quarantine iterations (acceptance: 50)")
+    verdict = "OK" if not problems else "WARN: " + "; ".join(problems)
+    print(f"[gate] integrity smoke: detections={rec.get('detections')}/"
+          f"{rec.get('storm_rounds')}, quarantined="
+          f"{rec.get('quarantined')} in {rec.get('rounds_to_quarantine')}"
+          f" round(s) (strikes={rec.get('strikes')}), post_ok="
+          f"{rec.get('post_iters')}, plans={rec.get('plan_mode')}, "
+          f"matcher={rec.get('matcher')} in {dt:.0f}s -> {verdict}",
+          flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -1165,6 +1243,11 @@ def main(argv=None) -> int:
         # traffic, and the priority-inversion / starvation counters
         # stay clean (ISSUE 18)
         _mt_smoke(env)
+        # warn-only: wire crc32 detects 100% of a pinned corruptor's
+        # storm rounds with sender attribution, the strike ledger
+        # quarantines it, and the shrunk team runs a checked matrix —
+        # classified silent-vs-detected-vs-hang (ISSUE 19)
+        _integrity_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

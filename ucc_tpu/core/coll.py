@@ -17,6 +17,7 @@ from typing import Any, Optional
 from ..api.types import (BufferInfo, BufferInfoV, CollArgs,
                          coll_args_msgsize)
 from ..constants import (CollArgsFlags, CollType, MemoryType, coll_type_str)
+from .. import integrity
 from ..mc.base import detect_mem_type
 from ..obs import metrics
 from ..schedule.schedule import Schedule
@@ -116,6 +117,12 @@ class CollRequest:
     #: coalescer is attached in the context: posting flushes open
     #: batches so this collective never waits out a bulk gather window
     _coal_flush = None
+    #: sampled result attestation (integrity/__init__.py): bound by
+    #: collective_init at the deterministic UCC_INTEGRITY_SAMPLE cadence
+    #: under UCC_INTEGRITY=verify — test() holds the request IN_PROGRESS
+    #: until the cross-rank digest exchange settles. Class-attr None
+    #: keeps the off path at one branch (the _flight pattern).
+    _attest = None
 
     def __init__(self, task: CollTask, team: Team, args: CollArgs):
         self.task = task
@@ -384,6 +391,13 @@ class CollRequest:
             return Status.OPERATION_INITIALIZED
         if st.is_error and self._try_runtime_fallback():
             return Status.IN_PROGRESS
+        if st == Status.OK and self._attest is not None:
+            # sampled result attestation: the collective itself is done,
+            # but this request stays IN_PROGRESS until every live rank's
+            # result digest has been exchanged and compared (raises
+            # DataCorruptedError on a digest minority)
+            from .. import integrity
+            return integrity.attest_test(self)
         return st
 
     def _try_runtime_fallback(self) -> bool:
@@ -634,6 +648,18 @@ def collective_init(args: CollArgs, team: Team) -> CollRequest:
         # program-order closure point — seal it (every rank inits this
         # collective at the same point by the ordered-issue contract)
         coal.flush("ineligible")
+    if integrity.VERIFY and task is inner and team.size > 1 and \
+            args.active_set is None and mem_type == MemoryType.HOST and \
+            (ct & integrity.ATTEST_COLLS) and req._coalesce is None and \
+            req._tuner is None:
+        # sampled cross-rank result attestation (UCC_INTEGRITY=verify):
+        # binds _attest at the deterministic UCC_INTEGRITY_SAMPLE cadence.
+        # Every predicate above is rank-invariant (coll type, active set,
+        # team size, mem type, wrap status; tuner/coalesce binding by the
+        # ordered-issue and tag-parity contracts), so all ranks tick the
+        # per-team attestation counter in lockstep — the checked subset
+        # is identical everywhere without any extra agreement round.
+        integrity.bind(req, team)
     return req
 
 

@@ -20,6 +20,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional
 
 from ..api.types import OobColl, OobRequest
@@ -909,7 +910,14 @@ class TcpTreeOob(TreeOob):
 # TCP store OOB (multi-process DCN bootstrap)
 # ---------------------------------------------------------------------------
 
-_MSG = struct.Struct("!II")  # rank, payload length
+# store frames carry a crc32 of their payload, ALWAYS verified: the
+# store is the bootstrap channel — a flipped bit here poisons pickled
+# endpoint addresses for the whole job, and the volume is tiny (one
+# contribution + one response per round), so the check is free. A
+# mismatch is a hard typed error, not a retry: the stream itself has
+# desynced beyond this frame.
+_MSG = struct.Struct("!III")   # rank, payload length, payload crc32
+_RSP = struct.Struct("!II")    # response: blob length, blob crc32
 
 
 def _store_cookie(key: str, size: int) -> bytes:
@@ -998,7 +1006,8 @@ class TcpStoreOob(OobColl):
     def allgather(self, data: bytes) -> OobRequest:
         sock = self._sock
         assert sock is not None
-        sock.sendall(_MSG.pack(self.rank, len(data)) + data)
+        sock.sendall(_MSG.pack(self.rank, len(data),
+                               zlib.crc32(data) & 0xFFFFFFFF) + data)
         return _TcpOobRequest(sock, self.size)
 
     def close(self) -> None:
@@ -1021,6 +1030,7 @@ class _TcpOobRequest(OobRequest):
         self.size = size
         self._buf = b""
         self._need: Optional[int] = None
+        self._crc = 0
         self._result: Optional[List[bytes]] = None
 
     def test(self) -> Status:
@@ -1032,17 +1042,27 @@ class _TcpOobRequest(OobRequest):
             # never read past THIS request's blob: surplus bytes would
             # belong to the next allgather's response on the shared
             # socket and dropping them would desync the stream
-            want = (4 - len(self._buf)) if self._need is None \
+            want = (_RSP.size - len(self._buf)) if self._need is None \
                 else (self._need - len(self._buf))
             chunk = self.sock.recv(want)
             if not chunk:
                 raise ConnectionError("OOB peer closed")
             self._buf += chunk
-            if self._need is None and len(self._buf) >= 4:
-                (ln,) = struct.unpack("!I", self._buf[:4])
-                self._need = 4 + ln
+            if self._need is None and len(self._buf) >= _RSP.size:
+                ln, self._crc = _RSP.unpack(self._buf[:_RSP.size])
+                self._need = _RSP.size + ln
             if self._need is not None and len(self._buf) >= self._need:
-                blob = pickle.loads(self._buf[4:self._need])
+                raw = self._buf[_RSP.size:self._need]
+                if zlib.crc32(raw) & 0xFFFFFFFF != self._crc:
+                    # never unpickle a payload that failed its checksum
+                    if metrics.ENABLED:
+                        metrics.inc("integrity_wire_mismatch",
+                                    component="core/oob")
+                    raise UccError(
+                        Status.ERR_DATA_CORRUPTED,
+                        "store response failed crc32 verification "
+                        "(corrupted bootstrap frame)")
+                blob = pickle.loads(raw)
                 if isinstance(blob, dict) and "__ucc_oob_error__" in blob:
                     # server-side bootstrap failure frame: convert the
                     # would-be hang into a typed error naming the ranks
@@ -1134,7 +1154,7 @@ class _StoreServer:
             len(registered), self.size, absent)
         blob = pickle.dumps({"__ucc_oob_error__": "bootstrap timed out",
                              "absent": absent})
-        out = struct.pack("!I", len(blob)) + blob
+        out = _RSP.pack(len(blob), zlib.crc32(blob) & 0xFFFFFFFF) + blob
         for c in self.conns:
             try:
                 c.sendall(out)
@@ -1180,12 +1200,20 @@ class _StoreServer:
                 contribs: List[Optional[bytes]] = [None] * self.size
                 for c in list(self.conns):
                     hdr = _recv_exact(c, _MSG.size)
-                    rank, ln = _MSG.unpack(hdr)
+                    rank, ln, crc = _MSG.unpack(hdr)
                     if not 0 <= rank < self.size:
                         raise OSError(f"stray rank {rank} on store conn")
-                    contribs[rank] = _recv_exact(c, ln)
+                    payload = _recv_exact(c, ln)
+                    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                        # a corrupted contribution must not be served to
+                        # EVERY rank: fail the round loudly instead
+                        raise OSError(
+                            f"store contribution from rank {rank} "
+                            f"failed crc32 verification")
+                    contribs[rank] = payload
                 blob = pickle.dumps(contribs)
-                out = struct.pack("!I", len(blob)) + blob
+                out = _RSP.pack(len(blob),
+                                zlib.crc32(blob) & 0xFFFFFFFF) + blob
                 for c in self.conns:
                     c.sendall(out)
         except (ConnectionError, OSError):

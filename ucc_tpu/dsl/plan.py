@@ -87,6 +87,7 @@ ST_ERROR = 2       # slot exhaustion / truncated delivery / purge
 ST_FENCED = 3
 ST_CANCELED = 4
 ST_ASSIST = 5
+ST_CORRUPT = 6     # wire crc32 mismatch on a plan recv (payload=round)
 ST_DEAD = 7        # python-side: state slot freed under us
 
 _DT_NATIVE = {np.dtype(np.float32): 1, np.dtype(np.float64): 2}
@@ -172,17 +173,39 @@ def _peer_mailboxes(team, subset, nranks: int):
     return mine, my_ctx, ctx_of, boxes
 
 
-def _fault_blocks_plans() -> bool:
+def _fault_blocks_plans(team=None, invariant=False) -> bool:
     """Probabilistic wire-fault injection (drop/delay/error) targets the
     per-message python posts a plan bypasses — running plans under it
     would silently un-inject the soak. kill-only specs keep plans on
     (the kill/shrink drill: detection cancels the task, which withdraws
-    the plan's recvs natively)."""
+    the plan's recvs natively).
+
+    Corruption rides the python send path too — but when the spec pins
+    a corruptor rank, only THAT rank needs to interpret; the others keep
+    native plans, whose C-side crc verify at delivery is exactly what
+    the corruption drill exercises (interpreted pushes are
+    wire-compatible with plan recvs). That makes the pinned answer
+    rank-VARIANT, so it may only gate :func:`resolve` (plan-engage,
+    where interpreting is wire-compatible) — candidate selection must
+    pass ``invariant=True`` and keep the generated task on every rank,
+    or the corruptor would pick a classic algorithm with a different
+    slot scheme and deadlock the collective. An unpinned corrupt spec
+    can strike any sender: plans off everywhere (rank-invariant)."""
     from ..fault import inject as fault
     if not fault.ENABLED:
         return False
     s = fault.SPEC
-    return bool(s.drop or s.delay or s.error or s.post_error)
+    if s.drop or s.delay or s.error or s.post_error:
+        return True
+    if s.corrupt:
+        if s.corrupt_rank is None:
+            return True
+        if invariant:
+            return False
+        my = getattr(team, "_my_ctx_rank", None) if team is not None \
+            else None
+        return my is None or my == s.corrupt_rank
+    return False
 
 
 def resolve(task, team, program: Program) -> bool:
@@ -196,7 +219,7 @@ def resolve(task, team, program: Program) -> bool:
     mode = native_mode(team)
     if mode == "n" or not team_plan_capable(team):
         return False
-    if _fault_blocks_plans():
+    if _fault_blocks_plans(team):
         return False
     nd = dt_numpy(task.dt)
     if mode == "auto":
@@ -601,7 +624,11 @@ class NativePlan:
         c = self._ctr
         return {"direct": int(c[0]), "eager": int(c[1]),
                 "rndv": int(c[2]), "fenced": int(c[3]),
-                "rounds": int(c[4]), "withdrawn": int(c[5])}
+                "rounds": int(c[4]), "withdrawn": int(c[5]),
+                "corrupt": int(c[6]),
+                # first corrupt sender's ctx rank (C stores rank+1 so
+                # zero means "none")
+                "corrupt_src": int(c[7]) - 1}
 
     def release_dst(self) -> None:
         self._dst = None
@@ -749,7 +776,7 @@ def _args_plan_eligible(team, program: Program, init_args) -> bool:
     if op not in (ReductionOp.SUM, ReductionOp.AVG, ReductionOp.PROD,
                   ReductionOp.MAX, ReductionOp.MIN):
         return False
-    if _fault_blocks_plans():
+    if _fault_blocks_plans(team, invariant=True):
         return False
     try:
         nd = dt_numpy(args.dst.datatype)

@@ -290,6 +290,12 @@ class HealthRegistry:
         with self._lock:
             was = self.dead.pop(ctx_rank, None)
             self.suspected.pop(ctx_rank, None)
+        # re-admission wipes the integrity strike ledger too: a rank
+        # quarantined for corruption rejoins with a clean slate (its
+        # first post-rejoin mismatch starts a fresh budget, it is not
+        # instantly re-quarantined on stale strikes)
+        from .. import integrity
+        integrity.clear_strikes(self.context, ctx_rank)
         _STANDALONE_NOTED.discard(ctx_rank)
         uid = self._peer_uids.get(ctx_rank)
         if uid:

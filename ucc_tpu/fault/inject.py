@@ -45,18 +45,22 @@ class FaultSpec:
     error: float = 0.0         # P(send/recv post fails)
     post_error: float = 0.0    # P(task post fails before wire traffic)
     kill: Set[int] = field(default_factory=set)   # dead ctx ranks
+    corrupt: float = 0.0       # P(send payload bit-flipped in flight)
+    corrupt_rank: Optional[int] = None  # pin corruption to one ctx rank
 
     @property
     def active(self) -> bool:
         return bool(self.drop or self.delay or self.error
-                    or self.post_error or self.kill)
+                    or self.post_error or self.kill or self.corrupt)
 
 
 def parse_spec(s: str) -> FaultSpec:
     """Parse ``drop=P,delay=P:S,delay_rank=R,error=P,post_error=P,
-    kill=R[+R..]``. ``delay_rank`` pins send delays to one ctx rank —
-    the controlled-straggler drill the flight-recorder diagnosis smoke
-    uses (a known culprit the diagnosis must name). Unknown keys raise:
+    kill=R[+R..],corrupt=P,corrupt_rank=R``. ``delay_rank`` pins send
+    delays to one ctx rank — the controlled-straggler drill the
+    flight-recorder diagnosis smoke uses (a known culprit the diagnosis
+    must name); ``corrupt_rank`` likewise pins payload bit-flips to one
+    sender (the controlled-corruptor drill). Unknown keys raise:
     a typo'd fault drill that silently injects nothing would report a
     no-hang pass it never earned."""
     spec = FaultSpec()
@@ -87,9 +91,14 @@ def parse_spec(s: str) -> FaultSpec:
             spec.post_error = float(v)
         elif k == "kill":
             spec.kill = {int(r) for r in v.split("+") if r.strip() != ""}
+        elif k == "corrupt":
+            spec.corrupt = float(v)
+        elif k == "corrupt_rank":
+            spec.corrupt_rank = int(v)
         else:
             raise ValueError(f"unknown UCC_FAULT key '{k}'")
-    for p in (spec.drop, spec.delay, spec.error, spec.post_error):
+    for p in (spec.drop, spec.delay, spec.error, spec.post_error,
+              spec.corrupt):
         if not 0.0 <= p <= 1.0:
             raise ValueError(f"UCC_FAULT probability {p} out of [0,1]")
     return spec
@@ -107,7 +116,8 @@ _lock = threading.Lock()
 _pending: List[Tuple[float, Callable[[], None]]] = []
 #: decision counters (diagnostics + soak reports; not the metrics
 #: registry — injection must work with UCC_STATS off)
-COUNTS = {"drop": 0, "delay": 0, "error": 0, "post_error": 0, "kill": 0}
+COUNTS = {"drop": 0, "delay": 0, "error": 0, "post_error": 0, "kill": 0,
+          "corrupt": 0}
 
 
 def configure(spec: str = "", seed: Optional[int] = None) -> None:
@@ -170,6 +180,45 @@ def send_action(ctx_rank: Optional[int] = None):
         COUNTS["delay"] += 1
         return ("delay", SPEC.delay_s)
     return None
+
+
+def corrupt_action(ctx_rank: Optional[int] = None) -> bool:
+    """Decide whether THIS send's payload gets corrupted. Independent of
+    the drop/error/delay lottery (a corrupted message still arrives —
+    that is the whole point: silent unless integrity checking catches
+    it). ``corrupt_rank`` pins the fault to one sender, the
+    controlled-corruptor drill the attestation attribution test needs."""
+    if not SPEC.corrupt:
+        return False
+    if SPEC.corrupt_rank is not None and ctx_rank != SPEC.corrupt_rank:
+        return False
+    if _rng.random() < SPEC.corrupt:
+        COUNTS["corrupt"] += 1
+        return True
+    return False
+
+
+def corrupt_send(data):
+    """Apply the corruption: one seeded bit flip in a COPY of the send
+    payload. Returns ``(corrupted_u8_array, clean_crc)`` where
+    *clean_crc* is the crc32 of the ORIGINAL bytes — handed to the
+    matcher as the send-side checksum, so the injection models
+    corruption IN FLIGHT (after the sender checksummed correct data),
+    the only kind a wire crc can catch. Zero-length payloads are
+    returned unchanged (nothing to flip)."""
+    import zlib
+
+    import numpy as np
+    u8 = np.frombuffer(data, dtype=np.uint8) if not isinstance(
+        data, np.ndarray) else np.ascontiguousarray(data).view(np.uint8)
+    u8 = u8.reshape(-1)
+    clean_crc = zlib.crc32(u8) & 0xFFFFFFFF
+    if u8.size == 0:
+        return u8, clean_crc
+    out = u8.copy()
+    i = _rng.randrange(out.size)
+    out[i] ^= 1 << _rng.randrange(8)
+    return out, clean_crc
 
 
 def recv_action(ctx_rank: Optional[int] = None):

@@ -472,6 +472,257 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
 
 
 # ---------------------------------------------------------------------------
+# corruption storm: wire checksums -> strikes -> quarantine (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def run_corrupt_soak(n_ranks: int = 4, corrupt_rank: int = 1,
+                     strikes: int = 3, pre_iters: int = 4,
+                     post_iters: int = 60, storm_rounds_max: int = 10,
+                     count: int = 256, coll_timeout_s: float = 2.0,
+                     iter_deadline_s: float = 15.0,
+                     matrix=DEFAULT_MATRIX) -> Dict:
+    """Integrity acceptance drill: one rank corrupts EVERY payload it
+    sends (``UCC_FAULT=corrupt=1.0,corrupt_rank=R`` — in-flight model,
+    the frame still carries the clean payload's crc32), integrity runs
+    in ``verify`` mode, and the pipeline under test is
+
+        wire crc mismatch at delivery -> ERR_DATA_CORRUPTED naming the
+        sender -> strike ledger -> quarantine (HealthRegistry) ->
+        shrink excludes the corruptor -> checked matrix on the survivors
+
+    The storm runs allreduce only: on the forced ring the corruptor's
+    downstream neighbour is the sole direct receiver, so it accumulates
+    exactly one strike per round and quarantine must trip in exactly
+    ``strikes`` detected rounds (more is a violation — detection that
+    does not escalate).  Allreduces are forced onto NATIVE EXECUTION
+    PLANS; the pinned corruptor interprets (rank-variant plan engage)
+    while its peers keep the C matcher's crc verify on the data path,
+    which is precisely the deployment shape the drill certifies.
+
+    Non-detecting ranks are starved of contributions each round; they
+    carry a per-collective TIMEOUT so they cancel instead of parking
+    (timeouts are acceptable collateral, hangs are violations; an
+    all-OK round with a wrong result is the cardinal sin: silent
+    corruption).  ``report["violations"]`` MUST be empty.
+    """
+    import os
+    from ucc_tpu import Status
+    from .. import integrity
+    from ..status import DataCorruptedError
+    from . import health
+
+    inject.reset()
+    prev_hb = (health.MODE, health.HEARTBEAT_INTERVAL,
+               health.HEARTBEAT_TIMEOUT)
+    # all three BEFORE context create: health registries and the native
+    # mailboxes' integrity arming are wired up in Context.__init__
+    health.configure("shrink", interval=0.05, timeout=2.0)
+    integrity.configure(mode="verify", sample=1, strikes=strikes)
+    plan_env = {k: os.environ.get(k)
+                for k in ("UCC_GEN_NATIVE", "UCC_TL_SHM_TUNE")}
+    os.environ["UCC_GEN_NATIVE"] = "y"
+    os.environ["UCC_TL_SHM_TUNE"] = "allreduce:@ring:inf"
+    ctxs = _make_job(n_ranks)
+    teams = _make_team(ctxs)
+    corrupt_ctx = ctxs[corrupt_rank].rank
+    report: Dict = {"pre_iters": 0, "storm_rounds": 0, "post_iters": 0,
+                    "violations": [], "outcomes": {}, "detections": 0,
+                    "quarantined": False, "rounds_to_quarantine": None,
+                    "corruptor": {"team_rank": corrupt_rank,
+                                  "ctx_rank": corrupt_ctx},
+                    "mode": "verify", "strikes": strikes,
+                    "teams_recreated": 0,
+                    "plan_mode": False, "agreed": {},
+                    "matcher": None, "stale_send_fenced": None}
+    bufs: Dict = {}
+    new_teams = None
+    try:
+        # -- healthy warm-up (no injection, results checked) -----------
+        for it in range(pre_iters):
+            coll = matrix[it % len(matrix)]
+            _drive_iter(ctxs, teams, coll, n_ranks, count, bufs,
+                        iter_deadline_s, report, "pre", range(n_ranks))
+            report["pre_iters"] += 1
+
+        # -- the storm -------------------------------------------------
+        # armed only now: team create's service collectives stay clean
+        inject.configure(f"corrupt=1.0,corrupt_rank={corrupt_ctx}", seed=0)
+        expected = sum(g + 1.0 for g in range(n_ranks))
+        for rnd in range(storm_rounds_max):
+            injected_before = inject.COUNTS.get("corrupt", 0)
+            reqs = [t.collective_init(
+                _coll_args("allreduce", r, n_ranks, count, bufs,
+                           coll_timeout_s))
+                    for r, t in enumerate(teams)]
+            for rq in reqs:
+                rq.post()
+            done: List = [None] * n_ranks
+            deadline = time.monotonic() + iter_deadline_s
+            while time.monotonic() < deadline and any(d is None
+                                                      for d in done):
+                for c in ctxs:
+                    c.progress()
+                for i, rq in enumerate(reqs):
+                    if done[i] is not None:
+                        continue
+                    try:
+                        st = rq.test()
+                    except DataCorruptedError as e:
+                        # the attestation hook raises; wire-path
+                        # corruption instead RETURNS the error status
+                        done[i] = (Status.ERR_DATA_CORRUPTED,
+                                   sorted(e.ranks))
+                        continue
+                    if st != Status.IN_PROGRESS:
+                        done[i] = (st, sorted(getattr(
+                            rq.task, "corrupt_ranks", ()) or ()))
+            report["storm_rounds"] += 1
+            # native plans must carry the peers' data path (the pinned
+            # corruptor itself interprets, by design) — probe BEFORE
+            # finalize releases the plan
+            if any(getattr(rq.task, "_plan", None) is not None
+                   for r, rq in enumerate(reqs) if r != corrupt_rank):
+                report["plan_mode"] = True
+            hung = [r for r, d in enumerate(done) if d is None]
+            for r in hung:
+                report["violations"].append(
+                    f"storm round {rnd}: rank {r} IN_PROGRESS past "
+                    f"deadline")
+                reqs[r].task.cancel(Status.ERR_TIMED_OUT)
+                done[r] = (Status.ERR_TIMED_OUT, [])
+            detectors = [r for r, (st, _) in enumerate(done)
+                         if st == Status.ERR_DATA_CORRUPTED]
+            for r, (st, _) in enumerate(done):
+                key = f"storm:{st.name}"
+                report["outcomes"][key] = report["outcomes"].get(key, 0) + 1
+            injected = inject.COUNTS.get("corrupt", 0) - injected_before
+            if detectors:
+                report["detections"] += 1
+                for r in detectors:
+                    named = done[r][1]
+                    if corrupt_ctx not in named:
+                        report["violations"].append(
+                            f"storm round {rnd}: rank {r} attribution "
+                            f"{named} misses ctx rank {corrupt_ctx}")
+            elif all(st == Status.OK for st, _ in done):
+                for g in range(n_ranks):
+                    if not np.allclose(bufs[g]["ar"], expected):
+                        report["violations"].append(
+                            f"storm round {rnd}: SILENT CORRUPTION — "
+                            f"rank {g} result {bufs[g]['ar'][0]} != "
+                            f"{expected} with no rank reporting "
+                            f"ERR_DATA_CORRUPTED")
+                        break
+            elif injected:
+                report["violations"].append(
+                    f"storm round {rnd}: {injected} corrupted sends "
+                    f"went undetected (outcomes "
+                    f"{[st.name for st, _ in done]})")
+            for rq in reqs:
+                try:
+                    rq.finalize()
+                except Exception:  # noqa: BLE001
+                    pass
+            quarantined = any(
+                corrupt_ctx in (ctxs[r].health.dead_set()
+                                if ctxs[r].health else ())
+                for r in range(n_ranks) if r != corrupt_rank)
+            if quarantined:
+                report["quarantined"] = True
+                report["rounds_to_quarantine"] = rnd + 1
+                break
+            # the faulted team's tag space is poisoned (run_soak
+            # contract); strike ledgers and health live on the CONTEXT,
+            # so they survive the re-create
+            prev = inject.pause()
+            teams = _recreate(teams, ctxs, report)
+            inject.restore(prev)
+
+        if not report["quarantined"]:
+            report["violations"].append(
+                f"corruptor not quarantined after {report['storm_rounds']}"
+                f" storm rounds ({report['detections']} detected)")
+        elif report["detections"] > strikes:
+            report["violations"].append(
+                f"quarantine took {report['detections']} detected rounds;"
+                f" strike threshold is {strikes}")
+        if not report["plan_mode"]:
+            report["violations"].append(
+                "storm ran without native execution plans on the "
+                "peers (native core unavailable?)")
+
+        # -- shrink the corruptor out ---------------------------------
+        # injection stays armed: the quarantined rank no longer sends,
+        # so nothing fires — exactly the production posture
+        if report["quarantined"]:
+            survivors = [r for r in range(n_ranks) if r != corrupt_rank]
+            sctxs = [ctxs[r] for r in survivors]
+            shrinks = {r: teams[r].shrink_post() for r in survivors}
+            deadline = time.monotonic() + iter_deadline_s
+            while time.monotonic() < deadline:
+                for c in sctxs:
+                    c.progress()
+                # poll every request each pass — test() drives the OOB
+                # rebuild rounds (a short-circuiting all() deadlocks)
+                sts = [s.test() for s in shrinks.values()]
+                if all(st != Status.IN_PROGRESS for st in sts):
+                    break
+            for r, s in shrinks.items():
+                st = s.test()
+                report["agreed"][r] = {"status": st.name,
+                                       "dead": s.failed_ranks,
+                                       "epoch": s.epoch}
+                if st != Status.OK:
+                    report["violations"].append(
+                        f"survivor {r} shrink failed: {st.name}")
+                elif corrupt_ctx not in (s.failed_ranks or ()):
+                    report["violations"].append(
+                        f"survivor {r} shrank without the corruptor: "
+                        f"{s.failed_ranks}")
+            views = {(tuple(v["dead"] or ()), v["epoch"])
+                     for v in report["agreed"].values()}
+            if len(views) > 1:
+                report["violations"].append(
+                    f"survivors diverged on (dead set, epoch): {views}")
+            if not report["violations"]:
+                new_teams = [shrinks[r].new_team for r in survivors]
+                _probe_stale_send_fence(teams[survivors[0]], report)
+
+            # -- checked matrix on the shrunk team --------------------
+            if new_teams:
+                nbufs: Dict = {}
+                nn = len(survivors)
+                for it in range(post_iters):
+                    coll = matrix[it % len(matrix)]
+                    _drive_iter(sctxs, new_teams, coll, nn, count, nbufs,
+                                iter_deadline_s, report, "post",
+                                survivors, check=True)
+                    report["post_iters"] += 1
+    finally:
+        report["injected"] = dict(inject.COUNTS)
+        inject.reset()
+        integrity.reset()
+        health.configure(prev_hb[0], interval=prev_hb[1],
+                         timeout=prev_hb[2])
+        for k, v in plan_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for t in list(teams) + list(new_teams or ()):
+            try:
+                t.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+        for c in ctxs:
+            try:
+                c.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+    return report
+
+
+# ---------------------------------------------------------------------------
 # churn scenario: interleaved kill -> shrink -> grow cycles (ISSUE 17)
 # ---------------------------------------------------------------------------
 
@@ -1231,7 +1482,12 @@ def _drive_iter(ctxs, teams, coll, n, count, bufs, deadline_s, report,
     while time.monotonic() < deadline:
         for c in ctxs:
             c.progress()
-        if all(rq.test() != Status.IN_PROGRESS for rq in reqs):
+        # poll EVERY request each pass (list, not a short-circuiting
+        # all()): in UCC_INTEGRITY=verify the sampled attestation digest
+        # exchange is driven from each request's own test(), so skipping
+        # the tail would starve the exchange until its abandon timeout
+        sts = [rq.test() for rq in reqs]
+        if all(st != Status.IN_PROGRESS for st in sts):
             break
     sts = [rq.test() for rq in reqs]
     for s in sts:
@@ -1306,6 +1562,20 @@ def main(argv=None) -> int:
     ap.add_argument("--mt-burst", type=int, default=6,
                     help="with --multi: bulk posts per team-rank per "
                     "round")
+    ap.add_argument("--corrupt", action="store_true",
+                    help="run the corruption-storm integrity drill: one "
+                    "rank corrupts every send (clean crc on the frame), "
+                    "wire checksums must detect+attribute 100%% of "
+                    "rounds, the strike ledger must quarantine the "
+                    "corruptor within --strikes detections, and the "
+                    "shrunk team must run a checked matrix "
+                    "(UCC_INTEGRITY=verify + UCC_FT=shrink + native "
+                    "plans)")
+    ap.add_argument("--corrupt-rank", type=int, default=1,
+                    help="with --corrupt: team rank that corrupts")
+    ap.add_argument("--strikes", type=int, default=3,
+                    help="with --corrupt: quarantine threshold "
+                    "(UCC_INTEGRITY_STRIKES)")
     ap.add_argument("--plans", action="store_true",
                     help="with --kill-shrink: run the drill with the "
                     "allreduces forced onto NATIVE EXECUTION PLANS "
@@ -1313,6 +1583,13 @@ def main(argv=None) -> int:
                     "ucc_plan_cancel withdrew posted recvs and a "
                     "pre-shrink plan send is fenced")
     args = ap.parse_args(argv)
+    if args.corrupt:
+        report = run_corrupt_soak(args.ranks,
+                                  corrupt_rank=args.corrupt_rank,
+                                  strikes=args.strikes,
+                                  post_iters=args.post_iters)
+        print(json.dumps(report, indent=1))
+        return 1 if report["violations"] else 0
     if args.multi:
         report = run_multi_tenant_soak(args.ranks, n_teams=args.mt_teams,
                                        rounds=args.mt_rounds,

@@ -40,8 +40,11 @@ logger = get_logger("native")
 #: must match kAbiVersion in native/ucc_tpu_core.cc
 #: (4: native execution plans — ucc_plan_build/post/test/cancel retire a
 #: verified DSL program's whole round schedule in C++; one ffi crossing
-#: posts the plan, completion is a mapped-word read)
-ABI_VERSION = 4
+#: posts the plan, completion is a mapped-word read.
+#: 5: wire integrity — per-entry crc32 checksum word, the kCorrupt
+#: completion state with sender attribution, ucc_mailbox_set_integrity
+#: and ucc_mailbox_push2)
+ABI_VERSION = 5
 
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -71,6 +74,7 @@ _ST_OK = 1
 _ST_TRUNCATED = 2
 _ST_FENCED = 3
 _ST_CANCELED = 4
+_ST_CORRUPT = 6
 
 _KIND_STR = ("direct", "eager", "rndv", "fenced")
 
@@ -350,6 +354,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.ucc_mailbox_pub_base.argtypes = [vp]
         lib.ucc_mailbox_push.restype = u64
         lib.ucc_mailbox_push.argtypes = [vp, u64, u64, u64, vp, u64, u64]
+        lib.ucc_mailbox_push2.restype = u64
+        lib.ucc_mailbox_push2.argtypes = [vp, u64, u64, u64, vp, u64,
+                                          u64, u64]
+        lib.ucc_mailbox_set_integrity.restype = None
+        lib.ucc_mailbox_set_integrity.argtypes = [vp, u64]
         lib.ucc_mailbox_post_recv.restype = u64
         lib.ucc_mailbox_post_recv.argtypes = [vp, u64, u64, u64, vp, u64]
         lib.ucc_mailbox_fence.restype = u64
@@ -492,7 +501,7 @@ class NativeSendReq:
 
 class NativeRecvReq:
     __slots__ = ("mb", "rid", "_idx", "_gen", "dst_keepalive", "_done",
-                 "nbytes", "error", "cancelled")
+                 "nbytes", "error", "cancelled", "corrupt_src")
 
     def __init__(self, mb: "NativeMailbox", rid: int, dst: np.ndarray):
         self.mb = mb
@@ -504,6 +513,7 @@ class NativeRecvReq:
         self.nbytes = 0
         self.error = None
         self.cancelled = False
+        self.corrupt_src = None      # sender ctx rank on a wire crc mismatch
 
     @property
     def done(self) -> bool:
@@ -549,7 +559,15 @@ class NativeRecvReq:
         if nb == _NB_MAX and ptr is not None:  # saturated: exact size
             nb = int(mb.lib.ucc_req_nbytes(ptr, self.rid))
         self.nbytes = nb
-        if st == _ST_TRUNCATED:
+        if st == _ST_CORRUPT:
+            # the nbytes field carries the SENDER's ctx rank (the C side
+            # parks it there for attribution; delivered length is moot —
+            # the payload failed its checksum and must not be consumed)
+            self.corrupt_src = nb
+            self.nbytes = 0
+            self.error = (f"data corrupted: crc32 mismatch (from ctx "
+                          f"rank {nb})")
+        elif st == _ST_TRUNCATED:
             sent = int(mb.lib.ucc_req_sent_nbytes(ptr, self.rid)) \
                 if ptr is not None else 0
             # counts are BYTES: the C side sees only byte lengths, and
@@ -629,10 +647,21 @@ class NativeMailbox:
         # hot-path entry points bound once; the fastcall ext (when built)
         # replaces ctypes marshalling with the buffer protocol
         self._push_fn = lib.ucc_mailbox_push
+        self._push2_fn = lib.ucc_mailbox_push2
         self._post_fn = lib.ucc_mailbox_post_recv
         ext = _EXT
         self._ext_push = ext.push if ext is not None else None
         self._ext_post = ext.post_recv if ext is not None else None
+        # UCC_INTEGRITY=wire|verify arms C-side checksum/verify for this
+        # endpoint's whole life — including plan-executor rounds, which
+        # never re-enter python. Off mode leaves the flag 0: the entry
+        # path is byte-identical to ABI 4 semantics.
+        try:
+            from . import integrity as _integ
+            if _integ.WIRE:
+                lib.ucc_mailbox_set_integrity(self.ptr, 1)
+        except Exception:  # noqa: BLE001 - teardown-order import only
+            pass
 
     # -- key packing ---------------------------------------------------
     def _intern(self, table: dict, obj, base: int) -> int:
@@ -690,10 +719,15 @@ class NativeMailbox:
 
     # -- data path -----------------------------------------------------
     def push_native(self, key, data: np.ndarray,
-                    eager_limit: Optional[int] = None):
+                    eager_limit: Optional[int] = None,
+                    crc: Optional[int] = None):
         """Send: returns ``(req, kind)`` with kind in direct / eager /
         rndv / fenced (the python Mailbox.send contract). Direct sends
-        deliver copy-free into the posted dst inside this call."""
+        deliver copy-free into the posted dst inside this call. *crc*
+        (a zlib.crc32 of the payload as the SENDER computed it) routes
+        through ``ucc_mailbox_push2`` so delivery verifies against the
+        supplied word instead of recomputing — the fault injector's
+        clean-checksum-corrupt-payload path."""
         ptr = self.ptr                # snapshot: see NativeRecvReq.test
         if ptr is None:
             # endpoint already closed: the message has nowhere to land
@@ -703,6 +737,18 @@ class NativeMailbox:
         if eager_limit is None:
             eager_limit = _eager_limit()
         a, b, c = self._pack(key)
+        if crc is not None:
+            if not data.flags["C_CONTIGUOUS"]:
+                data = np.ascontiguousarray(data)
+            ret = self._push2_fn(ptr, a, b, c, data.ctypes.data,
+                                 data.nbytes, eager_limit,
+                                 (1 << 32) | (crc & 0xFFFFFFFF))
+            kind = ret & 7
+            if kind == 2:             # rndv: parked zero-copy
+                rid = ret >> 3
+                self._send_keep[rid] = data
+                return NativeSendReq(self, rid), "rndv"
+            return _DoneSend(), _KIND_STR[kind]
         ext = self._ext_push
         if ext is not None:
             try:

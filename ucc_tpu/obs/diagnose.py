@@ -455,6 +455,51 @@ def detect_failed(merged: Dict[str, Any], _idx=None
     return findings
 
 
+def detect_integrity(merged: Dict[str, Any], _idx=None
+                     ) -> List[Dict[str, Any]]:
+    """Data-corruption attribution: ``integrity`` events the wire
+    checksum / result-attestation machinery recorded (kind ``wire``,
+    ``attest``, ``quarantine``, each naming the offending ctx rank) are
+    aggregated per offender, joined with the dump-level
+    ``quarantined_rank`` marker the quarantine trigger stamps."""
+    idx = _index(merged, _idx)
+    per: Dict[int, Dict[str, Any]] = {}
+
+    def slot(ctx: int) -> Dict[str, Any]:
+        return per.setdefault(ctx, {"kind": "integrity", "rank": ctx,
+                                    "wire_events": 0, "attest_events": 0,
+                                    "quarantined": False,
+                                    "reported_by": set()})
+
+    for r in sorted(idx):
+        for ev in idx[r].events:
+            if ev.get("ev") != "cmpl" or ev.get("coll") != "integrity":
+                continue
+            stage = ev.get("stage") or ""
+            try:
+                ctx = int(stage.split("=", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            f = slot(ctx)
+            f["reported_by"].add(r)
+            k = ev.get("alg")
+            if k == "wire":
+                f["wire_events"] += 1
+            elif k == "attest":
+                f["attest_events"] += 1
+            elif k == "quarantine":
+                f["quarantined"] = True
+    qr = merged.get("quarantined_rank")
+    if qr is not None:
+        slot(int(qr))["quarantined"] = True
+    findings = []
+    for ctx in sorted(per):
+        f = per[ctx]
+        f["reported_by"] = sorted(f["reported_by"])
+        findings.append(f)
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # top level
 # ---------------------------------------------------------------------------
@@ -468,6 +513,7 @@ def diagnose(merged: Dict[str, Any]) -> Dict[str, Any]:
     missing = detect_missing(merged, _idx=idx)
     failed = detect_failed(merged, _idx=idx)
     queue_wait = detect_queue_wait(merged, _idx=idx)
+    integrity = detect_integrity(merged, _idx=idx)
     summary: List[str] = []
     for f in desync:
         summary.append(
@@ -523,9 +569,23 @@ def diagnose(merged: Dict[str, Any]) -> Dict[str, Any]:
             f"{f['count']} wait(s) past the aging bound on rank(s) "
             f"{ranks}, worst {f['max_wait_ms']:.1f}ms"
             + (f" ({f['worst_coll']})" if f.get("worst_coll") else ""))
+    for f in integrity:
+        rep = ",".join(str(r) for r in f["reported_by"]) or "-"
+        parts = []
+        if f["wire_events"]:
+            parts.append(f"{f['wire_events']} wire crc mismatch(es)")
+        if f["attest_events"]:
+            parts.append(f"{f['attest_events']} attestation "
+                         f"minority event(s)")
+        what = ", ".join(parts) or "corruption evidence"
+        summary.append(
+            f"CORRUPT ctx rank {f['rank']}: {what}, reported by "
+            f"rank(s) {rep}"
+            + ("; QUARANTINED" if f["quarantined"] else ""))
     return {"desync": desync, "stragglers": stragglers,
             "missing": missing, "failed": failed,
-            "queue_wait": queue_wait, "summary": summary}
+            "queue_wait": queue_wait, "integrity": integrity,
+            "summary": summary}
 
 
 def _sig_str(sig: Dict[str, Any]) -> str:

@@ -714,9 +714,37 @@ def on_rank_failure(ctx_rank: int, source: str = "",
 _failure_noted: set = set()
 
 
+def on_integrity(kind: str, ctx_rank: int, detail: str = "") -> None:
+    """Data-integrity trigger (integrity subsystem): record the event in
+    every ring this process can see (the merged dump then shows the
+    corruption inline with the collectives around it), and on
+    ``quarantine`` also dump — the "what was in flight when rank N was
+    quarantined" record, one shot per rank like the failure path."""
+    if not ENABLED:
+        return
+    for rec in recorders():
+        rec.complete(-1, -1, -1, "integrity", kind,
+                     f"ctx_rank={ctx_rank}", 0.0, "ERR_DATA_CORRUPTED")
+    if kind != "quarantine" or ctx_rank in _integrity_noted:
+        return
+    _integrity_noted.add(ctx_rank)
+    try:
+        merged = collect_process(None, reason="quarantine")
+        merged["quarantined_rank"] = int(ctx_rank)
+        if detail:
+            merged["detail"] = detail
+        dump_merged(merged)
+    except Exception:  # noqa: BLE001 - diagnostics must never raise
+        logger.exception("flight quarantine dump failed")
+
+
+_integrity_noted: set = set()
+
+
 def reset() -> None:
     """Clear trigger one-shots (tests)."""
     _failure_noted.clear()
+    _integrity_noted.clear()
 
 
 _prev_sigusr2 = None

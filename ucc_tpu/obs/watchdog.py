@@ -116,6 +116,23 @@ def note_rank_failure(ranks, source: str = "", detail: str = "") -> None:
         logger.exception("watchdog rank-failure note write failed")
 
 
+def note_integrity(kind: str, ranks, detail: str = "") -> None:
+    """Append a data-integrity evidence line (``wire_mismatch`` /
+    ``digest_mismatch`` / ``quarantine``) naming the attributed ctx
+    ranks — the snapshot-gate/soak classifier reads these to tell
+    detected corruption from silent corruption from hangs."""
+    if not ENABLED:
+        return
+    rec = {"ts": time.time(), "pid": os.getpid(), "reason": "integrity",
+           "kind": kind, "ranks": sorted(int(r) for r in ranks),
+           "detail": detail}
+    try:
+        with open(_file, "a") as fh:
+            fh.write(json.dumps(rec, default=str) + "\n")
+    except OSError:
+        logger.exception("watchdog integrity note write failed")
+
+
 # ---------------------------------------------------------------------------
 # scan — called from ProgressQueue.progress() under `if watchdog.ENABLED:`
 # ---------------------------------------------------------------------------

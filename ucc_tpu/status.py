@@ -30,6 +30,7 @@ class Status(enum.IntEnum):
     ERR_TIMED_OUT = -8
     ERR_CANCELED = -9
     ERR_RANK_FAILED = -10      # a team member died (see RankFailedError)
+    ERR_DATA_CORRUPTED = -11   # checksum mismatch (see DataCorruptedError)
     ERR_LAST = -100
 
     @property
@@ -54,6 +55,7 @@ _STATUS_STR = {
     Status.ERR_TIMED_OUT: "Operation timed out",
     Status.ERR_CANCELED: "Operation canceled",
     Status.ERR_RANK_FAILED: "A team member rank has failed",
+    Status.ERR_DATA_CORRUPTED: "Data integrity check failed",
 }
 
 
@@ -77,6 +79,23 @@ class RankFailedError(UccError):
         if self.ranks:
             detail = f"{detail} (ranks {sorted(self.ranks)})"
         super().__init__(Status.ERR_RANK_FAILED, detail)
+
+
+class DataCorruptedError(UccError):
+    """ERR_DATA_CORRUPTED carrying attribution: *ranks* are the ctx
+    ranks whose data failed a checksum (wire crc mismatch names the
+    sender; a digest-attestation minority names the corruptor), and
+    *quarantine* the subset whose strike budget is exhausted — the
+    caller recovers by excluding those exactly like dead ranks
+    (``Team.shrink``; they may rejoin later via ``Team.join``)."""
+
+    def __init__(self, msg: str = "", ranks=(), quarantine=()):
+        self.ranks = frozenset(int(r) for r in ranks)
+        self.quarantine = frozenset(int(r) for r in quarantine)
+        detail = msg or "data corruption detected"
+        if self.ranks:
+            detail = f"{detail} (ctx ranks {sorted(self.ranks)})"
+        super().__init__(Status.ERR_DATA_CORRUPTED, detail)
 
 
 def check(status, msg: str = ""):

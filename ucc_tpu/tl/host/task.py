@@ -244,6 +244,30 @@ class HostCollTask(CollTask):
                         alg=alg)
         raise UccError(Status.ERR_NO_MESSAGE, reason)
 
+    def _integrity_error(self, src, detail: str = "") -> None:
+        """A delivery failed its wire checksum: record the evidence trail
+        (metrics / watchdog / flight / health suspicion, all inside
+        ``integrity.note_wire_mismatch``) and fail the collective with
+        ERR_DATA_CORRUPTED naming the sender — ``_advance`` maps the
+        raise onto the task status like every other UccError. *src* is
+        the sender's ctx rank (None/-1 = unattributed); also the native
+        plan path's terminal (GeneratedCollTask._run_plan)."""
+        from ... import integrity
+        from ...status import DataCorruptedError
+        core = getattr(self.tl_team, "core_team", None)
+        ctx = getattr(core, "context", None)
+        if ctx is not None and src is not None and src >= 0:
+            integrity.note_wire_mismatch(ctx, src, detail)
+        if metrics.ENABLED:
+            coll, alg = self._obs_names()
+            metrics.inc("coll_errors", component="tl/host", coll=coll,
+                        alg=alg)
+        ranks = (src,) if src is not None and src >= 0 else ()
+        # attribution rides its own attribute: failed_ranks means "dead",
+        # and one corrupt message does not make its sender dead
+        self.corrupt_ranks = sorted(ranks)
+        raise DataCorruptedError(detail or "data corrupted", ranks=ranks)
+
     def obs_describe(self, now=None) -> dict:
         d = super().obs_describe(now)
         d["grank"] = self.grank
@@ -337,10 +361,35 @@ class HostCollTask(CollTask):
         Returns a substitute request, or None to send normally. The
         error action fires BEFORE data_committed flips so a first-send
         error is runtime-fallback-eligible, matching a real local
-        transport failure at the post boundary."""
-        act = fault.send_action(getattr(self.tl_team, "_my_ctx_rank", None))
+        transport failure at the post boundary.
+
+        Corruption (``UCC_FAULT=corrupt=P``) is decided INDEPENDENTLY of
+        the drop/error/delay lottery: the payload is bit-flipped in a
+        copy and — when wire integrity is armed — the matcher receives
+        the crc32 of the ORIGINAL bytes, modelling corruption in flight.
+        With integrity off the poisoned bytes deliver silently, which is
+        exactly what the corruption-storm soak asserts against."""
+        my_ctx = getattr(self.tl_team, "_my_ctx_rank", None)
+        corrupted = False
+        crc = None
+        if fault.SPEC.corrupt and fault.corrupt_action(my_ctx):
+            data, clean_crc = fault.corrupt_send(data)
+            corrupted = True
+            from ... import integrity
+            if integrity.WIRE:
+                crc = clean_crc
+        act = fault.send_action(my_ctx)
         if act is None:
-            return None
+            if not corrupted:
+                return None
+            # perform the send here: returning None would let the caller
+            # transmit the ORIGINAL (clean) payload
+            self.data_committed = True
+            req = self.tl_team.send_nb(self.subset, peer_grank, self.tag,
+                                       slot, data, crc=crc)
+            if watchdog.ENABLED or fault.ENABLED:
+                self._obs_track("send", peer_grank, slot, req)
+            return req
         if act == "error":
             self._obs_error("fault injected: send post failed")
         if act == "drop":
@@ -353,10 +402,11 @@ class HostCollTask(CollTask):
         proxy = fault.DelayedSendReq()
         payload = data.copy()   # sender may legally reuse its buffer
 
-        def _fire(task=self, peer=peer_grank, d=payload, s=slot, p=proxy):
+        def _fire(task=self, peer=peer_grank, d=payload, s=slot, p=proxy,
+                  cw=crc):
             if not p.cancelled:
                 p.real = task.tl_team.send_nb(task.subset, peer, task.tag,
-                                              s, d)
+                                              s, d, crc=cw)
         fault.defer(delay_s, _fire)
         if watchdog.ENABLED or fault.ENABLED:
             self._obs_track("send", peer_grank, slot, proxy)
@@ -413,6 +463,8 @@ class HostCollTask(CollTask):
             if not r.test():
                 live.append(r)
             elif getattr(r, "error", None):
+                if getattr(r, "corrupt_src", None) is not None:
+                    self._integrity_error(r.corrupt_src, r.error or "")
                 self._obs_error(f"window request failed: {r.error}")
         return live
 
@@ -435,6 +487,8 @@ class HostCollTask(CollTask):
         for r in reqs:
             err = getattr(r, "error", None)
             if err:
+                if getattr(r, "corrupt_src", None) is not None:
+                    self._integrity_error(r.corrupt_src, err)
                 self._obs_error(err)
 
     def sendrecv(self, send_to: int, data: np.ndarray, recv_from: int,

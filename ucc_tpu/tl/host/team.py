@@ -153,10 +153,14 @@ class HostTlTeam(TlTeamBase):
         return self.ctx_map.eval(subset.map.eval(grank))
 
     def send_nb(self, subset: Subset, peer_grank: int, coll_tag: int,
-                slot: int, data: np.ndarray):
+                slot: int, data: np.ndarray, crc=None):
+        # *crc* (sender-computed zlib.crc32, or None = let the matcher
+        # decide) only flows on the instrumented path — the ctx-rank hot
+        # variants below stay signature-identical
         peer_ctx = self._peer_ctx_rank(subset, peer_grank)
         return self.comp_context.send_to(
-            peer_ctx, self._key(coll_tag, slot, self._my_ctx_rank), data)
+            peer_ctx, self._key(coll_tag, slot, self._my_ctx_rank), data,
+            crc=crc)
 
     def recv_nb(self, subset: Subset, peer_grank: int, coll_tag: int,
                 slot: int, dst: np.ndarray):

@@ -105,8 +105,9 @@ class TlShmContext(BaseContext):
             self._mailboxes[ctx_rank] = peer
         return peer
 
-    def send_to(self, peer_ctx_rank: int, key, data: np.ndarray):
-        return self.transport.send_nb(self._peer(peer_ctx_rank), key, data)
+    def send_to(self, peer_ctx_rank: int, key, data: np.ndarray, crc=None):
+        return self.transport.send_nb(self._peer(peer_ctx_rank), key, data,
+                                      crc=crc)
 
     # -- one-sided (tl/host/onesided.py): every peer is in-process, so
     # put/get/atomic apply directly under the registry lock; flush is a

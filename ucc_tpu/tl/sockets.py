@@ -11,7 +11,14 @@ the same Mailbox
 matching structure the in-process transport uses, so the entire host
 algorithm suite runs unchanged over TCP.
 
-Frame: [key_len u32][pickled key][payload_len u64][payload bytes].
+Frame: [key_len u32][payload_len u64][key_crc u32][payload_crc_word u64]
+[pickled key][payload bytes]. The key crc is ALWAYS present (keys are
+tiny; it turns a torn/desynced/corrupted stream into a loud
+ERR_DATA_CORRUPTED drop with peer attribution instead of unpickling
+garbage — the blast-radius caveat in ``_reader``). The payload crc word
+is ``(1<<32)|crc32`` when UCC_INTEGRITY wire mode armed the sender, 0
+otherwise; it rides into the Mailbox match metadata and is verified at
+delivery, failing exactly the one matched request.
 """
 from __future__ import annotations
 
@@ -19,11 +26,13 @@ import pickle
 import socket
 import struct
 import threading
+import zlib
 from queue import SimpleQueue
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from .. import integrity as _integrity
 from ..constants import COLL_TYPE_ALL, MemoryType
 from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
 from ..ec.cpu import EcCpu
@@ -39,7 +48,7 @@ from .host.transport import Mailbox, RecvReq, SendReq, _PendingSend
 
 logger = get_logger("tl_socket")
 
-_HDR = struct.Struct("!IQ")
+_HDR = struct.Struct("!IQIQ")   # key_len, payload_len, key_crc, pcrc word
 
 #: desync sanity bounds (tagged keys are small pickled tuples; one frame
 #: carries at most one collective's fragment — 1 GiB is far above any
@@ -151,7 +160,7 @@ class SocketTransport:
         try:
             while True:
                 hdr = _recv_exact(conn, _HDR.size)
-                klen, plen = _HDR.unpack(hdr)
+                klen, plen, kcrc, pcrcw = _HDR.unpack(hdr)
                 # a desynced stream decodes payload bytes as a header, so
                 # validate BEFORE allocating/reading: keys are small
                 # pickled tuples, payloads are bounded by what one
@@ -164,6 +173,22 @@ class SocketTransport:
                     conn.close()
                     return
                 kb = _recv_exact(conn, klen)
+                if zlib.crc32(kb) & 0xFFFFFFFF != kcrc:
+                    # torn or corrupt frame caught BEFORE unpickling: the
+                    # stream cannot be resynced, so fail it loudly with
+                    # ERR_DATA_CORRUPTED attribution (peer address; the
+                    # tagged key is unreadable) and drop the connection —
+                    # sender eviction + reconnect recovers
+                    from ..obs import metrics
+                    logger.error(
+                        "%s: socket frame key crc mismatch from %s "
+                        "(%d-byte key, head %r) — dropping connection",
+                        Status.ERR_DATA_CORRUPTED.name, peer, klen, kb[:16])
+                    if metrics.ENABLED:
+                        metrics.inc("integrity_wire_mismatch",
+                                    component="tl/socket")
+                    conn.close()
+                    return
                 try:
                     # the whole frame-processing body is the desync blast
                     # radius: a corrupt key can fail to unpickle, unpickle
@@ -184,7 +209,9 @@ class SocketTransport:
                         # RDMA progress model)
                         self._handle_onesided(key, data, errbox)
                         continue
-                    ps = _PendingSend(data, SendReq(done=True), copied=True)
+                    ps = _PendingSend(
+                        data, SendReq(done=True), copied=True,
+                        crc=(pcrcw & 0xFFFFFFFF) if pcrcw >> 32 else None)
                     self.mailbox.push(key, ps)
                 except (ConnectionError, OSError):
                     raise
@@ -254,9 +281,12 @@ class SocketTransport:
             self._conns[addr] = c
         return c
 
-    def send_to_addr(self, addr: Tuple[str, int], key, data: np.ndarray) -> SendReq:
+    def send_to_addr(self, addr: Tuple[str, int], key, data: np.ndarray,
+                     crc: Optional[int] = None) -> SendReq:
         payload = data.reshape(-1).view(np.uint8).tobytes()
         kb = pickle.dumps(key)
+        if crc is None and _integrity.WIRE:
+            crc = zlib.crc32(payload) & 0xFFFFFFFF
         # mirror the reader's desync sanity bounds: a frame the receiver
         # would reject as implausible must fail LOUDLY here, not be
         # transmitted and dropped there (fragmentation above this bound
@@ -268,7 +298,10 @@ class SocketTransport:
                 f"{_MAX_KEY_BYTES} or payload {len(payload)}B > "
                 f"{_MAX_FRAME_BYTES}); fragment the collective (pipelined "
                 f"schedule / sliding window) instead")
-        frame = _HDR.pack(len(kb), len(payload)) + kb + payload
+        frame = _HDR.pack(len(kb), len(payload),
+                          zlib.crc32(kb) & 0xFFFFFFFF,
+                          ((1 << 32) | crc) if crc is not None else 0
+                          ) + kb + payload
         with self._addr_lock(addr):
             conn = self._conn_to(addr)
             try:
@@ -371,7 +404,8 @@ class TlSocketContext(BaseContext):
             if blob:
                 self.peer_addrs[rank] = pickle.loads(blob)
 
-    def send_to(self, peer_ctx_rank: int, key, data: np.ndarray) -> SendReq:
+    def send_to(self, peer_ctx_rank: int, key, data: np.ndarray,
+                crc: Optional[int] = None) -> SendReq:
         addr = self.peer_addrs.get(peer_ctx_rank)
         if addr is None:
             raise UccError(Status.ERR_NOT_FOUND,
@@ -379,10 +413,13 @@ class TlSocketContext(BaseContext):
         if peer_ctx_rank == self.core_context.rank:
             # loopback without the network
             data = data.reshape(-1).view(np.uint8)
+            if crc is None and _integrity.WIRE:
+                crc = zlib.crc32(data) & 0xFFFFFFFF
             self.transport.mailbox.push(
-                key, _PendingSend(data.copy(), SendReq(done=True), True))
+                key, _PendingSend(data.copy(), SendReq(done=True), True,
+                                  crc=crc))
             return SendReq(done=True)
-        return self.transport.send_to_addr(addr, key, data)
+        return self.transport.send_to_addr(addr, key, data, crc=crc)
 
     # -- one-sided (tl/host/onesided.py) -------------------------------
     def _os_addr(self, peer_ctx_rank: int):

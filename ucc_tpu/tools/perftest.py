@@ -548,12 +548,12 @@ def run_storm_mode(args, n, dt, op) -> int:
                         rq = teams[0][r].collective_init(a)
                         rq.post()
                         hi.append(rq)
-                    while any(rq.test() == Status.IN_PROGRESS
-                              for rq in hi):
+                    while any([rq.test() == Status.IN_PROGRESS
+                               for rq in hi]):
                         for c in job.contexts:
                             c.progress()
-                    while any(rq.test() == Status.IN_PROGRESS
-                              for rq in bulk):
+                    while any([rq.test() == Status.IN_PROGRESS
+                               for rq in bulk]):
                         for c in job.contexts:
                             c.progress()
                     t3 = time.perf_counter()
@@ -628,7 +628,11 @@ def run_storm_mode(args, n, dt, op) -> int:
 
 def _wait_reqs(job, reqs) -> None:
     from ucc_tpu import Status as _St
-    while any(rq.test() == _St.IN_PROGRESS for rq in reqs):
+    # listified on purpose — a short-circuiting any() would starve the
+    # tail ranks' test()-driven work (the UCC_INTEGRITY=verify digest
+    # exchange) behind a still-running head rank until its abandon
+    # timeout, turning the sampled iterations into 60s stalls
+    while any([rq.test() == _St.IN_PROGRESS for rq in reqs]):
         for c in job.contexts:
             c.progress()
     for rq in reqs:
@@ -772,7 +776,9 @@ class InProcJob:
     def post_and_wait(self, reqs) -> None:
         for rq in reqs:
             rq.post()
-        while any(rq.test() == Status.IN_PROGRESS for rq in reqs):
+        # listified: every rank's test() must run each pass (it drives
+        # the verify-mode attestation exchange; see _wait_reqs)
+        while any([rq.test() == Status.IN_PROGRESS for rq in reqs]):
             for c in self.contexts:
                 c.progress()
         for rq in reqs:
@@ -796,9 +802,9 @@ class InProcJob:
             ev = UccEvent("compute_complete")
             self._ees[r].triggered_post(ev, rq)
             self._ees[r].set_event(ev)
-        while any(rq.test() == Status.IN_PROGRESS or
-                  rq.test() == Status.OPERATION_INITIALIZED
-                  for rq in reqs):
+        while any([rq.test() in (Status.IN_PROGRESS,
+                                 Status.OPERATION_INITIALIZED)
+                   for rq in reqs]):
             for c in self.contexts:
                 c.progress()
         for rq in reqs:
@@ -1155,6 +1161,11 @@ def main(argv=None) -> int:
                        "ranks": n, "count": count, "size_bytes": size,
                        "iters": args.iters,
                        **{k: round(v, 3) for k, v in st.items()}}
+                from .. import integrity as _integ
+                if _integ.ENABLED:
+                    # overhead numbers are meaningless without the mode
+                    # that produced them on the record
+                    rec["integrity"] = _integ.MODE
                 if args.full:
                     rec["busbw_GBps"] = round(bw, 3)
                 if qd is not None:

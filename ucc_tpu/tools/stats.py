@@ -168,6 +168,36 @@ def print_qos(snap: Dict[str, Any], out=None) -> None:
           "and coalescing off?)\n")
 
 
+def print_integrity(snap: Dict[str, Any], out=None) -> None:
+    """Focused data-integrity view (``--integrity``): the
+    ``integrity_*`` counter family the wire-checksum / attestation /
+    quarantine machinery emits, plus a derived detection ratio."""
+    w = (out or sys.stdout).write
+    w(f"# integrity view: pid {snap.get('pid')} uptime "
+      f"{snap.get('uptime_s')}s\n")
+    counters = snap.get("counters") or {}
+    rows = []
+    for name in ("integrity_wire_mismatch", "integrity_digest_checks",
+                 "integrity_digest_mismatch", "integrity_quarantines",
+                 "rank_failures_detected"):
+        for k, v in sorted((counters.get(name) or {}).items()):
+            rows.append((name, k, v))
+    if rows:
+        w("\n[integrity]\n")
+        for name, k, v in rows:
+            w(f"  {name:<28} {_fmt_key(k):<40} {_fmt_val(v)}\n")
+        checks = sum((counters.get("integrity_digest_checks") or {})
+                     .values())
+        hits = sum((counters.get("integrity_digest_mismatch") or {})
+                   .values())
+        if checks:
+            w(f"\n  digest mismatch ratio: {hits}/{int(checks)} "
+              f"({100.0 * hits / checks:.2f}%)\n")
+    else:
+        w("  no integrity_* series in this snapshot "
+          "(UCC_INTEGRITY off or no traffic)\n")
+
+
 def diff_snapshots(old: Dict[str, Any], new: Dict[str, Any],
                    out=None) -> None:
     w = (out or sys.stdout).write
@@ -256,6 +286,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="print only the multi-tenant QoS view: queue-"
                          "wait histogram, coalesce batch sizes, "
                          "contention counters")
+    ap.add_argument("--integrity", action="store_true",
+                    help="print only the data-integrity view: wire crc "
+                         "mismatches, attestation digest checks, "
+                         "quarantines")
     ap.add_argument("--watch", type=float, metavar="SECS", default=None,
                     help="live mode: re-read the file every SECS seconds "
                          "and print the per-interval delta")
@@ -283,6 +317,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         if args.qos:
             print_qos(snapsets[0][0 if args.first else -1])
+        elif args.integrity:
+            print_integrity(snapsets[0][0 if args.first else -1])
         elif len(snapsets) == 2:
             diff_snapshots(snapsets[0][-1], snapsets[1][-1])
         elif args.self_diff:
