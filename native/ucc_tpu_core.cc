@@ -68,8 +68,14 @@ namespace {
 // (4: native execution plans — ucc_plan_build/post/test/cancel retire a
 // verified DSL program's whole round schedule against the mailbox in C++;
 // 5: wire integrity — per-entry crc32 word, kCorrupt completion state,
-// ucc_mailbox_set_integrity / ucc_mailbox_push2)
-constexpr uint64_t kAbiVersion = 5;
+// ucc_mailbox_set_integrity / ucc_mailbox_push2;
+// 6: cross-process shared-memory arenas — ucc_mailbox_attach and the
+// ucc_ipc_*/ucc_arena_* surface in ucc_tpu_ipc.cc: the tag-match
+// structures, completion-publication slots and payload heap live in one
+// mmap'd POSIX shm segment per node, so ranks in different processes
+// match and deliver with the same direct/eager/rndv/fenced contracts as
+// the in-process mailbox)
+constexpr uint64_t kAbiVersion = 6;
 }  // namespace
 
 // The thin extension build (-DUCC_TPU_EXT_THIN) compiles ONLY the CPython

@@ -854,3 +854,48 @@ class TestGeneratedFaults:
                           matrix=("allreduce",))
         assert report["hangs"] == [], report["hangs"]
         assert report["iterations"] == 20
+
+
+# ---------------------------------------------------------------------------
+# pooled-tier gating knobs (UCC_POOL_ENABLE / UCC_POOL_CHUNKS)
+# ---------------------------------------------------------------------------
+
+class TestPoolKnobs:
+    """The pooled family gets its own gates so an operator can drop or
+    re-grid the one-sided window variants without rewriting
+    UCC_GEN_FAMILIES (the windows pin arena heap for the team's life)."""
+
+    def test_disable_drops_pooled_even_when_named(self, monkeypatch):
+        monkeypatch.setenv("UCC_POOL_ENABLE", "n")
+        fams = genreg._apply_pool_knobs(
+            None, genreg.parse_families("pooled(1,2),ring(2)"))
+        assert "pooled" not in fams
+        assert fams["ring"] == [2]
+
+    def test_force_adds_pooled_at_default_grid(self, monkeypatch):
+        monkeypatch.setenv("UCC_POOL_ENABLE", "y")
+        monkeypatch.delenv("UCC_POOL_CHUNKS", raising=False)
+        fams = genreg._apply_pool_knobs(
+            None, genreg.parse_families("ring(2)"))
+        assert fams["pooled"] == list(fam.DEFAULT_GRIDS["pooled"])
+
+    def test_chunks_regrids(self, monkeypatch):
+        monkeypatch.delenv("UCC_POOL_ENABLE", raising=False)
+        monkeypatch.setenv("UCC_POOL_CHUNKS", "4,2,4")
+        fams = genreg._apply_pool_knobs(
+            None, genreg.parse_families("pooled(1)"))
+        assert fams["pooled"] == [2, 4]
+
+    def test_auto_keeps_spec(self, monkeypatch):
+        monkeypatch.delenv("UCC_POOL_ENABLE", raising=False)
+        monkeypatch.delenv("UCC_POOL_CHUNKS", raising=False)
+        fams = genreg._apply_pool_knobs(
+            None, genreg.parse_families("pooled(1,2)"))
+        assert fams["pooled"] == [1, 2]
+
+    def test_bad_chunks_raises(self, monkeypatch):
+        from ucc_tpu.status import UccError
+        monkeypatch.setenv("UCC_POOL_CHUNKS", "1,zero")
+        with pytest.raises(UccError):
+            genreg._apply_pool_knobs(
+                None, genreg.parse_families("pooled(1)"))

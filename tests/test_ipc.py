@@ -1,0 +1,523 @@
+"""TL/IPC cross-process integration — the mmap-arena transport across a
+REAL process boundary.
+
+Three layers of coverage:
+
+- arena-level probes (2 OS processes attached to one named arena) that
+  pin the match-order kinds deterministically: posted-recv direct
+  delivery, unexpected-eager, unexpected-rndv, and the epoch-fence
+  stale-send discard;
+- the collective matrix over 2 processes x 4 ranks (2 rank threads per
+  process, TcpStoreOob bootstrap, ``UCC_TLS=ipc,self``) with the shared
+  arena's ``n_direct`` asserted and every result checked;
+- the pooled (one-sided window) tier: verifier gating of put programs
+  and forced execution of the ``gen_pooled`` allreduce variants on an
+  in-process ipc team, asserting ``n_pooled``/window counters tick.
+"""
+import multiprocessing as mp
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    sys.platform != "linux", reason="POSIX shm arenas are linux-only")
+
+
+def _native_ok() -> bool:
+    from ucc_tpu import native
+    return native.get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# arena-level: deterministic match-order kinds across a process boundary
+# ---------------------------------------------------------------------------
+
+_PROBE_EAGER = 1024          # push-time eager threshold for the probes
+_PROBE_TEAM = ("ipc-probe", 0)
+
+
+def _probe_key(tag: int, epoch: int = 1):
+    # TagKey shape the arena packs natively: (team, epoch, tag, slot, src)
+    return (_PROBE_TEAM, epoch, tag, 0, 0)
+
+
+def _arena_probe_worker(role: int, name: str, bar, q):
+    """role 0 pushes (ctx rank 0), role 1 receives (ctx rank 1). The
+    barrier sequences who acts first so each kind is forced, not raced."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from ucc_tpu import native
+
+        ar = native.IpcArena(name, heap_bytes=8 << 20, win_bytes=1 << 20)
+        ar.register(role)
+        ar.beat(role)
+        out = {"created": ar.created, "pid": os.getpid(), "kinds": {}}
+
+        def spin(req, what):
+            import time
+            deadline = time.monotonic() + 30
+            while not req.test():
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"{what} never completed")
+                time.sleep(0.0005)
+
+        pay_small = (np.arange(512) % 251).astype(np.uint8)
+        pay_big = (np.arange(64 << 10) % 249).astype(np.uint8)
+
+        # -- A: recv posted FIRST -> zero-copy direct delivery ----------
+        if role == 1:
+            dst_a = np.zeros(512, np.uint8)
+            req_a = ar.post_recv(_probe_key(1), 1, dst_a)
+        bar.wait(timeout=60)                       # recv is on the board
+        if role == 0:
+            req, kind = ar.push(_probe_key(1), 1, pay_small, _PROBE_EAGER)
+            out["kinds"]["recv_first"] = kind
+            spin(req, "direct send")
+        bar.wait(timeout=60)
+        if role == 1:
+            spin(req_a, "direct recv")
+            out["recv_first_ok"] = bool(np.array_equal(dst_a, pay_small))
+
+        # -- B: small send FIRST -> unexpected eager --------------------
+        if role == 0:
+            req, kind = ar.push(_probe_key(2), 1, pay_small, _PROBE_EAGER)
+            out["kinds"]["send_first_small"] = kind
+            spin(req, "eager send")
+        bar.wait(timeout=60)                       # unexpected is parked
+        if role == 1:
+            dst_b = np.zeros(512, np.uint8)
+            req_b = ar.post_recv(_probe_key(2), 1, dst_b)
+            spin(req_b, "eager recv")
+            out["send_first_small_ok"] = bool(
+                np.array_equal(dst_b, pay_small))
+        bar.wait(timeout=60)
+
+        # -- C: big send FIRST -> rndv held until the recv lands --------
+        if role == 0:
+            req_c, kind = ar.push(_probe_key(3), 1, pay_big, _PROBE_EAGER)
+            out["kinds"]["send_first_big"] = kind
+            out["rndv_pending"] = not req_c.test()
+        bar.wait(timeout=60)                       # rndv is parked
+        if role == 1:
+            dst_c = np.zeros(64 << 10, np.uint8)
+            req_cr = ar.post_recv(_probe_key(3), 1, dst_c)
+            spin(req_cr, "rndv recv")
+            out["send_first_big_ok"] = bool(np.array_equal(dst_c, pay_big))
+        bar.wait(timeout=60)
+        if role == 0:
+            spin(req_c, "rndv send completion")
+
+        # -- D: epoch fence discards the stale send at the boundary -----
+        if role == 1:
+            ar.fence(_PROBE_TEAM, 2)               # epoch < 2 is dead
+        bar.wait(timeout=60)
+        if role == 0:
+            _, kind = ar.push(_probe_key(4, epoch=1), 1, pay_small,
+                              _PROBE_EAGER)
+            out["kinds"]["stale_epoch"] = kind
+        bar.wait(timeout=60)
+
+        # liveness board: each side sees the OTHER process's pid
+        out["peer_pid"] = ar.peer_pid(1 - role)
+        out["peer_beat_ms"] = ar.beat_age_ms(1 - role)
+        out["counters"] = ar.counters()
+        ar.detach(unlink=bool(ar.created))
+        q.put((role, out))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        q.put((role, {"error": f"{e}\n{traceback.format_exc()}"}))
+
+
+def test_arena_match_orders_across_processes():
+    """direct / eager / rndv / fenced — each kind forced by ordering the
+    two processes with a barrier, payloads verified byte-for-byte."""
+    if not _native_ok():
+        pytest.skip("native core unavailable")
+    name = f"ucc-ipctest-{os.getpid()}"
+    ctx = mp.get_context("spawn")
+    bar = ctx.Barrier(2)
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_arena_probe_worker, args=(r, name, bar, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            role, res = q.get(timeout=120)
+            results[role] = res
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+        try:
+            os.unlink("/dev/shm/" + name)
+        except OSError:
+            pass
+    for role in (0, 1):
+        assert "error" not in results[role], results[role].get("error")
+    snd, rcv = results[0], results[1]
+    assert snd["pid"] != rcv["pid"]
+    # the kind classification, per match order
+    assert snd["kinds"]["recv_first"] == "direct"
+    assert snd["kinds"]["send_first_small"] == "eager"
+    assert snd["kinds"]["send_first_big"] == "rndv"
+    assert snd["rndv_pending"], "rndv send completed before the recv"
+    assert snd["kinds"]["stale_epoch"] == "fenced"
+    # payloads crossed the boundary intact
+    assert rcv["recv_first_ok"]
+    assert rcv["send_first_small_ok"]
+    assert rcv["send_first_big_ok"]
+    # shared counters saw every kind exactly where expected
+    ctr = snd["counters"]
+    assert ctr["n_direct"] >= 1
+    assert ctr["n_eager"] >= 1
+    assert ctr["n_rndv"] >= 1
+    assert ctr["n_fenced"] >= 1
+    assert ctr["attaches"] >= 2
+    assert ctr["bytes_moved"] >= 512 + 512 + (64 << 10)
+    # liveness board crossed the boundary too
+    assert snd["peer_pid"] == rcv["pid"]
+    assert rcv["peer_pid"] == snd["pid"]
+    assert snd["peer_beat_ms"] is not None
+
+
+# ---------------------------------------------------------------------------
+# collective matrix over 2 processes x 4 ranks
+# ---------------------------------------------------------------------------
+
+def _ipc_rank_main(rank: int, size: int, port: int, lib, results: dict):
+    import ucc_tpu
+    from ucc_tpu import (BufferInfo, CollArgs, CollType, ContextParams,
+                         DataType, ReductionOp, TcpStoreOob, TeamParams)
+
+    oob = TcpStoreOob(rank, size, port=port)
+    ctx = ucc_tpu.Context(lib, ContextParams(oob=oob))
+    team_oob = TcpStoreOob(rank, size, port=port + 1)
+    team = ctx.create_team(TeamParams(oob=team_oob))
+    res = {}
+
+    def run(args):
+        req = team.collective_init(args)
+        req.post()
+        req.wait(timeout=120)
+        req.finalize()
+
+    # allreduce (small) + allreduce (big: several chunks past the 8K
+    # eager threshold, so the boundary carries large payloads too)
+    for label, count in (("allreduce", 1024), ("allreduce_big", 65536)):
+        src = np.full(count, rank + 1.0, np.float32)
+        dst = np.zeros(count, np.float32)
+        run(CollArgs(coll_type=CollType.ALLREDUCE,
+                     src=BufferInfo(src, count, DataType.FLOAT32),
+                     dst=BufferInfo(dst, count, DataType.FLOAT32),
+                     op=ReductionOp.SUM))
+        res[label] = (float(dst[0]), float(dst[-1]))
+
+    buf = np.full(64, 7.0, np.float64) if rank == 1 else \
+        np.zeros(64, np.float64)
+    run(CollArgs(coll_type=CollType.BCAST, root=1,
+                 src=BufferInfo(buf, 64, DataType.FLOAT64)))
+    res["bcast"] = float(buf[0])
+
+    src = np.full(16, rank * 10.0, np.float32)
+    dst = np.zeros(16 * size, np.float32)
+    run(CollArgs(coll_type=CollType.ALLGATHER,
+                 src=BufferInfo(src, 16, DataType.FLOAT32),
+                 dst=BufferInfo(dst, 16 * size, DataType.FLOAT32)))
+    res["allgather"] = dst[::16].tolist()
+
+    src = (np.arange(4 * size) + rank).astype(np.float32)
+    dst = np.zeros(4, np.float32)
+    run(CollArgs(coll_type=CollType.REDUCE_SCATTER,
+                 src=BufferInfo(src, 4 * size, DataType.FLOAT32),
+                 dst=BufferInfo(dst, 4, DataType.FLOAT32),
+                 op=ReductionOp.SUM))
+    res["reduce_scatter"] = dst.tolist()
+
+    src = np.arange(2 * size, dtype=np.int32) + 100 * rank
+    dst = np.zeros(2 * size, np.int32)
+    run(CollArgs(coll_type=CollType.ALLTOALL,
+                 src=BufferInfo(src, 2 * size, DataType.INT32),
+                 dst=BufferInfo(dst, 2 * size, DataType.INT32)))
+    res["alltoall"] = dst.tolist()
+
+    run(CollArgs(coll_type=CollType.BARRIER))
+    res["barrier"] = "ok"
+
+    # the ipc endpoint MUST be under this team (ipc,self leaves no other
+    # transport); harvest its counters before teardown
+    tr = None
+    for _k, t in team._tl_tag_spaces():
+        if getattr(t, "arena", None) is not None:
+            tr = t
+    assert tr is not None, "team did not select the ipc TL"
+    res["tl"] = {"n_direct": tr.n_direct, "n_eager": tr.n_eager,
+                 "n_rndv": tr.n_rndv, "n_fenced": tr.n_fenced}
+    res["arena"] = tr.counters()
+    res["occupancy"] = tr.occupancy()
+    results[rank] = res
+    team.destroy()
+    ctx.destroy()
+    if rank == 0:
+        oob.close()
+
+
+def _ipc_matrix_worker(ranks, size: int, port: int, q):
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["UCC_TLS"] = "ipc,self"     # arena or bust
+        import ucc_tpu
+        # component discovery is not re-entrant — init the per-rank libs
+        # on the worker main thread, only the data path runs threaded
+        libs = {r: ucc_tpu.init() for r in ranks}
+        results: dict = {}
+        errs: list = []
+
+        def main(r):
+            try:
+                _ipc_rank_main(r, size, port, libs[r], results)
+            except Exception as e:  # noqa: BLE001
+                import traceback
+                errs.append((r, f"{e}\n{traceback.format_exc()}"))
+
+        ths = [threading.Thread(target=main, args=(r,)) for r in ranks]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=180)
+        for r, msg in errs:
+            results[r] = {"error": msg}
+        for r in ranks:
+            q.put((r, results.get(r, {"error": "rank thread hung"})))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        for r in ranks:
+            q.put((r, {"error": f"{e}\n{traceback.format_exc()}"}))
+
+
+def test_ipc_two_process_matrix():
+    """2 OS processes x 2 rank threads each: the collective matrix over
+    the shared arena, results checked and n_direct asserted — traffic
+    between the processes rides mmap'd memory, not sockets."""
+    if not _native_ok():
+        pytest.skip("native core unavailable")
+    from test_socket_tl import _free_port_pair
+    size = 4
+    port = _free_port_pair()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_ipc_matrix_worker,
+                         args=(split, size, port, q))
+             for split in ((0, 1), (2, 3))]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(size):
+            rank, res = q.get(timeout=240)
+            results[rank] = res
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    for r in range(size):
+        assert "error" not in results[r], results[r].get("error")
+    for r in range(size):
+        res = results[r]
+        assert res["allreduce"] == (10.0, 10.0)          # 1+2+3+4
+        assert res["allreduce_big"] == (10.0, 10.0)
+        assert res["bcast"] == 7.0
+        assert res["allgather"] == [0.0, 10.0, 20.0, 30.0]
+        # reduce_scatter: sum over ranks of (i + rank) on my 4-slice
+        base = [sum(4 * r + i + p for p in range(size)) for i in range(4)]
+        assert res["reduce_scatter"] == [float(v) for v in base]
+        expect = [100 * p + r * 2 + i for p in range(size)
+                  for i in range(2)]
+        assert res["alltoall"] == expect
+        assert res["barrier"] == "ok"
+    # the arena's counters are SHARED: any rank's snapshot covers all.
+    # Posted-recv direct delivery must have fired (recvs are posted at
+    # round start, well before the payload lands), and with 4 contexts
+    # attached the attach counter proves both processes mapped it.
+    ctr = results[0]["arena"]
+    assert ctr["n_direct"] > 0
+    assert ctr["attaches"] >= 4
+    assert ctr["bytes_moved"] > 0
+    moved = sum(results[r]["tl"]["n_direct"] + results[r]["tl"]["n_eager"]
+                + results[r]["tl"]["n_rndv"] for r in range(size))
+    assert moved > 0
+
+
+# ---------------------------------------------------------------------------
+# pooled tier: verifier gating + forced execution on an ipc team
+# ---------------------------------------------------------------------------
+
+def test_pooled_generator_verifies():
+    from ucc_tpu.dsl.families import gen_pooled
+    from ucc_tpu.dsl.verify import verify
+    for n in (2, 3, 4, 8):
+        for chunks in (1, 2):
+            verify(gen_pooled(n, chunks))
+
+
+def test_pooled_verifier_rejects_hazards():
+    from ucc_tpu.constants import CollType
+    from ucc_tpu.dsl.ir import ProgramBuilder
+    from ucc_tpu.dsl.verify import VerifyError, verify
+
+    # two overwriting puts into one chunk: one silently wins
+    b = ProgramBuilder("pooled", CollType.BCAST, nranks=3, nchunks=1)
+    b.next_round()
+    b.put(0, 0, to=2)
+    b.put(1, 0, to=2)
+    with pytest.raises(VerifyError):
+        verify(b.build("bad_double_put"))
+
+    # an overwriting put mixed with a recv into the same chunk
+    b = ProgramBuilder("pooled", CollType.BCAST, nranks=3, nchunks=1)
+    b.next_round()
+    b.put(0, 0, to=2)
+    b.send(1, 0, to=2)
+    b.recv(2, 0, frm=1)
+    with pytest.raises(VerifyError):
+        verify(b.build("bad_put_recv_mix"))
+
+    # puts never carry a wire codec (the pooled tier is exact)
+    b = ProgramBuilder("pooled", CollType.ALLREDUCE, nranks=2, nchunks=1,
+                       wire="f16")
+    b.next_round()
+    b.put_red(0, 0, to=1)
+    b.put_red(1, 0, to=0)
+    with pytest.raises(VerifyError):
+        verify(b.build("bad_wire_put"))
+
+
+def test_pooled_allreduce_forced(monkeypatch):
+    """Both gen_pooled grid variants execute a 4-rank SUM allreduce via
+    one-sided window puts on the arena, selected by forced_request with
+    origin='pooled' provenance; n_pooled and the window counters tick."""
+    if not _native_ok():
+        pytest.skip("native core unavailable")
+    monkeypatch.setenv("UCC_GEN", "y")
+    monkeypatch.setenv("UCC_TLS", "ipc,self")
+    monkeypatch.setenv("UCC_TL_IPC_ENABLE", "y")
+    from harness import UccJob
+    from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType,
+                         ReductionOp, Status)
+    from ucc_tpu.constants import MemoryType
+    from ucc_tpu.score.tuner import forced_request, sweep_candidates
+
+    n, msg = 4, 4096
+    count = msg // 4
+    job = UccJob(n)
+    try:
+        teams = job.create_team()
+        cands = sweep_candidates(teams[0], CollType.ALLREDUCE,
+                                 MemoryType.HOST, msg)
+        pooled = [i for i, c in enumerate(cands) if c.origin == "pooled"]
+        assert pooled, "no pooled candidates registered for the sweep"
+        for idx in pooled:
+            srcs = [np.random.default_rng(100 + r)
+                    .standard_normal(count).astype(np.float32)
+                    for r in range(n)]
+            expect = np.sum(srcs, axis=0)
+            dsts = [np.zeros(count, np.float32) for _ in range(n)]
+            reqs = [forced_request(
+                teams[r],
+                CollArgs(coll_type=CollType.ALLREDUCE,
+                         src=BufferInfo(srcs[r], count, DataType.FLOAT32),
+                         dst=BufferInfo(dsts[r], count, DataType.FLOAT32),
+                         op=ReductionOp.SUM),
+                CollType.ALLREDUCE, MemoryType.HOST, msg, idx)
+                for r in range(n)]
+            for rq in reqs:
+                rq.post()
+            job.progress_until(lambda: all(
+                rq.test() != Status.IN_PROGRESS for rq in reqs))
+            sts = [rq.test() for rq in reqs]
+            assert all(s == Status.OK for s in sts), sts
+            for rq in reqs:
+                rq.finalize()
+            for r in range(n):
+                np.testing.assert_allclose(dsts[r], expect, rtol=1e-5)
+        # the data path was the window tier, not the mailbox
+        tr = None
+        for _k, t in teams[0]._tl_tag_spaces():
+            if getattr(t, "arena", None) is not None:
+                tr = t
+        assert tr is not None, "pooled run did not ride the ipc TL"
+        assert getattr(tr, "n_pooled", 0) > 0
+        ctr = tr.counters()
+        assert ctr["windows"] > 0
+        assert ctr["window_bytes"] > 0
+    finally:
+        job.cleanup()
+
+
+def test_pooled_needs_arena(monkeypatch):
+    """Without an ipc arena under the team the pooled variant must bow
+    out with ERR_NOT_SUPPORTED at init (fallback keeps the walk alive),
+    never crash or produce wrong data."""
+    monkeypatch.setenv("UCC_GEN", "y")
+    monkeypatch.setenv("UCC_TLS", "shm,self")      # no arena transport
+    from harness import UccJob
+    from ucc_tpu import (BufferInfo, CollArgs, CollType, DataType,
+                         ReductionOp, Status, UccError)
+    from ucc_tpu.constants import MemoryType
+    from ucc_tpu.score.tuner import forced_request, sweep_candidates
+
+    n, msg = 2, 1024
+    count = msg // 4
+    job = UccJob(n)
+    try:
+        teams = job.create_team()
+        cands = sweep_candidates(teams[0], CollType.ALLREDUCE,
+                                 MemoryType.HOST, msg)
+        pooled = [i for i, c in enumerate(cands) if c.origin == "pooled"]
+        if not pooled:
+            pytest.skip("pooled candidates not in this comp's sweep")
+        idx = pooled[0]
+        src = np.ones(count, np.float32)
+        dst = np.zeros(count, np.float32)
+        args = CollArgs(coll_type=CollType.ALLREDUCE,
+                        src=BufferInfo(src, count, DataType.FLOAT32),
+                        dst=BufferInfo(dst, count, DataType.FLOAT32),
+                        op=ReductionOp.SUM)
+        try:
+            rq = forced_request(teams[0], args, CollType.ALLREDUCE,
+                                MemoryType.HOST, msg, idx)
+        except UccError as e:
+            assert e.status == Status.ERR_NOT_SUPPORTED
+        else:
+            rq.post()
+            st = rq.test()
+            assert st in (Status.ERR_NOT_SUPPORTED, Status.IN_PROGRESS)
+    finally:
+        job.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# cross-process kill -> agree -> shrink -> resume (fault/soak.py --procs)
+# ---------------------------------------------------------------------------
+
+def test_procs_kill_shrink_drill():
+    """One whole PROCESS SIGKILLed mid-soak: survivors in the other
+    process must detect via the arena pid board, agree on the dead set,
+    shrink, and run a checked matrix on the shrunk team (the --procs
+    drill, end to end)."""
+    from ucc_tpu.fault.soak import run_procs_kill_shrink
+    report = run_procs_kill_shrink(n_procs=2, ranks_per=2, pre_iters=1,
+                                   post_iters=6)
+    assert report["violations"] == [], report
+    for r in (0, 1):
+        rep = report["per_rank"][r]
+        assert rep["detected"]["status"] == "ERR_RANK_FAILED"
+        assert set(rep["detected"]["ranks"]) & {2, 3}
+        assert set(rep["agreed"]["dead"]) >= {2, 3}
+        assert rep["post"] == 6

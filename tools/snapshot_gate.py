@@ -1142,6 +1142,102 @@ def _integrity_smoke(env) -> None:
           flush=True)
 
 
+def _ipc_baseline() -> float:
+    """Best arena-vs-socket p50 speedup from the committed BENCH_r20
+    evidence (0.0 when the file is missing/unparseable)."""
+    import json
+    try:
+        with open(os.path.join(REPO, "BENCH_r20.json")) as f:
+            for ln in f:
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue
+                if rec.get("metric") == "xproc_ipc_vs_socket_p50_speedup":
+                    return float(rec.get("value") or 0.0)
+    except OSError:
+        pass
+    return 0.0
+
+
+def _ipc_smoke(env) -> None:
+    """WARN-ONLY cross-process transport probe (ISSUE 20 CI satellite):
+    run the 2-proc x 4-rank arena-vs-socket bench (``bench.py --ipc``)
+    at a trimmed size set and compare the best arena-tier speedup
+    against the committed BENCH_r20 baseline with a tolerance band
+    (UCC_GATE_IPC_TOL, default 40% — the ratio of two p50s on a noisy
+    box). Classifies the failure mode that matters for a shared-memory
+    transport: HANG (a rank parked across the process boundary —
+    matching or fence bug), ATTACH FAILURE (a leg died setting up the
+    arena/teams), and REGRESSION (speedup below the band). Never flips
+    the gate. Skip with UCC_GATE_IPC=0."""
+    import json
+    if os.environ.get("UCC_GATE_IPC", "1").strip().lower() in \
+            ("0", "n", "no", "off"):
+        print("[gate] ipc smoke: skipped (UCC_GATE_IPC=0)", flush=True)
+        return
+    try:
+        tol = float(os.environ.get("UCC_GATE_IPC_TOL", "0.40"))
+    except ValueError:
+        tol = 0.40
+    base = _ipc_baseline()
+    print("[gate] cross-process transport smoke (warn-only) ...",
+          flush=True)
+    t0 = time.monotonic()
+    # trimmed cells: one latency-bound, one at the matched-path ceiling,
+    # one bandwidth-bound pooled/socket-only; the gate's watchdog/stats
+    # arming stays out of the child for the same reason as _perf_smoke
+    smoke_env = {k: v for k, v in env.items()
+                 if not k.startswith(("UCC_WATCHDOG", "UCC_FAULT",
+                                      "UCC_STATS", "UCC_PROFILE"))}
+    smoke_env["UCC_XPROC_SIZES"] = "64K,8M,32M"
+    smoke_env["UCC_XPROC_ITERS"] = "6"
+    try:
+        r = subprocess.run([sys.executable, "bench.py", "--ipc"],
+                           cwd=REPO, env=smoke_env, capture_output=True,
+                           text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        print("[gate] WARN: ipc smoke timed out — HANG class (a rank "
+              "parked across the process boundary; not a gate failure)",
+              flush=True)
+        return
+    summary, error = None, None
+    for ln in (r.stdout or "").splitlines():
+        if not ln.startswith("{"):
+            continue
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        detail = rec.get("detail") or {}
+        if detail.get("error"):
+            error = f"{detail.get('transport')}: {detail['error']}"
+        if rec.get("metric") == "xproc_ipc_vs_socket_p50_speedup":
+            summary = rec
+    dt = time.monotonic() - t0
+    if error:
+        print(f"[gate] WARN: ipc smoke — ATTACH/RUN FAILURE on leg "
+              f"{error} in {dt:.0f}s (not a gate failure)", flush=True)
+        return
+    if summary is None:
+        print(f"[gate] WARN: ipc smoke — rc={r.returncode}, no speedup "
+              f"summary in {dt:.0f}s (not a gate failure)", flush=True)
+        return
+    value = float(summary.get("value") or 0.0)
+    per_size = (summary.get("detail") or {}).get("per_size") or {}
+    if base:
+        floor = base * (1.0 - tol)
+        verdict = "OK" if value >= floor else \
+            f"WARN: REGRESSION below baseline {base:.2f}x - " \
+            f"{tol:.0%} tolerance"
+    else:
+        floor = 0.0
+        verdict = "OK (no baseline recorded)"
+    print(f"[gate] ipc smoke: arena-vs-socket p50 speedup {value:.2f}x "
+          f"(baseline {base:.2f}x, floor {floor:.2f}x, per-size "
+          f"{per_size}) in {dt:.0f}s -> {verdict}", flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -1248,6 +1344,10 @@ def main(argv=None) -> int:
         # quarantines it, and the shrunk team runs a checked matrix —
         # classified silent-vs-detected-vs-hang (ISSUE 19)
         _integrity_smoke(env)
+        # warn-only: the cross-process arena + pooled tier hold their
+        # speedup over the socket TL on the 2-proc bench, classified
+        # hang-vs-attach-failure-vs-regression (ISSUE 20)
+        _ipc_smoke(env)
     print(f"[gate] {'PASS — safe to commit' if ok else 'FAIL — do NOT commit'}")
     return 0 if ok else 1
 

@@ -248,6 +248,18 @@ GLOBAL_CONFIG = register_table(ConfigTable(prefix="", name="global", fields=[
                 "schedule) on the virtual CPU mesh; xla / pallas force "
                 "one backend (pallas on CPU runs interpret-mode — the "
                 "test path)", parse_string),
+    ConfigField("POOL_ENABLE", "auto", "pooled (one-sided put+flag "
+                "window) variants of the generated families: auto = "
+                "whatever UCC_GEN_FAMILIES produced; n drops the pooled "
+                "family even if the spec named it (its windows pin "
+                "arena heap for the life of the team); y forces it in "
+                "at its grid when the spec left it out. Requires "
+                "UCC_GEN=y and an arena-backed (ipc) team to retire "
+                "through", parse_string),
+    ConfigField("POOL_CHUNKS", "", "chunk-count grid for the pooled "
+                "variants, e.g. '1,2,4' — replaces the default grid "
+                "(1,2) without rewriting UCC_GEN_FAMILIES",
+                parse_string),
     # multi-tenant service knobs (ISSUE 18): read from the environment at
     # import by schedule/progress.py, core/team.py, and core/coalesce.py
     # (same zero-cost pattern as the obs knobs); listed here so

@@ -37,7 +37,7 @@ from ..status import Status, UccError
 from ..tl.base import binfo_typed
 from ..tl.host.task import HostCollTask
 from ..utils.mathutils import block_count, block_offset
-from .ir import OpKind, Program
+from .ir import PUT_KINDS, OpKind, Program
 
 _F32 = np.dtype(np.float32)
 _DT_F32 = DataType.FLOAT32
@@ -168,6 +168,14 @@ class GeneratedCollTask(HostCollTask):
                     f"quantized {qp.mode} predicted error exceeds "
                     f"error budget {qp.budget:.4f}")
             self.qp = qp
+        # pooled tier (one-sided window puts): programs with PUT /
+        # PUT_RED edges retire those edges through the process-shared
+        # arena — resolved and window-allocated once at init so a full
+        # window table degrades to a clean NOT_SUPPORTED fallback
+        # instead of failing mid-collective
+        self._pool_rounds = None
+        if program.uses_windows:
+            self._pool_setup(team, program)
         # my instruction stream, split per round into wire/local phases
         # once at init (posts interpret the precompiled lists)
         self._rounds: List[Tuple[list, list, list]] = []
@@ -201,11 +209,11 @@ class GeneratedCollTask(HostCollTask):
         self._plan_active = False
         self._plan_harvested = True
         if self.coll != CollType.ALLREDUCE or self._edge_wire or \
-                self.root:
+                self.root or program.uses_windows:
             # plans lower the allreduce contract (dst-vector chunk
             # offsets, SUM-tree reductions, AVG end scale); the new
-            # collectives, per-edge-quantized programs and rotated
-            # bcast roots interpret
+            # collectives, per-edge-quantized programs, rotated bcast
+            # roots and window (pooled) programs interpret
             return
         try:
             from . import plan as _plan_mod
@@ -224,6 +232,141 @@ class GeneratedCollTask(HostCollTask):
         nch = self.prog.nchunks
         return [(block_offset(self.count, nch, c),
                  block_count(self.count, nch, c)) for c in range(nch)]
+
+    # ------------------------------------------------------------------
+    # pooled tier: one-sided put+flag windows in the process-shared arena
+    #
+    # Window identity is writer-side — ("pool", team_key, epoch, slot,
+    # writer ctx rank, payload bytes) — so a fan-out put (one chunk to
+    # many peers this round) shares ONE window every target reads. Cell
+    # layout: [flag 8B][acks: nranks x 8B][payload], header rounded to
+    # 64 so payload views stay element-aligned. The writer waits for
+    # every target's ack to reach the PREVIOUS sequence (SPSC reuse
+    # guard), copies the chunk, then releases flag = seq; each reader
+    # spins its flag to seq, consumes straight out of the mapped window
+    # (reduce directly from the view — the zero-copy half of the tier)
+    # and acks. seq is the per-team lockstep coll tag + 1 (nonzero,
+    # monotonic), so epochs/windows never see an ABA value; rank-local
+    # write ordering between overlapping collectives on the same window
+    # comes from a per-team claims ticket (claim BEFORE the first yield).
+    # A cancel mid-publish can strand a claimed-but-never-released seq;
+    # that is the team-failure path — recovery shrinks, the epoch bump
+    # re-keys every window fresh.
+    def _pool_setup(self, team, program: Program) -> None:
+        if program.wire or self._edge_wire:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "window programs are exact (no wire codec)")
+        arena = getattr(team.transport, "arena", None)
+        if arena is None:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "pooled program needs a shared-memory arena "
+                           "(ipc TL)")
+        self._pool_arena = arena
+        n = program.nranks
+        # header: flag + per-program-rank ack word, 64-aligned payload
+        self._pool_hdr = -(-(8 + 8 * n) // 64) * 64
+        out_rounds: List[list] = []
+        in_rounds: List[list] = []
+        for k in range(len(program.ranks[self._prog_rank].rounds)):
+            groups: dict = {}
+            for op in program.ranks[self._prog_rank].rounds[k]:
+                if op.kind in PUT_KINDS:
+                    g = groups.setdefault(op.slot, (op.chunk, op.kind, []))
+                    g[2].append(op.peer)
+            out_rounds.append([(slot,) + groups[slot]
+                               for slot in sorted(groups)])
+            inc = []
+            for p in range(n):
+                if p == self._prog_rank:
+                    continue
+                for op in program.ranks[p].rounds[k]:
+                    if op.kind in PUT_KINDS and op.peer == self._prog_rank:
+                        inc.append((p, op.slot, op.chunk, op.kind))
+            # overwrites apply before reductions (the verifier's order),
+            # then deterministic (source, slot) for reproducible sums
+            inc.sort(key=lambda t: (t[3] == OpKind.PUT_RED, t[0], t[1]))
+            in_rounds.append(inc)
+        self._pool_out = out_rounds
+        self._pool_in = in_rounds
+        self._pool_resolve()
+
+    def _pool_resolve(self) -> None:
+        """(Re)resolve every window this task touches for the CURRENT
+        count — payload bytes are part of the window identity, so a
+        retargeted count maps to its own windows. Raises NOT_SUPPORTED
+        (→ fallback walk / tuner unsupported record) when the arena's
+        window table or heap is exhausted."""
+        arena = self._pool_arena
+        esz = dt_numpy(self.dt).itemsize
+        bounds = self._chunk_bounds()
+        hdr = self._pool_hdr
+        tk = self.tl_team.team_key
+        ep = self.tl_team.team_epoch
+
+        def win(src_prog_rank: int, slot: int, chunk: int):
+            nb = bounds[chunk][1] * esz
+            src_ctx = self._ctx_of(self._peer(src_prog_rank))
+            woff = arena.window(("pool", tk, ep, slot, src_ctx, nb),
+                                hdr + nb)
+            if not woff:
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               "arena window table/heap exhausted")
+            return woff, nb
+
+        rounds = []
+        for groups, inc in zip(self._pool_out, self._pool_in):
+            o = []
+            for slot, chunk, kind, targets in groups:
+                woff, nb = win(self._prog_rank, slot, chunk)
+                o.append((woff, chunk, kind, targets, nb))
+            i = []
+            for p, slot, chunk, kind in inc:
+                woff, nb = win(p, slot, chunk)
+                i.append((woff, chunk, kind, nb))
+            rounds.append((o, i))
+        self._pool_rounds = rounds
+        self._pool_count = self.count
+
+    def _pool_publish(self, out, vec, bounds, seq, claims):
+        """Writer half: claim each window's ticket, wait out the previous
+        occupant's acks, copy my chunk in, release the flag."""
+        arena = self._pool_arena
+        hdr = self._pool_hdr
+        tr = self.tl_team.transport
+        for woff, chunk, kind, targets, nb in out:
+            prev = claims.get(woff)
+            if prev is None:
+                prev = arena.load_acquire(woff)
+            claims[woff] = seq         # ticket taken before any yield
+            for t in targets:
+                aoff = woff + 8 + 8 * t
+                while arena.load_acquire(aoff) != prev:
+                    yield
+            off, cnt = bounds[chunk]
+            arena.view(woff + hdr, nb)[:] = \
+                vec[off:off + cnt].view(np.uint8)
+            self.data_committed = True
+            arena.store_release(woff, seq)
+            tr.n_pooled = getattr(tr, "n_pooled", 0) + 1
+
+    def _pool_consume(self, inc, vec, bounds, seq, nd, red_op):
+        """Reader half: spin each incoming window's flag to this post's
+        seq, apply the payload straight from the mapped view (overwrite
+        or reduce — no staging copy), then ack."""
+        arena = self._pool_arena
+        hdr = self._pool_hdr
+        my_ack = 8 + 8 * self._prog_rank
+        for woff, chunk, kind, nb in inc:
+            while arena.load_acquire(woff) != seq:
+                yield
+            off, cnt = bounds[chunk]
+            pay = arena.view(woff + hdr, nb).view(nd)
+            if kind == OpKind.PUT:
+                vec[off:off + cnt] = pay
+            else:
+                acc = vec[off:off + cnt]
+                reduce_arrays([acc, pay], red_op, self.dt, out=acc)
+            arena.store_release(woff + my_ack, seq)
 
     def run(self):
         if self._plan is not None:
@@ -449,7 +592,16 @@ class GeneratedCollTask(HostCollTask):
             off, cnt = bounds[c]
             return vec[off:off + cnt]
 
-        for sends, recvs, local in self._rounds:
+        pool = self._pool_rounds
+        if pool is not None:
+            if self._pool_count != self.count:
+                # pipelined-fragment retarget: window geometry is
+                # count-exact, swap to this count's windows
+                self._pool_resolve()
+                pool = self._pool_rounds
+            seq = int(self.tag) + 1
+            claims = self.tl_team.__dict__.setdefault("_pool_claims", {})
+        for rnd, (sends, recvs, local) in enumerate(self._rounds):
             reqs = []
             landings = []
             wire_landings = []
@@ -500,6 +652,11 @@ class GeneratedCollTask(HostCollTask):
                     ri += 1
                     reqs.append(self.recv_nb(peer, tmp, slot=op.slot))
                     landings.append((op.chunk, tmp))
+            if pool is not None and pool[rnd][0]:
+                # publish BEFORE the two-sided wait: peers spinning on
+                # these flags may be the very ranks our recvs need
+                yield from self._pool_publish(pool[rnd][0], vec, bounds,
+                                              seq, claims)
             if reqs:
                 yield from self.wait(*reqs)
             for chunk, tmp in landings:
@@ -513,6 +670,9 @@ class GeneratedCollTask(HostCollTask):
                     qp.codec.decode(w, cnt, qp.block, t)
                     acc = view(op.chunk)
                     reduce_arrays([acc, t], red_op, _DT_F32, out=acc)
+            if pool is not None and pool[rnd][1]:
+                yield from self._pool_consume(pool[rnd][1], vec, bounds,
+                                              seq, nd, red_op)
             for op in local:
                 view(op.chunk)[:] = view(op.src_chunk)
         if coll == CollType.ALLREDUCE and self.op == ReductionOp.AVG:

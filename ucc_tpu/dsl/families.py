@@ -324,6 +324,53 @@ def sra_pipe_fragment(n: int, depth: int,
 
 
 # ---------------------------------------------------------------------------
+# pooled(chunks=m) — one-sided put+flag allreduce over arena windows
+# ---------------------------------------------------------------------------
+
+def gen_pooled(n: int, chunks: int = 1) -> Program:
+    """Pooled-window allreduce (the ipc TL's one-sided tier): two
+    rounds of one-sided puts through process-shared arena windows, no
+    two-sided matching at all.
+
+    Round 0: every rank PUT_REDs each foreign chunk into its owner's
+    window set (owner of chunk ``c`` is rank ``c // m``); the owner
+    reduces the ``n-1`` contributions into its own copy in
+    deterministic source order. Round 1: each owner PUTs the fully
+    reduced chunk back to every other rank — one window per
+    (owner, chunk), read by all ``n-1`` targets (the fan-out put).
+    2 rounds total regardless of team size: the direct exchange's
+    round count with none of its matcher traffic — latency is two
+    flag handoffs, bandwidth is two memcpys per chunk each way.
+
+    ``chunks=m`` splits each owner block into ``m`` cells (more,
+    smaller windows — the transport-pipelining knob the ring families
+    use). Only teams whose transport exposes a shared-memory arena
+    (tl/ipc) can run this; the compiled task raises NOT_SUPPORTED
+    everywhere else and the fallback walk picks a two-sided program.
+    """
+    m = int(chunks)
+    if n < 2:
+        raise Inapplicable(f"pooled needs >= 2 ranks (got {n})")
+    if m < 1:
+        raise Inapplicable(f"pooled chunking must be >= 1 (got {m})")
+    b = ProgramBuilder("pooled", CollType.ALLREDUCE, n, n * m,
+                       params={"chunks": m})
+    b.next_round()
+    for me in range(n):
+        for c in range(n * m):
+            owner = c // m
+            if owner != me:
+                b.put_red(me, c, to=owner)
+    b.next_round()
+    for owner in range(n):
+        for c in range(owner * m, (owner + 1) * m):
+            for peer in range(n):
+                if peer != owner:
+                    b.put(owner, c, to=peer)
+    return b.build(f"gen_pooled_c{m}")
+
+
+# ---------------------------------------------------------------------------
 # allgather families (ISSUE 14: IR beyond allreduce)
 # ---------------------------------------------------------------------------
 
@@ -656,6 +703,7 @@ DEFAULT_GRIDS: Dict[str, List[int]] = {
     "bc_kn": [2, 4, 0],        # 0 = radix n (linear fan-out)
     "bc_chain": [2, 4],
     "hier": [2, 0],            # top algorithm: sra radix / 0 = direct
+    "pooled": [1, 2],          # window cells per owner block (ipc TL)
 }
 
 #: the collective each family serves (registration + search routing)
@@ -666,6 +714,7 @@ FAMILY_COLL: Dict[str, CollType] = {
     "qdirect": CollType.ALLREDUCE,
     "sra": CollType.ALLREDUCE,
     "hier": CollType.ALLREDUCE,
+    "pooled": CollType.ALLREDUCE,
     "ag_ring": CollType.ALLGATHER,
     "ag_rd": CollType.ALLGATHER,
     "rs_ring": CollType.REDUCE_SCATTER,

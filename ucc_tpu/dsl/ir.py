@@ -33,6 +33,22 @@ Op kinds:
     (reduce-scatter-style accumulation).
 ``COPY(chunk, src_chunk)``
     Local chunk-to-chunk copy (applied after the round's deliveries).
+``PUT(chunk, peer)`` / ``PUT_RED(chunk, peer)``
+    One-sided put+flag through a process-shared arena window (the
+    pooled tier): the sender copies chunk ``chunk``'s current content
+    into a named window cell and releases a flag word; the target
+    consumes it at its OWN round ``k`` (the round the put was issued
+    in) — overwriting the chunk (``PUT``) or reducing into it
+    (``PUT_RED``). There is no receiver-side op: the executor derives
+    each rank's incoming-put list from the full program. The sender
+    never blocks on the target (no rendezvous edge in the wait graph),
+    which is what makes the tier one-sided. Puts sharing a
+    ``(sender, slot)`` pair write ONE window read by every target
+    (the fan-out broadcast case), so the verifier requires them to
+    agree on round and chunk. Only teams whose transport exposes a
+    shared-memory arena (tl/ipc) can run window programs; everywhere
+    else the compiled task raises NOT_SUPPORTED and the fallback walk
+    picks a two-sided candidate.
 """
 from __future__ import annotations
 
@@ -47,7 +63,8 @@ from ..constants import CollType
 #: executor contract changes) — the on-disk verified-program cache
 #: (registry._disk_cache) keys every entry by this, so a stale cache
 #: can never replay a program under semantics it was not verified for.
-DSL_VERSION = 2
+#: v3: one-sided PUT/PUT_RED window ops (the pooled tier).
+DSL_VERSION = 3
 
 
 class OpKind(enum.IntEnum):
@@ -55,6 +72,13 @@ class OpKind(enum.IntEnum):
     RECV = 1
     REDUCE = 2
     COPY = 3
+    PUT = 4        # one-sided window put (overwrites the target chunk)
+    PUT_RED = 5    # one-sided window put reduced into the target chunk
+
+
+#: the one-sided window kinds (matched by derivation, not by a
+#: receiver-side op)
+PUT_KINDS = frozenset((OpKind.PUT, OpKind.PUT_RED))
 
 
 @dataclass(frozen=True)
@@ -77,7 +101,8 @@ class Op:
         k = self.kind.name.lower()
         if self.kind == OpKind.COPY:
             return f"copy(chunk {self.src_chunk} -> {self.chunk})"
-        d = "to" if self.kind == OpKind.SEND else "from"
+        d = "to" if self.kind in (OpKind.SEND, OpKind.PUT,
+                                  OpKind.PUT_RED) else "from"
         q = f", q{self.wire}" if self.wire else ""
         return (f"{k}(chunk {self.chunk} {d} rank {self.peer}, "
                 f"slot {self.slot}{q})")
@@ -132,6 +157,21 @@ class Program:
                 if v:
                     break
             self.__dict__["_edge_wire_mode"] = v
+        return v
+
+    @property
+    def uses_windows(self) -> bool:
+        """True when any rank's stream holds a one-sided PUT/PUT_RED —
+        the program needs a process-shared arena (tl/ipc) and can never
+        lower to a native mailbox plan. Memoized like edge_wire_mode
+        (this sits on the per-collective init path)."""
+        v = self.__dict__.get("_uses_windows")
+        if v is None:
+            v = any(op.kind in PUT_KINDS
+                    for rp in self.ranks
+                    for ops in rp.rounds
+                    for op in ops)
+            self.__dict__["_uses_windows"] = v
         return v
 
     def block_chunks(self, rank: int) -> range:
@@ -241,6 +281,26 @@ class ProgramBuilder:
             Op(OpKind.REDUCE, chunk, frm,
                self._auto_slot(chunk) if slot is None else slot,
                wire=wire))
+
+    def put(self, rank: int, chunk: int, to: int,
+            slot: Optional[int] = None) -> None:
+        """One-sided window put: overwrite chunk ``chunk`` on rank
+        ``to`` with my current value, consumed at the target's round.
+        Puts never carry a wire precision (the pooled tier is exact)."""
+        self._check(rank, chunk, to)
+        self._rounds[self._round][rank].append(
+            Op(OpKind.PUT, chunk, to,
+               self._auto_slot(chunk) if slot is None else slot))
+
+    def put_red(self, rank: int, chunk: int, to: int,
+                slot: Optional[int] = None) -> None:
+        """One-sided window put reduced into the target chunk with the
+        collective's operator (applied in deterministic source-rank
+        order on the target)."""
+        self._check(rank, chunk, to)
+        self._rounds[self._round][rank].append(
+            Op(OpKind.PUT_RED, chunk, to,
+               self._auto_slot(chunk) if slot is None else slot))
 
     def copy(self, rank: int, dst_chunk: int, src_chunk: int) -> None:
         self._check(rank, dst_chunk, None)

@@ -215,6 +215,40 @@ def gen_enabled(team) -> bool:
                                                 "true", "t")
 
 
+def _apply_pool_knobs(team, fams: Dict[str, List[int]]) \
+        -> Dict[str, List[int]]:
+    """UCC_POOL_ENABLE / UCC_POOL_CHUNKS: the pooled (one-sided window)
+    variants get their own gates so an operator can drop or re-grid
+    them without rewriting the whole UCC_GEN_FAMILIES spec — the
+    windows pin arena heap for the life of the team, which a
+    memory-tight deployment may want off even with generation on.
+
+    ENABLE: auto (default) keeps whatever the family spec produced;
+    ``n`` drops the pooled family even if the spec named it; ``y``
+    forces it in at its grid when the spec left it out. CHUNKS is a
+    comma-separated chunk-count list replacing the pooled grid."""
+    en = _cfg_str(team, "pool_enable", "UCC_POOL_ENABLE") or "auto"
+    if en in ("n", "no", "off", "0", "false", "f"):
+        fams.pop("pooled", None)
+        return fams
+    if en in ("y", "yes", "on", "1", "true", "t") and "pooled" not in fams:
+        fams["pooled"] = list(fam.DEFAULT_GRIDS["pooled"])
+    chunks = _cfg_str(team, "pool_chunks", "UCC_POOL_CHUNKS")
+    if chunks and "pooled" in fams:
+        try:
+            grid = sorted({int(c) for c in chunks.split(",")
+                           if c.strip()})
+        except ValueError:
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           f"bad UCC_POOL_CHUNKS: '{chunks}'")
+        if not grid or any(g < 1 for g in grid):
+            raise UccError(Status.ERR_INVALID_PARAM,
+                           f"bad UCC_POOL_CHUNKS: '{chunks}' (need "
+                           f"positive chunk counts)")
+        fams["pooled"] = grid
+    return fams
+
+
 def parse_families(spec: str) -> Dict[str, List[int]]:
     """``ring(1,2,4),rhd(2,8),qdirect`` -> {family: params}. Empty spec
     = every family at its default grid. Unknown families or malformed
@@ -300,6 +334,8 @@ def _construct(family: str, params: Dict[str, Any], n: int, wire: str,
         return fam.gen_bc_kn(n, radix=(int(params.get("radix", 0)) or n))
     if family == "bc_chain":
         return fam.gen_bc_chain(n, chunks=int(params.get("chunks", 2)))
+    if family == "pooled":
+        return fam.gen_pooled(n, chunks=int(params.get("chunks", 1)))
     if family == "hier":
         if not paths:
             raise fam.Inapplicable(
@@ -359,7 +395,7 @@ _GRID_PARAM_KEY = {
     "ring": "chunks", "rhd": "radix", "sra": "radix",
     "sra_pipe": "depth", "ag_ring": "chunks", "ag_rd": "radix",
     "rs_ring": "chunks", "bc_kn": "radix", "bc_chain": "chunks",
-    "hier": "top",
+    "hier": "top", "pooled": "chunks",
 }
 
 
@@ -457,6 +493,7 @@ def generated_alg_specs(team) -> Dict[CollType, List]:
     except ValueError as e:
         raise UccError(Status.ERR_INVALID_PARAM,
                        f"bad UCC_GEN_FAMILIES: {e}")
+    fams = _apply_pool_knobs(team, fams)
     from .. import quant
 
     from .plan import native_mode, team_plan_capable
@@ -489,9 +526,12 @@ def generated_alg_specs(team) -> Dict[CollType, List]:
             gen=prog.param_str,
             # wire (quantized) programs only run as plans under an
             # explicit UCC_GEN_NATIVE=y (auto always interprets them);
-            # non-allreduce/per-edge-wire programs never do (ISSUE 14)
+            # non-allreduce/per-edge-wire programs never do (ISSUE 14);
+            # window (pooled) programs retire through the arena's
+            # one-sided path, never through a mailbox plan
             plan=plan_cap and prog.coll == CollType.ALLREDUCE
             and not prog.edge_wire_mode
+            and not prog.uses_windows
             and (not prog.wire or gn_mode == "y")))
 
     # searched winners FIRST: a winner the grid can also reach (the
@@ -518,7 +558,10 @@ def generated_alg_specs(team) -> Dict[CollType, List]:
         for param in params:
             p = build_program(family, param, n, paths=paths)
             if p is not None:
-                add(p)
+                # pooled (one-sided window) variants carry their own
+                # origin so provenance survives into tuner records
+                add(p, origin="pooled" if family == "pooled"
+                    else "generated")
             if family == "hier" and qmode:
                 # the quantized-DCN-edge variant rides along whenever a
                 # wire precision is enabled (its exact twin stays too)

@@ -216,12 +216,15 @@ def grid_program_names(coll: CollType, n: int, paths=None,
     """Names the fixed UCC_GEN_FAMILIES default grids reach at this
     (coll, n) — the baseline set a searched winner must beat to count
     as a search-only discovery. Delegates to the registry's own grid
-    walk so the qdirect/hier-quant gating rules live in ONE place."""
+    walk so the qdirect/hier-quant gating rules live in ONE place.
+    Window (pooled) programs are excluded: they only dispatch on
+    arena-backed teams, so the search neither proposes nor measures
+    them — they are not part of the searchable baseline."""
     from .registry import built_in_programs
     return {p.name
             for p in built_in_programs(n, quant_mode=quant_mode,
                                        paths=paths)
-            if p.coll == coll}
+            if p.coll == coll and not p.uses_windows}
 
 
 def shortlist(cands: Sequence[Candidate], model, nbytes: int,

@@ -41,13 +41,28 @@ up). Those are exactly the edges of a directed graph over
 that graph is acyclic. The check also enforces 1:1 send/recv matching —
 an unmatched recv is a guaranteed hang, an unmatched send a guaranteed
 stray message into a later collective's tag space.
+
+**One-sided window puts (the pooled tier).** ``PUT``/``PUT_RED`` ops
+have no receiver-side op: consumption is derived — the target applies
+every put issued at round ``k`` during its OWN round ``k``, after its
+two-sided wire ops complete. They are therefore EXCLUDED from 1:1
+send/recv matching and modeled separately: puts sharing a
+``(sender, slot)`` pair write one window cell (the fan-out broadcast
+case) and must agree on round, chunk and kind; the wait graph gains
+only the forward edge (sender posted round k) -> (target completes
+round k) — a put never blocks the sender, so the conservative
+rendezvous back-edge does not exist for this class. Hazard rules
+mirror RECV's: at most one overwriting put per (target, round, chunk),
+never mixed with a two-sided delivery or a reducing put into the same
+chunk. ``PUT_RED`` deliveries reduce in deterministic source-rank
+order and get the same double-count/undefined checks as ``REDUCE``.
 """
 from __future__ import annotations
 
 from typing import Dict, FrozenSet, List, Optional, Tuple
 
 from ..constants import CollType
-from .ir import Op, OpKind, Program
+from .ir import PUT_KINDS, Op, OpKind, Program
 
 #: number of symbolically-tracked values per location; contribution sets
 #: are frozensets of source ranks
@@ -116,7 +131,55 @@ def _match_ops(prog: Program):
     return {key: (sends[key], recvs[key]) for key in sends}
 
 
-def _topo_rounds(prog: Program, matches) -> List[Tuple[int, int]]:
+def _collect_puts(prog: Program):
+    """Derive the one-sided put structure. Returns ``(groups,
+    incoming)``: ``groups`` maps window identity ``(sender, slot)`` to
+    ``(round, chunk, kind, [targets])`` — all puts sharing a
+    (sender, slot) write ONE window cell, so they must agree on round,
+    chunk and kind, and may not name a target twice; ``incoming`` maps
+    ``(target, round)`` to the delivery list ``[(sender, op), ...]``
+    sorted by (sender, slot) — the deterministic order the executor
+    (and the symbolic model) applies them in."""
+    groups: Dict[Tuple[int, int], Tuple[int, int, OpKind, List[int]]] = {}
+    incoming: Dict[Tuple[int, int], List[Tuple[int, Op]]] = {}
+    for p, rp in enumerate(prog.ranks):
+        for k, ops in enumerate(rp.rounds):
+            for op in ops:
+                if op.kind not in PUT_KINDS:
+                    continue
+                if op.wire or prog.wire:
+                    raise VerifyError(
+                        f"{op.describe()} carries a wire precision — "
+                        f"window puts are exact (the pooled tier has "
+                        f"no edge codec)", rank=p, chunk=op.chunk,
+                        round_=k)
+                g = groups.get((p, op.slot))
+                if g is None:
+                    groups[(p, op.slot)] = (k, op.chunk, op.kind,
+                                            [op.peer])
+                else:
+                    gk, gc, gkind, dsts = g
+                    if gk != k or gc != op.chunk or gkind != op.kind:
+                        raise VerifyError(
+                            f"{op.describe()} reuses window slot "
+                            f"{op.slot} of round {gk} chunk {gc} "
+                            f"({gkind.name}) — puts sharing a "
+                            f"(sender, slot) write one window cell and "
+                            f"must agree on round, chunk and kind",
+                            rank=p, chunk=op.chunk, round_=k)
+                    if op.peer in dsts:
+                        raise VerifyError(
+                            f"duplicate {op.describe()} — the same "
+                            f"window already targets rank {op.peer}",
+                            rank=p, chunk=op.chunk, round_=k)
+                    dsts.append(op.peer)
+                incoming.setdefault((op.peer, k), []).append((p, op))
+    for lst in incoming.values():
+        lst.sort(key=lambda e: (e[0], e[1].slot))
+    return groups, incoming
+
+
+def _topo_rounds(prog: Program, matches, incoming) -> List[Tuple[int, int]]:
     """Topological order of (rank, round) completion nodes, or raise
     VerifyError naming a node on a cycle (the deadlock)."""
     n, R = prog.nranks, prog.n_rounds
@@ -142,6 +205,13 @@ def _topo_rounds(prog: Program, matches) -> List[Tuple[int, int]]:
         # sender's round-ks wait needs the receiver's recv to be up
         # (conservative rendezvous model)
         add((q, kr - 1), (p, ks))
+    # one-sided puts: the target consumes an issued-at-round-k put
+    # during its own round k, so it waits on the sender having POSTED
+    # round k (completed k-1). No reverse edge — a put never blocks
+    # the sender (that is what makes the tier one-sided).
+    for (q, k), lst in incoming.items():
+        for (p, _op) in lst:
+            add((p, k - 1), (q, k))
 
     order: List[Tuple[int, int]] = []
     ready = [u for u in nodes if indeg[u] == 0]
@@ -168,7 +238,7 @@ def _topo_rounds(prog: Program, matches) -> List[Tuple[int, int]]:
     return order
 
 
-def _check_round_hazards(prog: Program) -> None:
+def _check_round_hazards(prog: Program, incoming) -> None:
     """Intra-round buffer hazards the symbolic model cannot see.
 
     The executor posts a round's sends and recvs concurrently, and an
@@ -187,12 +257,36 @@ def _check_round_hazards(prog: Program) -> None:
     land in temporaries and apply after the round's wait (sends have
     completed — delivered or staged — by then), in deterministic
     program order, and disjoint unions commute.
+
+    One-sided put deliveries (``incoming`` maps (target, round) to
+    them) apply from the window AFTER the target's own wire ops
+    complete, so a put destination may coexist with a SEND source
+    (the window is the staging copy). What stays forbidden: two
+    overwriting puts into one chunk (one silently wins — a generator
+    bug), and an overwriting put mixed with ANY other delivery into
+    the same chunk (recv, reduce or reducing put — the survivor would
+    depend on apply order, which the model refuses to make load-
+    bearing). A reducing put mixed with an overwriting RECV is
+    rejected for the same reason.
     """
     for r, rp in enumerate(prog.ranks):
         for k, ops in enumerate(rp.rounds):
             send_src = set()
             recv_dst = set()
             reduce_dst = set()
+            put_over_dst = set()
+            put_red_dst = set()
+            for (_p, pop) in incoming.get((r, k), ()):
+                if pop.kind == OpKind.PUT:
+                    if pop.chunk in put_over_dst:
+                        raise VerifyError(
+                            f"two overwriting puts into chunk "
+                            f"{pop.chunk} within one round — one "
+                            f"write silently wins", rank=r,
+                            chunk=pop.chunk, round_=k)
+                    put_over_dst.add(pop.chunk)
+                else:
+                    put_red_dst.add(pop.chunk)
             for op in ops:
                 if op.kind == OpKind.SEND:
                     send_src.add(op.chunk)
@@ -219,6 +313,19 @@ def _check_round_hazards(prog: Program) -> None:
                     f"incoming delivery can overwrite the slice before "
                     f"the outgoing send is consumed", rank=r, chunk=c,
                     round_=k)
+            for c in sorted(put_over_dst
+                            & (recv_dst | reduce_dst | put_red_dst)):
+                raise VerifyError(
+                    f"chunk {c} takes an overwriting put and another "
+                    f"delivery within one round — the survivor would "
+                    f"depend on apply order", rank=r, chunk=c, round_=k)
+            for c in sorted(put_red_dst & recv_dst):
+                raise VerifyError(
+                    f"chunk {c} takes a reducing put and an "
+                    f"overwriting recv within one round — the recv "
+                    f"resolves at transport-arrival time, so the "
+                    f"reduction's base value is timing-dependent",
+                    rank=r, chunk=c, round_=k)
 
 
 #: collectives with a postcondition model; programs for anything else
@@ -338,14 +445,14 @@ def verify(prog: Program) -> None:
                 rank=r)
         for k, ops in enumerate(rp.rounds):
             for op in ops:
-                if op.kind == OpKind.REDUCE and \
+                if op.kind in (OpKind.REDUCE, OpKind.PUT_RED) and \
                         prog.coll in NON_REDUCING_COLLS:
                     raise VerifyError(
                         f"{op.describe()} in a "
                         f"{prog.coll.name.lower()} program — this "
                         f"collective has no reduction operator",
                         rank=r, chunk=op.chunk, round_=k)
-                if op.wire:
+                if op.wire and op.kind not in PUT_KINDS:
                     wires.add(op.wire)
     if len(wires) > 1:
         raise VerifyError(
@@ -355,7 +462,10 @@ def verify(prog: Program) -> None:
         raise VerifyError(
             "program-level wire precision combined with per-edge wire "
             "tags — use one or the other")
-    _check_round_hazards(prog)
+    # _collect_puts enforces the window-group invariants as it derives
+    # the delivery lists; the groups themselves are executor detail
+    _put_groups, incoming_puts = _collect_puts(prog)
+    _check_round_hazards(prog, incoming_puts)
     matches = _match_ops(prog)
     for (sender, recver) in matches.values():
         p, ks, sop = sender
@@ -374,22 +484,26 @@ def verify(prog: Program) -> None:
                 f"into {rop.describe()} — sender and receiver must "
                 f"agree on the edge codec or the byte counts differ",
                 rank=q, chunk=rop.chunk, round_=kr)
-    order = _topo_rounds(prog, matches)
+    order = _topo_rounds(prog, matches, incoming_puts)
 
     # ------------------------------------------------------------------
     # symbolic execution in wait-graph topological order
     state: List[List[Optional[_Val]]] = _initial_state(prog)
     sendval: Dict[Tuple[int, int, int], Optional[_Val]] = {}  # (src,dst,slot)
+    putval: Dict[Tuple[int, int], Optional[_Val]] = {}        # (src,slot)
 
     def snapshot_sends(r: int, k: int) -> None:
-        """Record send values of round *k* of rank *r* (the state the
-        sends observe: after round k-1 completed, before round k's own
-        deliveries)."""
+        """Record send/put values of round *k* of rank *r* (the state
+        the posts observe: after round k-1 completed, before round k's
+        own deliveries). Puts snapshot per window — (sender, slot) —
+        since every target of a fan-out put reads the one cell."""
         if k >= R:
             return
         for op in prog.ranks[r].rounds[k]:
             if op.kind == OpKind.SEND:
                 sendval[(r, op.peer, op.slot)] = state[r][op.chunk]
+            elif op.kind in PUT_KINDS:
+                putval[(r, op.slot)] = state[r][op.chunk]
 
     for r in range(n):
         snapshot_sends(r, 0)
@@ -418,6 +532,31 @@ def verify(prog: Program) -> None:
                         f"double-count them", rank=r, chunk=op.chunk,
                         round_=k)
                 state[r][op.chunk] = cur | incoming
+        # one-sided put deliveries, in the executor's order: overwrites
+        # first, then reductions, each in (sender, slot) order
+        deliveries = incoming_puts.get((r, k), ())
+        for p, op in deliveries:
+            if op.kind == OpKind.PUT:
+                state[r][op.chunk] = putval[(p, op.slot)]
+        for p, op in deliveries:
+            if op.kind == OpKind.PUT_RED:
+                inc_val = putval[(p, op.slot)]
+                cur = state[r][op.chunk]
+                if inc_val is None or cur is None:
+                    which = "incoming" if inc_val is None else "local"
+                    raise VerifyError(
+                        f"{op.describe()} (from rank {p}) reduces "
+                        f"UNDEFINED data (the {which} chunk never "
+                        f"received a value) — the result would be "
+                        f"garbage", rank=r, chunk=op.chunk, round_=k)
+                dup = inc_val & cur
+                if dup:
+                    raise VerifyError(
+                        f"contribution of rank(s) {sorted(dup)} "
+                        f"reduced twice by {op.describe()} (from rank "
+                        f"{p}) — the reduction would double-count "
+                        f"them", rank=r, chunk=op.chunk, round_=k)
+                state[r][op.chunk] = cur | inc_val
         for op in prog.ranks[r].rounds[k]:
             if op.kind == OpKind.COPY:
                 state[r][op.chunk] = state[r][op.src_chunk]

@@ -151,8 +151,29 @@ class HealthRegistry:
         self._last_beat = 0.0
         self._last_poll = 0.0
         self._lock = threading.Lock()
+        #: external liveness oracles, ``fn(ctx_rank) -> Optional[bool]``
+        #: (True = positively alive, False = conclusively dead, None =
+        #: no verdict). The in-process heartbeat board cannot see peers
+        #: in OTHER processes; a cross-process transport (tl/ipc arena
+        #: pid board) registers a source here so process death is
+        #: detected without waiting for a watchdog escalation.
+        self._sources: list = []
 
     # -- wiring --------------------------------------------------------
+    def add_liveness_source(self, fn) -> None:
+        """Register a cross-process liveness oracle (see ``_sources``)."""
+        self._sources.append(fn)
+
+    def _source_verdict(self, ctx_rank: int) -> Optional[bool]:
+        for fn in self._sources:
+            try:
+                v = fn(ctx_rank)
+            except Exception:  # noqa: BLE001 - oracles are best-effort
+                continue
+            if v is not None:
+                return v
+        return None
+
     def set_peers(self, uids: Dict[int, str]) -> None:
         """ctx rank -> context uid, learned from the context OOB address
         exchange (core/context.py stuffs each context's uid into the
@@ -183,8 +204,14 @@ class HealthRegistry:
             if last is None:
                 # never beaten HERE: the board is process-local, so a
                 # healthy peer in ANOTHER process never appears on it —
-                # abstain rather than condemn (multi-process detection
-                # leans on the other evidence sources; see module doc)
+                # abstain rather than condemn, unless a registered
+                # cross-process source (tl/ipc arena pid board) returns
+                # a conclusive death verdict
+                if self._source_verdict(rank) is False:
+                    if self.report_failure(
+                            rank, "liveness",
+                            "peer process dead (arena pid probe)"):
+                        newly.add(rank)
                 continue
             if now - last > HEARTBEAT_TIMEOUT:
                 if self.report_failure(
@@ -230,10 +257,15 @@ class HealthRegistry:
         with _BOARD_LOCK:
             last = _BOARD.get(uid) if uid else None
         # a peer that never beat on THIS process's board (cross-process
-        # peer) cannot be condemned by staleness — suspicion only
+        # peer) cannot be condemned by staleness — suspicion only,
+        # unless a cross-process source returns a death verdict
         if last is not None and now - last > HEARTBEAT_TIMEOUT:
             return self.report_failure(
                 ctx_rank, source, "stalled task peer with stale heartbeat")
+        if last is None and self._source_verdict(ctx_rank) is False:
+            return self.report_failure(
+                ctx_rank, source,
+                "stalled task peer whose process is dead (arena pid probe)")
         with self._lock:
             self.suspected[ctx_rank] = self.suspected.get(ctx_rank, 0) + 1
         return False
@@ -272,7 +304,9 @@ class HealthRegistry:
         with _BOARD_LOCK:
             last = _BOARD.get(uid)
         if last is None:
-            return False
+            # cross-process peer: a registered source's recent arena
+            # beat is the same positive evidence
+            return self._source_verdict(int(ctx_rank)) is True
         now = now if now is not None else time.monotonic()
         return now - last <= HEARTBEAT_TIMEOUT
 

@@ -24,6 +24,7 @@ Used by ``tests/test_fault.py``; runnable standalone::
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -468,6 +469,300 @@ def run_kill_shrink_soak(n_ranks: int = 4, kill_rank: int = 2,
                 c.destroy()
             except Exception:  # noqa: BLE001
                 pass
+    return report
+
+
+# ---------------------------------------------------------------------------
+# cross-process scenario: one WHOLE OS process killed (ipc arena drill)
+# ---------------------------------------------------------------------------
+
+def _free_port_pair() -> int:
+    """Adjacent free port pair held simultaneously (the TcpStoreOob
+    bootstrap binds *port* for the context world and *port+1* for the
+    team world; probing them separately races other listeners)."""
+    import socket as _s
+    while True:
+        a = _s.socket()
+        a.bind(("127.0.0.1", 0))
+        port = a.getsockname()[1]
+        b = _s.socket()
+        try:
+            b.bind(("127.0.0.1", port + 1))
+        except OSError:
+            a.close()
+            b.close()
+            continue
+        a.close()
+        b.close()
+        return port
+
+
+def _procs_rank_main(rank, size, port, lib, killed_ev, victim, pre_iters,
+                     post_iters, count, deadline_s, q):
+    """One rank of the cross-process drill (a thread inside its hosting
+    worker process). Victim ranks park on progress until the parent
+    SIGKILLs their process; survivors cross the kill, shrink, resume."""
+    import ucc_tpu
+    from ucc_tpu import ContextParams, Status, TcpStoreOob, TeamParams
+
+    rep: Dict = {"rank": rank, "violations": [], "pre": 0, "post": 0}
+    ctx = None
+    try:
+        oob = TcpStoreOob(rank, size, port=port)
+        ctx = ucc_tpu.Context(lib, ContextParams(oob=oob))
+        team = ctx.create_team(TeamParams(oob=TcpStoreOob(rank, size,
+                                                          port=port + 1)))
+        bufs: Dict = {}
+
+        def drive(t, coll, n, my_rank, b, check=False):
+            rq = t.collective_init(_coll_args(coll, my_rank, n, count, b,
+                                              0.0))
+            rq.post()
+            end = time.monotonic() + deadline_s
+            while time.monotonic() < end:
+                ctx.progress()
+                if rq.test() != Status.IN_PROGRESS:
+                    break
+            st = rq.test()
+            if st == Status.IN_PROGRESS:
+                rep["violations"].append(
+                    f"{coll} IN_PROGRESS past deadline")
+                rq.task.cancel(Status.ERR_TIMED_OUT)
+            elif check and st != Status.OK:
+                rep["violations"].append(f"{coll} failed: {st.name}")
+            elif check and coll == "allreduce":
+                expected = sum(g + 1.0 for g in range(n))
+                if not np.allclose(b[my_rank]["ar"], expected):
+                    rep["violations"].append(
+                        f"{coll} wrong result {b[my_rank]['ar'][0]} != "
+                        f"{expected}")
+            try:
+                rq.finalize()
+            except Exception:  # noqa: BLE001
+                pass
+            return st
+
+        # -- healthy matrix on the full cross-process team -------------
+        for it in range(pre_iters * len(DEFAULT_MATRIX)):
+            drive(team, DEFAULT_MATRIX[it % len(DEFAULT_MATRIX)], size,
+                  rank, bufs, check=True)
+            rep["pre"] += 1
+        q.put(("ready", rank))
+        if victim:
+            while True:            # parked until the parent's SIGKILL
+                ctx.progress()
+                time.sleep(0.001)
+        killed_ev.wait(timeout=120)
+
+        # -- collective across the kill: detect + attribute ------------
+        rq = team.collective_init(_coll_args("allreduce", rank, size,
+                                             count, bufs, 0.0))
+        rq.post()
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end:
+            ctx.progress()
+            if rq.test() != Status.IN_PROGRESS:
+                break
+        st = rq.test()
+        rep["detected"] = {"status": st.name,
+                           "ranks": sorted(rq.failed_ranks or [])}
+        if st == Status.IN_PROGRESS:
+            rep["violations"].append("IN_PROGRESS after process kill")
+            rq.task.cancel(Status.ERR_TIMED_OUT)
+        elif st != Status.ERR_RANK_FAILED:
+            rep["violations"].append(
+                f"saw {st.name} after process kill, not ERR_RANK_FAILED")
+        try:
+            rq.finalize()
+        except Exception:  # noqa: BLE001
+            pass
+
+        # -- agree + shrink among the survivors ------------------------
+        s = team.shrink_post()
+        end = time.monotonic() + 60
+        while time.monotonic() < end:
+            ctx.progress()
+            if s.test() != Status.IN_PROGRESS:
+                break
+        if s.test() != Status.OK:
+            rep["violations"].append(f"shrink failed: {s.test().name}")
+            q.put(("report", rank, rep))
+            return
+        rep["agreed"] = {"epoch": s.epoch,
+                         "dead": sorted(s.failed_ranks or [])}
+        new_team = s.new_team
+
+        # -- resume: checked matrix on the shrunk team -----------------
+        nn = new_team.size
+        my = getattr(new_team, "rank", rank)
+        nbufs: Dict = {}
+        for it in range(post_iters):
+            drive(new_team, DEFAULT_MATRIX[it % len(DEFAULT_MATRIX)], nn,
+                  my, nbufs, check=True)
+            rep["post"] += 1
+        q.put(("report", rank, rep))
+        try:
+            new_team.destroy()
+            team.destroy()
+        except Exception:  # noqa: BLE001
+            pass
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        rep["violations"].append(
+            f"rank raised {type(e).__name__}: {e}\n"
+            f"{traceback.format_exc()}")
+        q.put(("report", rank, rep))
+    finally:
+        if ctx is not None:
+            try:
+                ctx.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _procs_worker(ranks, size, port, q, killed_ev, victim, pre_iters,
+                  post_iters, count, deadline_s):
+    """One OS process hosting *ranks* (a thread per rank) of the
+    cross-process drill. Forced onto the ipc TL: every payload between
+    the processes rides the shared arena."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault("UCC_TLS", "ipc,self")
+        import ucc_tpu
+        from . import health
+        health.configure("shrink", interval=0.05, timeout=2.0)
+        # component discovery is not re-entrant: init libs on the main
+        # thread, the rank threads only drive the data path
+        libs = {r: ucc_tpu.init() for r in ranks}
+        ths = [threading.Thread(
+            target=_procs_rank_main,
+            args=(r, size, port, libs[r], killed_ev, victim, pre_iters,
+                  post_iters, count, deadline_s, q), daemon=True)
+            for r in ranks]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=600)
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        for r in ranks:
+            q.put(("report", r, {"rank": r, "violations": [
+                f"worker crashed: {e}\n{traceback.format_exc()}"]}))
+
+
+def run_procs_kill_shrink(n_procs: int = 2, ranks_per: int = 2,
+                          pre_iters: int = 1, post_iters: int = 12,
+                          count: int = 64,
+                          iter_deadline_s: float = 20.0) -> Dict:
+    """The cross-process recovery drill: *n_procs* OS processes host
+    ``ranks_per`` ranks each over one shared-memory arena
+    (``UCC_TLS=ipc,self``); after a healthy matrix the LAST process is
+    SIGKILLed whole — no goodbye, exactly a crashed node. Survivors
+    must detect via the arena pid board (heartbeats stop AND the pid is
+    conclusively gone), agree on the dead set, shrink, and run a
+    checked matrix on the shrunk team.
+
+    Returns a report dict; ``report["violations"]`` MUST be empty.
+    """
+    import multiprocessing as mp
+    import queue as _q
+
+    size = n_procs * ranks_per
+    victim = n_procs - 1
+    splits = [tuple(range(p * ranks_per, (p + 1) * ranks_per))
+              for p in range(n_procs)]
+    port = _free_port_pair()
+    mctx = mp.get_context("spawn")
+    # one queue PER process, never shared across the kill boundary: a
+    # shared mp.Queue's write lock is a plain semaphore, and SIGKILLing
+    # the victim while its feeder thread holds it (it was just
+    # descheduled between send_bytes and release — routine on one core)
+    # orphans the lock and wedges every survivor's feeder forever
+    qs = [mctx.Queue() for _ in range(n_procs)]
+    killed_ev = mctx.Event()
+    procs = [mctx.Process(target=_procs_worker,
+                          args=(splits[p], size, port, qs[p], killed_ev,
+                                p == victim, pre_iters, post_iters,
+                                count, iter_deadline_s))
+             for p in range(n_procs)]
+    survivors = [r for p in range(n_procs) if p != victim
+                 for r in splits[p]]
+    report: Dict = {"procs": n_procs, "ranks": size, "violations": [],
+                    "killed": {"proc": victim,
+                               "ctx_ranks": sorted(splits[victim])},
+                    "per_rank": {}}
+    for p in procs:
+        p.start()
+    def drain(sources, done, timeout_s):
+        deadline = time.monotonic() + timeout_s
+        while not done() and time.monotonic() < deadline:
+            got = False
+            for qq in sources:
+                try:
+                    msg = qq.get_nowait()
+                except _q.Empty:
+                    continue
+                except (EOFError, OSError):
+                    continue               # writer died mid-frame
+                got = True
+                if msg[0] == "ready":
+                    ready.add(msg[1])
+                else:
+                    report["per_rank"][msg[1]] = msg[2]
+            if not got:
+                time.sleep(0.05)
+
+    try:
+        ready: set = set()
+        drain(qs, lambda: len(ready) >= size, 240)
+        if len(ready) < size:
+            report["violations"].append(
+                f"only ranks {sorted(ready)} of {size} reached the kill "
+                f"point")
+            return report
+
+        procs[victim].kill()                       # SIGKILL, whole process
+        procs[victim].join(timeout=30)
+        killed_ev.set()
+
+        # only survivor queues from here: the victim's pipe may hold a
+        # truncated frame
+        drain([qs[p] for p in range(n_procs) if p != victim],
+              lambda: len(report["per_rank"]) >= len(survivors), 300)
+
+        dead_expect = set(splits[victim])
+        views = set()
+        for r in survivors:
+            rep = report["per_rank"].get(r)
+            if rep is None:
+                report["violations"].append(f"rank {r} never reported")
+                continue
+            for v in rep.get("violations", ()):
+                report["violations"].append(f"rank {r}: {v}")
+            det = rep.get("detected") or {}
+            if not dead_expect & set(det.get("ranks", ())):
+                report["violations"].append(
+                    f"rank {r} attribution {det.get('ranks')} misses the "
+                    f"killed process ranks {sorted(dead_expect)}")
+            agreed = rep.get("agreed")
+            if agreed is not None:
+                views.add((tuple(agreed["dead"]), agreed["epoch"]))
+                if not dead_expect <= set(agreed["dead"]):
+                    report["violations"].append(
+                        f"rank {r} shrank without the whole killed "
+                        f"process: {agreed['dead']}")
+            if rep.get("post", 0) < post_iters:
+                report["violations"].append(
+                    f"rank {r} resumed only {rep.get('post', 0)}/"
+                    f"{post_iters} post-shrink iterations")
+        if len(views) > 1:
+            report["violations"].append(
+                f"survivors diverged on (dead set, epoch): {views}")
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
     return report
 
 
@@ -1576,6 +1871,13 @@ def main(argv=None) -> int:
     ap.add_argument("--strikes", type=int, default=3,
                     help="with --corrupt: quarantine threshold "
                     "(UCC_INTEGRITY_STRIKES)")
+    ap.add_argument("--procs", type=int, default=0,
+                    help="run the CROSS-PROCESS kill+shrink drill: N OS "
+                    "processes host --ranks ranks over one shared-memory "
+                    "arena (UCC_TLS=ipc,self), the last process is "
+                    "SIGKILLed whole, survivors must detect via the "
+                    "arena pid board, agree, shrink and resume a "
+                    "checked matrix")
     ap.add_argument("--plans", action="store_true",
                     help="with --kill-shrink: run the drill with the "
                     "allreduces forced onto NATIVE EXECUTION PLANS "
@@ -1583,6 +1885,13 @@ def main(argv=None) -> int:
                     "ucc_plan_cancel withdrew posted recvs and a "
                     "pre-shrink plan send is fenced")
     args = ap.parse_args(argv)
+    if args.procs:
+        report = run_procs_kill_shrink(
+            n_procs=args.procs,
+            ranks_per=max(1, args.ranks // args.procs),
+            post_iters=args.post_iters)
+        print(json.dumps(report, indent=1))
+        return 1 if report["violations"] else 0
     if args.corrupt:
         report = run_corrupt_soak(args.ranks,
                                   corrupt_rank=args.corrupt_rank,

@@ -43,8 +43,12 @@ logger = get_logger("native")
 #: posts the plan, completion is a mapped-word read.
 #: 5: wire integrity — per-entry crc32 checksum word, the kCorrupt
 #: completion state with sender attribution, ucc_mailbox_set_integrity
-#: and ucc_mailbox_push2)
-ABI_VERSION = 5
+#: and ucc_mailbox_push2.
+#: 6: cross-process shared-memory arenas — ucc_mailbox_attach and the
+#: ucc_ipc_*/ucc_arena_* surface in native/ucc_tpu_ipc.cc: match
+#: structures, completion slots and the payload heap in one mmap'd POSIX
+#: shm segment per node, same delivery contracts across processes)
+ABI_VERSION = 6
 
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -53,6 +57,10 @@ _LOCK = threading.Lock()
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 _SRC_PATH = os.path.join(_NATIVE_DIR, "ucc_tpu_core.cc")
+#: second translation unit of the same .so (the ABI-6 IPC arena) — every
+#: staleness decision must consider the NEWEST source, or edits to one
+#: file would ship a silently stale matcher for the other
+_IPC_SRC_PATH = os.path.join(_NATIVE_DIR, "ucc_tpu_ipc.cc")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libucc_tpu_core.so")
 _EXT_PATH = os.path.join(_NATIVE_DIR, "ucc_tpu_core_ext.so")
 _BUILD_LOG = os.path.join(_NATIVE_DIR, "build.log")
@@ -147,6 +155,20 @@ def _native_enabled() -> bool:
     return raw not in ("n", "no", "0", "off", "false", "f")
 
 
+def _src_mtime() -> Optional[float]:
+    """Newest mtime across the native sources; None when neither exists
+    (distribution without sources)."""
+    newest = None
+    for p in (_SRC_PATH, _IPC_SRC_PATH):
+        try:
+            m = os.path.getmtime(p)
+        except OSError:
+            continue
+        if newest is None or m > newest:
+            newest = m
+    return newest
+
+
 def _write_build_log(text: str) -> None:
     try:
         with open(_BUILD_LOG, "w") as fh:
@@ -194,11 +216,8 @@ def _build(force: bool = False) -> Optional[bool]:
             post_mtime = None
         lib_fresh = post_mtime is not None and post_mtime != pre_mtime
         if not lib_fresh and not force and post_mtime is not None:
-            try:
-                lib_fresh = not os.path.isfile(_SRC_PATH) or \
-                    post_mtime >= os.path.getmtime(_SRC_PATH)
-            except OSError:
-                lib_fresh = False
+            src = _src_mtime()
+            lib_fresh = src is None or post_mtime >= src
         if lib_fresh:
             logger.warning("native fastcall ext build failed rc=%s — "
                            "core loads via ctypes (see %s)", r.returncode,
@@ -241,10 +260,10 @@ def _stale() -> bool:
     stale ext either way."""
     if not os.path.isfile(_SO_PATH):
         return True
-    if not os.path.isfile(_SRC_PATH):
+    src_mtime = _src_mtime()
+    if src_mtime is None:
         return False           # distribution without sources: trust the .so
     try:
-        src_mtime = os.path.getmtime(_SRC_PATH)
         if src_mtime > os.path.getmtime(_SO_PATH):
             return True
         if not os.path.isfile(_EXT_PATH):
@@ -272,10 +291,10 @@ def _load_ext():
     # step succeeded, or the core was rebuilt with no Python headers.
     try:
         ext_mtime = os.path.getmtime(_EXT_PATH)
-        if os.path.isfile(_SRC_PATH) and \
-                os.path.getmtime(_SRC_PATH) > ext_mtime:
-            logger.debug("fastcall ext older than %s; using ctypes path",
-                         _SRC_PATH)
+        src_mtime = _src_mtime()
+        if src_mtime is not None and src_mtime > ext_mtime:
+            logger.debug("fastcall ext older than the native sources; "
+                         "using ctypes path")
             return None
         if os.path.isfile(_SO_PATH) and \
                 os.path.getmtime(_SO_PATH) > ext_mtime:
@@ -403,6 +422,74 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.ucc_mpmc_push.argtypes = [vp, u64]
         lib.ucc_mpmc_pop.restype = ctypes.c_int
         lib.ucc_mpmc_pop.argtypes = [vp, ctypes.POINTER(u64)]
+        # ---- ABI 6: cross-process shared-memory arena ----
+        lib.ucc_mailbox_attach.restype = vp
+        lib.ucc_mailbox_attach.argtypes = [ctypes.c_char_p, u64, u64]
+        lib.ucc_arena_probe.restype = u64
+        lib.ucc_arena_probe.argtypes = [ctypes.c_char_p,
+                                        ctypes.POINTER(u64), u64]
+        lib.ucc_arena_detach.restype = None
+        lib.ucc_arena_detach.argtypes = [vp, ctypes.c_int]
+        lib.ucc_arena_created.restype = u64
+        lib.ucc_arena_created.argtypes = [vp]
+        lib.ucc_arena_total_bytes.restype = u64
+        lib.ucc_arena_total_bytes.argtypes = [vp]
+        lib.ucc_arena_creator_pid.restype = u64
+        lib.ucc_arena_creator_pid.argtypes = [vp]
+        lib.ucc_ipc_pub_base.restype = vp
+        lib.ucc_ipc_pub_base.argtypes = [vp]
+        lib.ucc_ipc_slot_cap.restype = u64
+        lib.ucc_ipc_slot_cap.argtypes = [vp]
+        lib.ucc_ipc_set_integrity.restype = None
+        lib.ucc_ipc_set_integrity.argtypes = [vp, u64]
+        lib.ucc_arena_max_msg.restype = u64
+        lib.ucc_arena_max_msg.argtypes = [vp]
+        lib.ucc_arena_register.restype = u64
+        lib.ucc_arena_register.argtypes = [vp, u64, u64]
+        lib.ucc_arena_beat.restype = None
+        lib.ucc_arena_beat.argtypes = [vp, u64]
+        lib.ucc_arena_peer_pid.restype = u64
+        lib.ucc_arena_peer_pid.argtypes = [vp, u64]
+        lib.ucc_arena_beat_age_ms.restype = u64
+        lib.ucc_arena_beat_age_ms.argtypes = [vp, u64]
+        lib.ucc_arena_intern.restype = u64
+        lib.ucc_arena_intern.argtypes = [vp, ctypes.c_char_p, u64]
+        lib.ucc_arena_alloc.restype = u64
+        lib.ucc_arena_alloc.argtypes = [vp, u64]
+        lib.ucc_arena_free.restype = None
+        lib.ucc_arena_free.argtypes = [vp, u64]
+        lib.ucc_arena_base.restype = vp
+        lib.ucc_arena_base.argtypes = [vp]
+        lib.ucc_arena_window.restype = u64
+        lib.ucc_arena_window.argtypes = [vp, u64, u64]
+        lib.ucc_arena_store_release.restype = None
+        lib.ucc_arena_store_release.argtypes = [vp, u64, u64]
+        lib.ucc_arena_load_acquire.restype = u64
+        lib.ucc_arena_load_acquire.argtypes = [vp, u64]
+        lib.ucc_ipc_push.restype = u64
+        lib.ucc_ipc_push.argtypes = [vp, u64, u64, u64, u64, vp, u64,
+                                     u64, u64]
+        lib.ucc_ipc_post_recv.restype = u64
+        lib.ucc_ipc_post_recv.argtypes = [vp, u64, u64, u64, u64, u64,
+                                          u64]
+        lib.ucc_ipc_req_poll.restype = u64
+        lib.ucc_ipc_req_poll.argtypes = [vp, u64]
+        lib.ucc_ipc_req_nbytes.restype = u64
+        lib.ucc_ipc_req_nbytes.argtypes = [vp, u64]
+        lib.ucc_ipc_req_sent_nbytes.restype = u64
+        lib.ucc_ipc_req_sent_nbytes.argtypes = [vp, u64]
+        lib.ucc_ipc_req_cancel.restype = ctypes.c_int
+        lib.ucc_ipc_req_cancel.argtypes = [vp, u64, u64, u64, u64, u64]
+        lib.ucc_ipc_req_free.restype = None
+        lib.ucc_ipc_req_free.argtypes = [vp, u64]
+        lib.ucc_ipc_fence.restype = u64
+        lib.ucc_ipc_fence.argtypes = [vp, u64, u64]
+        lib.ucc_ipc_purge_rank.restype = u64
+        lib.ucc_ipc_purge_rank.argtypes = [vp, u64]
+        lib.ucc_arena_counters.restype = None
+        lib.ucc_arena_counters.argtypes = [vp, ctypes.POINTER(u64)]
+        lib.ucc_arena_occupancy.restype = None
+        lib.ucc_arena_occupancy.argtypes = [vp, ctypes.POINTER(u64)]
         global _EXT
         _EXT = _load_ext()
         _LIB = lib
@@ -921,6 +1008,464 @@ def poll_pending(reqs):
     for mb, group in groups.values():
         pending.extend(mb.test_many(group))
     return pending
+
+
+# ---------------------------------------------------------------------------
+# cross-process shared-memory arena (ABI 6, native/ucc_tpu_ipc.cc)
+# ---------------------------------------------------------------------------
+
+#: /dev/shm segment name prefix — the reaper only ever touches these
+ARENA_PREFIX = "ucc-ipc-"
+
+#: ucc_arena_counters export order (see the C_* enum in ucc_tpu_ipc.cc)
+ARENA_COUNTER_NAMES = (
+    "n_direct", "n_eager", "n_rndv", "n_fenced", "bytes_moved",
+    "attaches", "alloc_fail", "unexp_parked", "posted_parked",
+    "slots_live", "purged", "corrupt", "truncated", "canceled",
+    "interned_keys", "windows", "window_bytes", "blocks_live")
+
+
+class IpcSendReq:
+    """Cross-process rendezvous send: the payload is STAGED into an arena
+    block (raw pointers cannot cross address spaces), but the request
+    keeps rndv semantics — it completes only when a matching recv on the
+    other side consumes the entry."""
+
+    __slots__ = ("arena", "rid", "_idx", "_gen", "_done", "cancelled")
+
+    def __init__(self, arena: "IpcArena", rid: int):
+        self.arena = arena
+        self.rid = rid
+        self._idx = rid & _IDX_MASK
+        self._gen = rid >> _SLOT_BITS
+        self._done = False
+        self.cancelled = False
+
+    @property
+    def done(self) -> bool:
+        return self.test()
+
+    def test(self) -> bool:
+        if self._done:
+            return True
+        ar = self.arena
+        pub = ar._pub
+        if pub is None:
+            self._done = True
+            return True
+        v = pub[self._idx]
+        if (v >> 32) != self._gen or (v & 7):
+            ptr = ar.ptr
+            if ptr is None or int(ar.lib.ucc_ipc_req_poll(ptr, self.rid)):
+                if ptr is not None:
+                    ar.lib.ucc_ipc_req_free(ptr, self.rid)
+                self._done = True
+        return self._done
+
+    def cancel(self) -> None:
+        """Stop waiting; the staged payload stays deliverable (arena-
+        owned — no keepalive to release)."""
+        self.cancelled = True
+        self._done = True
+
+
+class IpcRecvReq:
+    """Posted cross-process recv. The destination ndarray cannot be
+    handed to the other process, so delivery lands in an arena bounce
+    block and this request copies out exactly once at completion."""
+
+    __slots__ = ("arena", "rid", "_idx", "_gen", "_key4", "_blk",
+                 "dst_keepalive", "_done", "nbytes", "error", "cancelled",
+                 "corrupt_src")
+
+    def __init__(self, arena: "IpcArena", rid: int, key4, blk: int,
+                 dst: np.ndarray):
+        self.arena = arena
+        self.rid = rid
+        self._idx = rid & _IDX_MASK
+        self._gen = rid >> _SLOT_BITS
+        self._key4 = key4
+        self._blk = blk
+        self.dst_keepalive = dst
+        self._done = False
+        self.nbytes = 0
+        self.error = None
+        self.cancelled = False
+        self.corrupt_src = None
+
+    @property
+    def done(self) -> bool:
+        return self.test()
+
+    def test(self) -> bool:
+        if self._done:
+            return True
+        ar = self.arena
+        pub = ar._pub
+        if pub is None:
+            self._release(None)
+            self._done = True
+            return True
+        v = pub[self._idx]
+        if (v >> 32) != self._gen:
+            self._release(ar.ptr)
+            self._done = True         # freed under us (purge/teardown)
+            return True
+        if not (v & 7):
+            return False
+        # confirm with one acquire-ordered ffi load before copying the
+        # payload out of the arena (same visibility contract as
+        # NativeRecvReq.test — the delivering memcpy ran in ANOTHER
+        # PROCESS, so the barrier is the only ordering we own)
+        ptr = ar.ptr
+        if ptr is None:
+            self._release(None)
+            self._done = True
+            return True
+        v = int(ar.lib.ucc_ipc_req_poll(ptr, self.rid))
+        if v == 0:
+            return False
+        self._finish(v, ptr)
+        return True
+
+    def _finish(self, v: int, ptr) -> None:
+        ar = self.arena
+        st = v & 7
+        nb = (v >> 3) & _NB_MAX
+        if nb == _NB_MAX:
+            nb = int(ar.lib.ucc_ipc_req_nbytes(ptr, self.rid))
+        if st == _ST_CORRUPT:
+            self.corrupt_src = nb
+            self.nbytes = 0
+            self.error = (f"data corrupted: crc32 mismatch (from ctx "
+                          f"rank {nb})")
+        elif st in (_ST_OK, _ST_TRUNCATED):
+            self.nbytes = nb
+            if self._blk and nb:
+                ctypes.memmove(self.dst_keepalive.ctypes.data,
+                               ar.base + self._blk, nb)
+            if st == _ST_TRUNCATED:
+                sent = int(ar.lib.ucc_ipc_req_sent_nbytes(ptr, self.rid))
+                self.error = (f"message truncated: sent {sent} bytes "
+                              f"into a {self.dst_keepalive.nbytes}-byte "
+                              f"recv buffer")
+        elif st == _ST_FENCED:
+            self.error = "fenced: stale team epoch"
+            self.cancelled = True
+        elif st == _ST_CANCELED:
+            self.error = self.error or "canceled"
+            self.cancelled = True
+        ar.lib.ucc_ipc_req_free(ptr, self.rid)
+        self._release(ptr, keep_rid=True)
+        self._done = True
+
+    def _release(self, ptr, keep_rid: bool = False) -> None:
+        """Return the bounce block (and, unless already freed, the
+        request slot) to the arena."""
+        ar = self.arena
+        if self._blk and ptr is not None:
+            ar.lib.ucc_arena_free(ptr, self._blk)
+        self._blk = 0
+        if not keep_rid and ptr is not None:
+            ar.lib.ucc_ipc_req_free(ptr, self.rid)
+
+    def cancel(self) -> None:
+        """Withdraw: unlinked under the shard lock that matches, so a
+        delivered request stays delivered (RecvReq.cancel contract)."""
+        if self._done:
+            self.cancelled = True
+            return
+        ar = self.arena
+        ptr = ar.ptr
+        if ptr is None:
+            self.error = self.error or "canceled"
+            self.cancelled = True
+            self._done = True
+            return
+        a, b, c, d = self._key4
+        if ar.lib.ucc_ipc_req_cancel(ptr, a, b, c, d, self.rid):
+            self.error = self.error or "canceled"
+            self.cancelled = True
+            self._release(ptr)
+            self._done = True
+        else:
+            self.test()               # already delivered/fenced: harvest
+            self.cancelled = True
+
+
+class IpcArena:
+    """Python handle on one attached cross-process arena: key packing
+    (via the arena's shared intern table, so every process derives the
+    SAME ids), the push/post_recv data path, fences, per-rank purge,
+    liveness board and observability counters."""
+
+    def __init__(self, shm_name: str, heap_bytes: int = 256 << 20,
+                 win_bytes: int = 16 << 20, integrity: bool = False):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native core unavailable (the IPC arena "
+                               "has no python fallback)")
+        self.lib = lib
+        self.name = shm_name if shm_name.startswith("/") \
+            else "/" + shm_name
+        self.ptr = lib.ucc_mailbox_attach(self.name.encode(), heap_bytes,
+                                          win_bytes)
+        if not self.ptr:
+            raise RuntimeError(f"arena attach failed: {self.name}")
+        self.created = bool(lib.ucc_arena_created(self.ptr))
+        self.base = int(lib.ucc_arena_base(self.ptr))
+        self.max_msg = int(lib.ucc_arena_max_msg(self.ptr))
+        self.slot_cap = int(lib.ucc_ipc_slot_cap(self.ptr))
+        pub_addr = lib.ucc_ipc_pub_base(self.ptr)
+        self._pub_buf = (ctypes.c_uint64 * self.slot_cap).from_address(
+            pub_addr)
+        self._pub = memoryview(self._pub_buf).cast("B").cast("Q")
+        self._intern_cache: dict = {}
+        self._intern_mu = threading.Lock()
+        if integrity:
+            lib.ucc_ipc_set_integrity(self.ptr, 1)
+
+    # -- key packing (cross-process-stable) ----------------------------
+    def _intern(self, obj) -> int:
+        """Deterministic bytes -> shared id: every process interning the
+        same key gets the same id back from the arena table (the process-
+        global counter NativeMailbox uses cannot work across processes)."""
+        v = self._intern_cache.get(obj)
+        if v is not None:
+            return v
+        raw = repr(obj).encode()
+        if len(raw) > 120:
+            import hashlib
+            raw = hashlib.sha1(raw).hexdigest().encode()
+        with self._intern_mu:
+            v = self._intern_cache.get(obj)
+            if v is None:
+                v = int(self.lib.ucc_arena_intern(self.ptr, raw,
+                                                  len(raw)))
+                if v == 0:
+                    raise RuntimeError("arena intern table full")
+                self._intern_cache[obj] = v
+        return v
+
+    def pack(self, key):
+        """TagKey -> three u64 words, same canonical shape as
+        NativeMailbox._pack but with arena-interned ids."""
+        try:
+            team, epoch, tag, slot, src = key
+        except (TypeError, ValueError):
+            return (self._intern(("K", key)) << 32, 0, 0)
+        if type(epoch) is not int or type(slot) is not int \
+                or type(src) is not int:
+            return (self._intern(("K", key)) << 32, 0, 0)
+        if type(tag) is not int:
+            if isinstance(tag, tuple) and len(tag) == 2 \
+                    and tag[0] == "svc" and type(tag[1]) is int:
+                tag = _SVC_TAG_BASE | (tag[1] & 0xFFFFFFFFFFFF)
+            else:
+                tag = _TUPLE_TAG_BASE | self._intern(("T", tag))
+        team_id = self._intern(("team", team))
+        return ((team_id << 32) | (epoch & 0xFFFFFFFF), tag,
+                ((slot & 0xFFFFFFFF) << 32) | (src & 0xFFFFFFFF))
+
+    def team_id(self, team_key) -> int:
+        return self._intern(("team", team_key))
+
+    # -- data path -----------------------------------------------------
+    def push(self, key, dst_rank: int, data: np.ndarray,
+             eager_limit: Optional[int] = None,
+             crc: Optional[int] = None):
+        """Send *data* to context rank *dst_rank*: ``(req, kind)`` with
+        the Mailbox.send kind vocabulary. Direct sends memcpy straight
+        into the receiver's bounce inside this call — across the process
+        boundary."""
+        ptr = self.ptr
+        if ptr is None:
+            return _DoneSend(), "eager"
+        if data.nbytes > self.max_msg:
+            raise ValueError(
+                f"message of {data.nbytes} bytes exceeds the arena "
+                f"payload class cap ({self.max_msg}); raise "
+                f"UCC_TL_IPC_HEAP or route this team over the socket TL")
+        if eager_limit is None:
+            eager_limit = _eager_limit()
+        if not data.flags["C_CONTIGUOUS"]:
+            data = np.ascontiguousarray(data)
+        a, b, c = self.pack(key)
+        crc_word = (1 << 32) | (crc & 0xFFFFFFFF) if crc is not None \
+            else 0
+        ret = int(self.lib.ucc_ipc_push(
+            ptr, a, b, c, dst_rank, data.ctypes.data, data.nbytes,
+            eager_limit, crc_word))
+        kind = ret & 7
+        if kind == 2:
+            return IpcSendReq(self, ret >> 3), "rndv"
+        if kind == 7:
+            raise RuntimeError(
+                "arena payload heap exhausted (alloc_fail): raise "
+                "UCC_TL_IPC_HEAP or drain posted traffic")
+        return _DoneSend(), _KIND_STR[kind]
+
+    def post_recv(self, key, dst_rank: int,
+                  dst: np.ndarray) -> IpcRecvReq:
+        ptr = self.ptr
+        if ptr is None:
+            raise RuntimeError("arena is detached")
+        if not dst.flags["C_CONTIGUOUS"] or not dst.flags["WRITEABLE"]:
+            raise ValueError("recv destination must be C-contiguous "
+                             "and writable")
+        if dst.nbytes > self.max_msg:
+            raise ValueError(
+                f"recv of {dst.nbytes} bytes exceeds the arena payload "
+                f"class cap ({self.max_msg}); raise UCC_TL_IPC_HEAP or "
+                f"route this team over the socket TL")
+        blk = int(self.lib.ucc_arena_alloc(ptr, max(dst.nbytes, 1)))
+        if blk == 0:
+            raise RuntimeError(
+                "arena payload heap exhausted (alloc_fail): raise "
+                "UCC_TL_IPC_HEAP or drain posted traffic")
+        a, b, c = self.pack(key)
+        rid = int(self.lib.ucc_ipc_post_recv(ptr, a, b, c, dst_rank, blk,
+                                             dst.nbytes))
+        if rid == 0:
+            self.lib.ucc_arena_free(ptr, blk)
+            raise RuntimeError("arena request slots exhausted")
+        return IpcRecvReq(self, rid, (a, b, c, dst_rank), blk, dst)
+
+    # -- control plane -------------------------------------------------
+    def fence(self, team_key, min_epoch: int) -> int:
+        ptr = self.ptr
+        if ptr is None:
+            return 0
+        return int(self.lib.ucc_ipc_fence(ptr, self.team_id(team_key),
+                                          min_epoch))
+
+    def purge_rank(self, ctx_rank: int) -> int:
+        ptr = self.ptr
+        if ptr is None:
+            return 0
+        return int(self.lib.ucc_ipc_purge_rank(ptr, ctx_rank))
+
+    def register(self, ctx_rank: int, pid: Optional[int] = None) -> None:
+        if self.ptr:
+            self.lib.ucc_arena_register(self.ptr, ctx_rank,
+                                        pid if pid is not None
+                                        else os.getpid())
+
+    def beat(self, ctx_rank: int) -> None:
+        if self.ptr:
+            self.lib.ucc_arena_beat(self.ptr, ctx_rank)
+
+    def peer_pid(self, ctx_rank: int) -> int:
+        return int(self.lib.ucc_arena_peer_pid(self.ptr, ctx_rank)) \
+            if self.ptr else 0
+
+    def beat_age_ms(self, ctx_rank: int) -> Optional[float]:
+        """Milliseconds since *ctx_rank* last beat; None when it never
+        registered in this arena."""
+        if not self.ptr:
+            return None
+        v = int(self.lib.ucc_arena_beat_age_ms(self.ptr, ctx_rank))
+        return None if v == (1 << 64) - 1 else float(v)
+
+    def window(self, key_obj, nbytes: int) -> int:
+        """Get-or-create a persistent named window (pooled tier);
+        returns its arena offset, 0 when the window heap is exhausted."""
+        return int(self.lib.ucc_arena_window(self.ptr,
+                                             self._intern(("W", key_obj)),
+                                             nbytes)) if self.ptr else 0
+
+    def store_release(self, off: int, val: int) -> None:
+        self.lib.ucc_arena_store_release(self.ptr, off, val)
+
+    def load_acquire(self, off: int) -> int:
+        return int(self.lib.ucc_arena_load_acquire(self.ptr, off))
+
+    def view(self, off: int, nbytes: int) -> np.ndarray:
+        """uint8 ndarray view of arena bytes [off, off+nbytes) — the
+        pooled executor reads/writes window payloads through this."""
+        buf = (ctypes.c_uint8 * nbytes).from_address(self.base + off)
+        return np.frombuffer(buf, dtype=np.uint8)
+
+    def counters(self) -> dict:
+        out = (ctypes.c_uint64 * 24)()
+        if self.ptr:
+            self.lib.ucc_arena_counters(self.ptr, out)
+        return {name: int(out[i])
+                for i, name in enumerate(ARENA_COUNTER_NAMES)}
+
+    def occupancy(self):
+        """(unexp parked, posted recvs, live slots, free payload blocks,
+        total payload blocks) — the mc_pool-style gauge the watchdog
+        samples."""
+        out = (ctypes.c_uint64 * 5)()
+        if self.ptr:
+            self.lib.ucc_arena_occupancy(self.ptr, out)
+        return tuple(int(v) for v in out)
+
+    def total_bytes(self) -> int:
+        return int(self.lib.ucc_arena_total_bytes(self.ptr)) \
+            if self.ptr else 0
+
+    def detach(self, unlink: bool = False) -> None:
+        if self.ptr:
+            ptr, self.ptr = self.ptr, None
+            self._pub = None
+            self._pub_buf = None
+            self.lib.ucc_arena_detach(ptr, 1 if unlink else 0)
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True               # exists, owned by someone else
+    except OSError:
+        return True               # unknowable: never reap on doubt
+
+
+def reap_stale_arenas(prefix: str = ARENA_PREFIX) -> list:
+    """Unlink /dev/shm/ucc-ipc-* segments whose creator AND every
+    registered rank pid are dead (a crashed run leaks its arena — the
+    kernel only reclaims at unlink). Called at context create; returns
+    the reaped names. A segment that probes as not-ready is left alone
+    unless its file is old enough that no live create can explain it."""
+    lib = get_lib()
+    if lib is None:
+        return []
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return []
+    reaped = []
+    for fn in names:
+        if not fn.startswith(prefix):
+            continue
+        path = "/dev/shm/" + fn
+        out = (ctypes.c_uint64 * 300)()
+        n = int(lib.ucc_arena_probe(("/" + fn).encode(), out, 300))
+        if n == 0:
+            # unreadable or mid-create: only a long-abandoned file
+            # (creator crashed between shm_open and ready=1) is reaped
+            try:
+                import time
+                if time.time() - os.path.getmtime(path) < 300:
+                    continue
+            except OSError:
+                continue
+        elif any(_pid_alive(int(out[i])) for i in range(n)):
+            continue
+        try:
+            os.unlink(path)
+            reaped.append(fn)
+            logger.info("reaped stale arena %s", fn)
+        except OSError:
+            pass
+    return reaped
 
 
 class NativeMpmcQueue:

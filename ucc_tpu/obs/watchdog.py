@@ -288,12 +288,24 @@ def _describe_team(team: Any, now: float) -> Dict[str, Any]:
 def _occupancy_section() -> List[Dict[str, Any]]:
     """Mailbox backlog per live endpoint (unexpected-queue length,
     posted recvs, native slot-table in-use) — a backlog is invisible
-    until it becomes a stall, so the dump samples it explicitly."""
+    until it becomes a stall, so the dump samples it explicitly. Rows
+    from the cross-process arena endpoints ride along (parked traffic +
+    payload-block pressure per attached arena): block-class exhaustion
+    there stalls exactly like a mailbox backlog but lives in another
+    process's address space, so it has to be sampled from the shared
+    segment."""
+    rows: List[Dict[str, Any]] = []
     try:
         from ..tl.host.transport import occupancy_snapshot
-        return occupancy_snapshot()
+        rows.extend(occupancy_snapshot())
     except Exception:  # noqa: BLE001 - diagnostics must never raise
-        return []
+        pass
+    try:
+        from ..tl.ipc import occupancy_snapshot as ipc_occupancy
+        rows.extend(ipc_occupancy())
+    except Exception:  # noqa: BLE001 - diagnostics must never raise
+        pass
+    return rows
 
 
 def _config_provenance() -> Dict[str, Any]:

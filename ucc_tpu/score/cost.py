@@ -67,10 +67,14 @@ SEED_LINKS: Dict[str, Tuple[float, float]] = {
     # exchange trade latency against per-hop bytes on-chip exactly like
     # host programs do on sockets)
     "ici": (1.0, 2.0e-5),
+    # one-sided put+flag windows in the process-shared mmap arena (the
+    # pooled tier): no mailbox match, no bounce copy — cheaper latency
+    # and slightly better bytes than the two-sided shm path
+    "pooled": (1.5, 3.0e-4),
 }
 
 #: slowest-first ordering for "which link bounds this round's latency"
-_LINK_RANK = {"dcn": 3, "socket": 2, "shm": 1, "ici": 0}
+_LINK_RANK = {"dcn": 4, "socket": 3, "shm": 2, "pooled": 1, "ici": 0}
 
 
 @dataclass
@@ -121,7 +125,7 @@ class CostModel:
         critical rank and when accumulating that rank's byte features —
         so a program whose critical path runs through a straggler prices
         proportionally worse, and the search front-end routes around it."""
-        from ..dsl.ir import OpKind
+        from ..dsl.ir import PUT_KINDS, OpKind
         feats: Dict[str, List[float]] = {}
 
         def feat(link: str) -> List[float]:
@@ -136,9 +140,14 @@ class CostModel:
             round_links: set = set()
             for r in range(prog.nranks):
                 for op in prog.ranks[r].rounds[k]:
-                    if op.kind != OpKind.SEND:
+                    if op.kind == OpKind.SEND:
+                        link = link_of(r, op.peer) if link_of else "shm"
+                    elif op.kind in PUT_KINDS:
+                        # one-sided window puts always ride the arena,
+                        # whatever the topology says about the edge
+                        link = "pooled"
+                    else:
                         continue
-                    link = link_of(r, op.peer) if link_of else "shm"
                     payload = block_count(nbytes, nch, op.chunk)
                     wire = prog.wire or op.wire
                     byts = _wire_bytes(payload, wire, quant_block)

@@ -1,0 +1,451 @@
+"""TL/IPC — cross-process shared-memory transport layer.
+
+The missing middle tier between TL/SHM (threads sharing one process) and
+TL/SOCKET (byte streams): ranks in DIFFERENT processes on one host match
+and deliver through a single mmap'd arena (native/ucc_tpu_ipc.cc,
+``ucc_mailbox_attach`` — ABI 6) holding the TagKey match structures and
+per-shard robust-mutex + lock-free-ring state in process-shared memory.
+A send whose recv is already posted memcpys sender→bounce inside the
+push call — across the process boundary, no serialize/syscall per hop —
+and the whole host algorithm suite runs unchanged on top (the
+n_direct/eager/rndv/fenced contracts, epoch fencing, cancel-skip and
+integrity checksums are the native matcher's own, shared with TL/SHM).
+
+Arena rendezvous rides the context OOB address exchange: every rank
+advertises ``(host_hash, pid, uid, heap, win)``; ranks sharing a
+physical host derive the SAME segment name from the sorted uid set (no
+extra OOB round), race O_CREAT|O_EXCL, and the loser attaches. Sizing
+consensus is the lowest same-host ctx rank's advertised (heap, win) so a
+heterogeneous env cannot produce two processes with different layouts.
+
+By default the arena is only attached when the same-host peer set spans
+more than one pid (a pure thread job keeps TL/SHM and creates no
+/dev/shm segment at all); ``UCC_TL_IPC_ENABLE=y`` forces the attach —
+also within one process, which is how the pooled-tier window path is
+exercisable from in-process tests.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import integrity as _integrity
+from ..constants import COLL_TYPE_ALL, MemoryType
+from ..core.components import BaseContext, BaseLib, TransportLayer, register_tl
+from ..ec.cpu import EcCpu
+from ..status import Status, UccError
+from ..utils.config import (ConfigField, ConfigTable, parse_bool,
+                            parse_memunits, parse_string, register_table)
+from ..utils.log import get_logger
+from .host.config_fields import HOST_ALG_FIELDS
+from .host.team import HostTlTeam
+from .host.transport import eager_limit_from_env
+
+logger = get_logger("tl_ipc")
+
+TL_IPC_CONFIG = register_table(ConfigTable(
+    prefix="TL_IPC_", name="tl/ipc", fields=HOST_ALG_FIELDS + [
+        ConfigField("ENABLE", "auto", "attach the cross-process arena: "
+                    "auto = only when the same-host peer set spans more "
+                    "than one pid; y forces (enables ipc teams between "
+                    "in-process ranks too — the pooled-tier test path); "
+                    "n disables the TL entirely", parse_string),
+        ConfigField("HEAP", "256M", "arena payload heap per node "
+                    "(bounce blocks in 4K/64K/1M/8M classes; the largest "
+                    "class is the max single message). Must resolve "
+                    "identically in every process on the node — sizing "
+                    "consensus is the lowest same-host rank's value",
+                    parse_memunits),
+        ConfigField("WINDOW", "64M", "arena window heap per node: "
+                    "persistent named segments the pooled tier reduces "
+                    "through (one-sided put+flag). Windows are bump-"
+                    "allocated per (team epoch, slot, writer, size) and "
+                    "live until the arena dies, so sweeps across many "
+                    "message sizes want headroom here", parse_memunits),
+        ConfigField("EAGER_THRESH", "auto", "eager copy threshold for "
+                    "UNEXPECTED sends; larger sends stage into an arena "
+                    "block but keep rendezvous completion semantics. "
+                    "auto = defer to UCC_HOST_EAGER_LIMIT (default 8k)",
+                    parse_memunits),
+    ]))
+
+#: arena crash-liveness cadence (seconds): how often a progressing
+#: endpoint refreshes its board slot; peers treat a dead pid as failed
+#: immediately, so this only bounds gauge staleness, not detection
+_BEAT_PERIOD = 0.05
+
+
+class IpcTransport:
+    """One endpoint per (context × arena): the Mailbox-compatible face
+    the host algorithm suite drives (recv_nb / fence / occupancy /
+    progress) plus the send path the TL context routes through
+    ``send_to``. Counters mirror InProcTransport so tests and bench read
+    the tiers identically across TLs."""
+
+    def __init__(self, arena, my_ctx_rank: int, eager_limit: int):
+        self.arena = arena
+        self.my_ctx_rank = int(my_ctx_rank)
+        self.EAGER_THRESHOLD = int(eager_limit)
+        self.n_direct = 0
+        self.n_eager = 0
+        self.n_rndv = 0
+        self.n_fenced = 0
+        #: window publishes by the pooled (one-sided put+flag) tier;
+        #: bumped by the DSL executor, read by bench/perftest tiering
+        self.n_pooled = 0
+        self._last_beat = 0.0
+        self._closed = False
+
+    # -- data path -----------------------------------------------------
+    def send_to(self, peer_ctx_rank: int, key, data: np.ndarray,
+                crc: Optional[int] = None):
+        req, kind = self.arena.push(key, int(peer_ctx_rank),
+                                    data.reshape(-1).view(np.uint8),
+                                    self.EAGER_THRESHOLD, crc=crc)
+        if kind == "direct":
+            self.n_direct += 1
+        elif kind == "eager":
+            self.n_eager += 1
+        elif kind == "rndv":
+            self.n_rndv += 1
+        else:
+            self.n_fenced += 1
+        return req
+
+    def recv_nb(self, key, dst: np.ndarray):
+        return self.arena.post_recv(key, self.my_ctx_rank,
+                                    dst.reshape(-1).view(np.uint8))
+
+    def fence(self, team_key, min_epoch: int) -> int:
+        """Epoch-fence is ARENA-WIDE (the match space is shared): one
+        rank's fence bounds stale traffic for every process attached."""
+        return self.arena.fence(team_key, min_epoch)
+
+    def progress(self) -> None:
+        """Called per task progress tick: refresh this rank's arena
+        liveness stamp (rate-limited — one monotonic read per tick, one
+        shared-memory store per _BEAT_PERIOD)."""
+        now = time.monotonic()
+        if now - self._last_beat >= _BEAT_PERIOD:
+            self._last_beat = now
+            self.arena.beat(self.my_ctx_rank)
+
+    # -- observability -------------------------------------------------
+    def occupancy(self) -> Dict[str, int]:
+        unexp, posted, slots, free_blocks, total_blocks = \
+            self.arena.occupancy()
+        return {"unexpected": unexp, "posted": posted,
+                "native_slots_in_use": slots,
+                "arena_free_blocks": free_blocks,
+                "arena_total_blocks": total_blocks}
+
+    def counters(self) -> Dict[str, int]:
+        return self.arena.counters()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            _forget_endpoint(self)
+
+
+class TlIpcContext(BaseContext):
+    def __init__(self, comp_lib, core_context, config):
+        super().__init__(comp_lib, core_context, config)
+        self.executor = EcCpu()
+        self.transport: Optional[IpcTransport] = None
+        self.arena = None
+        self.peer_addrs: Dict[int, tuple] = {}
+        self._uid = core_context._ctx_uid
+        self._enable = "auto"
+        self._heap = 256 << 20
+        self._win = 64 << 20
+        if config is not None:
+            try:
+                self._enable = (str(config.get("enable")).strip().lower()
+                                or "auto")
+            except KeyError:
+                pass
+            try:
+                self._heap = int(config.get("heap"))
+                self._win = int(config.get("window"))
+            except KeyError:
+                pass
+        self._eager = eager_limit_from_env()
+        if config is not None:
+            from ..utils.config import SIZE_AUTO
+            try:
+                if config.eager_thresh != SIZE_AUTO:
+                    self._eager = int(config.eager_thresh)
+            except (KeyError, AttributeError):
+                pass
+
+    # -- address plumbing ---------------------------------------------
+    def pack_address(self) -> bytes:
+        pi = self.core_context.proc_info
+        return pickle.dumps((pi.phys_host_hash, pi.pid, self._uid,
+                             self._heap, self._win))
+
+    def unpack_addresses(self, addrs: Dict[int, bytes]) -> None:
+        for rank, blob in addrs.items():
+            if blob:
+                self.peer_addrs[rank] = pickle.loads(blob)
+
+    def _same_host_set(self):
+        """Ctx ranks that share this process's physical host (sorted)."""
+        my_hh = self.core_context.proc_info.phys_host_hash
+        return sorted(r for r, a in self.peer_addrs.items()
+                      if a[0] == my_hh)
+
+    def same_arena(self, ctx_rank: int) -> bool:
+        a = self.peer_addrs.get(int(ctx_rank))
+        return (self.transport is not None and a is not None
+                and a[0] == self.core_context.proc_info.phys_host_hash)
+
+    # -- arena rendezvous ---------------------------------------------
+    def create_epilog(self) -> None:
+        if self._enable == "n":
+            return
+        local = self._same_host_set()
+        if len(local) < 2:
+            return
+        pids = {self.peer_addrs[r][1] for r in local}
+        force = False
+        if self._enable not in ("", "auto"):
+            try:
+                force = parse_bool(self._enable)
+            except ValueError:
+                force = False
+        if len(pids) < 2 and not force:
+            return                 # pure thread job: TL/SHM owns it
+        from .. import native
+        if native.get_lib() is None:
+            logger.warning("tl/ipc disabled: native core unavailable "
+                           "(the arena has no python fallback)")
+            return
+        # crash hygiene first: unlink segments whose creator and every
+        # registered rank pid are dead (a crashed prior run leaks its
+        # arena — the kernel only reclaims at unlink)
+        try:
+            native.reap_stale_arenas()
+        except Exception:  # noqa: BLE001 - hygiene must not block create
+            logger.debug("stale-arena reap failed", exc_info=True)
+        # deterministic name: every same-host rank hashes the SAME
+        # sorted uid set, so they all open one segment; the O_EXCL race
+        # inside attach picks the creator. Sizing consensus: the lowest
+        # same-host ctx rank's advertised (heap, win).
+        digest = hashlib.sha1(
+            "|".join(self.peer_addrs[r][2] for r in local).encode()
+        ).hexdigest()[:16]
+        name = native.ARENA_PREFIX + digest
+        heap, win = self.peer_addrs[local[0]][3:5]
+        my_rank = self.core_context.rank
+        try:
+            self.arena = native.IpcArena(name, heap_bytes=int(heap),
+                                         win_bytes=int(win),
+                                         integrity=_integrity.WIRE)
+        except (RuntimeError, OSError) as e:
+            logger.warning("tl/ipc arena attach failed (%s): %s — "
+                           "teams will fall back to the socket TL",
+                           name, e)
+            return
+        self.arena.register(my_rank)
+        self.arena.beat(my_rank)
+        self.transport = IpcTransport(self.arena, my_rank, self._eager)
+        _remember_endpoint(self.transport)
+        logger.info("tl/ipc arena %s attached (%s, %d ranks on host, "
+                    "%d MiB heap)", name,
+                    "created" if self.arena.created else "joined",
+                    len(local), int(heap) >> 20)
+        # cross-process liveness: feed the arena pid board into the FT
+        # health registry — a SIGKILLed peer PROCESS is detected by pid
+        # probe even though it never beat on this process's board
+        reg = getattr(self.core_context, "health", None)
+        if reg is not None and hasattr(reg, "add_liveness_source"):
+            reg.add_liveness_source(self._liveness)
+
+    def _liveness(self, ctx_rank: int) -> Optional[bool]:
+        """Arena-board verdict for *ctx_rank*: False = its pid is gone
+        (process death — conclusive), True = it beat recently (alive),
+        None = not in this arena / never registered / beat merely stale
+        (a wedged-but-alive process is the watchdog's case, not ours)."""
+        ar = self.arena
+        if ar is None or not self.same_arena(ctx_rank):
+            return None
+        pid = ar.peer_pid(int(ctx_rank))
+        if pid == 0:
+            return None
+        from ..native import _pid_alive
+        if not _pid_alive(pid):
+            return False
+        age = ar.beat_age_ms(int(ctx_rank))
+        from ..fault import health as ft
+        if age is not None and age <= ft.HEARTBEAT_TIMEOUT * 1000.0:
+            return True
+        return None
+
+    # -- send path -----------------------------------------------------
+    def send_to(self, peer_ctx_rank: int, key, data: np.ndarray,
+                crc: Optional[int] = None):
+        tr = self.transport
+        if tr is None:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "ipc arena not attached")
+        if not self.same_arena(peer_ctx_rank) \
+                and peer_ctx_rank != self.core_context.rank:
+            raise UccError(Status.ERR_NOT_FOUND,
+                           f"ctx rank {peer_ctx_rank} not in this arena")
+        return tr.send_to(peer_ctx_rank, key, data, crc=crc)
+
+    # -- one-sided: cross-process segments are not registered in this
+    # process's REGISTRY, so only same-process targets are serviceable;
+    # one-sided algorithm variants stay opt-in via TUNE on ipc teams
+    def os_put(self, peer_ctx_rank: int, desc: dict, offset: int,
+               data: np.ndarray, notify=None) -> None:
+        from .host.onesided import local_os_put
+        if desc.get("ctx_uid") != self.core_context._ctx_uid and \
+                not _same_process_desc(desc):
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "tl/ipc one-sided put targets another process")
+        local_os_put(desc, offset, data, notify)
+
+    def os_get(self, peer_ctx_rank: int, desc: dict, offset: int,
+               dst: np.ndarray):
+        from .host.onesided import local_os_get
+        if desc.get("ctx_uid") != self.core_context._ctx_uid and \
+                not _same_process_desc(desc):
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "tl/ipc one-sided get targets another process")
+        return local_os_get(desc, offset, dst)
+
+    def os_flush(self, peer_ctx_rank: int):
+        from .host.transport import SendReq
+        return SendReq(done=True)
+
+    def destroy(self) -> None:
+        if self.transport is not None:
+            self.transport.close()
+            self.transport = None
+        if self.arena is not None:
+            # the creator unlinks the NAME on clean shutdown (attached
+            # peers keep their mappings; a crashed creator leaves the
+            # segment for reap_stale_arenas at the next context create)
+            self.arena.detach(unlink=self.arena.created)
+            self.arena = None
+
+
+def _same_process_desc(desc: dict) -> bool:
+    from .host.onesided import REGISTRY
+    return REGISTRY.read_get(desc.get("ctx_uid"), desc.get("seg_id"),
+                             0, 0) is not None
+
+
+class TlIpcTeam(HostTlTeam):
+    NAME = "ipc"
+
+    def __init__(self, comp_context, core_team, scope: str = "cl"):
+        if comp_context.transport is None:
+            raise UccError(Status.ERR_NOT_SUPPORTED,
+                           "tl/ipc: no arena attached (single process, "
+                           "cross-host context, or UCC_TL_IPC_ENABLE=n)")
+        super().__init__(comp_context, core_team, scope)
+        ctx_map = self.ctx_map
+        my_ctx = core_team.context.rank
+        for gr in range(self.size):
+            cr = ctx_map.eval(gr)
+            if cr != my_ctx and not comp_context.same_arena(cr):
+                raise UccError(Status.ERR_NOT_SUPPORTED,
+                               "tl/ipc requires all team ranks in one "
+                               "node arena")
+
+
+TlIpcTeam.TL_CLS = None  # set below
+
+
+@register_tl
+class TlIpc(TransportLayer):
+    NAME = "ipc"
+    #: between tl/shm (40, same-process) and tl/socket (10): the
+    #: intra-node cross-process prior
+    DEFAULT_SCORE = 25
+    SUPPORTED_COLLS = COLL_TYPE_ALL
+    SUPPORTED_MEM_TYPES = (MemoryType.HOST,)
+    SERVICE_CAPABLE = True
+    CONTEXT_CONFIG = TL_IPC_CONFIG
+    lib_cls = BaseLib
+    context_cls = TlIpcContext
+    team_cls = TlIpcTeam
+
+
+TlIpcTeam.TL_CLS = TlIpc
+
+
+# ---------------------------------------------------------------------------
+# backlog observability: arena endpoints surface in the same watchdog /
+# UCC_STATS channels as the in-process mailboxes (tl/host/transport)
+# ---------------------------------------------------------------------------
+
+import threading as _threading  # noqa: E402 - endpoint registry wiring
+import weakref as _weakref      # noqa: E402
+
+_EP_LOCK = _threading.Lock()
+_ENDPOINTS: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def _remember_endpoint(ep: IpcTransport) -> None:
+    with _EP_LOCK:
+        _ENDPOINTS.add(ep)
+
+
+def _forget_endpoint(ep: IpcTransport) -> None:
+    with _EP_LOCK:
+        _ENDPOINTS.discard(ep)
+
+
+def occupancy_snapshot(limit: int = 16):
+    """Per-arena occupancy rows for watchdog dumps (mc_pool-style
+    gauge: parked traffic + payload-block pressure)."""
+    with _EP_LOCK:
+        eps = list(_ENDPOINTS)[:limit]
+    out = []
+    for ep in eps:
+        try:
+            d = ep.occupancy()
+        except Exception:  # noqa: BLE001 - diagnostics only
+            continue
+        d["arena"] = ep.arena.name.lstrip("/")
+        d["ctx_rank"] = ep.my_ctx_rank
+        out.append(d)
+    return out
+
+
+def _arena_sampler() -> None:
+    """Arena byte/attach gauges for UCC_STATS snapshots (`ucc_stats`)."""
+    from ..obs import metrics
+    with _EP_LOCK:
+        eps = list(_ENDPOINTS)
+    if not eps:
+        return
+    total = attaches = moved = live = 0
+    for ep in eps[:16]:
+        try:
+            c = ep.counters()
+            total += ep.arena.total_bytes()
+        except Exception:  # noqa: BLE001
+            continue
+        attaches += c.get("attaches", 0)
+        moved += c.get("bytes_moved", 0)
+        live += c.get("blocks_live", 0)
+    metrics.gauge("arena_bytes", total, component="tl/ipc")
+    metrics.gauge("arena_attaches", attaches, component="tl/ipc")
+    metrics.gauge("arena_bytes_moved", moved, component="tl/ipc")
+    metrics.gauge("arena_blocks_live", live, component="tl/ipc")
+
+
+from ..obs import metrics as _obs_metrics  # noqa: E402 - sampler wiring
+
+_obs_metrics.register_sampler(_arena_sampler)

@@ -302,7 +302,8 @@ def run_op_bench(args) -> int:
             rec = {"bench": "op", "op": args.coll, "dtype": args.dtype,
                    "mem": args.mem, "nbufs": nbufs, "count": count,
                    "size_bytes": nbytes,
-                   **{k: round(v, 3) for k, v in st.items()}}
+                   **{k: round(v, 3) for k, v in st.items()},
+                   "detail": {"transport": "local"}}
             if args.full:
                 rec["bw_GBps"] = round(bw, 3)
             print(json.dumps(rec), flush=True)
@@ -357,13 +358,14 @@ def run_sweep_mode(args, job, coll, dt, op, mem, bmin, bmax, n,
                                      args.warmup)
             if lats is None:
                 continue    # candidate refused these args / failed / hung
-            print(json.dumps(measurement_record(
+            rec = measurement_record(
                 args.coll, mem, n, (comp, alg), size, count, args.iters,
                 lat_stats(lats), precision=cands[idx].precision,
                 gen=cands[idx].gen,
                 predicted_us=cost.predict_for_record(
-                    cost_model, cands[idx].gen, n, size))),
-                flush=True)
+                    cost_model, cands[idx].gen, n, size))
+            rec["detail"] = {"transport": _job_tier(job)}
+            print(json.dumps(rec), flush=True)
         size *= 2
     return 0
 
@@ -571,6 +573,7 @@ def run_storm_mode(args, n, dt, op) -> int:
                 rec = {"bench": "storm", "mode": mode, "teams": T,
                        "ranks": n, "burst": K, "size_bytes": size,
                        "iters": args.iters,
+                       "detail": {"transport": _job_tier(job)},
                        "classes": {
                            "hi": {"priority": 3 if mode == "qos"
                                   else None,
@@ -624,6 +627,98 @@ def run_storm_mode(args, n, dt, op) -> int:
               f"{summary['hi_p99_improvement']}x "
               f"({'OK' if summary['ok'] else 'BELOW 2x'})")
     return 0 if summary["ok"] else 1
+
+
+def transport_tier(team) -> str:
+    """Classify the transport tier serving a team's host tag spaces:
+    ``pooled`` (ipc arena with one-sided window traffic) > ``ipc``
+    (cross-process arena) > ``socket`` > ``shm-thread`` (in-process
+    native mailbox). Every JSON record carries this as
+    ``detail.transport`` so BENCH deltas attribute the tier rather than
+    guessing it from the rank layout."""
+    tiers = set()
+    pooled = False
+    try:
+        for _key, tr in team._tl_tag_spaces():
+            if getattr(tr, "arena", None) is not None:
+                tiers.add("ipc")
+                if getattr(tr, "n_pooled", 0) > 0:
+                    pooled = True
+            elif "Socket" in type(tr).__name__:
+                tiers.add("socket")
+            else:
+                tiers.add("shm-thread")
+    except Exception:  # noqa: BLE001 - classification must not kill a run
+        return "unknown"
+    if pooled:
+        return "pooled"
+    for t in ("ipc", "socket", "shm-thread"):
+        if t in tiers:
+            return t
+    return "shm-thread"
+
+
+def _job_tier(job) -> str:
+    team = job.teams[0] if getattr(job, "teams", None) else job.team
+    return transport_tier(team)
+
+
+def _free_port_pair() -> int:
+    """A base port p where both p and p+1 bind (ctx store + team store)."""
+    import socket as _socket
+    for _ in range(64):
+        s0 = _socket.socket()
+        s0.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        s0.bind(("127.0.0.1", 0))
+        port = s0.getsockname()[1]
+        s1 = _socket.socket()
+        s1.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        try:
+            s1.bind(("127.0.0.1", port + 1))
+        except OSError:
+            continue
+        finally:
+            s0.close()
+            s1.close()
+        return port
+    raise SystemExit("perftest: no adjacent free port pair")
+
+
+def run_procs_mode(args, argv) -> int:
+    """``--procs N``: self-fork N single-rank worker processes wired by a
+    TCP store rendezvous — each child runs the existing ``--store`` path
+    and rank 0 inherits stdout, so output (table or JSON lines) is
+    identical to a hand-launched multi-process run. The parent is only a
+    launcher + reaper. The transport tier the children land on follows
+    the ambient UCC_TLS (the ipc arena TL wins by score where enabled)."""
+    import os as _os
+    import subprocess
+    port = _free_port_pair()
+    base = list(argv) if argv is not None else sys.argv[1:]
+    child_argv = []
+    skip = False
+    for a in base:
+        if skip:
+            skip = False
+            continue
+        if a == "--procs":
+            skip = True
+            continue
+        if a.startswith("--procs="):
+            continue
+        child_argv.append(a)
+    procs = []
+    for r in range(args.procs):
+        cmd = [sys.executable, "-m", "ucc_tpu.tools.perftest",
+               *child_argv, "--store", f"127.0.0.1:{port}",
+               "--rank", str(r), "--np", str(args.procs)]
+        procs.append(subprocess.Popen(
+            cmd, env=dict(_os.environ),
+            stdout=None if r == 0 else subprocess.DEVNULL))
+    rc = 0
+    for pr in procs:
+        rc = max(rc, pr.wait())
+    return rc
 
 
 def _wait_reqs(job, reqs) -> None:
@@ -946,7 +1041,25 @@ def main(argv=None) -> int:
     p.add_argument("--store", default="", help="host:port for multi-process")
     p.add_argument("--rank", type=int, default=0)
     p.add_argument("--np", type=int, dest="world", default=1)
+    p.add_argument("--procs", type=int, default=0,
+                   help="spawn N worker PROCESSES (one rank each) wired "
+                        "by an automatic TCP store rendezvous — the "
+                        "multi-process twin of -p, exercising the "
+                        "cross-process transport (ipc arena where "
+                        "enabled, else socket). Rank 0's output is "
+                        "printed; other ranks are silenced")
     args = p.parse_args(argv)
+
+    if args.procs:
+        if args.store:
+            raise SystemExit("perftest: --procs and --store are exclusive "
+                             "(--procs launches --store workers itself)")
+        if args.sweep or args.storm or args.quant or args.gen \
+                or args.gen_device:
+            raise SystemExit("perftest: --procs is incompatible with the "
+                             "in-process-only modes (--sweep/--storm/"
+                             "--quant/--gen/--gen-device)")
+        return run_procs_mode(args, argv)
 
     # shared across the collective and executor-op paths: negative
     # warmup skews the timed-round bookkeeping silently, zero iters
@@ -1061,6 +1174,7 @@ def main(argv=None) -> int:
         return run_sweep_mode(args, job, coll, dt, op, mem, bmin, bmax, n,
                               devices)
 
+    tier = _job_tier(job)
     if is_lead and not args.json:
         hdr = f"{'count':>12} {'size':>10} {'time avg(us)':>14} " \
               f"{'min(us)':>10} {'max(us)':>10} {'p50(us)':>10} " \
@@ -1068,7 +1182,7 @@ def main(argv=None) -> int:
         if args.full:
             hdr += f" {'bus bw(GB/s)':>14}"
         print(f"# ucc_perftest: {args.coll} {args.dtype} {args.op} "
-              f"mem={args.mem} ranks={n}")
+              f"mem={args.mem} ranks={n} transport={tier}")
         print(hdr)
 
     size = max(bmin, esz)
@@ -1168,8 +1282,11 @@ def main(argv=None) -> int:
                     rec["integrity"] = _integ.MODE
                 if args.full:
                     rec["busbw_GBps"] = round(bw, 3)
+                # tier re-sampled per size: pooled only shows once a
+                # one-sided window variant has actually moved traffic
+                rec["detail"] = {"transport": _job_tier(job)}
                 if qd is not None:
-                    rec["detail"] = {"quant": qd}
+                    rec["detail"]["quant"] = qd
                 print(json.dumps(rec), flush=True)
             else:
                 line = f"{count:>12} {memunits_str(size):>10} " \
